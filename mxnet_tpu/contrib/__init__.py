@@ -1,0 +1,5 @@
+"""Contrib namespace (parity: reference python/mxnet/contrib/ + src/operator/contrib/)."""
+from . import autograd
+from . import ndarray
+from . import symbol
+from . import tensorboard

@@ -1,0 +1,182 @@
+"""Imperative autograd (parity: reference python/mxnet/contrib/autograd.py:14-183
++ src/ndarray/autograd.{h,cc} AutogradRuntime).
+
+TPU-native design: instead of recording a tape of engine ops and replaying
+through a throw-away GraphExecutor (reference autograd.cc:148-230), marked
+arrays are traced functionally — `backward` re-executes the recorded op
+sequence under `jax.vjp`.  The recording is exact (op + captured jax
+values), so replay cost is one traced+jitted call.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section", "mark_variables",
+           "backward", "compute_gradient", "grad_and_loss", "grad"]
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.is_training = False
+        self.tape = []  # list of (fn, in_refs, out_refs) — functional record
+        self.marked = {}  # id(NDArray) -> (ndarray, grad_ndarray, grad_req)
+
+
+_STATE = _TapeState()
+
+
+def set_is_training(is_train):
+    """Toggle training/recording (parity: contrib/autograd.py set_is_training)."""
+    prev = _STATE.is_training
+    _STATE.is_training = bool(is_train)
+    if not is_train:
+        _STATE.tape = []
+    return prev
+
+
+def is_training():
+    return _STATE.is_training
+
+
+class train_section:
+    """`with autograd.train_section():` recording scope (parity: :14-63)."""
+
+    def __enter__(self):
+        self._prev = set_is_training(True)
+        return self
+
+    def __exit__(self, *args):
+        _STATE.is_training = self._prev
+
+
+class test_section:
+    def __enter__(self):
+        self._prev = set_is_training(False)
+        return self
+
+    def __exit__(self, *args):
+        _STATE.is_training = self._prev
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (parity: contrib/autograd.py mark_variables)."""
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad_arr, req in zip(variables, gradients, grad_reqs):
+        _STATE.marked[id(var)] = (var, grad_arr, req)
+        var._autograd_marked = True
+
+
+def _record(fn, inputs, outputs):
+    if _STATE.is_training:
+        _STATE.tape.append((fn, [id(x) for x in inputs], inputs, [id(y) for y in outputs], outputs))
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Compute marked-variable gradients (parity: contrib/autograd.py backward:108).
+
+    Replays the recorded computation functionally from the marked variables
+    and runs jax.vjp over it.
+    """
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    marked = list(_STATE.marked.values())
+    if not marked:
+        return
+    var_arrays = [v for v, _, _ in marked]
+    out_ids = {id(o) for o in outputs}
+
+    # build pure function: marked values -> outputs, by replaying the tape
+    tape = list(_STATE.tape)
+
+    def replay(marked_vals):
+        env = {id(v): val for v, val in zip(var_arrays, marked_vals)}
+
+        def lookup(arr):
+            return env.get(id(arr), arr.data)
+
+        for fn, in_ids, in_arrs, out_ids_, out_arrs in tape:
+            ins = [lookup(a) for a in in_arrs]
+            res = fn(*ins)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for oid, oarr, val in zip(out_ids_, out_arrs, res):
+                env[oid] = val
+        return tuple(env.get(id(o), o.data) for o in outputs)
+
+    primals = tuple(v.data for v in var_arrays)
+    outs, vjp_fn = jax.vjp(replay, primals)
+    if out_grads is None:
+        cots = tuple(jnp.ones_like(o) for o in outs)
+    else:
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        cots = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads)
+    (grads,) = vjp_fn(cots)
+    for (var, grad_arr, req), g in zip(marked, grads):
+        if grad_arr is None or req == "null":
+            continue
+        if req == "add":
+            grad_arr._set_data(grad_arr.data + g)
+        else:
+            grad_arr._set_data(g)
+    if not retain_graph:
+        _STATE.tape = []
+
+
+compute_gradient = backward
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss (parity: :140-168)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = args
+        if argnum is not None:
+            argnum_ = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in argnum_]
+        for x in variables:
+            assert isinstance(x, NDArray), "type of autograd input should NDArray."
+
+        def pure(vals):
+            boxed = list(args)
+            if argnum is not None:
+                argnum_ = argnum if isinstance(argnum, list) else [argnum]
+                for i, v in zip(argnum_, vals):
+                    boxed[i] = NDArray(v, args[i].ctx)
+            else:
+                boxed = [NDArray(v, a.ctx) for v, a in zip(vals, args)]
+            out = func(*boxed)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o.data for o in outs)
+
+        primals = tuple(v.data for v in variables)
+        outs, vjp_fn = jax.vjp(pure, primals)
+        cots = tuple(jnp.ones_like(o) for o in outs)
+        (grads,) = vjp_fn(cots)
+        grad_vals = [NDArray(g, variables[i].ctx) for i, g in enumerate(grads)]
+        loss = [NDArray(o, variables[0].ctx) for o in outs]
+        return grad_vals, loss[0] if len(loss) == 1 else loss
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Return a function computing only the gradient (parity: :170-183)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

@@ -1,0 +1,22 @@
+"""contrib ndarray ops (parity: mx.contrib.ndarray — multibox/ctc etc.).
+
+Populated from the registry once contrib ops are registered (ops in
+mxnet_tpu/ops/contrib_ops.py, TPU equivalents of reference
+src/operator/contrib/)."""
+from __future__ import annotations
+
+import sys
+
+from ..ndarray import _make_nd_function
+from ..ops.registry import OP_REGISTRY
+
+
+def _populate():
+    mod = sys.modules[__name__]
+    for name, op in OP_REGISTRY.items():
+        if name.startswith("_contrib_"):
+            setattr(mod, name[len("_contrib_"):], _make_nd_function(op))
+            setattr(mod, name, _make_nd_function(op))
+
+
+_populate()

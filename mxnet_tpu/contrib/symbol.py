@@ -1,0 +1,18 @@
+"""contrib symbol ops (parity: mx.contrib.symbol — MultiBox*, CTCLoss etc.)."""
+from __future__ import annotations
+
+import sys
+
+from ..symbol import _make_sym_function
+from ..ops.registry import OP_REGISTRY
+
+
+def _populate():
+    mod = sys.modules[__name__]
+    for name, op in OP_REGISTRY.items():
+        if name.startswith("_contrib_"):
+            setattr(mod, name[len("_contrib_"):], _make_sym_function(op))
+            setattr(mod, name, _make_sym_function(op))
+
+
+_populate()

@@ -1,0 +1,34 @@
+"""TensorBoard logging shim (parity: reference python/mxnet/contrib/tensorboard.py)."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Log metrics to a TensorBoard event writer if one is available."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        try:
+            from tensorboardX import SummaryWriter
+
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            logging.warning("tensorboardX not installed; metrics will be logged via logging")
+            self.summary_writer = None
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        name_value = param.eval_metric.get_name_value()
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value, self.step)
+            else:
+                logging.info("tb[%d] %s=%f", self.step, name, value)

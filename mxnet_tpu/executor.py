@@ -1,0 +1,421 @@
+"""Executor — binds a Symbol to devices and runs it.
+
+TPU-native equivalent of the reference GraphExecutor
+(reference src/executor/graph_executor.cc, include/mxnet/executor.h).
+
+Architecture mapping (SURVEY.md §7 phase 3):
+  * The reference builds the full fwd+bwd graph, runs NNVM passes
+    (PlanMemory, AttachOpExecs, DetectInplaceAddTo), then replays cached
+    engine ops per node with bulk "segments".  Here the ENTIRE graph is
+    lowered into ONE jitted XLA executable per (is_train, backward) mode —
+    bulk-exec taken to its limit; XLA is the memory planner and fuser.
+  * Gradient pass ≙ `jax.vjp` over the interpreted graph.  Loss ops carry
+    `custom_vjp` so `backward()` without head gradients matches reference
+    semantics (graph_executor.cc:102-175 AggregateGradient: multiple
+    consumers of one variable sum naturally under AD).
+  * grad_req 'write'/'add'/'null' (reference OpReqType) applied on the
+    host side after the fused call; 'add' accumulates into grad arrays.
+  * Multi-device: pass `mesh` — inputs are sharded over the mesh's 'data'
+    axis, params replicated; XLA SPMD inserts the gradient all-reduce that
+    the reference got from KVStore device-mode P2P reduction
+    (src/kvstore/comm.h:204-355).  This is the TPU-idiomatic data path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray
+from .ops.random_ops import GLOBAL_RNG
+from .symbol import _topo_order
+
+__all__ = ["Executor"]
+
+
+def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_train, rng):
+    """Interpret the graph as pure JAX ops (traced once under jit).
+
+    Returns (outputs tuple, aux_updates tuple ordered like aux_names).
+    """
+    arg_env = dict(zip(arg_names, arg_vals))
+    aux_env = dict(zip(aux_names, aux_vals))
+    env = {}
+    aux_updates = dict(aux_env)
+    for i, node in enumerate(order):
+        if node.op is None:
+            if node.is_aux:
+                env[id(node)] = (aux_env[node.name],)
+            else:
+                env[id(node)] = (arg_env[node.name],)
+            continue
+        op = node.op
+        ins = [env[id(src)][idx] for src, idx in node.inputs]
+        ins += [aux_updates[a.name] for a in node.aux_vars]
+        kwargs = {k: v for k, v in node.attrs.items() if not k.startswith("__") and k != "ctx_group"}
+        if op.need_is_train:
+            kwargs["is_train"] = is_train
+        if op.need_rng:
+            kwargs["rng"] = jax.random.fold_in(rng, i) if rng is not None else None
+        res = op.fn(*ins, **kwargs)
+        if not isinstance(res, tuple):
+            res = (res,)
+        if op.num_aux_out:
+            main = res[: len(res) - op.num_aux_out]
+            for a, upd in zip(node.aux_vars, res[len(res) - op.num_aux_out:]):
+                aux_updates[a.name] = upd
+            res = main
+        env[id(node)] = res
+    outputs = tuple(env[id(nd)][ix] for nd, ix in entries)
+    aux_out = tuple(aux_updates[n] for n in aux_names)
+    return outputs, aux_out
+
+
+class Executor:
+    """Bound computation graph (parity: python/mxnet/executor.py Executor)."""
+
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict, mesh=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        self._grad_req = grad_req
+        self._mesh = mesh
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._entries = symbol._entries
+        self._order = _topo_order(self._entries)
+        self._outputs_cache = None
+        self._last_is_train = False
+        self._monitor_callback = None
+        self._rng = GLOBAL_RNG.next_key()
+        self._step_rng = self._rng
+        self._aux_applied = False
+        self._jit_fwd = {}
+        self._jit_bwd = {}
+        self._data_sharding = None
+        self._repl_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._data_sharding = NamedSharding(mesh, P("data"))
+            self._repl_sharding = NamedSharding(mesh, P())
+
+    # ------------------------------------------------------------------
+    # construction (parity: Executor::SimpleBind / Bind)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None, mesh=None,
+                    shared_exec=None, **kwargs):
+        """Allocate all arrays from shapes and bind
+        (reference GraphExecutor simple_bind overload, executor.h:76)."""
+        ctx = ctx or current_context()
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("simple_bind: cannot infer shapes from %s" % kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        arg_dict, grad_dict = {}, {}
+        req_dict = _norm_grad_req(grad_req, arg_names)
+        shared = shared_exec.arg_dict if shared_exec is not None else {}
+        shared_grad = shared_exec.grad_dict if shared_exec is not None else {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dtype = jnp.dtype(type_dict.get(name, "float32"))
+            if name in shared and tuple(shared[name].shape) == tuple(shape):
+                arg_dict[name] = shared[name]
+            else:
+                arg_dict[name] = NDArray(jnp.zeros(shape, dtype=dtype), ctx)
+            if req_dict.get(name, "null") != "null":
+                if name in shared_grad and tuple(shared_grad[name].shape) == tuple(shape):
+                    grad_dict[name] = shared_grad[name]
+                else:
+                    grad_dict[name] = NDArray(jnp.zeros(shape, dtype=dtype), ctx)
+        shared_aux = shared_exec.aux_dict if shared_exec is not None else {}
+        aux_dict = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in shared_aux and tuple(shared_aux[name].shape) == tuple(shape):
+                aux_dict[name] = shared_aux[name]
+            else:
+                aux_dict[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx)
+        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh)
+
+    @staticmethod
+    def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None, mesh=None):
+        """Bind with user-provided arrays (reference Executor::Bind)."""
+        ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, dict):
+            arg_dict = {n: args[n] for n in arg_names if n in args}
+            missing = [n for n in arg_names if n not in args]
+            if missing:
+                raise MXNetError("bind: missing arguments %s" % missing)
+        else:
+            if len(args) != len(arg_names):
+                raise MXNetError("bind: expected %d args, got %d" % (len(arg_names), len(args)))
+            arg_dict = dict(zip(arg_names, args))
+        req_dict = _norm_grad_req(grad_req, arg_names)
+        if args_grad is None:
+            grad_dict = {}
+            for n in arg_names:
+                if req_dict.get(n, "null") != "null":
+                    req_dict[n] = "null"
+        elif isinstance(args_grad, dict):
+            grad_dict = dict(args_grad)
+            for n in arg_names:
+                if n not in grad_dict:
+                    req_dict[n] = "null"
+        else:
+            grad_dict = dict(zip(arg_names, args_grad))
+        if aux_states is None:
+            aux_dict = {n: NDArray(jnp.zeros(()), ctx) for n in aux_names} if aux_names else {}
+            if aux_names:
+                # infer aux shapes from args
+                shapes = {n: arg_dict[n].shape for n in arg_names}
+                _, _, aux_shapes = symbol.infer_shape(**shapes)
+                aux_dict = {
+                    n: NDArray(jnp.zeros(s), ctx) for n, s in zip(aux_names, aux_shapes)
+                }
+        elif isinstance(aux_states, dict):
+            aux_dict = dict(aux_states)
+        else:
+            aux_dict = dict(zip(aux_names, aux_states))
+        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh)
+
+    # ------------------------------------------------------------------
+    # data-path helpers
+    # ------------------------------------------------------------------
+    @property
+    def _data_arg_names(self):
+        # args without grads are inputs (data/label); used for sharding decisions
+        return [n for n in self._arg_names if self._grad_req.get(n, "null") == "null"]
+
+    def _gather_args(self):
+        vals = []
+        for n in self._arg_names:
+            v = self.arg_dict[n].data
+            vals.append(v)
+        return tuple(vals)
+
+    def _gather_aux(self):
+        return tuple(self.aux_dict[n].data for n in self._aux_names)
+
+    def _place(self, vals):
+        """Apply mesh shardings: batch inputs over 'data', params replicated."""
+        if self._mesh is None:
+            return vals
+        placed = []
+        data_names = set(self._data_arg_names)
+        for n, v in zip(self._arg_names, vals):
+            sh = self._data_sharding if n in data_names else self._repl_sharding
+            placed.append(jax.device_put(v, sh))
+        return tuple(placed)
+
+    # ------------------------------------------------------------------
+    # forward / backward (parity: MXExecutorForward/Backward)
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Set inputs and (lazily) run forward.
+
+        Training-mode forward DEFERS computation: if `backward()` follows
+        (the fit hot path), one fused fwd+bwd executable runs exactly once —
+        the analog of the reference's bulk-exec segments
+        (graph_executor.cc:1094-1170).  Reading `outputs` before backward
+        triggers a forward-only run with the SAME per-step RNG key, so
+        dropout masks agree between reported outputs and gradients, and
+        aux (BatchNorm moving stats) updates apply exactly once per step.
+        """
+        for name, value in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError("Unknown argument %s" % name)
+            v = value.data if isinstance(value, NDArray) else jnp.asarray(value)
+            if tuple(v.shape) != tuple(self.arg_dict[name].shape):
+                raise MXNetError(
+                    "Shape mismatch for argument %s: bound %s, got %s (use reshape())"
+                    % (name, self.arg_dict[name].shape, tuple(v.shape))
+                )
+            self.arg_dict[name]._set_data(v)
+        self._last_is_train = bool(is_train)
+        self._outputs_cache = None
+        self._step_rng = self._next_rng()
+        self._aux_applied = False
+        if not is_train:
+            self._compute_forward(False)
+        return self.outputs if not is_train else None
+
+    def _fwd_fn(self, is_train):
+        if is_train not in self._jit_fwd:
+            entries, order = self._entries, self._order
+            an, xn = self._arg_names, self._aux_names
+
+            def f(arg_vals, aux_vals, rng):
+                return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train, rng)
+
+            self._jit_fwd[is_train] = jax.jit(f)
+        return self._jit_fwd[is_train]
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _compute_forward(self, is_train):
+        fn = self._fwd_fn(is_train)
+        args = self._place(self._gather_args())
+        outs, aux_upd = fn(args, self._gather_aux(), self._step_rng)
+        self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
+        if is_train and not self._aux_applied:
+            self._write_aux(aux_upd)
+            self._aux_applied = True
+        if self._monitor_callback is not None:
+            for name, o in zip(self._symbol.list_outputs(), self._outputs_cache):
+                self._monitor_callback(name, o)
+
+    @property
+    def _first_ctx(self):
+        return self._ctx if isinstance(self._ctx, Context) else self._ctx[0]
+
+    def _write_aux(self, aux_upd):
+        for n, v in zip(self._aux_names, aux_upd):
+            self.aux_dict[n]._set_data(v)
+
+    @property
+    def outputs(self):
+        if self._outputs_cache is None:
+            self._compute_forward(self._last_is_train)
+        return self._outputs_cache
+
+    def backward(self, out_grads=None):
+        """Fused forward+backward in one XLA executable; grads land per grad_req."""
+        diff_names = [n for n in self._arg_names if self._grad_req.get(n, "null") != "null"]
+        if not diff_names:
+            return
+        has_heads = out_grads is not None
+        key = (True, has_heads)
+        if key not in self._jit_bwd:
+            entries, order = self._entries, self._order
+            an, xn = self._arg_names, self._aux_names
+            diff_idx = [an.index(n) for n in diff_names]
+            nondiff_idx = [i for i in range(len(an)) if i not in diff_idx]
+
+            def f(diff_vals, nondiff_vals, aux_vals, rng, head_grads):
+                def fwd(dv):
+                    vals = [None] * len(an)
+                    for i, v in zip(diff_idx, dv):
+                        vals[i] = v
+                    for i, v in zip(nondiff_idx, nondiff_vals):
+                        vals[i] = v
+                    outs, aux_upd = _run_graph(entries, order, an, xn, tuple(vals), aux_vals, True, rng)
+                    return outs, aux_upd
+
+                (outs, aux_upd), vjp_fn = jax.vjp(fwd, diff_vals, has_aux=False)
+                if head_grads is None:
+                    cots = tuple(jnp.ones_like(o) for o in outs)
+                else:
+                    cots = tuple(head_grads)
+                zero_aux = tuple(jnp.zeros_like(a) for a in aux_upd)
+                (grads,) = vjp_fn((cots, zero_aux))
+                return outs, aux_upd, grads
+
+            self._jit_bwd[key] = (jax.jit(f), diff_names, diff_idx, nondiff_idx)
+        fn, diff_names, diff_idx, nondiff_idx = self._jit_bwd[key]
+        all_vals = self._place(self._gather_args())
+        diff_vals = tuple(all_vals[i] for i in diff_idx)
+        nondiff_vals = tuple(all_vals[i] for i in nondiff_idx)
+        heads = None
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads)
+        outs, aux_upd, grads = fn(diff_vals, nondiff_vals, self._gather_aux(), self._step_rng, heads)
+        self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
+        if not self._aux_applied:
+            self._write_aux(aux_upd)
+            self._aux_applied = True
+        for n, g in zip(diff_names, grads):
+            req = self._grad_req.get(n, "write")
+            tgt = self.grad_dict.get(n)
+            if tgt is None:
+                continue
+            if req == "add":
+                tgt._set_data(tgt.data + g)
+            else:
+                tgt._set_data(g)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        self.forward(is_train=True, **kwargs)
+        self.backward(out_grads)
+        return self.outputs
+
+    # ------------------------------------------------------------------
+    # misc (parity: python/mxnet/executor.py)
+    # ------------------------------------------------------------------
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError("Unknown param %s" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError("Unknown aux %s" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes, sharing parameter arrays
+        (parity: executor.py reshape; reference shared-pool rebinding)."""
+        new_shapes = dict(kwargs)
+        arg_shapes, _, _ = self._symbol.infer_shape(**new_shapes)
+        arg_dict = {}
+        for n, s in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if tuple(cur.shape) == tuple(s):
+                arg_dict[n] = cur
+            else:
+                arg_dict[n] = NDArray(jnp.zeros(s, dtype=cur.dtype), self._first_ctx)
+        return Executor(
+            self._symbol, self._ctx, arg_dict,
+            {n: NDArray(jnp.zeros_like(arg_dict[n].data), self._first_ctx) for n in self.grad_dict},
+            dict(self._grad_req), dict(self.aux_dict), mesh=self._mesh,
+        )
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]
+        for node in self._order:
+            if node.op is not None:
+                lines.append("%s(%s) <- %s" % (node.op.name, node.name,
+                                               [s.name for s, _ in node.inputs]))
+        return "\n".join(lines)
+
+
+def _norm_grad_req(grad_req, arg_names):
+    if isinstance(grad_req, str):
+        return {n: grad_req for n in arg_names}
+    if isinstance(grad_req, (list, tuple)):
+        return dict(zip(arg_names, grad_req))
+    out = {n: "null" for n in arg_names}
+    out.update(grad_req)
+    return out

@@ -1,0 +1,456 @@
+"""Data iterators (parity: reference python/mxnet/io.py + src/io/).
+
+Host-side pipeline feeding the device: the reference's C++ chain
+(record parser → BatchLoader → Normalize → PrefetcherIter double-buffering,
+reference src/io/iter_prefetcher.h:28-130) maps to python iterators with a
+background prefetch thread; the heavy RecordIO/image path has a native C++
+backend (src/recordio.cc via recordio.py ctypes bindings).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+import queue as _queue
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray, array
+
+__all__ = [
+    "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+    "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
+]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Data description incl. dtype/layout (parity: io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype, self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch:
+    """One batch: data/label NDArray lists + pad/index (parity: io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None, bucket_key=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (parity: io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(
+                data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=self.getindex()
+            )
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        pass
+
+    def getdata(self):
+        pass
+
+    def getlabel(self):
+        pass
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        pass
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        if shuffle:
+            _np.random.shuffle(self.idx)
+            self.data = [(k, v[self.idx]) for k, v in self.data]
+            self.label = [(k, v[self.idx]) for k, v in self.label]
+        if last_batch_handle == "discard":
+            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
+            self.idx = self.idx[:new_n]
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.num_data = self.idx.shape[0]
+        assert self.num_data >= batch_size, "batch_size needs to be smaller than data size."
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [
+            DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])), v.dtype)
+            for k, v in self.data
+        ]
+
+    @property
+    def provide_label(self):
+        return [
+            DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])), v.dtype)
+            for k, v in self.label
+        ]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(
+                data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=None
+            )
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [array(x[1][self.cursor : self.cursor + self.batch_size]) for x in data_source]
+        pad = self.batch_size - self.num_data + self.cursor
+        return [
+            array(_np.concatenate((x[1][self.cursor :], x[1][:pad]), axis=0)) for x in data_source
+        ]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data (parity: io.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them or dict with them as values")
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            data[k] = v.asnumpy()
+    return list(sorted(data.items()))
+
+
+class ResizeIter(DataIter):
+    """Resize another iterator to `size` batches per epoch (parity: io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators
+    (parity: io.py PrefetchingIter; reference double-buffering
+    src/io/iter_prefetcher.h:96-118)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self._queues = None
+        self._started = False
+        self.prefetch_threads = []
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self._start_prefetch()
+
+    def _start_prefetch(self):
+        self._queues = [_queue.Queue(maxsize=2) for _ in range(self.n_iter)]
+        self._started = True
+
+        def prefetch_func(i):
+            while self._started:
+                try:
+                    batch = self.iters[i].next()
+                except StopIteration:
+                    batch = None
+                self._queues[i].put(batch)
+                if batch is None:
+                    break
+
+        self.prefetch_threads = []
+        for i in range(self.n_iter):
+            t = threading.Thread(target=prefetch_func, args=(i,), daemon=True)
+            t.start()
+            self.prefetch_threads.append(t)
+
+    def _stop_prefetch(self):
+        """Shut producers down cleanly: a producer may be blocked in put(), so
+        drain while joining, and only discard queues once threads are dead."""
+        self._started = False
+        for t in self.prefetch_threads:
+            while t.is_alive():
+                for q in self._queues:
+                    try:
+                        q.get_nowait()
+                    except _queue.Empty:
+                        pass
+                t.join(timeout=0.01)
+        self._queues = None
+        self.prefetch_threads = []
+
+    def __del__(self):
+        self._started = False
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum(
+            [
+                [DataDesc(r[x.name], x.shape, x.dtype) if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
+                 for x in i.provide_data]
+                for r, i in zip(self.rename_data, self.iters)
+            ],
+            [],
+        )
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum(
+            [
+                [DataDesc(r[x.name], x.shape, x.dtype) if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
+                 for x in i.provide_label]
+                for r, i in zip(self.rename_label, self.iters)
+            ],
+            [],
+        )
+
+    def reset(self):
+        self._stop_prefetch()
+        for it in self.iters:
+            it.reset()
+        self._start_prefetch()
+
+    def iter_next(self):
+        batches = [q.get() for q in self._queues]
+        if any(b is None for b in batches):
+            return False
+        self.current_batch = batches
+        return True
+
+    def next(self):
+        if self.iter_next():
+            if self.n_iter == 1:
+                return self.current_batch[0]
+            return DataBatch(
+                data=sum([b.data for b in self.current_batch], []),
+                label=sum([b.label for b in self.current_batch], []),
+                pad=self.current_batch[0].pad,
+                index=self.current_batch[0].index,
+            )
+        raise StopIteration
+
+    def getdata(self):
+        return sum([b.data for b in self.current_batch], [])
+
+    def getlabel(self):
+        return sum([b.label for b in self.current_batch], [])
+
+    def getindex(self):
+        return self.current_batch[0].index
+
+    def getpad(self):
+        return self.current_batch[0].pad
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST raw-ubyte reader (parity: reference src/io/iter_mnist.cc:61-241).
+
+    Reads idx-format image/label files (optionally .gz); `flat` controls
+    (B,784) vs (B,1,28,28).
+    """
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, silent=False, seed=0,
+                 input_shape=None, **kwargs):
+        images = _read_idx_images(image)
+        labels = _read_idx_labels(label)
+        images = images.astype(_np.float32) / 255.0
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1, images.shape[1], images.shape[2])
+        super().__init__(
+            images, labels.astype(_np.float32), batch_size=batch_size,
+            shuffle=bool(shuffle), last_batch_handle="discard",
+            data_name="data", label_name="softmax_label",
+        )
+
+
+def _open_maybe_gz(path):
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        path = path + ".gz"
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _read_idx_images(path):
+    with _open_maybe_gz(path) as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError("Invalid MNIST image file %s" % path)
+        data = _np.frombuffer(f.read(num * rows * cols), dtype=_np.uint8)
+        return data.reshape(num, rows, cols)
+
+
+def _read_idx_labels(path):
+    with _open_maybe_gz(path) as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError("Invalid MNIST label file %s" % path)
+        return _np.frombuffer(f.read(num), dtype=_np.uint8)
+
+
+class CSVIter(NDArrayIter):
+    """CSV reader (parity: reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=128, round_batch=True, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        else:
+            label = _np.zeros((data.shape[0],), dtype=_np.float32)
+        super().__init__(
+            data, label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard",
+        )
+
+
+def ImageRecordIter(**kwargs):
+    """RecordIO-packed image iterator (reference src/io/iter_image_recordio_2.cc).
+
+    Implemented over the native C++ RecordIO reader — see image_io.py.
+    """
+    from .image_io import ImageRecordIterImpl
+
+    return ImageRecordIterImpl(**kwargs)

@@ -1,0 +1,148 @@
+"""KVStore — parameter aggregation and distribution.
+
+Parity with reference src/kvstore/ + python/mxnet/kvstore.py
+(SURVEY.md §2 ⚙8/⚙9): `local`/`device` do in-process gradient reduction
+(the reference's CommCPU/CommDevice P2P tree-sums), `dist_*` map to the
+multi-process backend in parallel/dist.py.
+
+TPU-native notes:
+  * On one host, "devices" share the XLA runtime, so Reduce is a single
+    fused add — and the preferred data-parallel path doesn't go through
+    KVStore at all: ExecutorGroup compiles ONE SPMD executable over a
+    `jax.sharding.Mesh`, where XLA inserts the ICI all-reduce that the
+    reference got from CommDevice GPU P2P (src/kvstore/comm.h:204-355).
+    KVStore remains the API façade (update_on_kvstore path, optimizer on
+    store, dist modes) so reference training scripts run unmodified.
+  * `dist_sync`/`dist_device_sync`/`dist_async` semantics (sharded servers,
+    worker barriers, async hogwild — kvstore_dist_server.h:136-228) are
+    provided by a host-side control plane over TCP (parallel/dist.py) with
+    gradients riding XLA collectives when a real multi-host mesh exists.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .base import MXNetError
+from . import optimizer as opt
+from .ndarray import NDArray, zeros
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(key, vals):
+    if isinstance(key, (list, tuple)):
+        assert isinstance(vals, (list, tuple)) and len(key) == len(vals)
+        return list(key), list(vals)
+    return [key], [vals]
+
+
+class KVStore:
+    """In-process key-value store (parity: python/mxnet/kvstore.py KVStore)."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._barrier_count = 0
+
+    # ------------------------------------------------------------------
+    # init/push/pull (parity: kvstore.py init/push/pull;
+    # reference KVStoreLocal::Push/Pull kvstore_local.h:65-118)
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            if k in self._store:
+                continue  # parity: re-Init of existing key ignored (dist_server.h:147-163)
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        """Push (aggregate) values.  A list-of-lists aggregates per key across
+        devices — Reduce ≙ fused on-device sum (reference comm.h:216-259)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                merged = v[0].copy()
+                for other in v[1:]:
+                    merged += other
+            else:
+                merged = v.copy()
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = _ctype_key_value(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % str(k))
+            src = self._store[k]
+            if isinstance(o, (list, tuple)):
+                for oo in o:
+                    oo[:] = src
+            else:
+                o[:] = src
+
+    # ------------------------------------------------------------------
+    # optimizer plumbing (parity: kvstore.py set_optimizer/_set_updater)
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        if "dist" in self.type and self.rank == 0:
+            # parity: pickle optimizer to servers (kvstore.py set_optimizer)
+            optim_str = pickle.dumps(optimizer, 0)
+            self._send_command_to_servers(0, optim_str)
+        else:
+            self._set_updater(opt.get_updater(optimizer))
+        self._optimizer = optimizer
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _send_command_to_servers(self, head, body):
+        # single-process fallback: apply locally
+        self._set_updater(opt.get_updater(pickle.loads(body)))
+
+    # ------------------------------------------------------------------
+    # topology (parity: kvstore.py rank/num_workers/barrier)
+    # ------------------------------------------------------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def create(name="local"):
+    """Create a KVStore (parity: kvstore.py create; reference
+    src/kvstore/kvstore.cc:16-43 type dispatch)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name.startswith("dist"):
+        if os.environ.get("DMLC_ROLE") or os.environ.get("MXTPU_DIST_URI"):
+            from .parallel.dist import DistKVStore
+
+            return DistKVStore(name)
+        # no cluster configured: degrade to local semantics, rank 0 of 1
+        return KVStore(name)
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu", "local_allreduce_device", "device"):
+        return KVStore(name)
+    raise MXNetError("Unknown KVStore type %s" % name)

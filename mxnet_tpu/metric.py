@@ -1,0 +1,401 @@
+"""Evaluation metrics (parity: reference python/mxnet/metric.py:27-1057)."""
+from __future__ import annotations
+
+import math
+
+import numpy
+
+from .base import numeric_types, string_types
+from .ndarray import NDArray
+
+__all__ = [
+    "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
+    "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy", "Loss", "Torch",
+    "Caffe", "CustomMetric", "np", "create",
+]
+
+
+def check_label_shapes(labels, preds, shape=0):
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}".format(label_shape, pred_shape)
+        )
+
+
+class EvalMetric:
+    """Base metric (parity: metric.py EvalMetric)."""
+
+    def __init__(self, name, num=None, output_names=None, label_names=None):
+        self.name = name
+        self.num = num
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        if self.num is None:
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+
+    def get(self):
+        if self.num is None:
+            if self.num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.sum_metric / self.num_inst)
+        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
+        values = [
+            x / y if y != 0 else float("nan") for x, y in zip(self.sum_metric, self.num_inst)
+        ]
+        return (names, values)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics (parity: metric.py CompositeEvalMetric)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+        if metrics is None:
+            metrics = []
+        self.metrics = [create(m) if isinstance(m, str) else m for m in metrics]
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str) else metric)
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError("Metric index {} is out of range 0 and {}".format(index, len(self.metrics)))
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        results = []
+        for metric in self.metrics:
+            result = metric.get()
+            name = result[0]
+            if isinstance(name, string_types):
+                name = [name]
+                result = [result[1]]
+            else:
+                result = result[1]
+            names.extend(name)
+            results.extend(result)
+        return (names, results)
+
+
+class Accuracy(EvalMetric):
+    """Classification accuracy (parity: metric.py Accuracy)."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred_np = pred_label.asnumpy() if isinstance(pred_label, NDArray) else numpy.asarray(pred_label)
+            label_np = label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)
+            # parity: argmax whenever prediction and label shapes differ
+            # (reference metric.py Accuracy — handles (N,1) column labels too)
+            if pred_np.shape != label_np.shape:
+                pred_np = numpy.argmax(pred_np, axis=self.axis)
+            label_np = label_np.astype("int32")
+            pred_np = pred_np.astype("int32")
+            check_label_shapes(label_np.flat, pred_np.flat)
+            self.sum_metric += (pred_np.flat == label_np.flat).sum()
+            self.num_inst += len(pred_np.flat)
+
+
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (parity: metric.py TopKAccuracy)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred_np = numpy.argsort(
+                (pred_label.asnumpy() if isinstance(pred_label, NDArray) else pred_label).astype("float32")
+            )
+            label_np = (label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)).astype("int32")
+            check_label_shapes(label_np, pred_np, 0)
+            num_samples = pred_np.shape[0]
+            num_dims = len(pred_np.shape)
+            if num_dims == 1:
+                self.sum_metric += (pred_np.flat == label_np.flat).sum()
+            elif num_dims == 2:
+                num_classes = pred_np.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    self.sum_metric += (pred_np[:, num_classes - 1 - j].flat == label_np.flat).sum()
+            self.num_inst += num_samples
+
+
+class F1(EvalMetric):
+    """Binary F1 (parity: metric.py F1)."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            label_np = (label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)).astype("int32")
+            pred_label = numpy.argmax(pred_np, axis=1)
+            check_label_shapes(label_np, pred_np)
+            if len(numpy.unique(label_np)) > 2:
+                raise ValueError("F1 currently only supports binary classification.")
+            true_positives, false_positives, false_negatives = 0.0, 0.0, 0.0
+            for y_pred, y_true in zip(pred_label, label_np):
+                if y_pred == 1 and y_true == 1:
+                    true_positives += 1.0
+                elif y_pred == 1 and y_true == 0:
+                    false_positives += 1.0
+                elif y_pred == 0 and y_true == 1:
+                    false_negatives += 1.0
+            if true_positives + false_positives > 0:
+                precision = true_positives / (true_positives + false_positives)
+            else:
+                precision = 0.0
+            if true_positives + false_negatives > 0:
+                recall = true_positives / (true_positives + false_negatives)
+            else:
+                recall = 0.0
+            if precision + recall > 0:
+                f1_score = 2 * precision * recall / (precision + recall)
+            else:
+                f1_score = 0.0
+            self.sum_metric += f1_score
+            self.num_inst += 1
+
+
+class Perplexity(EvalMetric):
+    """Perplexity (parity: metric.py Perplexity)."""
+
+    def __init__(self, ignore_label, axis=-1, name="perplexity", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            assert label_np.size == pred_np.size / pred_np.shape[-1], (
+                "shape mismatch: %s vs. %s" % (label_np.shape, pred_np.shape)
+            )
+            label_flat = label_np.reshape((label_np.size,)).astype("int32")
+            probs = pred_np.reshape((-1, pred_np.shape[-1]))[numpy.arange(label_flat.size), label_flat]
+            if self.ignore_label is not None:
+                ignore = (label_flat == self.ignore_label).astype(probs.dtype)
+                num -= int(numpy.sum(ignore))
+                probs = probs * (1 - ignore) + ignore
+            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
+            num += label_flat.size
+        self.sum_metric += numpy.exp(loss / num) * num if False else loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            self.sum_metric += numpy.abs(label_np - pred_np).mean()
+            self.num_inst += 1
+
+
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            self.sum_metric += ((label_np - pred_np) ** 2.0).mean()
+            self.num_inst += 1
+
+
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            self.sum_metric += numpy.sqrt(((label_np - pred_np) ** 2.0).mean())
+            self.num_inst += 1
+
+
+class CrossEntropy(EvalMetric):
+    """Cross entropy of softmax output vs int labels (parity: metric.py CrossEntropy)."""
+
+    def __init__(self, eps=1e-8, name="cross-entropy", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = (label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)).ravel()
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            assert label_np.shape[0] == pred_np.shape[0]
+            prob = pred_np[numpy.arange(label_np.shape[0]), numpy.int64(label_np)]
+            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
+            self.num_inst += label_np.shape[0]
+
+
+class Loss(EvalMetric):
+    """Mean of the output itself (parity: metric.py Loss)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+
+    def update(self, _, preds):
+        for pred in preds:
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            self.sum_metric += pred_np.sum()
+            self.num_inst += pred_np.size
+
+
+class Torch(Loss):
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+
+
+class Caffe(Loss):
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names, label_names=label_names)
+
+
+class CustomMetric(EvalMetric):
+    """Metric from a python function (parity: metric.py CustomMetric)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False, output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names=output_names, label_names=label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label_np = label.asnumpy() if isinstance(label, NDArray) else numpy.asarray(label)
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+            reval = self._feval(label_np, pred_np)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval into a metric (parity: metric.py np)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def create(metric, **kwargs):
+    """Create by name or callable or list (parity: metric.py create)."""
+    if callable(metric):
+        return CustomMetric(metric)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite_metric = CompositeEvalMetric()
+        for child_metric in metric:
+            composite_metric.add(create(child_metric, **kwargs))
+        return composite_metric
+    metrics = {
+        "acc": Accuracy, "accuracy": Accuracy, "ce": CrossEntropy,
+        "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
+        "top_k_accuracy": TopKAccuracy, "topkaccuracy": TopKAccuracy,
+        "perplexity": Perplexity, "loss": Loss, "torch": Torch, "caffe": Caffe,
+        "cross-entropy": CrossEntropy, "crossentropy": CrossEntropy,
+        "composite": CompositeEvalMetric,
+    }
+    try:
+        return metrics[metric.lower()](**kwargs)
+    except Exception:
+        raise ValueError("Metric must be either callable or in {}".format(sorted(metrics.keys())))

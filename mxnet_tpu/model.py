@@ -1,0 +1,232 @@
+"""Legacy model API + checkpoint helpers.
+
+Parity: reference python/mxnet/model.py (_create_kvstore:40-77,
+_update_params_on_kvstore:89-100, _update_params:101-125,
+save_checkpoint:323, load_checkpoint:353, FeedForward:731+).
+"""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+from . import io as mxio
+from . import ndarray as nd
+from . import symbol as sym
+from .base import MXNetError
+from .context import cpu, current_context
+from .initializer import Uniform
+from .kvstore import KVStore
+from . import kvstore as kvs
+from . import optimizer as opt
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint", "FeedForward"]
+
+BatchEndParam = namedtuple("BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Create kvstore + decide update_on_kvstore (parity: model.py:40-77)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape) for param in arg_params.values()) if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+import numpy as np  # noqa: E402  (used in _create_kvstore size heuristic)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on_kvstore):
+    """Init kvstore with params (parity: model.py:79-88)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """Push-then-pull per param (parity: model.py:89-100; priority = -index so
+    early-layer grads sync first ≙ reference comm/compute overlap)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None, param_names=None):
+    """Local updater path (parity: model.py:101-125)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updater(index * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write prefix-symbol.json + prefix-%04d.params (parity: model.py:323-352)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) (parity: model.py:353+)."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Legacy training API (parity: model.py FeedForward:731+), implemented as
+    a thin adapter over the Module family."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=Uniform(0.01), numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [current_context()]
+        elif not isinstance(ctx, list):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.kwargs = kwargs.copy()
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self._module = None
+
+    def _make_module(self, data_iter):
+        from .module import Module
+
+        label_names = [d.name for d in (data_iter.provide_label or [])]
+        data_names = [d.name for d in data_iter.provide_data]
+        self._module = Module(self.symbol, data_names=data_names,
+                              label_names=label_names or None, context=self.ctx)
+        return self._module
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """Train (parity: model.py FeedForward.fit)."""
+        data = self._init_iter(X, y, is_train=True)
+        mod = self._make_module(data)
+        optimizer = self.optimizer
+        if isinstance(optimizer, str):
+            batch_size = data.batch_size
+            optimizer = opt.create(optimizer, rescale_grad=(1.0 / batch_size), **self.kwargs)
+        mod.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=optimizer, initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback, monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._init_iter(X, None, is_train=False)
+        mod = self._module
+        if mod is None or not mod.binded:
+            mod = self._make_module(data)
+            mod.bind(data.provide_data, data.provide_label or None, for_training=False)
+            if self.arg_params is not None:
+                mod.set_params(self.arg_params, self.aux_params or {}, allow_missing=False)
+            else:
+                raise MXNetError("Model has not been trained or loaded")
+        return mod.predict(data, num_batch=num_batch, reset=reset)
+
+    def score(self, X, eval_metric="acc", num_batch=None, batch_end_callback=None, reset=True):
+        data = self._init_iter(X, None, is_train=False)
+        mod = self._module
+        if mod is None or not mod.binded:
+            mod = self._make_module(data)
+            mod.bind(data.provide_data, data.provide_label or None, for_training=False)
+            mod.set_params(self.arg_params, self.aux_params or {})
+        res = mod.score(data, eval_metric, num_batch=num_batch,
+                        batch_end_callback=batch_end_callback, reset=reset)
+        return res[0][1] if res else float("nan")
+
+    def _init_iter(self, X, y, is_train):
+        import numpy as _np
+
+        if isinstance(X, (mxio.DataIter,)):
+            return X
+        if isinstance(X, (_np.ndarray,)) or hasattr(X, "asnumpy"):
+            if y is None:
+                y = _np.zeros(X.shape[0])
+            batch_size = min(self.numpy_batch_size, X.shape[0])
+            return mxio.NDArrayIter(X, y, batch_size=batch_size, shuffle=is_train,
+                                    last_batch_handle="roll_over" if is_train else "pad")
+        raise TypeError("X must be DataIter or numpy array")
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params, self.aux_params)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=Uniform(0.01), eval_data=None,
+               eval_metric="acc", epoch_end_callback=None, batch_end_callback=None,
+               kvstore="local", logger=None, work_load_list=None,
+               eval_end_callback=None, eval_batch_end_callback=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, epoch_size=epoch_size,
+                            optimizer=optimizer, initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

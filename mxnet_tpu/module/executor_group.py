@@ -1,0 +1,218 @@
+"""DataParallelExecutorGroup — TPU-first SPMD edition.
+
+Parity target: reference python/mxnet/module/executor_group.py (batch
+splitting via decide_slices:216-238, per-device simple_bind:583, scatter/
+gather, forward:371, backward:503).
+
+TPU-native redesign: instead of N per-device executors with host-side
+scatter/gather + KVStore reduction, the group binds ONE executor whose
+arrays are sharded over a `jax.sharding.Mesh` ('data' axis = all given
+contexts).  XLA SPMD partitions the single executable, shards the batch,
+replicates the params, and inserts the ICI all-reduce for gradients —
+replacing CommDevice P2P reduce (reference src/kvstore/comm.h:204-355)
+with compiler-scheduled collectives.  `decide_slices` is kept for API
+parity and for workload-aware host-side batch sharding.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from ..executor import Executor
+from ..io import DataDesc
+from ..ndarray import NDArray
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice batch by workload (parity: executor_manager.py _split_input_slice:14)."""
+    total_work_load = sum(work_load_list)
+    batch_num_list = [
+        round(work_load * batch_size / total_work_load) for work_load in work_load_list
+    ]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _make_mesh(contexts):
+    """Build a 1-D 'data' mesh over the resolved jax devices of `contexts`."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = []
+    seen = set()
+    for ctx in contexts:
+        d = ctx.jax_device()
+        if id(d) in seen:
+            # same physical device requested twice (e.g. cpu(0), cpu(1) on a
+            # 1-device host): fall back to single-device execution
+            return None
+        seen.add(id(d))
+        devices.append(d)
+    if len(devices) <= 1:
+        return None
+    return Mesh(_np.array(devices), ("data",))
+
+
+class DataParallelExecutorGroup:
+    """One SPMD executor over all contexts (parity class name/API:
+    executor_group.py DataParallelExecutorGroup:82)."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=logging, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        self.logger = logger
+        self.mesh = _make_mesh(contexts)
+        self.batch_size = None
+        self.slices = None
+        self.execs = []
+        self.data_names = None
+        self.label_names = None
+        self.data_shapes = None
+        self.label_shapes = None
+        self.grad_req_spec = grad_req
+        self.shared_group = shared_group
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """Workload-aware batch slices (parity: executor_group.py:216-238)."""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(s, "layout", "NCHW")) for s in data_shapes]
+        for (name, shape), axis in zip([(s.name, s.shape) for s in data_shapes], major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, (
+                    "all data must have the same batch size: batch_size = %d, but %s has shape %s"
+                    % (self.batch_size, name, str(shape))
+                )
+            else:
+                self.batch_size = batch_size
+                self.slices = _split_input_slice(self.batch_size, self.workload)
+        return major_axis
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None, reshape=False):
+        """Bind the single SPMD executor (replaces per-device simple_bind loop,
+        reference executor_group.py:583)."""
+        self.batch_size = None
+        descs = [s if isinstance(s, DataDesc) else DataDesc(s[0], s[1]) for s in data_shapes]
+        self.decide_slices(descs)
+        self.data_names = [s.name for s in descs]
+        self.data_shapes = descs
+        label_descs = []
+        if label_shapes is not None:
+            label_descs = [s if isinstance(s, DataDesc) else DataDesc(s[0], s[1]) for s in label_shapes]
+        self.label_names = [s.name for s in label_descs]
+        self.label_shapes = label_descs or None
+        shape_kwargs = {s.name: s.shape for s in descs + label_descs}
+        input_names = set(self.data_names) | set(self.label_names)
+        grad_req = {}
+        for name in self.arg_names:
+            if not self.for_training:
+                grad_req[name] = "null"
+            elif name in input_names:
+                grad_req[name] = "write" if (self.inputs_need_grad and name in self.data_names) else "null"
+            elif name in self.fixed_param_names:
+                grad_req[name] = "null"
+            else:
+                grad_req[name] = self.grad_req_spec if isinstance(self.grad_req_spec, str) else (
+                    self.grad_req_spec.get(name, "write")
+                )
+        shared_exec = shared_group.execs[0] if shared_group is not None else None
+        exe = Executor.simple_bind(
+            self.symbol, self.contexts[0], grad_req=grad_req, mesh=self.mesh,
+            shared_exec=shared_exec, **shape_kwargs
+        )
+        self.execs = [exe]
+
+    # ------------------------------------------------------------------
+    # parameter management
+    # ------------------------------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        self.execs[0].copy_params_from(arg_params, aux_params, allow_extra_params=True)
+
+    def get_params(self, arg_params, aux_params):
+        for name in self.param_names:
+            arg_params[name][:] = self.execs[0].arg_dict[name]
+        for name in self.aux_names:
+            aux_params[name][:] = self.execs[0].aux_dict[name]
+
+    # ------------------------------------------------------------------
+    # execution (parity: executor_group.py forward:371 / backward:503)
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        kwargs = {}
+        for name, arr in zip(self.data_names, data_batch.data):
+            kwargs[name] = arr
+        if self.label_names and data_batch.label:
+            for name, arr in zip(self.label_names, data_batch.label):
+                kwargs[name] = arr
+        self.execs[0].forward(is_train=is_train, **kwargs)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        self.execs[0].backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        outs = self.execs[0].outputs
+        if merge_multi_context:
+            return outs
+        return [[o] for o in outs]
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [self.execs[0].grad_dict.get(n) for n in self.data_names]
+        if merge_multi_context:
+            return grads
+        return [[g] for g in grads]
+
+    def update_metric(self, eval_metric, labels):
+        preds = self.execs[0].outputs
+        eval_metric.update(labels, preds)
+
+    @property
+    def grad_arrays(self):
+        """[[grad per device]] — single SPMD exec exposes one copy
+        (grads already globally reduced by XLA)."""
+        return [[self.execs[0].grad_dict[n]] for n in self.param_names
+                if n in self.execs[0].grad_dict]
+
+    @property
+    def param_arrays(self):
+        return [[self.execs[0].arg_dict[n]] for n in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[self.execs[0].aux_dict[n]] for n in self.aux_names]
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)

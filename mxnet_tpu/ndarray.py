@@ -1,0 +1,527 @@
+"""NDArray — the imperative tensor.
+
+TPU-native equivalent of the reference NDArray
+(reference include/mxnet/ndarray.h:59-436, src/ndarray/ndarray.cc).
+
+Architecture mapping (SURVEY.md §7 phase 2):
+  * The reference NDArray is a view over a ref-counted Chunk holding a
+    Storage handle plus a dependency-engine variable; every op is pushed to
+    the ThreadedEngine with declared read/write sets.  Here the payload is a
+    `jax.Array`: PJRT's async dispatch + XLA's data-flow ordering provide
+    exactly the engine's read-after-write guarantees, and `wait_to_read` ≙
+    `block_until_ready` (reference WaitToRead, ndarray.h:297).
+  * Mutation (`a[:] = x`, `a += b`) is functional underneath: the wrapped
+    buffer is replaced.  Donated-buffer aliasing inside jitted executors
+    recovers in-place update performance (SURVEY.md §7 hard-part 1).
+  * `Slice`/`At` views (reference ndarray.h:267-311) are write-through:
+    a view records (parent, index); reads slice the parent lazily, writes
+    scatter into the parent — preserving the reference's aliasing semantics
+    without aliased device memory.
+  * Imperative op invoke (reference MXImperativeInvoke,
+    src/c_api/c_api_ndarray.cc:248-430) becomes: unbox args → registered
+    JAX fn (eager, per-primitive compile cache ≙ CuDNNAlgoReg) → box.
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+import struct
+import sys
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ops.registry import OP_REGISTRY, get_op
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "concatenate", "load", "save", "waitall", "imresize", "onehot_encode"]
+
+_DTYPE_ALIASES = {None: jnp.float32}
+
+
+def _as_jax(value, dtype=None):
+    if isinstance(value, NDArray):
+        return value.data
+    if isinstance(value, jax.Array):
+        return value
+    return jnp.asarray(value, dtype=dtype)
+
+
+class NDArray:
+    """Multi-dimensional array on a device (parity: python/mxnet/ndarray.py NDArray)."""
+
+    __slots__ = ("_data", "_ctx", "_parent", "_index", "writable")
+
+    def __init__(self, data, ctx=None, _parent=None, _index=None):
+        self._parent = _parent
+        self._index = _index
+        self._ctx = ctx if ctx is not None else current_context()
+        self._data = data
+        self.writable = True
+
+    # ------------------------------------------------------------------
+    # payload access
+    # ------------------------------------------------------------------
+    @property
+    def data(self):
+        """The underlying jax.Array (lazy slice of parent for views)."""
+        if self._parent is not None:
+            return self._parent.data[self._index]
+        return self._data
+
+    def _set_data(self, value):
+        if self._parent is not None:
+            self._parent._set_data(self._parent.data.at[self._index].set(value))
+        else:
+            self._data = value
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def size(self):
+        return int(self.data.size)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def dtype(self):
+        return _np.dtype(self.data.dtype)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    @property
+    def ctx(self):
+        return self._ctx
+
+    @property
+    def T(self):
+        return NDArray(self.data.T, self._ctx)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        return "<NDArray %s @%s>" % ("x".join(map(str, self.shape)), self._ctx)
+
+    def __str__(self):
+        return str(self.asnumpy())
+
+    # ------------------------------------------------------------------
+    # host transfer / sync (reference WaitToRead / asnumpy)
+    # ------------------------------------------------------------------
+    def asnumpy(self):
+        return _np.asarray(self.data)
+
+    def asscalar(self):
+        return self.asnumpy().reshape(()).item()
+
+    def wait_to_read(self):
+        d = self.data
+        if hasattr(d, "block_until_ready"):
+            d.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    # ------------------------------------------------------------------
+    # conversion / copies
+    # ------------------------------------------------------------------
+    def astype(self, dtype):
+        return NDArray(self.data.astype(jnp.dtype(dtype)), self._ctx)
+
+    def copy(self):
+        return NDArray(self.data + 0, self._ctx)
+
+    def copyto(self, other):
+        """Copy into an NDArray or to a Context (reference ndarray.h CopyFromTo)."""
+        if isinstance(other, NDArray):
+            other[:] = self
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self.data, other.jax_device()), other)
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, ctx):
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    def reshape(self, shape, **kwargs):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return NDArray(jnp.reshape(self.data, tuple(shape)), self._ctx)
+
+    def broadcast_to(self, shape):
+        return NDArray(jnp.broadcast_to(self.data, tuple(shape)), self._ctx)
+
+    # ------------------------------------------------------------------
+    # views (reference Slice/At are zero-copy aliases; here write-through)
+    # ------------------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key.data.astype(jnp.int32)
+            return NDArray(self.data[key], self._ctx)
+        return NDArray(None, self._ctx, _parent=self, _index=key)
+
+    def __setitem__(self, key, value):
+        val = _as_jax(value, dtype=self.dtype)
+        # NOTE: builtins.slice — the registry populates a module-level `slice`
+        # op function in this namespace, which would shadow the builtin here.
+        if isinstance(key, builtins.slice) and key == builtins.slice(None):
+            base = self.data
+            self._set_data(jnp.broadcast_to(val, base.shape).astype(base.dtype))
+        else:
+            if isinstance(key, NDArray):
+                key = key.data.astype(jnp.int32)
+            self._set_data(self.data.at[key].set(val))
+
+    def slice(self, start, stop):
+        return self[start:stop]
+
+    def at(self, idx):
+        return self[idx]
+
+    # ------------------------------------------------------------------
+    # arithmetic — dispatches through the op registry so imperative and
+    # symbolic share one definition (SURVEY.md §7 phase 2)
+    # ------------------------------------------------------------------
+    def _binary(self, other, op_name, scalar_name, reverse=False):
+        if isinstance(other, NDArray) or isinstance(other, jax.Array):
+            lhs, rhs = self.data, _as_jax(other)
+            if reverse:
+                lhs, rhs = rhs, lhs
+            return NDArray(get_op(op_name).fn(lhs, rhs), self._ctx)
+        return NDArray(get_op(scalar_name).fn(self.data, scalar=float(other)), self._ctx)
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, NDArray):
+            return o.__sub__(self)
+        return NDArray(get_op("_rminus_scalar").fn(self.data, scalar=float(o)), self._ctx)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, o):
+        if isinstance(o, NDArray):
+            return o.__truediv__(self)
+        return NDArray(get_op("_rdiv_scalar").fn(self.data, scalar=float(o)), self._ctx)
+
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        return self._binary(o, "_mod", "_mod_scalar")
+
+    def __pow__(self, o):
+        return self._binary(o, "_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return NDArray(get_op("_rpower_scalar").fn(self.data, scalar=float(o)), self._ctx)
+
+    def __neg__(self):
+        return NDArray(-self.data, self._ctx)
+
+    def __iadd__(self, o):
+        self._set_data((self + o).data.astype(self.dtype))
+        return self
+
+    def __isub__(self, o):
+        self._set_data((self - o).data.astype(self.dtype))
+        return self
+
+    def __imul__(self, o):
+        self._set_data((self * o).data.astype(self.dtype))
+        return self
+
+    def __itruediv__(self, o):
+        self._set_data((self / o).data.astype(self.dtype))
+        return self
+
+    __idiv__ = __itruediv__
+
+    def __eq__(self, o):
+        return self._binary(o, "_equal", "_equal_scalar") if o is not None else False
+
+    def __ne__(self, o):
+        return self._binary(o, "_not_equal", "_not_equal_scalar") if o is not None else True
+
+    def __gt__(self, o):
+        return self._binary(o, "_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx": (self._ctx.device_type, self._ctx.device_id)}
+
+    def __setstate__(self, state):
+        self._parent = None
+        self._index = None
+        self._ctx = Context(*state["ctx"])
+        self._data = jnp.asarray(state["data"])
+        self.writable = True
+
+    # convenience reductions mirroring generated methods
+    def sum(self, axis=None, keepdims=False):
+        return NDArray(jnp.sum(self.data, axis=axis, keepdims=keepdims), self._ctx)
+
+    def mean(self, axis=None, keepdims=False):
+        return NDArray(jnp.mean(self.data, axis=axis, keepdims=keepdims), self._ctx)
+
+    def max(self, axis=None, keepdims=False):
+        return NDArray(jnp.max(self.data, axis=axis, keepdims=keepdims), self._ctx)
+
+    def min(self, axis=None, keepdims=False):
+        return NDArray(jnp.min(self.data, axis=axis, keepdims=keepdims), self._ctx)
+
+    def abs(self):
+        return NDArray(jnp.abs(self.data), self._ctx)
+
+    def flatten(self):
+        return NDArray(self.data.reshape((self.shape[0], -1)), self._ctx)
+
+    def expand_dims(self, axis):
+        return NDArray(jnp.expand_dims(self.data, axis), self._ctx)
+
+    def transpose(self, axes=None):
+        return NDArray(jnp.transpose(self.data, axes), self._ctx)
+
+    def argmax(self, axis=None):
+        return NDArray(jnp.argmax(self.data, axis=axis).astype(jnp.float32), self._ctx)
+
+
+# ----------------------------------------------------------------------
+# creation routines (parity: python/mxnet/ndarray.py module functions)
+# ----------------------------------------------------------------------
+
+
+# NOTE on placement: creation returns UNCOMMITTED jax arrays — XLA places
+# them on the default device and freely co-locates with other operands.
+# Committing every array to its Context's device (the reference model,
+# where NDArray memory is physically on ctx) would poison mixed-context
+# arithmetic under JAX's committed-device rules.  Explicit placement
+# happens in exactly two places: Executor mesh shardings and copyto().
+
+
+def array(source_array, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(source_array, NDArray):
+        source_array = source_array.data
+    if dtype is None and not isinstance(source_array, (_np.ndarray, jax.Array)):
+        dtype = "float32"  # parity: python lists default to float32
+    arr = jnp.asarray(source_array, dtype=jnp.dtype(dtype) if dtype else None)
+    if arr.dtype == jnp.float64:
+        arr = arr.astype(jnp.float32)
+    return NDArray(arr, ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def _norm_shape(shape):
+    return shape if isinstance(shape, tuple) else (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def zeros(shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    return NDArray(jnp.zeros(_norm_shape(shape), dtype=jnp.dtype(dtype) if dtype else jnp.float32), ctx)
+
+
+def ones(shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    return NDArray(jnp.ones(_norm_shape(shape), dtype=jnp.dtype(dtype) if dtype else jnp.float32), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    return NDArray(jnp.full(_norm_shape(shape), val, dtype=jnp.dtype(dtype) if dtype else jnp.float32), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    arr = get_op("_arange").fn(start=start, stop=stop, step=step, repeat=repeat,
+                               dtype=dtype or "float32")
+    ctx = ctx or current_context()
+    return NDArray(arr, ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return NDArray(jnp.concatenate([a.data for a in arrays], axis=axis), arrays[0].ctx)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    out[:] = NDArray(jax.nn.one_hot(indices.data.astype(jnp.int32), depth), indices.ctx)
+    return out
+
+
+def imresize(src, w, h, interp=1):
+    out = jax.image.resize(src.data, (h, w) + src.shape[2:], method="bilinear" if interp else "nearest")
+    return NDArray(out, src.ctx)
+
+
+def waitall():
+    """Block until all async computation completes (reference Engine::WaitForAll)."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+# ----------------------------------------------------------------------
+# serialization (parity: mx.nd.save/load → reference src/c_api/c_api.cc:218-271;
+# format here is a self-describing container, not the reference binary ABI)
+# ----------------------------------------------------------------------
+
+_SAVE_MAGIC = b"MXTPU001"
+
+
+def save(fname, data):
+    """Save list or dict of NDArray (parity: python/mxnet/ndarray.py save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        arrays = [data[k] for k in keys]
+    else:
+        keys = None
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(_SAVE_MAGIC)
+        f.write(struct.pack("<q", len(arrays)))
+        f.write(struct.pack("<q", 1 if keys is not None else 0))
+        for i, arr in enumerate(arrays):
+            name = (keys[i] if keys is not None else "").encode()
+            f.write(struct.pack("<q", len(name)))
+            f.write(name)
+            np_arr = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
+            dt = np_arr.dtype.str.encode()
+            f.write(struct.pack("<q", len(dt)))
+            f.write(dt)
+            f.write(struct.pack("<q", np_arr.ndim))
+            for d in np_arr.shape:
+                f.write(struct.pack("<q", d))
+            raw = np_arr.tobytes()
+            f.write(struct.pack("<q", len(raw)))
+            f.write(raw)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save`."""
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _SAVE_MAGIC:
+            raise MXNetError("Invalid NDArray file format: " + fname)
+        (num,) = struct.unpack("<q", f.read(8))
+        (has_keys,) = struct.unpack("<q", f.read(8))
+        keys, arrays = [], []
+        for _ in range(num):
+            (nlen,) = struct.unpack("<q", f.read(8))
+            keys.append(f.read(nlen).decode())
+            (dlen,) = struct.unpack("<q", f.read(8))
+            dt = _np.dtype(f.read(dlen).decode())
+            (ndim,) = struct.unpack("<q", f.read(8))
+            shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+            (rlen,) = struct.unpack("<q", f.read(8))
+            np_arr = _np.frombuffer(f.read(rlen), dtype=dt).reshape(shape)
+            arrays.append(array(np_arr))
+    if has_keys:
+        return dict(zip(keys, arrays))
+    return arrays
+
+
+# ----------------------------------------------------------------------
+# generated op namespace (parity: reference codegen ndarray.py:2362-2514
+# `_make_ndarray_function` — here generated from the registry at import)
+# ----------------------------------------------------------------------
+
+
+def _make_nd_function(op):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)  # accepted for symbol-compat call sites
+        ctx = kwargs.pop("ctx", None)
+        jax_args = [_as_jax(a) for a in args]
+        res_ctx = None
+        for a in args:
+            if isinstance(a, NDArray):
+                res_ctx = a.ctx
+                break
+        res_ctx = ctx or res_ctx or current_context()
+        result = op.fn(*jax_args, **kwargs)
+        n_main = op.num_outputs(kwargs) if callable(op.num_outputs) else op.num_outputs
+        if isinstance(result, tuple):
+            main = result[: len(result) - op.num_aux_out] if op.num_aux_out else result
+            boxed = tuple(NDArray(r, res_ctx) for r in main)
+            if len(boxed) == 1:
+                boxed = boxed[0]
+        else:
+            boxed = NDArray(result, res_ctx)
+        if out is not None:
+            if isinstance(boxed, tuple):
+                for o, b in zip(out if isinstance(out, (list, tuple)) else [out], boxed):
+                    o[:] = b
+            else:
+                out[:] = boxed
+            return out
+        return boxed
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def _populate(module):
+    seen = {}
+    for name, op in OP_REGISTRY.items():
+        if id(op) not in seen:
+            seen[id(op)] = _make_nd_function(op)
+        public = name
+        if not hasattr(module, public):
+            setattr(module, public, seen[id(op)])
+
+
+_populate(sys.modules[__name__])

@@ -1,0 +1,155 @@
+"""Custom python operators (parity: reference python/mxnet/operator.py:19-855 +
+src/operator/custom/custom-inl.h).
+
+TPU-native design: the reference calls python back on a dedicated worker
+thread per op execution (custom-inl.h:48-70).  Here a CustomOp's python
+`forward`/`backward` run ONCE at trace time — their NDArray math is traced
+into the same XLA executable as the rest of the graph, so custom ops cost
+nothing at step time as long as they are expressed in `mx.nd` ops.
+`backward` is wired in via `jax.custom_vjp`.  (NumPy-computing custom ops
+work on the imperative path, where values are concrete.)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ops.registry import Op, OP_REGISTRY
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+
+class CustomOp:
+    """Base class for custom python operators (parity: operator.py CustomOp:396)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring OpReqType (parity: operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Declares shapes/types/deps of a custom op (parity: operator.py CustomOpProp:442)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_CUSTOM_REGISTRY = {}
+
+
+def register(reg_name):
+    """Register a CustomOpProp class under `reg_name`
+    (parity: mx.operator.register:576)."""
+
+    def do_register(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+
+        def op_fn(*inputs, **attrs):
+            kwargs = {k: v for k, v in attrs.items() if k not in ("is_train", "rng")}
+            is_train = attrs.get("is_train", False)
+            prop = prop_cls(**{k: str(v) for k, v in kwargs.items()})
+            n_out = len(prop.list_outputs())
+            in_shapes = [tuple(x.shape) for x in inputs]
+            _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+            cop = prop.create_operator(None, in_shapes, ["float32"] * len(inputs))
+
+            @jax.custom_vjp
+            def f(*xs):
+                return _run_fwd(cop, xs, out_shapes, is_train)
+
+            def f_fwd(*xs):
+                outs = _run_fwd(cop, xs, out_shapes, is_train)
+                return outs, (xs, outs)
+
+            def f_bwd(res, gs):
+                xs, outs = res
+                in_data = [NDArray(x) for x in xs]
+                out_data = [NDArray(o) for o in (outs if isinstance(outs, tuple) else (outs,))]
+                out_grad = [NDArray(g) for g in (gs if isinstance(gs, tuple) else (gs,))]
+                in_grad = [NDArray(jnp.zeros_like(x)) for x in xs]
+                cop.backward(["write"] * len(in_grad), out_grad, in_data, out_data, in_grad, [])
+                return tuple(g.data for g in in_grad)
+
+            f.defvjp(f_fwd, f_bwd)
+            return f(*inputs)
+
+        def _run_fwd(cop, xs, out_shapes, is_train):
+            in_data = [NDArray(x) for x in xs]
+            out_data = [NDArray(jnp.zeros(s, dtype=xs[0].dtype if xs else jnp.float32))
+                        for s in out_shapes]
+            cop.forward(is_train, ["write"] * len(out_data), in_data, out_data, [])
+            outs = tuple(o.data for o in out_data)
+            return outs if len(outs) > 1 else outs[0]
+
+        dummy = prop_cls()
+        OP_REGISTRY["Custom:" + reg_name] = Op(
+            "Custom:" + reg_name, op_fn, inputs=tuple(dummy.list_arguments()),
+            num_outputs=len(dummy.list_outputs()), need_is_train=True,
+            doc="Custom op %s" % reg_name,
+        )
+        # refresh generated namespaces so mx.nd/<sym> see the new op
+        from . import ndarray as _nd_mod
+        from . import symbol as _sym_mod
+
+        _nd_mod._populate(_nd_mod)
+        _sym_mod._populate(_sym_mod.__name__)
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    return list(_CUSTOM_REGISTRY.keys())
+
+
+def Custom(*args, op_type=None, **kwargs):
+    """Invoke a registered custom op by op_type (parity: mx.nd.Custom / mx.sym.Custom)."""
+    if op_type is None or ("Custom:" + op_type) not in OP_REGISTRY:
+        raise MXNetError("Custom op %s not registered" % op_type)
+    from .symbol import Symbol, _create
+
+    if args and isinstance(args[0], Symbol):
+        return _create("Custom:" + op_type, list(args), kwargs)
+    op = OP_REGISTRY["Custom:" + op_type]
+    from .ndarray import _make_nd_function
+
+    return _make_nd_function(op)(*args, **kwargs)

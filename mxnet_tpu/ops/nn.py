@@ -1,0 +1,738 @@
+"""Neural-network layer operators.
+
+TPU-native equivalents of the reference's legacy layer ops
+(reference src/operator/*-inl.h, SURVEY.md §2 ⚙10) and nn primitives
+(src/operator/nn/).  Where the reference hand-writes im2col/cuDNN calls,
+here each layer is a pure JAX function: XLA lowers convolutions and
+matmuls onto the MXU, fuses the elementwise epilogues, and plans memory —
+the roles of mshadow + cuDNN + PlanMemory collapse into the compiler.
+
+Loss-style ops (SoftmaxOutput, *RegressionOutput, MakeLoss, SVMOutput)
+reproduce the reference semantics of *ignoring the incoming head gradient*
+(reference src/operator/softmax_output-inl.h backward writes (p - label)
+directly) via `jax.custom_vjp`.
+
+Layout: NCHW / OIHW, matching the reference default so model code ports
+unmodified.  XLA relayouts internally for the TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .tensor import _axis, _bool, _dtype, _lit, _shape
+
+# ----------------------------------------------------------------------
+# helpers
+# ----------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    v = _shape(v)
+    if v is None or v == ():
+        return (1,) * n if n else ()
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _loss_vjp(fwd_fn, grad_fn):
+    """Build a loss op whose backward ignores head gradients.
+
+    Parity: reference loss layers write their gradient directly into
+    in_grad regardless of out_grad (e.g. src/operator/softmax_output-inl.h).
+    """
+
+    def op_fn(data, label, **attrs):
+        @jax.custom_vjp
+        def f(d, l):
+            return fwd_fn(d, l, attrs)
+
+        def f_fwd(d, l):
+            out = fwd_fn(d, l, attrs)
+            return out, (d, l, out)
+
+        def f_bwd(res, g):
+            d, l, out = res
+            return grad_fn(d, l, out, attrs), jnp.zeros_like(l)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(data, label)
+
+    return op_fn
+
+
+# ----------------------------------------------------------------------
+# FullyConnected (reference src/operator/fully_connected-inl.h:55-87:
+# out = dot(data, W.T) + bias — one MXU matmul + fused bias add)
+# ----------------------------------------------------------------------
+
+
+def _infer_fc(in_shapes, attrs):
+    data = in_shapes[0]
+    num_hidden = int(_lit(attrs["num_hidden"]))
+    no_bias = _bool(attrs.get("no_bias", False))
+    flatten = _bool(attrs.get("flatten", True))
+    if flatten:
+        in_dim = 1
+        for d in data[1:]:
+            in_dim *= d
+        out = (data[0], num_hidden)
+    else:
+        in_dim = data[-1]
+        out = tuple(data[:-1]) + (num_hidden,)
+    shapes = [data, (num_hidden, in_dim)]
+    if not no_bias:
+        shapes.append((num_hidden,))
+    return shapes, [out]
+
+
+@register(
+    "FullyConnected",
+    inputs=("data", "weight", "bias"),
+    infer_shape=_infer_fc,
+)
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True, **kw):
+    if _bool(flatten):
+        data = data.reshape((data.shape[0], -1))
+    out = jnp.dot(data, weight.T)
+    if bias is not None and not _bool(no_bias):
+        out = out + bias
+    return out
+
+
+# ----------------------------------------------------------------------
+# Convolution / Deconvolution (reference src/operator/convolution-inl.h)
+# ----------------------------------------------------------------------
+
+
+def _conv_out_dim(x, k, s, p, d):
+    return (x + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def _infer_conv(in_shapes, attrs):
+    data = in_shapes[0]
+    kernel = _shape(attrs["kernel"])
+    nf = int(_lit(attrs["num_filter"]))
+    stride = _pair(attrs.get("stride"), len(kernel))
+    pad = _pair(attrs.get("pad", (0,) * len(kernel)), len(kernel))
+    if _shape(attrs.get("pad")) is None:
+        pad = (0,) * len(kernel)
+    dilate = _pair(attrs.get("dilate"), len(kernel))
+    groups = int(_lit(attrs.get("num_group", 1)))
+    no_bias = _bool(attrs.get("no_bias", False))
+    wshape = (nf, data[1] // groups) + kernel
+    spatial = tuple(
+        _conv_out_dim(data[2 + i], kernel[i], stride[i], pad[i], dilate[i]) for i in range(len(kernel))
+    )
+    out = (data[0], nf) + spatial
+    shapes = [data, wshape]
+    if not no_bias:
+        shapes.append((nf,))
+    return shapes, [out]
+
+
+@register("Convolution", inputs=("data", "weight", "bias"), infer_shape=_infer_conv)
+def convolution(
+    data,
+    weight,
+    bias=None,
+    kernel=None,
+    num_filter=None,
+    stride=None,
+    pad=None,
+    dilate=None,
+    num_group=1,
+    no_bias=False,
+    **kw,
+):
+    """N-d convolution on the MXU (reference src/operator/convolution-inl.h).
+
+    The reference lowers to im2col+gemm or cuDNN; here a single
+    `lax.conv_general_dilated` lets XLA tile directly onto the systolic array.
+    """
+    kernel = _shape(kernel)
+    n = len(kernel)
+    stride = _pair(stride, n)
+    dilate = _pair(dilate, n)
+    p = _shape(pad) or (0,) * n
+    pairs = [(int(x), int(x)) for x in p]
+    spatial = "".join("DHW"[3 - n + i] for i in range(n)) if n <= 3 else None
+    dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    out = lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=pairs,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(_lit(num_group)),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None,
+    )
+    if bias is not None and not _bool(no_bias):
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def _infer_deconv(in_shapes, attrs):
+    data = in_shapes[0]
+    kernel = _shape(attrs["kernel"])
+    nf = int(_lit(attrs["num_filter"]))
+    n = len(kernel)
+    stride = _pair(attrs.get("stride"), n)
+    pad = _shape(attrs.get("pad")) or (0,) * n
+    adj = _shape(attrs.get("adj")) or (0,) * n
+    no_bias = _bool(attrs.get("no_bias", True))
+    groups = int(_lit(attrs.get("num_group", 1)))
+    wshape = (data[1], nf // groups) + kernel
+    spatial = tuple(
+        stride[i] * (data[2 + i] - 1) + kernel[i] - 2 * pad[i] + adj[i] for i in range(n)
+    )
+    out = (data[0], nf) + spatial
+    shapes = [data, wshape]
+    if not no_bias:
+        shapes.append((nf,))
+    return shapes, [out]
+
+
+@register("Deconvolution", inputs=("data", "weight", "bias"), infer_shape=_infer_deconv)
+def deconvolution(
+    data, weight, bias=None, kernel=None, num_filter=None, stride=None, pad=None, adj=None,
+    num_group=1, no_bias=True, **kw
+):
+    """Transposed convolution (reference src/operator/deconvolution-inl.h)."""
+    kernel = _shape(kernel)
+    n = len(kernel)
+    stride = _pair(stride, n)
+    p = _shape(pad) or (0,) * n
+    spatial = "".join("DHW"[3 - n + i] for i in range(n))
+    dn = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+    pairs = [(kernel[i] - 1 - p[i], kernel[i] - 1 - p[i]) for i in range(n)]
+    out = lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=(1,) * n,
+        padding=pairs,
+        lhs_dilation=stride,
+        dimension_numbers=dn,
+        feature_group_count=int(_lit(num_group)),
+    )
+    if bias is not None and not _bool(no_bias):
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+# ----------------------------------------------------------------------
+# Pooling (reference src/operator/pooling-inl.h + src/operator/nn/pool.h)
+# ----------------------------------------------------------------------
+
+
+def _pool_out_dim(x, k, s, p, convention):
+    if convention == "full":
+        return -((x + 2 * p - k) // -s) + 1  # ceil
+    return (x + 2 * p - k) // s + 1
+
+
+def _infer_pool(in_shapes, attrs):
+    data = in_shapes[0]
+    if _bool(attrs.get("global_pool", False)):
+        return [data], [tuple(data[:2]) + (1,) * (len(data) - 2)]
+    kernel = _shape(attrs["kernel"])
+    n = len(kernel)
+    stride = _pair(attrs.get("stride"), n)
+    pad = _shape(attrs.get("pad")) or (0,) * n
+    conv = str(attrs.get("pooling_convention", "valid"))
+    spatial = tuple(_pool_out_dim(data[2 + i], kernel[i], stride[i], pad[i], conv) for i in range(n))
+    return [data], [tuple(data[:2]) + spatial]
+
+
+@register("Pooling", infer_shape=_infer_pool, aliases=("Pooling_v1",))
+def pooling(
+    data, kernel=None, pool_type="max", stride=None, pad=None, global_pool=False,
+    pooling_convention="valid", **kw
+):
+    """Max/avg/sum pooling via XLA reduce_window (reference src/operator/nn/pool.h)."""
+    nd = data.ndim - 2
+    if _bool(global_pool):
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = _shape(kernel)
+        stride = _pair(stride, nd)
+        pad = _shape(pad) or (0,) * nd
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    pt = str(pool_type)
+    if pt == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(data, init, lax.max, window, strides, pads)
+    elif pt in ("avg", "sum"):
+        out = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pt == "avg":
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            out = out / denom
+    else:
+        raise ValueError("unsupported pool_type %s" % pt)
+    return out
+
+
+# ----------------------------------------------------------------------
+# BatchNorm (reference src/operator/batch_norm-inl.h) — aux moving stats
+# returned as extra outputs and threaded back by the executor.
+# ----------------------------------------------------------------------
+
+
+def _infer_bn(in_shapes, attrs):
+    data = in_shapes[0]
+    axis = int(_lit(attrs.get("axis", 1)))
+    c = (data[axis],)
+    return [data, c, c], [data], [c, c]
+
+
+@register(
+    "BatchNorm",
+    inputs=("data", "gamma", "beta"),
+    aux=("moving_mean", "moving_var"),
+    infer_shape=_infer_bn,
+    need_is_train=True,
+    num_aux_out=2,
+    aliases=("BatchNorm_v1",),
+)
+def batch_norm(
+    data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9, fix_gamma=True,
+    use_global_stats=False, axis=1, is_train=False, **kw
+):
+    """Batch normalization (reference src/operator/batch_norm-inl.h).
+
+    Training: normalize with batch stats, update moving stats; returns
+    (out, new_moving_mean, new_moving_var).  fix_gamma pins gamma to 1
+    (reference batch_norm-inl.h fix_gamma handling).
+    """
+    eps = float(_lit(eps))
+    momentum = float(_lit(momentum))
+    ax = int(_lit(axis))
+    reduce_axes = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    bshape = tuple(bshape)
+    if _bool(fix_gamma):
+        gamma = jnp.ones_like(gamma)
+    if is_train and not _bool(use_global_stats):
+        mean = jnp.mean(data, axis=reduce_axes)
+        var = jnp.var(data, axis=reduce_axes)
+        new_mm = moving_mean * momentum + lax.stop_gradient(mean) * (1 - momentum)
+        new_mv = moving_var * momentum + lax.stop_gradient(var) * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out, new_mm, new_mv
+
+
+def _infer_in(in_shapes, attrs):
+    data = in_shapes[0]
+    c = (data[1],)
+    return [data, c, c], [data]
+
+
+@register("InstanceNorm", inputs=("data", "gamma", "beta"), infer_shape=_infer_in)
+def instance_norm(data, gamma, beta, eps=1e-3, **kw):
+    """Instance norm (reference src/operator/instance_norm-inl.h)."""
+    eps = float(_lit(eps))
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance", **kw):
+    """L2 normalization (reference src/operator/l2_normalization-inl.h)."""
+    eps = float(_lit(eps))
+    mode = str(mode)
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    elif mode == "channel":
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("LRN")
+def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0, **kw):
+    """Local response norm across channels (reference src/operator/lrn-inl.h)."""
+    nsize = int(_lit(nsize))
+    alpha, beta, knorm = float(_lit(alpha)), float(_lit(beta)), float(_lit(knorm))
+    sq = jnp.square(data)
+    half = nsize // 2
+    summed = lax.reduce_window(
+        sq, 0.0, lax.add, (1, nsize, 1, 1), (1, 1, 1, 1), ((0, 0), (half, half), (0, 0), (0, 0))
+    )
+    return data * jnp.power(knorm + alpha / nsize * summed, -beta)
+
+
+# ----------------------------------------------------------------------
+# activations
+# ----------------------------------------------------------------------
+
+
+@register("Activation")
+def activation(data, act_type="relu", **kw):
+    """Activation (reference src/operator/activation-inl.h)."""
+    act = str(act_type)
+    if act == "relu":
+        return jax.nn.relu(data)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act == "tanh":
+        return jnp.tanh(data)
+    if act == "softrelu":
+        return jax.nn.softplus(data)
+    if act == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %s" % act)
+
+
+def _infer_leaky(in_shapes, attrs):
+    data = in_shapes[0]
+    if str(attrs.get("act_type", "leaky")) == "prelu":
+        return [data, (data[1],)], [data]
+    return [data], [data]
+
+
+@register("LeakyReLU", inputs=("data", "gamma"), infer_shape=_infer_leaky)
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334, **kw):
+    """Leaky family (reference src/operator/leaky_relu-inl.h)."""
+    act = str(act_type)
+    if act == "leaky":
+        return jnp.where(data > 0, data, float(_lit(slope)) * data)
+    if act == "elu":
+        s = float(_lit(slope))
+        return jnp.where(data > 0, data, s * (jnp.exp(data) - 1.0))
+    if act == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act == "rrelu":
+        s = (float(_lit(lower_bound)) + float(_lit(upper_bound))) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError("unknown act_type %s" % act)
+
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, **kw):
+    t = _lit(temperature)
+    if t:
+        data = data / float(t)
+    return jax.nn.softmax(data, axis=_axis(axis, -1))
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, **kw):
+    return jax.nn.log_softmax(data, axis=_axis(axis, -1))
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance", **kw):
+    if str(mode) == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape((data.shape[0], -1)), axis=-1).reshape(data.shape)
+
+
+# ----------------------------------------------------------------------
+# Dropout (reference src/operator/dropout-inl.h) — rng threaded by executor
+# ----------------------------------------------------------------------
+
+
+@register("Dropout", need_is_train=True, need_rng=True)
+def dropout(data, p=0.5, mode="training", is_train=False, rng=None, **kw):
+    p = float(_lit(p))
+    if (not is_train and str(mode) != "always") or p <= 0.0 or rng is None:
+        return data
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, data.shape)
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+# ----------------------------------------------------------------------
+# Embedding (reference src/operator/tensor/indexing_op.h Embedding)
+# ----------------------------------------------------------------------
+
+
+def _infer_embed(in_shapes, attrs):
+    data = in_shapes[0]
+    idim = int(_lit(attrs["input_dim"]))
+    odim = int(_lit(attrs["output_dim"]))
+    return [data, (idim, odim)], [tuple(data) + (odim,)]
+
+
+@register("Embedding", inputs=("data", "weight"), infer_shape=_infer_embed)
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", **kw):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ----------------------------------------------------------------------
+# loss output layers — backward ignores head gradients (reference
+# src/operator/softmax_output-inl.h, regression_output-inl.h,
+# svm_output-inl.h, make_loss-inl.h)
+# ----------------------------------------------------------------------
+
+
+def _softmax_fwd(data, label, attrs):
+    if _bool(attrs.get("multi_output", False)):
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_bwd(data, label, out, attrs):
+    grad_scale = float(_lit(attrs.get("grad_scale", 1.0)))
+    use_ignore = _bool(attrs.get("use_ignore", False))
+    ignore_label = float(_lit(attrs.get("ignore_label", -1)))
+    normalization = str(attrs.get("normalization", "null"))
+    multi_output = _bool(attrs.get("multi_output", False))
+    cls_axis = 1 if multi_output else -1
+    num_cls = data.shape[cls_axis]
+    if label.ndim == out.ndim:
+        onehot = label
+        valid = jnp.ones(label.shape[:1], dtype=data.dtype)
+    else:
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, num_cls, dtype=data.dtype, axis=cls_axis)
+        valid = jnp.ones_like(label, dtype=data.dtype)
+        if use_ignore:
+            keep = (label != ignore_label).astype(data.dtype)
+            onehot = onehot * jnp.expand_dims(keep, cls_axis)
+            gmask = jnp.expand_dims(keep, cls_axis)
+            valid = keep
+        else:
+            gmask = 1.0
+    grad = out - onehot
+    if use_ignore and label.ndim != out.ndim:
+        grad = grad * gmask
+    if normalization == "batch":
+        grad = grad / data.shape[0]
+    elif normalization == "valid":
+        grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+    return grad * grad_scale
+
+
+def _infer_softmax_out(in_shapes, attrs):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None
+    if _bool(attrs.get("multi_output", False)):
+        label = (data[0],) + tuple(data[2:])
+    else:
+        label = tuple(data[:-1])
+    return [data, label], [data]
+
+
+def _infer_reg_out(in_shapes, attrs):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None
+    return [data, data], [data]
+
+
+def _infer_svm_out(in_shapes, attrs):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, None
+    return [data, tuple(data[:-1])], [data]
+
+
+@register("SoftmaxOutput", inputs=("data", "label"), aliases=("Softmax",),
+          infer_shape=_infer_softmax_out)
+def softmax_output(data, label, **attrs):
+    """Softmax with integrated CE gradient (reference src/operator/softmax_output-inl.h)."""
+    return _loss_vjp(_softmax_fwd, _softmax_bwd)(data, label, **attrs)
+
+
+@register("LinearRegressionOutput", inputs=("data", "label"), infer_shape=_infer_reg_out)
+def linear_regression_output(data, label, **attrs):
+    return _loss_vjp(
+        lambda d, l, a: d,
+        lambda d, l, out, a: (out - l.reshape(out.shape)) * float(_lit(a.get("grad_scale", 1.0))),
+    )(data, label, **attrs)
+
+
+@register("LogisticRegressionOutput", inputs=("data", "label"), infer_shape=_infer_reg_out)
+def logistic_regression_output(data, label, **attrs):
+    return _loss_vjp(
+        lambda d, l, a: jax.nn.sigmoid(d),
+        lambda d, l, out, a: (out - l.reshape(out.shape)) * float(_lit(a.get("grad_scale", 1.0))),
+    )(data, label, **attrs)
+
+
+@register("MAERegressionOutput", inputs=("data", "label"), infer_shape=_infer_reg_out)
+def mae_regression_output(data, label, **attrs):
+    return _loss_vjp(
+        lambda d, l, a: d,
+        lambda d, l, out, a: jnp.sign(out - l.reshape(out.shape)) * float(_lit(a.get("grad_scale", 1.0))),
+    )(data, label, **attrs)
+
+
+@register("SVMOutput", inputs=("data", "label"), infer_shape=_infer_svm_out)
+def svm_output(data, label, **attrs):
+    def bwd(d, l, out, a):
+        margin = float(_lit(a.get("margin", 1.0)))
+        reg = float(_lit(a.get("regularization_coefficient", 1.0)))
+        use_linear = _bool(a.get("use_linear", False))
+        lab = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, d.shape[-1], dtype=d.dtype)
+        score_true = jnp.sum(d * onehot, axis=-1, keepdims=True)
+        viol = (margin - (score_true - d)) > 0
+        viol = jnp.where(onehot > 0, False, viol)
+        if use_linear:
+            g = viol.astype(d.dtype)
+        else:
+            g = 2.0 * (margin - (score_true - d)) * viol.astype(d.dtype)
+        g = g - onehot * jnp.sum(g, axis=-1, keepdims=True)
+        return g * reg
+
+    return _loss_vjp(lambda d, l, a: d, bwd)(data, label, **attrs)
+
+
+@register("MakeLoss")
+def make_loss(data, grad_scale=1.0, normalization="null", valid_thresh=0.0, **attrs):
+    """Turn any symbol into a loss (reference src/operator/make_loss-inl.h)."""
+    gs = float(_lit(grad_scale))
+    norm = str(normalization)
+
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def f_fwd(d):
+        return d, d
+
+    def f_bwd(d, g):
+        grad = jnp.full_like(d, gs)
+        if norm == "batch":
+            grad = grad / d.shape[0]
+        elif norm == "valid":
+            grad = grad / jnp.maximum(jnp.sum((d > float(_lit(valid_thresh))).astype(d.dtype)), 1.0)
+        return (grad,)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data)
+
+
+# ----------------------------------------------------------------------
+# sequence ops (reference src/operator/sequence_{mask,last,reverse}-inl.h)
+# layout: (seq_len, batch, ...) as in the reference
+# ----------------------------------------------------------------------
+
+
+def _seq_len_mask(data, sequence_length, use_sequence_length):
+    T = data.shape[0]
+    if _bool(use_sequence_length) and sequence_length is not None:
+        return sequence_length
+    return None
+
+
+def _infer_seq(in_shapes, attrs):
+    data = in_shapes[0]
+    if _bool(attrs.get("use_sequence_length", False)):
+        return [data, (data[1],)], [data]
+    return [data], [data]
+
+
+@register("SequenceMask", inputs=("data", "sequence_length"), infer_shape=_infer_seq)
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0, **kw):
+    if not _bool(use_sequence_length) or sequence_length is None:
+        return data
+    ax = int(_lit(axis))
+    T = data.shape[ax]
+    steps = jnp.arange(T)
+    if ax == 0:
+        mask = steps[:, None] < sequence_length[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < sequence_length[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, float(_lit(value)))
+
+
+def _infer_seq_last(in_shapes, attrs):
+    data = in_shapes[0]
+    out = tuple(data[1:])
+    if _bool(attrs.get("use_sequence_length", False)):
+        return [data, (data[1],)], [out]
+    return [data], [out]
+
+
+@register("SequenceLast", inputs=("data", "sequence_length"), infer_shape=_infer_seq_last)
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    if not _bool(use_sequence_length) or sequence_length is None:
+        return data[-1]
+    idx = (sequence_length - 1).astype(jnp.int32)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+    )[0]
+
+
+@register("SequenceReverse", inputs=("data", "sequence_length"), infer_shape=_infer_seq)
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, **kw):
+    if not _bool(use_sequence_length) or sequence_length is None:
+        return jnp.flip(data, 0)
+    T = data.shape[0]
+    steps = jnp.arange(T)
+    rev_idx = sequence_length[None, :] - 1 - steps[:, None]
+    rev_idx = jnp.where(rev_idx >= 0, rev_idx, steps[:, None]).astype(jnp.int32)
+    return jnp.take_along_axis(data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+# ----------------------------------------------------------------------
+# spatial ops
+# ----------------------------------------------------------------------
+
+
+def _infer_upsampling(in_shapes, attrs):
+    data = in_shapes[0]
+    s = int(_lit(attrs.get("scale", 1)))
+    return [data], [tuple(data[:2]) + tuple(d * s for d in data[2:])]
+
+
+@register("UpSampling", variadic=True, infer_shape=_infer_upsampling)
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1, **kw):
+    """Nearest upsampling (reference src/operator/upsampling-inl.h)."""
+    data = args[0]
+    s = int(_lit(scale))
+    out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+    return out
+
+
+def _infer_crop(in_shapes, attrs):
+    data = in_shapes[0]
+    if len(in_shapes) > 1 and in_shapes[1] is not None:
+        ref = in_shapes[1]
+        return list(in_shapes), [tuple(data[:2]) + tuple(ref[2:])]
+    hw = _shape(attrs.get("h_w"))
+    return [data], [tuple(data[:2]) + tuple(hw)]
+
+
+@register("Crop", variadic=True, infer_shape=_infer_crop)
+def crop(*args, offset=(0, 0), h_w=(0, 0), num_args=1, center_crop=False, **kw):
+    """Crop to size (reference src/operator/crop-inl.h)."""
+    data = args[0]
+    if len(args) > 1:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = _shape(h_w)
+    if _bool(center_crop):
+        oy = (data.shape[2] - th) // 2
+        ox = (data.shape[3] - tw) // 2
+    else:
+        oy, ox = _shape(offset)
+    return data[:, :, oy : oy + th, ox : ox + tw]

@@ -1,0 +1,138 @@
+"""Random sampling operators.
+
+TPU-native equivalents of reference src/operator/random/sample_op.cc
+(uniform/normal/gamma/exponential/poisson/negative_binomial/
+generalized_negative_binomial) and multinomial
+(src/operator/random/multisample_op.cc).
+
+Design: JAX's counter-based PRNG replaces the reference's per-device
+mshadow `Random<xpu>` resource (reference src/resource.cc kRandom pools).
+A process-global key chain (`mxnet_tpu.random.seed`) feeds the imperative
+path; graph executors thread explicit keys so compiled training steps stay
+pure and reproducible (stateless RNG is the TPU-idiomatic design — no
+per-thread generator state to shard).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .tensor import _dtype, _lit, _shape
+
+
+class _RngState:
+    """Process-global key chain for imperative sampling."""
+
+    def __init__(self, seed=0):
+        self._lock = threading.Lock()
+        self._key = jax.random.key(seed)
+
+    def seed(self, seed):
+        with self._lock:
+            self._key = jax.random.key(seed)
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+GLOBAL_RNG = _RngState(0)
+
+
+def _key(rng):
+    return rng if rng is not None else GLOBAL_RNG.next_key()
+
+
+def _reg_sample(name, fn, aliases=()):
+    def impl(shape=None, dtype="float32", rng=None, **attrs):
+        return fn(_key(rng), _shape(shape) or (1,), _dtype(dtype) or jnp.float32, attrs)
+
+    register(name, inputs=(), need_rng=True, aliases=aliases)(impl)
+
+
+_reg_sample(
+    "_random_uniform",
+    lambda k, s, d, a: jax.random.uniform(
+        k, s, d, minval=float(_lit(a.get("low", 0.0))), maxval=float(_lit(a.get("high", 1.0)))
+    ),
+    aliases=("uniform", "random_uniform", "_sample_uniform"),
+)
+_reg_sample(
+    "_random_normal",
+    lambda k, s, d, a: jax.random.normal(k, s, d) * float(_lit(a.get("scale", 1.0)))
+    + float(_lit(a.get("loc", 0.0))),
+    aliases=("normal", "random_normal", "_sample_normal"),
+)
+_reg_sample(
+    "_random_gamma",
+    lambda k, s, d, a: jax.random.gamma(k, float(_lit(a.get("alpha", 1.0))), s, d)
+    * float(_lit(a.get("beta", 1.0))),
+    aliases=("random_gamma", "_sample_gamma"),
+)
+_reg_sample(
+    "_random_exponential",
+    lambda k, s, d, a: jax.random.exponential(k, s, d) / float(_lit(a.get("lam", 1.0))),
+    aliases=("random_exponential", "_sample_exponential"),
+)
+_reg_sample(
+    "_random_poisson",
+    lambda k, s, d, a: jax.random.poisson(k, float(_lit(a.get("lam", 1.0))), s).astype(d),
+    aliases=("random_poisson", "_sample_poisson"),
+)
+
+
+def _neg_binomial(k, s, d, a):
+    # NB(k_succ, p) sampled as Poisson(Gamma(k_succ, (1-p)/p))
+    k1, k2 = jax.random.split(k)
+    kk = float(_lit(a.get("k", 1)))
+    p = float(_lit(a.get("p", 1.0)))
+    lam = jax.random.gamma(k1, kk, s) * (1.0 - p) / max(p, 1e-12)
+    return jax.random.poisson(k2, lam, s).astype(d)
+
+
+_reg_sample("_random_negative_binomial", _neg_binomial, aliases=("random_negative_binomial",))
+
+
+def _gen_neg_binomial(k, s, d, a):
+    k1, k2 = jax.random.split(k)
+    mu = float(_lit(a.get("mu", 1.0)))
+    alpha = float(_lit(a.get("alpha", 1.0)))
+    r = 1.0 / max(alpha, 1e-12)
+    lam = jax.random.gamma(k1, r, s) * (mu * alpha)
+    return jax.random.poisson(k2, lam, s).astype(d)
+
+
+_reg_sample(
+    "_random_generalized_negative_binomial",
+    _gen_neg_binomial,
+    aliases=("random_generalized_negative_binomial",),
+)
+
+
+@register("_sample_multinomial", inputs=("data",), need_rng=True, aliases=("sample_multinomial",))
+def sample_multinomial(data, shape=None, get_prob=False, rng=None, dtype="int32", **kw):
+    """Sample class indices from probability rows
+    (reference src/operator/random/multisample_op.cc)."""
+    k = _key(rng)
+    n = _shape(shape)
+    num = 1
+    if n:
+        for d in n:
+            num *= d
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(k, logits, shape=(num,))
+        out = out.reshape(n) if n else out[0]
+    else:
+        out = jax.random.categorical(k, logits[:, None, :], axis=-1, shape=(data.shape[0], num))
+        out = out.reshape((data.shape[0],) + tuple(n)) if n else out[:, 0]
+    return out.astype(_dtype(dtype) or jnp.int32)
+
+
+@register("_shuffle", inputs=("data",), need_rng=True, aliases=("shuffle",))
+def shuffle(data, rng=None, **kw):
+    return jax.random.permutation(_key(rng), data, axis=0)

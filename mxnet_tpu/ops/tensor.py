@@ -1,0 +1,650 @@
+"""Tensor operator families.
+
+TPU-native equivalents of the reference's stateless NNVM tensor ops
+(reference src/operator/tensor/* — elemwise, broadcast/reduce, matrix,
+indexing, init, ordering; SURVEY.md §2 ⚙11).  Each op is a pure JAX
+function; XLA supplies fusion, tiling onto the MXU, and the GPU-side
+primitives the reference got from mshadow/cub.
+"""
+from __future__ import annotations
+
+import ast
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ----------------------------------------------------------------------
+# attr normalization helpers (attrs may arrive as strings from saved JSON,
+# parity: reference symbol JSON attrs are all strings)
+# ----------------------------------------------------------------------
+
+
+def _lit(v):
+    if isinstance(v, str):
+        try:
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def _shape(v):
+    v = _lit(v)
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,)
+    return tuple(int(x) for x in v)
+
+
+def _axis(v, default=None):
+    v = _lit(v)
+    if v is None or v == "None" or v == ():
+        return default
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return int(v)
+
+
+def _bool(v):
+    v = _lit(v)
+    if isinstance(v, str):
+        return v in ("True", "true", "1")
+    return bool(v)
+
+
+def _dtype(v):
+    if v is None:
+        return None
+    return jnp.dtype(v)
+
+
+# ----------------------------------------------------------------------
+# elementwise binary (+ broadcast variants: in this framework the plain
+# elemwise ops already broadcast, matching numpy; the broadcast_* names are
+# kept for source compatibility with reference src/operator/tensor/
+# elemwise_binary_broadcast_op_basic.cc)
+# ----------------------------------------------------------------------
+
+
+def _reg_binary(name, fn, aliases=()):
+    register(name, inputs=("lhs", "rhs"), aliases=aliases)(fn)
+
+
+_reg_binary("elemwise_add", lambda lhs, rhs: lhs + rhs, aliases=("_plus", "_Plus", "broadcast_add", "broadcast_plus"))
+_reg_binary("elemwise_sub", lambda lhs, rhs: lhs - rhs, aliases=("_minus", "_Minus", "broadcast_sub", "broadcast_minus"))
+_reg_binary("elemwise_mul", lambda lhs, rhs: lhs * rhs, aliases=("_mul", "_Mul", "broadcast_mul"))
+_reg_binary("elemwise_div", lambda lhs, rhs: lhs / rhs, aliases=("_div", "_Div", "broadcast_div"))
+_reg_binary("_power", lambda lhs, rhs: jnp.power(lhs, rhs), aliases=("_Power", "broadcast_power", "pow"))
+_reg_binary("_maximum", jnp.maximum, aliases=("_Maximum", "broadcast_maximum", "maximum"))
+_reg_binary("_minimum", jnp.minimum, aliases=("_Minimum", "broadcast_minimum", "minimum"))
+_reg_binary("_mod", jnp.mod, aliases=("broadcast_mod",))
+_reg_binary("_hypot", lambda lhs, rhs: jnp.hypot(lhs, rhs), aliases=("broadcast_hypot",))
+
+# comparison / logic (no gradient flows; match reference zero-grad behavior)
+for _n, _f in [
+    ("_equal", jnp.equal),
+    ("_not_equal", jnp.not_equal),
+    ("_greater", jnp.greater),
+    ("_greater_equal", jnp.greater_equal),
+    ("_lesser", jnp.less),
+    ("_lesser_equal", jnp.less_equal),
+]:
+    _cmp = (lambda f: lambda lhs, rhs: lax.stop_gradient(f(lhs, rhs).astype(jnp.result_type(lhs))))(_f)
+    _reg_binary(_n, _cmp, aliases=("broadcast" + _n, _n.lstrip("_")))
+
+# scalar variants (reference src/operator/tensor/elemwise_binary_scalar_op*)
+
+
+def _reg_scalar(name, fn, aliases=()):
+    register(name, inputs=("data",), aliases=aliases)(
+        (lambda f: lambda data, scalar=1.0, **kw: f(data, float(_lit(scalar))))(fn)
+    )
+
+
+_reg_scalar("_plus_scalar", lambda x, s: x + s, aliases=("_PlusScalar",))
+_reg_scalar("_minus_scalar", lambda x, s: x - s, aliases=("_MinusScalar",))
+_reg_scalar("_rminus_scalar", lambda x, s: s - x, aliases=("_RMinusScalar",))
+_reg_scalar("_mul_scalar", lambda x, s: x * s, aliases=("_MulScalar",))
+_reg_scalar("_div_scalar", lambda x, s: x / s, aliases=("_DivScalar",))
+_reg_scalar("_rdiv_scalar", lambda x, s: s / x, aliases=("_RDivScalar",))
+_reg_scalar("_power_scalar", lambda x, s: jnp.power(x, s), aliases=("_PowerScalar",))
+_reg_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x), aliases=("_RPowerScalar",))
+_reg_scalar("_maximum_scalar", lambda x, s: jnp.maximum(x, s), aliases=("_MaximumScalar",))
+_reg_scalar("_minimum_scalar", lambda x, s: jnp.minimum(x, s), aliases=("_MinimumScalar",))
+_reg_scalar("_mod_scalar", lambda x, s: jnp.mod(x, s))
+_reg_scalar("_equal_scalar", lambda x, s: lax.stop_gradient((x == s).astype(x.dtype)))
+_reg_scalar("_not_equal_scalar", lambda x, s: lax.stop_gradient((x != s).astype(x.dtype)))
+_reg_scalar("_greater_scalar", lambda x, s: lax.stop_gradient((x > s).astype(x.dtype)))
+_reg_scalar("_greater_equal_scalar", lambda x, s: lax.stop_gradient((x >= s).astype(x.dtype)))
+_reg_scalar("_lesser_scalar", lambda x, s: lax.stop_gradient((x < s).astype(x.dtype)))
+_reg_scalar("_lesser_equal_scalar", lambda x, s: lax.stop_gradient((x <= s).astype(x.dtype)))
+
+# ----------------------------------------------------------------------
+# elementwise unary (reference src/operator/tensor/elemwise_unary_op.cc)
+# ----------------------------------------------------------------------
+
+for _n, _f, _al in [
+    ("negative", jnp.negative, ("_np_negative",)),
+    ("abs", jnp.abs, ()),
+    ("sign", jnp.sign, ()),
+    ("round", jnp.round, ()),
+    ("rint", jnp.rint, ()),
+    ("ceil", jnp.ceil, ()),
+    ("floor", jnp.floor, ()),
+    ("trunc", jnp.trunc, ()),
+    ("fix", jnp.trunc, ()),
+    ("square", jnp.square, ()),
+    ("sqrt", jnp.sqrt, ()),
+    ("rsqrt", lambda x: lax.rsqrt(x), ()),
+    ("cbrt", jnp.cbrt, ()),
+    ("rcbrt", lambda x: 1.0 / jnp.cbrt(x), ()),
+    ("exp", jnp.exp, ()),
+    ("log", jnp.log, ()),
+    ("log10", jnp.log10, ()),
+    ("log2", jnp.log2, ()),
+    ("log1p", jnp.log1p, ()),
+    ("expm1", jnp.expm1, ()),
+    ("sin", jnp.sin, ()),
+    ("cos", jnp.cos, ()),
+    ("tan", jnp.tan, ()),
+    ("arcsin", jnp.arcsin, ()),
+    ("arccos", jnp.arccos, ()),
+    ("arctan", jnp.arctan, ()),
+    ("sinh", jnp.sinh, ()),
+    ("cosh", jnp.cosh, ()),
+    ("tanh", jnp.tanh, ()),
+    ("arcsinh", jnp.arcsinh, ()),
+    ("arccosh", jnp.arccosh, ()),
+    ("arctanh", jnp.arctanh, ()),
+    ("degrees", jnp.degrees, ()),
+    ("radians", jnp.radians, ()),
+    ("sigmoid", jax.nn.sigmoid, ()),
+    ("relu", jax.nn.relu, ()),
+    ("softsign", jax.nn.soft_sign, ()),
+    ("reciprocal", lambda x: 1.0 / x, ()),
+    ("gamma", lambda x: jnp.exp(lax.lgamma(x)), ()),
+    ("gammaln", lambda x: lax.lgamma(x), ()),
+    ("erf", lambda x: lax.erf(x), ()),
+]:
+    register(_n, inputs=("data",), aliases=_al)((lambda f: lambda data, **kw: f(data))(_f))
+
+
+@register("_copy", aliases=("identity",))
+def _copy(data, **kw):
+    return data
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data, **kw):
+    """Stop gradient flow (reference src/operator/tensor/elemwise_unary_op.cc BlockGrad)."""
+    return lax.stop_gradient(data)
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, dtype="float32", **kw):
+    return data.astype(_dtype(dtype))
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None, **kw):
+    return jnp.clip(data, _lit(a_min), _lit(a_max))
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0, **kw):
+    """Smooth L1 (reference src/operator/tensor/elemwise_unary_op.cc smooth_l1)."""
+    sigma2 = float(_lit(scalar)) ** 2
+    adata = jnp.abs(data)
+    return jnp.where(adata < 1.0 / sigma2, 0.5 * sigma2 * data * data, adata - 0.5 / sigma2)
+
+
+@register("add_n", variadic=True, aliases=("ElementWiseSum", "_sum"))
+def add_n(*args, **kw):
+    """Sum of N arrays (reference src/ndarray/ndarray.cc ElementwiseSum)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ----------------------------------------------------------------------
+# reductions (reference src/operator/tensor/broadcast_reduce_op_value.cc)
+# ----------------------------------------------------------------------
+
+
+def _reg_reduce(name, fn, aliases=()):
+    def impl(data, axis=None, keepdims=False, exclude=False, **kw):
+        ax = _axis(axis)
+        if _bool(exclude) and ax is not None:
+            axes = (ax,) if isinstance(ax, int) else ax
+            ax = tuple(i for i in range(data.ndim) if i not in axes)
+        return fn(data, axis=ax, keepdims=_bool(keepdims))
+
+    register(name, inputs=("data",), aliases=aliases)(impl)
+
+
+_reg_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reg_reduce("mean", jnp.mean)
+_reg_reduce("prod", jnp.prod)
+_reg_reduce("nansum", jnp.nansum)
+_reg_reduce("nanprod", jnp.nanprod)
+_reg_reduce("max", jnp.max, aliases=("max_axis",))
+_reg_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm")
+def norm(data, **kw):
+    return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False, **kw):
+    out = jnp.argmax(data, axis=_axis(axis)).astype(jnp.float32)
+    if _bool(keepdims) and _axis(axis) is not None:
+        out = jnp.expand_dims(out, _axis(axis))
+    return out
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False, **kw):
+    out = jnp.argmin(data, axis=_axis(axis)).astype(jnp.float32)
+    if _bool(keepdims) and _axis(axis) is not None:
+        out = jnp.expand_dims(out, _axis(axis))
+    return out
+
+
+@register("argmax_channel")
+def argmax_channel(data, **kw):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+# ----------------------------------------------------------------------
+# broadcast / shape manipulation
+# ----------------------------------------------------------------------
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=None, **kw):
+    tgt = _shape(shape)
+    out_shape = tuple(d if t == 0 else t for d, t in zip(data.shape, tgt))
+    return jnp.broadcast_to(data, out_shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=None, size=None, **kw):
+    axes = _axis(axis)
+    sizes = _axis(size)
+    if isinstance(axes, int):
+        axes, sizes = (axes,), (sizes,)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+def _infer_reshape(in_shapes, attrs):
+    # full numpy-compatible reshape incl. mxnet special codes 0,-1,-2,-3,-4
+    data = in_shapes[0]
+    tgt = _shape(attrs.get("shape"))
+    if _bool(attrs.get("reverse", False)):
+        data_r = tuple(reversed(data))
+        out = _mx_reshape(data_r, tuple(reversed(tgt)))
+        return [data], [tuple(reversed(out))]
+    return [data], [_mx_reshape(data, tgt)]
+
+
+def _mx_reshape(data, tgt):
+    """MXNet reshape shape codes (reference src/operator/tensor/matrix_op-inl.h:95-180):
+    0 copy dim, -1 infer, -2 copy rest, -3 merge two, -4 split."""
+    out = []
+    i = 0  # index into data
+    j = 0
+    tgt = list(tgt)
+    while j < len(tgt):
+        t = tgt[j]
+        if t == 0:
+            out.append(data[i])
+            i += 1
+        elif t == -1:
+            out.append(-1)
+            i += 1
+        elif t == -2:
+            out.extend(data[i:])
+            i = len(data)
+        elif t == -3:
+            out.append(data[i] * data[i + 1])
+            i += 2
+        elif t == -4:
+            d1, d2 = tgt[j + 1], tgt[j + 2]
+            j += 2
+            if d1 == -1:
+                d1 = data[i] // d2
+            if d2 == -1:
+                d2 = data[i] // d1
+            out.extend([d1, d2])
+            i += 1
+        else:
+            out.append(t)
+            i += 1
+        j += 1
+    # resolve single -1
+    import numpy as _np
+
+    total = int(_np.prod(data)) if data else 1
+    known = 1
+    neg = None
+    for k, v in enumerate(out):
+        if v == -1:
+            neg = k
+        else:
+            known *= v
+    if neg is not None:
+        out[neg] = total // max(known, 1)
+    return tuple(int(v) for v in out)
+
+
+@register("Reshape", aliases=("reshape",), infer_shape=_infer_reshape)
+def reshape(data, shape=None, reverse=False, **kw):
+    _, (out_shape,) = _infer_reshape([data.shape], {"shape": shape, "reverse": reverse})
+    return jnp.reshape(data, out_shape)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data, **kw):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0, **kw):
+    return jnp.expand_dims(data, _axis(axis))
+
+
+@register("transpose")
+def transpose(data, axes=None, **kw):
+    ax = _axis(axes)
+    if ax == () or ax is None:
+        ax = None
+    return jnp.transpose(data, ax)
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def swapaxes(data, dim1=0, dim2=0, **kw):
+    return jnp.swapaxes(data, int(_lit(dim1)), int(_lit(dim2)))
+
+
+@register("slice")
+def slice_op(data, begin=None, end=None, step=None, **kw):
+    b, e, s = _shape(begin), _lit(end), _lit(step)
+    if isinstance(e, int):
+        e = (e,)
+    idx = []
+    for i in range(len(b)):
+        ei = e[i] if e is not None and i < len(e) else None
+        si = s[i] if isinstance(s, (tuple, list)) and i < len(s) and s[i] else None
+        idx.append(slice(b[i], ei, si))
+    return data[tuple(idx)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None, **kw):
+    a = _axis(axis)
+    b = int(_lit(begin))
+    e = _lit(end)
+    idx = [slice(None)] * data.ndim
+    idx[a] = slice(b, e)
+    return data[tuple(idx)]
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, axis=0, **kw):
+    ax = _axis(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    return jnp.flip(data, ax)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None, **kw):
+    return jnp.repeat(data, int(_lit(repeats)), axis=_axis(axis))
+
+
+@register("tile")
+def tile(data, reps=None, **kw):
+    return jnp.tile(data, _shape(reps))
+
+
+@register("Concat", aliases=("concat",), variadic=True)
+def concat(*args, dim=1, **kw):
+    """Concatenate along dim (reference src/operator/concat-inl.h)."""
+    return jnp.concatenate(args, axis=_axis(dim, 1))
+
+
+@register("stack", variadic=True)
+def stack(*args, axis=0, **kw):
+    return jnp.stack(args, axis=_axis(axis, 0))
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=lambda attrs: int(_lit(attrs.get("num_outputs", 1))))
+def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False, **kw):
+    """Split along axis (reference src/operator/slice_channel-inl.h)."""
+    parts = jnp.split(data, int(_lit(num_outputs)), axis=_axis(axis, 1))
+    if _bool(squeeze_axis):
+        parts = [jnp.squeeze(p, axis=_axis(axis, 1)) for p in parts]
+    return tuple(parts)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, mode="constant", pad_width=None, constant_value=0.0, **kw):
+    pw = _shape(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode = str(mode)
+    if mode == "constant":
+        return jnp.pad(data, pairs, constant_values=float(_lit(constant_value)))
+    return jnp.pad(data, pairs, mode="edge" if mode == "edge" else "reflect")
+
+
+@register("squeeze")
+def squeeze(data, axis=None, **kw):
+    return jnp.squeeze(data, axis=_axis(axis))
+
+
+# ----------------------------------------------------------------------
+# dot / linear algebra — the MXU path: keep matmuls batched + fused
+# ----------------------------------------------------------------------
+
+
+def _infer_dot(in_shapes, attrs):
+    lhs, rhs = in_shapes
+    ta, tb = _bool(attrs.get("transpose_a", False)), _bool(attrs.get("transpose_b", False))
+    la = lhs[::-1] if ta else lhs
+    lb = rhs[::-1] if tb else rhs
+    if len(la) == 1 and len(lb) == 1:
+        out = ()
+    else:
+        out = tuple(la[:-1]) + tuple(lb[1:])
+    return [lhs, rhs], [out]
+
+
+@register("dot", inputs=("lhs", "rhs"), infer_shape=_infer_dot)
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    """Matrix product mapped straight onto the MXU
+    (reference src/operator/tensor/dot-inl.h)."""
+    if _bool(transpose_a):
+        lhs = lhs.T
+    if _bool(transpose_b):
+        rhs = rhs.T
+    return jnp.dot(lhs, rhs)
+
+
+@register("batch_dot", inputs=("lhs", "rhs"))
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    if _bool(transpose_a):
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if _bool(transpose_b):
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("_linalg_gemm2", inputs=("A", "B"))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
+    if _bool(transpose_a):
+        A = jnp.swapaxes(A, -1, -2)
+    if _bool(transpose_b):
+        B = jnp.swapaxes(B, -1, -2)
+    return float(_lit(alpha)) * jnp.matmul(A, B)
+
+
+@register("_linalg_potrf", inputs=("A",))
+def linalg_potrf(A, **kw):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_syrk", inputs=("A",))
+def linalg_syrk(A, transpose=False, alpha=1.0, **kw):
+    if _bool(transpose):
+        A = jnp.swapaxes(A, -1, -2)
+    return float(_lit(alpha)) * jnp.matmul(A, jnp.swapaxes(A, -1, -2))
+
+
+# ----------------------------------------------------------------------
+# indexing (reference src/operator/tensor/indexing_op.cc)
+# ----------------------------------------------------------------------
+
+
+@register("take", inputs=("a", "indices"))
+def take(a, indices, axis=0, mode="clip", **kw):
+    return jnp.take(a, indices.astype(jnp.int32), axis=_axis(axis, 0), mode=str(mode))
+
+
+@register("batch_take", inputs=("a", "indices"))
+def batch_take(a, indices, **kw):
+    return a[jnp.arange(a.shape[0]), indices.astype(jnp.int32)]
+
+
+@register("one_hot", inputs=("indices",))
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    on, off = float(_lit(on_value)), float(_lit(off_value))
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(_lit(depth)), dtype=_dtype(dtype))
+    return oh * (on - off) + off
+
+
+@register("gather_nd", inputs=("data", "indices"))
+def gather_nd(data, indices, **kw):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd", inputs=("data", "indices"))
+def scatter_nd(data, indices, shape=None, **kw):
+    out = jnp.zeros(_shape(shape), dtype=data.dtype)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return out.at[idx].add(data)
+
+
+@register("where", inputs=("condition", "x", "y"))
+def where(condition, x, y, **kw):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("pick", inputs=("data", "index"))
+def pick(data, index, axis=-1, keepdims=False, **kw):
+    a = _axis(axis, -1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(index.astype(jnp.int32), a), axis=a)
+    if not _bool(keepdims):
+        out = jnp.squeeze(out, axis=a)
+    return out
+
+
+# ----------------------------------------------------------------------
+# ordering (reference src/operator/tensor/ordering_op.cc; cub → XLA sort)
+# ----------------------------------------------------------------------
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True, **kw):
+    out = jnp.sort(data, axis=_axis(axis, -1))
+    if not _bool(is_ascend):
+        out = jnp.flip(out, axis=_axis(axis, -1))
+    return out
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, **kw):
+    ax = _axis(axis, -1)
+    out = jnp.argsort(data, axis=ax)
+    if not _bool(is_ascend):
+        out = jnp.flip(out, axis=ax)
+    return out.astype(jnp.float32)
+
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, **kw):
+    ax = _axis(axis, -1)
+    k = int(_lit(k))
+    data_m = jnp.moveaxis(data, ax, -1)
+    if _bool(is_ascend):
+        vals, idx = lax.top_k(-data_m, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(data_m, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(jnp.float32)
+    rt = str(ret_typ)
+    if rt == "value":
+        return vals
+    if rt == "both":
+        return (vals, idx)
+    return idx
+
+
+# ----------------------------------------------------------------------
+# init ops (reference src/operator/tensor/init_op.cc)
+# ----------------------------------------------------------------------
+
+
+def _infer_from_shape_attr(in_shapes, attrs):
+    return [], [_shape(attrs.get("shape"))]
+
+
+@register("_zeros", inputs=(), infer_shape=_infer_from_shape_attr, aliases=("zeros",))
+def zeros(shape=None, dtype="float32", **kw):
+    return jnp.zeros(_shape(shape), dtype=_dtype(dtype) or jnp.float32)
+
+
+@register("_ones", inputs=(), infer_shape=_infer_from_shape_attr, aliases=("ones",))
+def ones(shape=None, dtype="float32", **kw):
+    return jnp.ones(_shape(shape), dtype=_dtype(dtype) or jnp.float32)
+
+
+@register("_full", inputs=(), infer_shape=_infer_from_shape_attr, aliases=("full",))
+def full(shape=None, value=0.0, dtype="float32", **kw):
+    return jnp.full(_shape(shape), float(_lit(value)), dtype=_dtype(dtype) or jnp.float32)
+
+
+@register("_arange", inputs=(), aliases=("arange",))
+def arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32", **kw):
+    out = jnp.arange(float(_lit(start)), _lit(stop), float(_lit(step)), dtype=_dtype(dtype))
+    r = int(_lit(repeat))
+    if r > 1:
+        out = jnp.repeat(out, r)
+    return out
+
+
+@register("zeros_like")
+def zeros_like(data, **kw):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data, **kw):
+    return jnp.ones_like(data)
+
+
+@register("_eye", inputs=(), aliases=("eye",))
+def eye(N=0, M=0, k=0, dtype="float32", **kw):
+    m = int(_lit(M)) or None
+    return jnp.eye(int(_lit(N)), m, k=int(_lit(k)), dtype=_dtype(dtype))

@@ -1,0 +1,505 @@
+"""Optimizers (parity: reference python/mxnet/optimizer.py:13-852).
+
+Python is the source of truth in the reference too (the C++ side has only a
+vestigial SGD, reference src/optimizer/sgd-inl.h) — here every update rule
+is a pure JAX expression over `jax.Array`s, so XLA fuses each step; the
+`Updater` keeps per-key state exactly like the reference
+(optimizer.py Updater/get_updater).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from .lr_scheduler import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad",
+    "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Test", "Updater",
+    "get_updater", "create", "register",
+]
+
+
+class Optimizer:
+    """Base optimizer (parity: optimizer.py Optimizer)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict)
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_mult(self, args_lr_mult):
+        """Per-arg lr multipliers incl. __lr_mult__ attrs (parity: optimizer.py)."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+
+
+def _prep_grad(opt, grad):
+    g = grad.data * opt.rescale_grad
+    if opt.clip_gradient is not None:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    return g
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum & optional multi-precision (parity: optimizer.py:311)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        momentum = None
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == jnp.float16:
+            weight_master_copy = weight.astype("float32")
+            if self.momentum != 0.0:
+                momentum = zeros(weight.shape, weight.context, dtype="float32")
+            return (momentum, weight_master_copy)
+        if self.momentum != 0.0:
+            momentum = zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return momentum
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        use_mp = isinstance(state, (list, tuple))
+        w32 = state[1].data if use_mp else weight.data
+        g = grad.data.astype(w32.dtype) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * w32
+        mom_state = state[0] if use_mp else state
+        if mom_state is not None:
+            mom = mom_state.data * self.momentum - lr * g
+            mom_state._set_data(mom)
+            new_w = w32 + mom
+        else:
+            new_w = w32 - lr * g
+        if use_mp:
+            state[1]._set_data(new_w)
+            weight._set_data(new_w.astype(weight.dtype))
+        else:
+            weight._set_data(new_w)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: optimizer.py:388)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = _prep_grad(self, grad)
+        mon, previous_weight = state
+        w = weight.data
+        comp = g + wd * w + self.lamda * g * g * (w - previous_weight.data)
+        if mon is not None:
+            m = mon.data * self.momentum - lr * comp
+            mon._set_data(m)
+        else:
+            m = -lr * comp
+        previous_weight._set_data(w)
+        weight._set_data(w + m)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: optimizer.py:444)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = _prep_grad(self, grad)
+        w = weight.data
+        if state is not None:
+            mom = state.data * self.momentum
+            gfull = g + wd * w
+            mom = mom + gfull
+            g2 = gfull + self.momentum * mom
+            state._set_data(mom)
+            weight._set_data(w - lr * g2)
+        else:
+            weight._set_data(w - lr * (g + wd * w))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: optimizer.py:480)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = _prep_grad(self, grad)
+        from .ops.random_ops import GLOBAL_RNG
+        import jax
+
+        noise = jax.random.normal(GLOBAL_RNG.next_key(), weight.shape) * math.sqrt(lr)
+        weight._set_data(weight.data - lr / 2 * (g + wd * weight.data) + noise)
+
+
+@register
+class ccSGD(SGD):
+    """Alias of SGD (parity: optimizer.py ccSGD — kept for compatibility)."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (parity: optimizer.py:515)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        g = _prep_grad(self, grad) + wd * weight.data
+        mean, var = state
+        m = self.beta1 * mean.data + (1.0 - self.beta1) * g
+        v = self.beta2 * var.data + (1.0 - self.beta2) * g * g
+        mean._set_data(m)
+        var._set_data(v)
+        weight._set_data(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (parity: optimizer.py:568)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = _prep_grad(self, grad)
+        history = state
+        h = history.data + g * g
+        history._set_data(h)
+        weight._set_data(
+            weight.data - lr * (g / jnp.sqrt(h + self.float_stable_eps) + wd * weight.data)
+        )
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered/non-centered (parity: optimizer.py:605)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context), zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context))
+        return (zeros(weight.shape, weight.context),)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = _prep_grad(self, grad) + wd * weight.data
+        if self.centered:
+            n, gm, delta = state
+            n_new = (1 - self.gamma1) * g * g + self.gamma1 * n.data
+            g_new = (1 - self.gamma1) * g + self.gamma1 * gm.data
+            d_new = self.gamma2 * delta.data - lr * g / jnp.sqrt(n_new - g_new * g_new + self.epsilon)
+            n._set_data(n_new)
+            gm._set_data(g_new)
+            delta._set_data(d_new)
+            new_w = weight.data + d_new
+        else:
+            (n,) = state
+            n_new = (1 - self.gamma1) * g * g + self.gamma1 * n.data
+            n._set_data(n_new)
+            new_w = weight.data - lr * g / jnp.sqrt(n_new + self.epsilon)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        weight._set_data(new_w)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (parity: optimizer.py:681)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context), zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = _prep_grad(self, grad)
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g.data + (1.0 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta.data + self.epsilon) / jnp.sqrt(ag + self.epsilon) * g
+        ad = self.rho * acc_delta.data + (1.0 - self.rho) * delta * delta
+        acc_g._set_data(ag)
+        acc_delta._set_data(ad)
+        weight._set_data(weight.data - delta - wd * weight.data)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (parity: optimizer.py:730)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(**kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+        self.lr = learning_rate
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context), zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        lr = self._get_lr(index)
+        g = _prep_grad(self, grad)
+        dn, n = state
+        d = dn.data + g - (jnp.sqrt(n.data + g * g) - jnp.sqrt(n.data)) / lr * weight.data
+        nn = n.data + g * g
+        dn._set_data(d)
+        n._set_data(nn)
+        w = (jnp.sign(d) * self.lamda1 - d) / ((self.beta + jnp.sqrt(nn)) / lr + wd) * (
+            jnp.abs(d) > self.lamda1
+        )
+        weight._set_data(w)
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (infinity-norm Adam variant)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context), zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr /= 1.0 - self.beta1 ** t
+        g = _prep_grad(self, grad) + wd * weight.data
+        m_t, u_t = state
+        m = self.beta1 * m_t.data + (1.0 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u_t.data, jnp.abs(g))
+        m_t._set_data(m)
+        u_t._set_data(u)
+        weight._set_data(weight.data - lr * m / (u + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context), zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        g = _prep_grad(self, grad) + wd * weight.data
+        mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mom_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mom_t
+        m_sched_next = self.m_schedule * mom_t1
+        m_t, v_t = state
+        m = self.beta1 * m_t.data + (1.0 - self.beta1) * g
+        v = self.beta2 * v_t.data + (1.0 - self.beta2) * g * g
+        m_t._set_data(m)
+        v_t._set_data(v)
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m / (1.0 - m_sched_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - mom_t) * g_prime + mom_t1 * m_prime
+        weight._set_data(weight.data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon))
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w += g (parity: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight.data + grad.data * self.rescale_grad)
+        state._set_data(weight.data)
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater:
+    """Apply an optimizer with per-key state (parity: optimizer.py get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        serializable = {}
+        for k, v in self.states.items():
+            serializable[k] = v
+        return pickle.dumps(serializable)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

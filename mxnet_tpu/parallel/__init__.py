@@ -1,0 +1,15 @@
+"""Parallelism toolkit — the TPU-native successor of the reference's
+multi-device machinery (SURVEY.md §2.5).
+
+The reference scales via per-device executors + KVStore reduction
+(data parallel) and group2ctx device placement (model parallel).  Here
+parallelism is expressed as shardings over a `jax.sharding.Mesh`:
+  * mesh.py       — mesh construction helpers (dp/tp/pp/sp axes)
+  * collectives.py— psum/all_gather/ppermute wrappers ≙ comm layer
+  * ring_attention.py — context-parallel ring attention (new capability
+    the reference lacks; SURVEY.md §5 long-context)
+  * dist.py       — multi-process control plane (Postoffice/tracker analog)
+"""
+from . import mesh
+from . import collectives
+from .mesh import make_mesh, data_parallel_mesh

@@ -1,0 +1,56 @@
+"""Device-mesh helpers.
+
+TPU-first parallelism layout (SURVEY.md §7): a training job picks a mesh
+with named axes — 'data' (DP), 'model' (TP), 'pipe' (PP), 'seq' (SP/CP) —
+annotates array shardings, and lets XLA insert the ICI/DCN collectives.
+This replaces the reference's KVStore device groups and group2ctx placement
+(reference src/kvstore/comm.h, src/executor/graph_executor.cc:347-360).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "data_parallel_mesh", "shard_batch", "replicate",
+           "P", "Mesh", "NamedSharding"]
+
+P = PartitionSpec
+
+
+def make_mesh(axes, devices=None):
+    """Create a mesh from {'axis': size} (sizes must multiply to #devices;
+    a -1 size is inferred)."""
+    devices = devices if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise ValueError("mesh axes %s do not cover %d devices" % (dict(zip(names, sizes)), n))
+    arr = _np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(devices=None):
+    """1-D 'data' mesh over all devices (the kvstore='device' analog)."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(_np.array(devices), ("data",))
+
+
+def shard_batch(mesh, x, axis="data"):
+    """Place an array sharded along its leading dim over `axis`."""
+    return jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+
+def replicate(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -1,0 +1,84 @@
+"""Profiler — chrome://tracing output + XLA profile bridge.
+
+Parity: reference src/engine/profiler.{h,cc} + python/mxnet/profiler.py.
+The reference brackets every engine op with SetOprStart/SetOprEnd; here the
+unit of execution is a jitted XLA executable, so we record per-call spans
+(compile vs run) and can additionally capture a device-level XLA trace via
+`jax.profiler` when requested.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile", "record_span"]
+
+_STATE = {"mode": "symbolic", "filename": "profile.json", "running": False}
+_EVENTS = []
+_LOCK = threading.Lock()
+_JAX_TRACE_DIR = None
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Configure profiler (parity: python/mxnet/profiler.py profiler_set_config)."""
+    if mode not in ("symbolic", "all", "xla"):
+        raise ValueError("mode must be 'symbolic', 'all' or 'xla'")
+    _STATE["mode"] = mode
+    _STATE["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """Start/stop profiling (parity: profiler.py profiler_set_state)."""
+    global _JAX_TRACE_DIR
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if state == "run" and not _STATE["running"]:
+        _STATE["running"] = True
+        if _STATE["mode"] == "xla":
+            import jax
+
+            _JAX_TRACE_DIR = _STATE["filename"] + ".xla"
+            jax.profiler.start_trace(_JAX_TRACE_DIR)
+    elif state == "stop" and _STATE["running"]:
+        _STATE["running"] = False
+        if _STATE["mode"] == "xla" and _JAX_TRACE_DIR is not None:
+            import jax
+
+            jax.profiler.stop_trace()
+
+
+def record_span(name, start_us, dur_us, cat="operator", tid=0):
+    """Record one span; called by executors when profiling is on."""
+    if not _STATE["running"]:
+        return
+    with _LOCK:
+        _EVENTS.append({"name": name, "cat": cat, "ph": "X", "ts": start_us,
+                        "dur": dur_us, "pid": 0, "tid": tid})
+
+
+class span:
+    """Context manager measuring one span."""
+
+    def __init__(self, name, cat="operator"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        if _STATE["running"]:
+            t1 = time.time()
+            record_span(self.name, int(self.t0 * 1e6), int((t1 - self.t0) * 1e6), self.cat)
+
+
+def dump_profile():
+    """Write chrome-tracing JSON (parity: reference Profiler::DumpProfile
+    src/engine/profiler.cc:134-190)."""
+    with _LOCK:
+        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        with open(_STATE["filename"], "w") as f:
+            json.dump(payload, f)
+        _EVENTS.clear()

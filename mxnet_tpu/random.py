@@ -1,0 +1,39 @@
+"""Random utilities (parity: reference python/mxnet/random.py).
+
+Seeding resets the process-global JAX key chain — the TPU-native analog of
+the reference's per-device mshadow PRNG reseeding (reference src/resource.cc
+SeedRandom; python/mxnet/random.py:seed).
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray  # noqa: F401  (re-export site for samplers)
+from .ops.random_ops import GLOBAL_RNG
+
+__all__ = ["seed", "uniform", "normal"]
+
+
+def seed(seed_state):
+    """Seed all random number generators (parity: mx.random.seed)."""
+    if not isinstance(seed_state, int):
+        raise ValueError("seed_state must be int")
+    GLOBAL_RNG.seed(seed_state)
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, out=None, dtype="float32"):
+    from . import ndarray as nd
+
+    res = nd._random_uniform(low=low, high=high, shape=shape, dtype=dtype, ctx=ctx)
+    if out is not None:
+        out[:] = res
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, out=None, dtype="float32"):
+    from . import ndarray as nd
+
+    res = nd._random_normal(loc=loc, scale=scale, shape=shape, dtype=dtype, ctx=ctx)
+    if out is not None:
+        out[:] = res
+        return out
+    return res

@@ -1,0 +1,236 @@
+"""RecordIO (parity: reference python/mxnet/recordio.py + dmlc-core RecordIO).
+
+Binary-compatible with the reference on-disk format so packed datasets
+interop: each record is [magic u32][cflag:3|length:29 u32][payload][pad to 4B]
+with magic 0xced7230a (dmlc-core include/dmlc/recordio.h reconstructed from
+usage — SURVEY.md §2.2).  A native C++ fast path (src/recordio.cc) is used
+for bulk reads when built; this module is the always-available fallback and
+the format reference.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_KMAX_REC = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (parity: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        if d.get("flag"):
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        if length > _KMAX_REC:
+            raise MXNetError("Record too long: %d" % length)
+        self.handle.write(struct.pack("<II", _MAGIC, length))
+        self.handle.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
+        length = lrec & _KMAX_REC
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with .idx sidecar (parity: MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = open(self.idx_path, "r")
+            for line in self.fidx.readlines():
+                line = line.strip().split("\t")
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        if self.is_open:
+            super().close()
+            self.fidx.close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# image record header (parity: recordio.py IRHeader — flag, float label, id, id2)
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a (possibly multi-)label header + payload (parity: recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        header = header._replace(label=float(header.label))
+        packed = struct.pack(_IR_FORMAT, *header)
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32)
+        header = header._replace(flag=label.size, label=0)
+        packed = struct.pack(_IR_FORMAT, *header) + label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload) (parity: recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(s[: header.flag * 4], dtype=_np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4 :]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (requires cv2 or PIL; parity: recordio.py pack_img)."""
+    encoded = _encode_img(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def unpack_img(s, iscolor=-1):
+    header, s = unpack(s)
+    img = _decode_img(s, iscolor)
+    return header, img
+
+
+def _encode_img(img, quality, img_fmt):
+    try:
+        import cv2
+
+        ext = img_fmt.lower()
+        params = [int(cv2.IMWRITE_JPEG_QUALITY), quality] if "jpg" in ext or "jpeg" in ext else []
+        ret, buf = cv2.imencode(img_fmt, img, params)
+        assert ret
+        return buf.tobytes()
+    except ImportError:
+        pass
+    try:
+        import io as _io
+
+        from PIL import Image
+
+        b = _io.BytesIO()
+        Image.fromarray(img).save(b, format="JPEG", quality=quality)
+        return b.getvalue()
+    except ImportError:
+        # raw fallback: shape-prefixed uncompressed
+        arr = _np.asarray(img, dtype=_np.uint8)
+        head = struct.pack("<III", 0xFEEDBEEF, arr.shape[0], arr.shape[1])
+        ch = arr.shape[2] if arr.ndim == 3 else 1
+        return head + struct.pack("<I", ch) + arr.tobytes()
+
+
+def _decode_img(s, iscolor=-1):
+    if len(s) >= 16 and struct.unpack("<I", s[:4])[0] == 0xFEEDBEEF:
+        h, w, c = struct.unpack("<III", s[4:16])
+        arr = _np.frombuffer(s[16:], dtype=_np.uint8)
+        return arr.reshape((h, w, c) if c > 1 else (h, w))
+    try:
+        import cv2
+
+        return cv2.imdecode(_np.frombuffer(s, dtype=_np.uint8), iscolor)
+    except ImportError:
+        pass
+    import io as _io
+
+    from PIL import Image
+
+    return _np.asarray(Image.open(_io.BytesIO(s)))

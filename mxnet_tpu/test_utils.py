@@ -1,0 +1,336 @@
+"""Testing fixtures (parity: reference python/mxnet/test_utils.py).
+
+The reference's highest-leverage correctness harness (SURVEY.md §4):
+`check_numeric_gradient` (finite differences, test_utils.py:420),
+`check_symbolic_forward/backward` (:533,:598), `check_consistency` (:765 —
+same graph on several contexts/dtypes cross-compared).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as nd
+from . import symbol as sym
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = [
+    "default_context", "set_default_context", "assert_almost_equal", "same", "reldiff",
+    "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d", "random_arrays",
+    "check_numeric_gradient", "check_symbolic_forward", "check_symbolic_backward",
+    "check_consistency", "simple_forward",
+]
+
+_DEFAULT_CTX = None
+
+
+def default_context():
+    """Context for the test suite (parity: test_utils.py default_context:28)."""
+    global _DEFAULT_CTX
+    if _DEFAULT_CTX is None:
+        return current_context()
+    return _DEFAULT_CTX
+
+
+def set_default_context(ctx):
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def same(a, b):
+    return _np.array_equal(a, b)
+
+
+def reldiff(a, b):
+    diff = _np.sum(_np.abs(a - b))
+    norm = _np.sum(_np.abs(a)) + _np.sum(_np.abs(b))
+    if diff == 0:
+        return 0
+    return diff / norm
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    return _np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    """Assert allclose with readable error (parity: test_utils.py:129)."""
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    a = a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else _np.asarray(b)
+    if almost_equal(a, b, rtol, atol):
+        return
+    index = _np.unravel_index(_np.argmax(_np.abs(a - b)), a.shape) if a.shape else ()
+    rel = reldiff(a, b)
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%f, atol=%f. Location of maximum error: %s, %s=%s, %s=%s"
+        % (rel, rtol, atol, str(index),
+           names[0], a[index] if a.shape else a, names[1], b[index] if b.shape else b)
+    )
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def rand_ndarray(shape, ctx=None, dtype="float32"):
+    return array(_np.random.uniform(-1.0, 1.0, shape).astype(dtype), ctx=ctx)
+
+
+def random_arrays(*shapes):
+    arrays = [_np.random.randn(*s).astype("float32") for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def simple_forward(sym_, ctx=None, is_train=False, **inputs):
+    """Forward a symbol with numpy inputs, return numpy outputs
+    (parity: test_utils.py simple_forward)."""
+    ctx = ctx or default_context()
+    inputs = {k: array(v, ctx=ctx) for k, v in inputs.items()}
+    exe = sym_.bind(ctx, args=inputs)
+    exe.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(symbol, location, ctx):
+    assert isinstance(location, (dict, list, tuple))
+    if isinstance(location, dict):
+        if set(location.keys()) != set(symbol.list_arguments()):
+            raise ValueError(
+                "Symbol arguments and keys of the given location do not match. symbol args:%s, location.keys():%s"
+                % (str(set(symbol.list_arguments())), str(set(location.keys())))
+            )
+    else:
+        location = {k: v for k, v in zip(symbol.list_arguments(), location)}
+    return {
+        k: array(v, ctx=ctx) if isinstance(v, _np.ndarray) else v for k, v in location.items()
+    }
+
+
+def _parse_aux_states(symbol, aux_states, ctx):
+    if aux_states is None:
+        return None
+    if isinstance(aux_states, dict):
+        return {k: array(v, ctx=ctx) if isinstance(v, _np.ndarray) else v
+                for k, v in aux_states.items()}
+    return {k: array(v, ctx=ctx) for k, v in zip(symbol.list_auxiliary_states(), aux_states)}
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4, use_forward_train=True):
+    """Central finite differences on an executor (parity: test_utils.py numeric_grad)."""
+    approx_grads = {k: _np.zeros(v.shape, dtype=_np.float32) for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k in location:
+        old_value = location[k].copy()
+        for i in range(int(_np.prod(old_value.shape)) if old_value.shape else 1):
+            # forward at x+eps/2 and x-eps/2
+            flat = old_value.ravel().copy()
+            flat[i] += eps / 2.0
+            executor.arg_dict[k][:] = flat.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_peps = _np.sum(executor.outputs[0].asnumpy())
+            flat[i] -= eps
+            executor.arg_dict[k][:] = flat.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_neps = _np.sum(executor.outputs[0].asnumpy())
+            approx_grads[k].ravel()[i] = (f_peps - f_neps) / eps
+            executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(sym_, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None):
+    """Finite-difference gradient check (parity: test_utils.py:420)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    aux_states = _parse_aux_states(sym_, aux_states, ctx)
+    if grad_nodes is None:
+        grad_nodes = sym_.list_arguments()
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, (list, tuple)):
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, dict):
+        grad_req = grad_nodes.copy()
+        grad_nodes = grad_nodes.keys()
+    else:
+        raise ValueError("grad_nodes must be a list, tuple or dict")
+    input_shape = {k: v.shape for k, v in location.items()}
+    _, out_shape, _ = sym_.infer_shape(**input_shape)
+    proj = sym.Variable("__random_proj")
+    out = sym.sum(sym_ * proj)
+    out = sym.MakeLoss(out)
+    location = dict(location)
+    location["__random_proj"] = rand_ndarray(out_shape[0], ctx=ctx)
+    args_grad_npy = {k: _np.random.normal(0, 0.01, size=location[k].shape).astype("float32")
+                     for k in grad_nodes}
+    args_grad = {k: array(v, ctx=ctx) for k, v in args_grad_npy.items()}
+    executor = out.bind(ctx, args=location, args_grad=args_grad,
+                        aux_states=aux_states, grad_req=grad_req)
+    executor.forward(is_train=True)
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+    numeric_gradients = numeric_grad(
+        out.bind(ctx, args={k: v.copy() for k, v in location.items()},
+                 aux_states=aux_states),
+        {k: v.asnumpy() for k, v in location.items()},
+        aux_states, eps=numeric_eps, use_forward_train=use_forward_train,
+    )
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        if grad_req[name] == "write":
+            assert_almost_equal(fd_grad, sym_grad, rtol, atol or 1e-4,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(fd_grad, sym_grad - args_grad_npy[name], rtol, atol or 1e-4,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "null":
+            assert_almost_equal(args_grad_npy[name], sym_grad, rtol, atol or 1e-4)
+        else:
+            raise ValueError("Invalid grad_req %s for argument %s" % (grad_req[name], name))
+
+
+def check_symbolic_forward(sym_, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None):
+    """Compare forward vs expected numpy (parity: test_utils.py:533)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    aux_states = _parse_aux_states(sym_, aux_states, ctx)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym_.list_outputs()]
+    executor = sym_.bind(ctx, args=location, aux_states=aux_states)
+    outputs = executor.forward()
+    for output_name, expect, output in zip(sym_.list_outputs(), expected, outputs):
+        assert_almost_equal(expect, output.asnumpy(), rtol, atol or 1e-20,
+                            ("EXPECTED_%s" % output_name, "FORWARD_%s" % output_name))
+
+
+def check_symbolic_backward(sym_, location, out_grads, expected, rtol=1e-5, atol=None,
+                            aux_states=None, grad_req="write", ctx=None):
+    """Compare backward vs expected numpy (parity: test_utils.py:598)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx)
+    aux_states = _parse_aux_states(sym_, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym_.list_arguments(), expected)}
+    args_grad_npy = {k: _np.random.normal(size=v.shape).astype("float32")
+                     for k, v in expected.items()}
+    args_grad_data = {k: array(v, ctx=ctx) for k, v in args_grad_npy.items()}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req for k in sym_.list_arguments()}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = {k: v for k, v in zip(sym_.list_arguments(), grad_req)}
+    executor = sym_.bind(ctx, args=location, args_grad=args_grad_data,
+                         aux_states=aux_states, grad_req=grad_req)
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [array(v, ctx=ctx) if isinstance(v, _np.ndarray) else v for v in out_grads]
+    elif isinstance(out_grads, _np.ndarray):
+        out_grads = [array(out_grads, ctx=ctx)]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()}
+    for name in expected:
+        if grad_req[name] == "write":
+            assert_almost_equal(expected[name], grads[name], rtol, atol or 1e-20,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(expected[name], grads[name] - args_grad_npy[name],
+                                rtol, atol or 1e-20)
+        elif grad_req[name] == "null":
+            assert_almost_equal(args_grad_npy[name], grads[name], rtol, atol or 1e-20)
+
+
+def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None, raise_on_err=True):
+    """Run the same symbol on several contexts/dtypes and cross-compare
+    (parity: test_utils.py check_consistency:765)."""
+    if tol is None:
+        tol = {_np.dtype(_np.float16): 1e-1, _np.dtype(_np.float32): 1e-3,
+               _np.dtype(_np.float64): 1e-5, _np.dtype(_np.uint8): 0,
+               _np.dtype(_np.int32): 0}
+    elif isinstance(tol, float):
+        tol = {_np.dtype(_np.float16): tol, _np.dtype(_np.float32): tol,
+               _np.dtype(_np.float64): tol, _np.dtype(_np.uint8): 0,
+               _np.dtype(_np.int32): 0}
+    assert len(ctx_list) > 1
+    if isinstance(sym_, sym.Symbol):
+        sym_ = [sym_] * len(ctx_list)
+    else:
+        assert len(sym_) == len(ctx_list)
+    output_names = sym_[0].list_outputs()
+    arg_names = sym_[0].list_arguments()
+    exe_list = []
+    for s, ctx in zip(sym_, ctx_list):
+        assert s.list_arguments() == arg_names
+        assert s.list_outputs() == output_names
+        exe_list.append(s.simple_bind(grad_req=grad_req, **ctx))
+    arg_params = {} if arg_params is None else arg_params
+    aux_params = {} if aux_params is None else aux_params
+    for n, arr in exe_list[0].arg_dict.items():
+        if n not in arg_params:
+            arg_params[n] = _np.random.normal(size=arr.shape, scale=scale)
+    for n, arr in exe_list[0].aux_dict.items():
+        if n not in aux_params:
+            aux_params[n] = 0
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = _np.asarray(arg_params[name]).astype(arr.dtype)
+        for name, arr in exe.aux_dict.items():
+            arr[:] = _np.asarray(aux_params[name]).astype(arr.dtype)
+    dtypes = [_np.dtype(exe.outputs[0].dtype) for exe in exe_list]
+    max_idx = _np.argmax([dtypes.index(d) for d in dtypes]) if False else int(
+        _np.argmax([d.itemsize for d in dtypes]))
+    gt = None
+    # forward
+    for exe in exe_list:
+        exe.forward(is_train=grad_req != "null")
+    gt_outputs = [o.asnumpy() for o in exe_list[max_idx].outputs]
+    for i, exe in enumerate(exe_list):
+        if i == max_idx:
+            continue
+        rtol = tol[dtypes[i]]
+        for name, out, gt_out in zip(output_names, exe.outputs, gt_outputs):
+            try:
+                assert_almost_equal(out.asnumpy(), gt_out, rtol=rtol, atol=rtol)
+            except AssertionError as e:
+                print("Predict Err: ctx %d vs ctx %d at %s" % (i, max_idx, name))
+                print(e)
+                if raise_on_err:
+                    raise
+    # backward
+    if grad_req != "null":
+        for exe in exe_list:
+            out_grads = [nd.ones(o.shape, ctx=exe._first_ctx) for o in exe.outputs]
+            exe.backward(out_grads)
+        gt_grads = {n: exe_list[max_idx].grad_dict[n].asnumpy()
+                    for n in exe_list[max_idx].grad_dict}
+        for i, exe in enumerate(exe_list):
+            if i == max_idx:
+                continue
+            rtol = tol[dtypes[i]]
+            for name in exe.grad_dict:
+                try:
+                    assert_almost_equal(exe.grad_dict[name].asnumpy(), gt_grads[name],
+                                        rtol=rtol, atol=rtol)
+                except AssertionError as e:
+                    print("Train Err: ctx %d vs ctx %d at %s" % (i, max_idx, name))
+                    print(e)
+                    if raise_on_err:
+                        raise
+    return gt

@@ -1,0 +1,188 @@
+"""Network visualization (parity: reference python/mxnet/visualization.py —
+print_summary and plot_network:331)."""
+from __future__ import annotations
+
+import json
+
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print layer-by-layer summary (parity: visualization.py print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {h[0] for h in conf["heads"]}
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name + "_output" if input_node["op"] != "null" else input_name
+                        if key in shape_dict:
+                            pre_filter = pre_filter + int(shape_dict[key][1]) if len(
+                                shape_dict[key]) > 1 else pre_filter
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "Convolution":
+            import ast
+
+            kernel = ast.literal_eval(str(attrs.get("kernel", "()")))
+            num_filter = int(attrs.get("num_filter", 0))
+            cur_param = pre_filter * num_filter
+            for k in kernel:
+                cur_param *= k
+            cur_param += num_filter
+        elif op == "FullyConnected":
+            cur_param = pre_filter * int(attrs.get("num_hidden", 0)) + int(attrs.get("num_hidden", 0))
+        elif op == "BatchNorm":
+            cur_param = pre_filter * 2
+        first_connection = "" if not pre_node else pre_node[0]
+        fields = [node["name"] + "(" + op + ")",
+                  "x".join([str(x) for x in out_shape]),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        if len(pre_node) > 1:
+            for i in range(1, len(pre_node)):
+                fields = ["", "", "", pre_node[i]]
+                print_row(fields, positions)
+        return cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if show_shape:
+                key = node["name"] + "_output" if op != "null" else node["name"]
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        total_params += print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print("Total params: %s" % total_params)
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Render the graph with graphviz if available (parity: visualization.py plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    draw_shape = False
+    shape_dict = {}
+    if shape is not None:
+        draw_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    if node_attrs:
+        node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+          "#fdb462", "#b3de69", "#fccde5")
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = {"shape": "oval", "fixedsize": "false"}
+        attrs.update(node_attr)
+        label = name
+        if op == "null":
+            if name.endswith("_weight") or name.endswith("_bias") or name.endswith(
+                    "_gamma") or name.endswith("_beta") or name.endswith("_moving_mean") or \
+                    name.endswith("_moving_var"):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            attrs["shape"] = "ellipse"
+            attrs["fillcolor"] = cm[0]
+        elif op == "Convolution":
+            a = node.get("attrs", {})
+            label = "Convolution\n%s/%s, %s" % (a.get("kernel", "?"), a.get("stride", "(1,1)"),
+                                                a.get("num_filter", "?"))
+            attrs["fillcolor"] = cm[1]
+        elif op == "FullyConnected":
+            label = "FullyConnected\n%s" % node.get("attrs", {}).get("num_hidden", "?")
+            attrs["fillcolor"] = cm[1]
+        elif op == "BatchNorm":
+            attrs["fillcolor"] = cm[3]
+        elif op == "Activation" or op == "LeakyReLU":
+            label = "%s\n%s" % (op, node.get("attrs", {}).get("act_type", ""))
+            attrs["fillcolor"] = cm[2]
+        elif op == "Pooling":
+            a = node.get("attrs", {})
+            label = "Pooling\n%s, %s/%s" % (a.get("pool_type", "?"), a.get("kernel", "?"),
+                                            a.get("stride", "(1,1)"))
+            attrs["fillcolor"] = cm[4]
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attrs["fillcolor"] = cm[5]
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            attrs["fillcolor"] = cm[6]
+        else:
+            attrs["fillcolor"] = cm[7]
+        dot.node(name=name, label=label, **attrs)
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        inputs = node["inputs"]
+        for item in inputs:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name in hidden_nodes:
+                continue
+            attrs = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = input_name + "_output" if input_node["op"] != "null" else input_name
+                if key in shape_dict:
+                    attrs["label"] = "x".join([str(x) for x in shape_dict[key]])
+            dot.edge(tail_name=name, head_name=input_name, **attrs)
+    return dot

@@ -1,0 +1,18 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+The reference tests multi-device paths with CPU device ids standing in for
+GPUs (reference tests/python/unittest/test_multi_device_exec.py:4-33);
+here XLA's host-platform device-count flag gives 8 real(ly separate) CPU
+devices so sharding/collective code paths execute without TPU hardware.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+# the axon site config forces the TPU platform regardless of env; override.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,119 @@
+"""Executor tests (modeled on reference tests/python/unittest/test_executor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_bind_forward():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    a_arr = mx.nd.array(np.random.randn(4, 4).astype("float32"))
+    b_arr = mx.nd.array(np.random.randn(4, 4).astype("float32"))
+    exe = c.bind(mx.cpu(), args={"a": a_arr, "b": b_arr})
+    out = exe.forward()
+    assert_almost_equal(out[0].asnumpy(), a_arr.asnumpy() + b_arr.asnumpy())
+
+
+def test_backward_grads():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * b
+    a_np = np.random.randn(3, 3).astype("float32")
+    b_np = np.random.randn(3, 3).astype("float32")
+    a_grad = mx.nd.zeros((3, 3))
+    b_grad = mx.nd.zeros((3, 3))
+    exe = c.bind(mx.cpu(), args={"a": mx.nd.array(a_np), "b": mx.nd.array(b_np)},
+                 args_grad={"a": a_grad, "b": b_grad})
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((3, 3)))
+    assert_almost_equal(a_grad.asnumpy(), b_np)
+    assert_almost_equal(b_grad.asnumpy(), a_np)
+
+
+def test_grad_req_add():
+    a = mx.sym.Variable("a")
+    c = a * a
+    a_np = np.array([2.0, 3.0], dtype="float32")
+    a_grad = mx.nd.array(np.array([1.0, 1.0], dtype="float32"))
+    exe = c.bind(mx.cpu(), args={"a": mx.nd.array(a_np)}, args_grad={"a": a_grad},
+                 grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((2,)))
+    assert_almost_equal(a_grad.asnumpy(), 1.0 + 2 * a_np)
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((2,)))
+    assert_almost_equal(a_grad.asnumpy(), 1.0 + 4 * a_np)
+
+
+def test_simple_bind():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(4, 16))
+    assert exe.arg_dict["fc_weight"].shape == (8, 16)
+    assert exe.grad_dict["fc_weight"].shape == (8, 16)
+    exe.arg_dict["data"][:] = 1.0
+    exe.arg_dict["fc_weight"][:] = 0.5
+    exe.arg_dict["fc_bias"][:] = 0.25
+    out = exe.forward()[0]
+    assert_almost_equal(out.asnumpy(), np.full((4, 8), 16 * 0.5 + 0.25))
+
+
+def test_reshape():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4)
+    exe = y.simple_bind(mx.cpu(), x=(5, 4), grad_req="null")
+    exe.arg_dict["x"][:] = 1
+    exe.forward()
+    exe2 = exe.reshape(x=(3, 4))
+    assert exe2.arg_dict["x"].shape == (3, 4)
+    # params shared with original executor
+    assert exe2.arg_dict["fullyconnected0_weight"] is exe.arg_dict["fullyconnected0_weight"]
+
+
+def test_dropout_executor():
+    """Dropout active in training, identity in inference."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Dropout(data, p=0.5)
+    exe = net.simple_bind(mx.cpu(), data=(100, 100), grad_req="null")
+    exe.arg_dict["data"][:] = 1.0
+    out_test = exe.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_test, np.ones((100, 100)))
+    exe.forward(is_train=True)
+    out_train = exe.outputs[0]  # train-mode forward is lazy; outputs triggers it
+    frac_zero = (out_train.asnumpy() == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+
+
+def test_batchnorm_aux_update():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5)
+    exe = bn.simple_bind(mx.cpu(), data=(8, 3, 4, 4))
+    exe.aux_dict["bn_moving_var"][:] = 1.0
+    exe.arg_dict["data"][:] = np.random.randn(8, 3, 4, 4) * 2 + 5
+    before = exe.aux_dict["bn_moving_mean"].asnumpy().copy()
+    exe.forward(is_train=True)
+    exe.backward()
+    after = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(before, after)  # moving stats updated in training
+    # inference uses (and does not update) moving stats
+    before = after.copy()
+    exe.forward(is_train=False)
+    assert np.allclose(before, exe.aux_dict["bn_moving_mean"].asnumpy())
+
+
+def test_loss_backward_no_headgrad():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("lab")
+    out = mx.sym.SoftmaxOutput(data, label, name="softmax")
+    d = np.random.randn(6, 4).astype("float32")
+    lab = np.array([0, 1, 2, 3, 0, 1], dtype="float32")
+    dgrad = mx.nd.zeros((6, 4))
+    exe = out.bind(mx.cpu(), args={"data": mx.nd.array(d), "lab": mx.nd.array(lab)},
+                   args_grad={"data": dgrad}, grad_req={"data": "write", "lab": "null"})
+    exe.forward(is_train=True)
+    exe.backward()
+    p = exe.outputs[0].asnumpy()
+    onehot = np.eye(4)[lab.astype(int)]
+    assert_almost_equal(dgrad.asnumpy(), p - onehot, rtol=1e-5, atol=1e-6)

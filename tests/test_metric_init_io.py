@@ -1,0 +1,210 @@
+"""Metric, initializer and IO tests (reference test_metric.py, test_init.py,
+test_io.py patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ----------------------------- metrics -----------------------------
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array(np.array([[0.3, 0.7], [0.6, 0.4], [0.2, 0.8]]))
+    label = mx.nd.array(np.array([1, 0, 0]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(2.0 / 3)
+
+
+def test_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array(np.array([[0.1, 0.2, 0.7], [0.7, 0.2, 0.1]]))
+    label = mx.nd.array(np.array([1, 2]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array(np.array([[1.0], [2.0]]))
+    label = mx.nd.array(np.array([0.0, 4.0]))
+    for name, expected in [("mse", (1 + 4) / 2.0), ("mae", (1 + 2) / 2.0),
+                           ("rmse", np.sqrt(2.5))]:
+        m = mx.metric.create(name)
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(expected)
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array(np.array([[0.5, 0.5], [0.9, 0.1]]))
+    label = mx.nd.array(np.array([0, 0]))
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert m.get()[1] == pytest.approx(expected, rel=1e-5)
+
+
+def test_composite_and_custom():
+    comp = mx.metric.create(["acc", "mse"])
+    pred = mx.nd.array(np.array([[0.3, 0.7]]))
+    label = mx.nd.array(np.array([1.0]))
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert "accuracy" in names and "mse" in names
+
+    def feval(label, pred):
+        return float(np.sum(pred))
+
+    m = mx.metric.np(feval)
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+# ----------------------------- initializers -----------------------------
+
+
+def test_initializers():
+    for init, name, check in [
+        (mx.init.Uniform(0.1), "fc_weight", lambda a: np.abs(a).max() <= 0.1),
+        (mx.init.Normal(0.01), "fc_weight", lambda a: np.abs(a).mean() < 0.05),
+        (mx.init.One(), "fc_weight", lambda a: (a == 1).all()),
+        (mx.init.Zero(), "fc_weight", lambda a: (a == 0).all()),
+        (mx.init.Constant(2.5), "fc_weight", lambda a: (a == 2.5).all()),
+    ]:
+        arr = mx.nd.zeros((10, 10))
+        init(name, arr)
+        assert check(arr.asnumpy()), type(init)
+
+
+def test_init_dispatch():
+    init = mx.init.Uniform(0.1)
+    bias = mx.nd.ones((4,))
+    init("fc1_bias", bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = mx.nd.zeros((4,))
+    init("bn_gamma", gamma)
+    assert (gamma.asnumpy() == 1).all()
+    mv = mx.nd.ones((4,))
+    init("bn_moving_mean", mv)
+    assert (mv.asnumpy() == 0).all()
+
+
+def test_xavier_orthogonal():
+    arr = mx.nd.zeros((64, 32))
+    mx.init.Xavier(factor_type="avg", magnitude=3)("w_weight", arr)
+    a = arr.asnumpy()
+    bound = np.sqrt(3.0 / ((64 + 32) / 2))
+    assert np.abs(a).max() <= bound + 1e-6
+    arr2 = mx.nd.zeros((16, 16))
+    mx.init.Orthogonal()("w_weight", arr2)
+    q = arr2.asnumpy()
+    qtq = q.T @ q / (q.T @ q)[0, 0]
+    assert_almost_equal(np.diag(np.abs(qtq)), np.ones(16), rtol=1e-4, atol=1e-4)
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed([".*bias", ".*"], [mx.init.Zero(), mx.init.One()])
+    b = mx.nd.ones((3,))
+    w = mx.nd.zeros((3,))
+    init("fc_bias", b)
+    init("fc_weight", w)
+    assert (b.asnumpy() == 0).all() and (w.asnumpy() == 1).all()
+
+
+# ----------------------------- io -----------------------------
+
+
+def test_ndarray_iter():
+    X = np.arange(40).reshape(10, 4).astype("float32")
+    y = np.arange(10).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    it2 = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+
+
+def test_ndarray_iter_provide():
+    X = np.zeros((8, 2, 3), dtype="float32")
+    it = mx.io.NDArrayIter(X, np.zeros(8), batch_size=4)
+    assert it.provide_data[0].shape == (4, 2, 3)
+    assert it.provide_label[0].shape == (4,)
+
+
+def test_resize_iter():
+    X = np.zeros((8, 2), dtype="float32")
+    it = mx.io.ResizeIter(mx.io.NDArrayIter(X, np.zeros(8), batch_size=4), size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    X = np.random.randn(16, 3).astype("float32")
+    base = mx.io.NDArrayIter(X, np.zeros(16), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3)
+        n += 1
+    assert n == 4
+    it.reset()
+    assert sum(1 for _ in it) == 4
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    np.savetxt(data_path, np.arange(24).reshape(6, 4), delimiter=",")
+    np.savetxt(label_path, np.arange(6), delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(4,), label_csv=label_path,
+                       batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 4)
+
+
+# ----------------------------- recordio -----------------------------
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    rec = mx.recordio.MXRecordIO(path, "w")
+    payloads = [b"x" * n for n in (1, 5, 128, 1000)]
+    for p in payloads:
+        rec.write(p)
+    rec.close()
+    rec = mx.recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert rec.read() == p
+    assert rec.read() is None
+    rec.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        rec.write_idx(i, b"rec%d" % i)
+    rec.close()
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert rec.read_idx(3) == b"rec3"
+    assert rec.read_idx(0) == b"rec0"
+    assert rec.keys == list(range(5))
+
+
+def test_pack_unpack():
+    header = mx.recordio.IRHeader(0, 3.0, 7, 0)
+    packed = mx.recordio.pack(header, b"payload")
+    h2, content = mx.recordio.unpack(packed)
+    assert h2.label == 3.0 and h2.id == 7
+    assert content == b"payload"
+    header = mx.recordio.IRHeader(0, np.array([1.0, 2.0], dtype=np.float32), 7, 0)
+    packed = mx.recordio.pack(header, b"p2")
+    h3, content = mx.recordio.unpack(packed)
+    assert list(h3.label) == [1.0, 2.0]
+    assert content == b"p2"

@@ -1,0 +1,176 @@
+"""Module tests (modeled on reference tests/python/unittest/test_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_data(n=512, d=10, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype("float32")
+    w = rng.randn(d, k)
+    y = np.argmax(X @ w, axis=1).astype("float32")
+    return X, y
+
+
+def _mlp(num_classes=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_converges():
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9}, num_epoch=5)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_forward_shapes():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((8, 10))], label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 3)
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(8), rtol=1e-5)
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))], label_shapes=[("softmax_label", (8,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((8, 10))], label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    [dgrad] = mod.get_input_grads()
+    assert dgrad.shape == (8, 10)
+    assert np.abs(dgrad.asnumpy()).sum() > 0
+
+
+def test_module_checkpoint(tmp_path):
+    X, y = _toy_data(n=64)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.01})
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    mod2 = mx.mod.Module.load(prefix, 1, load_optimizer_states=True)
+    mod2.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod2.init_params()
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        assert_almost_equal(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_module_predict():
+    X, y = _toy_data(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)  # 100 % 32 != 0 → pad path
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 3)
+
+
+def test_module_multi_device_spmd():
+    """Data parallel over multiple virtual devices = ONE SPMD executable
+    (the reference's multi-GPU ExecutorGroup path, executor_group.py:216)."""
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=64)
+    contexts = [mx.cpu(i) for i in range(4)]
+    mod = mx.mod.Module(_mlp(), context=contexts)
+    assert mod._exec_group is None
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9}, num_epoch=8)
+    assert mod._exec_group.mesh is not None  # really ran the SPMD path
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_spmd_grads_match_single_device():
+    """Gradients from the 4-device SPMD executable must equal the
+    single-device ones bit-for-bit up to reduction order."""
+    X, y = _toy_data(n=64)
+    batch = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    grads = {}
+    for name, ctxs in [("single", [mx.cpu(0)]), ("spmd", [mx.cpu(i) for i in range(4)])]:
+        mx.random.seed(3)
+        mod = mx.mod.Module(_mlp(), context=ctxs)
+        mod.bind(data_shapes=[("data", (64, 10))], label_shapes=[("softmax_label", (64,))])
+        mod.init_params(mx.init.Xavier(), force_init=True)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        exe = mod._exec_group.execs[0]
+        grads[name] = {k: v.asnumpy() for k, v in exe.grad_dict.items()}
+    for k in grads["single"]:
+        assert_almost_equal(grads["single"][k], grads["spmd"][k], rtol=1e-4, atol=1e-5)
+
+
+def test_module_kvstore_device_matches_local():
+    """kvstore='device' and default updater path give identical results."""
+    X, y = _toy_data(n=128)
+    results = []
+    for kv in ["local", "device", None]:
+        mx.random.seed(7)
+        train = mx.io.NDArrayIter(X, y, batch_size=32)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(train, optimizer="sgd", kvstore=kv,
+                optimizer_params={"learning_rate": 0.05}, num_epoch=2,
+                initializer=mx.init.Xavier(), force_init=True)
+        a, _ = mod.get_params()
+        results.append({k: v.asnumpy() for k, v in a.items()})
+    for k in results[0]:
+        assert_almost_equal(results[0][k], results[1][k], rtol=1e-4, atol=1e-5)
+        assert_almost_equal(results[0][k], results[2][k], rtol=1e-4, atol=1e-5)
+
+
+def test_bucketing_module():
+    """Bucketing over two 'sequence lengths' with shared params
+    (reference test_bucketing pattern)."""
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        # mean over the variable-length axis keeps param shapes bucket-invariant
+        pooled = mx.sym.mean(data, axis=1, keepdims=True)
+        net = mx.sym.FullyConnected(pooled, num_hidden=16, name="fc_shared")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="out")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=20, context=mx.cpu())
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    def make_batch(seq_len, bs=8):
+        return DataBatch(
+            data=[mx.nd.ones((bs, seq_len))], label=[mx.nd.zeros((bs,))],
+            bucket_key=seq_len,
+            provide_data=[DataDesc("data", (bs, seq_len))],
+            provide_label=[DataDesc("softmax_label", (bs,))], pad=0,
+        )
+
+    mod.bind(data_shapes=[DataDesc("data", (8, 20))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.01})
+    for seq_len in (20, 10, 20, 5):
+        mod.forward(make_batch(seq_len))
+        mod.backward()
+        mod.update()
+        assert mod.get_outputs()[0].shape == (8, 3)
+    # parameters are shared across bucket executors (reference shared_exec)
+    default_exec = mod._buckets[20]._exec_group.execs[0]
+    small_exec = mod._buckets[10]._exec_group.execs[0]
+    assert default_exec.arg_dict["fc_shared_weight"] is small_exec.arg_dict["fc_shared_weight"]

@@ -1,0 +1,142 @@
+"""NDArray tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def test_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.asnumpy().sum() == 0
+    b = mx.nd.ones((2, 2))
+    assert b.asnumpy().sum() == 4
+    c = mx.nd.full((2, 2), 3.5)
+    assert c.asnumpy()[0, 0] == 3.5
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    e = mx.nd.arange(0, 10, 2)
+    assert list(e.asnumpy()) == [0, 2, 4, 6, 8]
+
+
+def test_arithmetic():
+    a = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    b = mx.nd.array(np.array([[5.0, 6.0], [7.0, 8.0]]))
+    assert_almost_equal((a + b).asnumpy(), np.array([[6, 8], [10, 12]]))
+    assert_almost_equal((b - a).asnumpy(), np.array([[4, 4], [4, 4]]))
+    assert_almost_equal((a * b).asnumpy(), np.array([[5, 12], [21, 32]]))
+    assert_almost_equal((b / a).asnumpy(), np.array([[5, 3], [7 / 3.0, 2]]), rtol=1e-6)
+    assert_almost_equal((a + 1).asnumpy(), np.array([[2, 3], [4, 5]]))
+    assert_almost_equal((2 * a).asnumpy(), np.array([[2, 4], [6, 8]]))
+    assert_almost_equal((1 - a).asnumpy(), np.array([[0, -1], [-2, -3]]))
+    assert_almost_equal((a ** 2).asnumpy(), np.array([[1, 4], [9, 16]]))
+    assert_almost_equal((-a).asnumpy(), -a.asnumpy())
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 2))
+    a += 1
+    assert a.asnumpy().sum() == 8
+    a *= 3
+    assert a.asnumpy().sum() == 24
+    a -= 1
+    a /= 5
+    assert_almost_equal(a.asnumpy(), np.ones((2, 2)))
+
+
+def test_setitem_getitem():
+    a = mx.nd.zeros((4, 4))
+    a[:] = 2.0
+    assert a.asnumpy().sum() == 32
+    a[1] = 5.0
+    assert a.asnumpy()[1].sum() == 20
+    a[2:4] = 1.0
+    assert a.asnumpy()[2:4].sum() == 8
+    b = a[0:2]
+    assert b.shape == (2, 4)
+    # write-through view semantics (reference zero-copy Slice aliasing)
+    b[:] = 7.0
+    assert a.asnumpy()[0:2].sum() == 56
+
+
+def test_copy():
+    a = mx.nd.ones((2, 3))
+    b = a.copy()
+    b[:] = 2
+    assert a.asnumpy().sum() == 6
+    c = mx.nd.zeros((2, 3))
+    a.copyto(c)
+    assert c.asnumpy().sum() == 6
+    d = a.astype("int32")
+    assert d.dtype == np.int32
+
+
+def test_reshape_transpose():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    f = a.flatten()
+    assert f.shape == (2, 12)
+
+
+def test_generated_ops():
+    a = mx.nd.array(np.array([1.0, 4.0, 9.0]))
+    assert_almost_equal(mx.nd.sqrt(a).asnumpy(), np.array([1, 2, 3]))
+    assert_almost_equal(mx.nd.exp(mx.nd.zeros((2,))).asnumpy(), np.ones(2))
+    assert_almost_equal(mx.nd.sum(a).asnumpy(), 14.0)
+    assert_almost_equal(mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((3, 4))).asnumpy(),
+                        3 * np.ones((2, 4)))
+    assert_almost_equal(mx.nd.clip(a, a_min=2.0, a_max=5.0).asnumpy(), np.array([2, 4, 5]))
+    c = mx.nd.concat(mx.nd.ones((2, 2)), mx.nd.zeros((2, 2)), dim=1)
+    assert c.shape == (2, 4)
+    parts = mx.nd.split(mx.nd.ones((2, 4)), num_outputs=2, axis=1)
+    assert parts[0].shape == (2, 2)
+
+
+def test_out_kwarg():
+    a = mx.nd.array(np.array([4.0, 16.0]))
+    out = mx.nd.zeros((2,))
+    mx.nd.sqrt(a, out=out)
+    assert_almost_equal(out.asnumpy(), np.array([2.0, 4.0]))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    a = mx.nd.array(np.random.randn(3, 4).astype("float32"))
+    b = mx.nd.array(np.arange(5).astype("int32"), dtype="int32")
+    mx.nd.save(fname, {"a": a, "b": b})
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"a", "b"}
+    assert_almost_equal(loaded["a"].asnumpy(), a.asnumpy())
+    assert loaded["b"].dtype == np.int32
+    mx.nd.save(fname, [a, b])
+    as_list = mx.nd.load(fname)
+    assert isinstance(as_list, list) and len(as_list) == 2
+
+
+def test_comparison():
+    a = mx.nd.array(np.array([1.0, 2.0, 3.0]))
+    b = mx.nd.array(np.array([3.0, 2.0, 1.0]))
+    assert_almost_equal((a == b).asnumpy(), np.array([0, 1, 0]))
+    assert_almost_equal((a > b).asnumpy(), np.array([0, 0, 1]))
+    assert_almost_equal((a <= 2).asnumpy(), np.array([1, 1, 0]))
+
+
+def test_random():
+    mx.random.seed(42)
+    a = mx.nd.uniform(low=0, high=1, shape=(100, 100))
+    mx.random.seed(42)
+    b = mx.nd.uniform(low=0, high=1, shape=(100, 100))
+    assert_almost_equal(a.asnumpy(), b.asnumpy())
+    assert 0.45 < a.asnumpy().mean() < 0.55
+    c = mx.nd.normal(loc=2.0, scale=0.5, shape=(200, 200))
+    assert abs(c.asnumpy().mean() - 2.0) < 0.05
+
+
+def test_wait_to_read():
+    a = mx.nd.ones((10, 10))
+    b = a * 2
+    b.wait_to_read()
+    mx.nd.waitall()

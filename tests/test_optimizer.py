@@ -1,0 +1,133 @@
+"""Optimizer tests vs hand-written numpy updates (modeled on reference
+tests/python/unittest/test_optimizer.py:396)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(opt, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_vs_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(5, 4).astype("float32")
+    grads = [rng.randn(5, 4).astype("float32") for _ in range(5)]
+    lr, mom, wd = 0.1, 0.9, 0.01
+    got = _run_steps(mx.optimizer.SGD(learning_rate=lr, momentum=mom, wd=wd), w0, grads)
+    w = w0.copy()
+    v = np.zeros_like(w)
+    for g in grads:
+        gg = g + wd * w
+        v = mom * v - lr * gg
+        w = w + v
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum():
+    w0 = np.ones((3,), dtype="float32")
+    g = np.ones((3,), dtype="float32")
+    got = _run_steps(mx.optimizer.SGD(learning_rate=0.5), w0, [g])
+    assert_almost_equal(got, w0 - 0.5 * g)
+
+
+def test_adam_vs_numpy():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(10).astype("float32")
+    grads = [rng.randn(10).astype("float32") for _ in range(4)]
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    got = _run_steps(mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps),
+                     w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_rmsprop_vs_numpy():
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(6).astype("float32")
+    grads = [rng.randn(6).astype("float32") for _ in range(3)]
+    lr, gamma1, eps = 0.01, 0.9, 1e-8
+    got = _run_steps(mx.optimizer.RMSProp(learning_rate=lr, gamma1=gamma1, epsilon=eps),
+                     w0, grads)
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for g in grads:
+        n = (1 - gamma1) * g * g + gamma1 * n
+        w = w - lr * g / np.sqrt(n + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad_vs_numpy():
+    rng = np.random.RandomState(4)
+    w0 = rng.randn(6).astype("float32")
+    grads = [rng.randn(6).astype("float32") for _ in range(3)]
+    lr, eps = 0.1, 1e-7
+    got = _run_steps(mx.optimizer.AdaGrad(learning_rate=lr, eps=eps), w0, grads)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for g in grads:
+        h += g * g
+        w = w - lr * g / np.sqrt(h + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_clip_and_rescale():
+    w0 = np.zeros((4,), dtype="float32")
+    g = np.array([10.0, -10.0, 0.5, -0.5], dtype="float32")
+    opt = mx.optimizer.SGD(learning_rate=1.0, rescale_grad=0.1, clip_gradient=0.3)
+    got = _run_steps(opt, w0, [g])
+    expected = -np.clip(g * 0.1, -0.3, 0.3)
+    assert_almost_equal(got, expected)
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert opt._get_lr(0) == 1.0
+    opt.num_update = 11
+    assert opt._get_lr(0) == pytest.approx(0.5)
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    m.base_lr = 1.0
+    assert m(3) == 1.0
+    assert m(10) == pytest.approx(0.1)
+    assert m(20) == pytest.approx(0.01)
+
+
+def test_updater_per_key_state():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w1, w2 = mx.nd.ones((2,)), mx.nd.ones((3,))
+    upd(0, mx.nd.ones((2,)), w1)
+    upd(1, mx.nd.ones((3,)), w2)
+    assert 0 in upd.states and 1 in upd.states
+    assert upd.states[0].shape == (2,)
+
+
+def test_optimizer_registry():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "nag", "ftrl",
+                 "sgld", "dcasgd", "adamax", "nadam", "test"]:
+        opt = mx.optimizer.create(name)
+        assert isinstance(opt, mx.optimizer.Optimizer)
+
+
+def test_lr_wd_mult():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w", lr_mult=0.5, wd_mult=0.0)
+    out = mx.sym.dot(data, w)
+    opt = mx.optimizer.SGD(learning_rate=1.0, sym=out)
+    assert opt.lr_mult.get("w") == 0.5
+    opt.idx2name = {0: "w"}
+    assert opt._get_lr(0) == 0.5

@@ -1,0 +1,115 @@
+"""Symbol tests (modeled on reference tests/python/unittest/test_symbol.py +
+test_infer_shape.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def mlp2():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc1", num_hidden=1000)
+    out = mx.sym.Activation(out, act_type="relu")
+    out = mx.sym.FullyConnected(out, name="fc2", num_hidden=10)
+    return out
+
+
+def test_symbol_basic():
+    net = mlp2()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert net.list_outputs() == ["fc2_output"]
+    assert net.name == "fc2"
+
+
+def test_compose():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=100)
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+
+
+def test_infer_shape():
+    net = mlp2()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(100, 100))
+    assert arg_shapes == [(100, 100), (1000, 100), (1000,), (10, 1000), (10,)]
+    assert out_shapes == [(100, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1), name="conv1")
+    bn = mx.sym.BatchNorm(conv, name="bn1")
+    pool = mx.sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 28, 28))
+    assert arg_shapes[1] == (16, 3, 3, 3)  # conv1_weight
+    assert out_shapes == [(2, 16, 14, 14)]
+    assert aux_shapes == [(16,), (16,)]
+
+
+def test_grouped_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    fc2 = mx.sym.FullyConnected(data, num_hidden=20, name="fc2")
+    group = mx.sym.Group([fc1, fc2])
+    assert group.list_outputs() == ["fc1_output", "fc2_output"]
+    assert group[0].list_outputs() == ["fc1_output"]
+    assert group["fc2_output"].name == "fc2"
+
+
+def test_multi_output():
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=3, name="split")
+    assert len(parts.list_outputs()) == 3
+    out = parts[0] + parts[1] * parts[2]
+    _, out_shapes, _ = out.infer_shape(data=(2, 6))
+    assert out_shapes == [(2, 2)]
+
+
+def test_symbol_arith():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = 2 * a + b / a - 1
+    exe = c.bind(mx.cpu(), {"a": mx.nd.array([2.0]), "b": mx.nd.array([4.0])})
+    assert exe.forward()[0].asscalar() == 5.0
+
+
+def test_save_load_json(tmp_path):
+    net = mlp2()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net3 = mx.sym.load(fname)
+    assert net3.tojson() == net.tojson()
+    # a saved graph with aux states round-trips too
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(mx.sym.Convolution(data, kernel=(3, 3), num_filter=4), name="bn")
+    js = bn.tojson()
+    bn2 = mx.sym.load_json(js)
+    assert bn2.list_auxiliary_states() == bn.list_auxiliary_states()
+
+
+def test_internals():
+    net = mlp2()
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    _, out_shapes, _ = fc1.infer_shape(data=(10, 100))
+    assert out_shapes == [(10, 1000)]
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        fc = mx.sym.FullyConnected(a, num_hidden=10)
+    assert fc.attr("ctx_group") == "dev1"
+
+
+def test_variable_shape_attr():
+    v = mx.sym.Variable("x", shape=(3, 4))
+    out = v * 2
+    _, out_shapes, _ = out.infer_shape()
+    assert out_shapes == [(3, 4)]
