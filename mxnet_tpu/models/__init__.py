@@ -1,0 +1,15 @@
+"""Model zoo — symbol builders for the reference's example models
+(reference example/image-classification/symbols/, example/rnn/,
+example/ssd/; SURVEY.md §6 benchmark configs)."""
+from . import lenet
+from . import mlp
+from . import resnet
+from . import alexnet
+from . import vgg
+from . import inception_v3
+from .lenet import get_lenet
+from .mlp import get_mlp
+from .resnet import get_resnet
+from .alexnet import get_alexnet
+from .vgg import get_vgg
+from .inception_v3 import get_inception_v3

@@ -42,6 +42,14 @@ class _RngState:
 
 GLOBAL_RNG = _RngState(0)
 
+# Host-side generator for initializers / host sampling.  Module-private so
+# mx.random.seed never clobbers the user's global numpy stream (the
+# reference's random.seed doesn't touch numpy either).  Seeded 0 so default
+# runs are deterministic without an explicit seed.
+import numpy as _np  # noqa: E402
+
+HOST_RNG = _np.random.RandomState(0)
+
 
 def _key(rng):
     return rng if rng is not None else GLOBAL_RNG.next_key()

@@ -13,10 +13,16 @@ __all__ = ["seed", "uniform", "normal"]
 
 
 def seed(seed_state):
-    """Seed all random number generators (parity: mx.random.seed)."""
+    """Seed all random number generators (parity: mx.random.seed).
+
+    Seeds both the device-side JAX key chain (samplers, dropout) and the
+    host-side numpy generator used by initializers and data shuffling."""
     if not isinstance(seed_state, int):
         raise ValueError("seed_state must be int")
     GLOBAL_RNG.seed(seed_state)
+    from .ops.random_ops import HOST_RNG
+
+    HOST_RNG.seed(seed_state % (2 ** 32))
 
 
 def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, out=None, dtype="float32"):
