@@ -1,16 +1,18 @@
 #!/usr/bin/env python
 """Headline benchmark: ResNet-50 ImageNet-shape training throughput, 1 chip.
 
+Measures the FULL training step through the public API — Module.forward_
+backward + update (one fused XLA dispatch: fwd+bwd+SGD with donated
+buffers) — matching how the reference's 181.53 img/s baseline was measured
+(train_imagenet.py full steps on 1x P100, reference docs/how_to/perf.md:
+181-190).
+
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "img/s", "vs_baseline": N}
 
-Baseline: reference MXNet v0.10 training ResNet-50 batch 32 on 1x P100 =
-181.53 img/s (reference docs/how_to/perf.md:181-190; BASELINE.md).
-
 Methodology note: on the tunneled TPU platform `block_until_ready` can
-return early, so steps are fenced by a 1-element host transfer after N
-timed steps (transfer cost amortized; verified against known-FLOPs
-matmuls).
+return early, so the timed loop is fenced by NDArray.wait_to_read (scalar
+host transfer), amortized over N steps.
 """
 import json
 import time
@@ -24,37 +26,39 @@ STEPS = 30
 
 def main():
     import mxnet_tpu as mx
-    from mxnet_tpu.initializer import InitDesc, Xavier
     from mxnet_tpu.models.resnet import resnet
 
-    net = resnet(50)
-    exe = net.simple_bind(mx.tpu(), data=(BATCH, 3, 224, 224), softmax_label=(BATCH,))
-    init = Xavier(rnd_type="gaussian", factor_type="in", magnitude=2)
     mx.random.seed(0)
-    for name, arr in exe.arg_dict.items():
-        if name not in ("data", "softmax_label"):
-            init(InitDesc(name), arr)
+    net = resnet(50)
+    mod = mx.mod.Module(net, context=mx.tpu())
+    mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
     rng = np.random.RandomState(0)
-    exe.arg_dict["data"][:] = rng.randn(BATCH, 3, 224, 224).astype("float32")
-    exe.arg_dict["softmax_label"][:] = rng.randint(0, 1000, BATCH).astype("float32")
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(BATCH, 3, 224, 224).astype("float32"))],
+        label=[mx.nd.array(rng.randint(0, 1000, BATCH).astype("float32"))],
+    )
 
     def fence():
-        exe.grad_dict["conv0_weight"].wait_to_read()
+        mod._exec_group.execs[0].arg_dict["fc1_weight"].wait_to_read()
 
-    # warm-up (compile)
-    exe.forward(is_train=True)
-    exe.backward()
+    for _ in range(3):  # compile + settle
+        mod.forward_backward(batch)
+        mod.update()
     fence()
 
     t0 = time.time()
     for _ in range(STEPS):
-        exe.forward(is_train=True)
-        exe.backward()
+        mod.forward_backward(batch)
+        mod.update()
     fence()
     dt = (time.time() - t0) / STEPS
     img_s = BATCH / dt
     print(json.dumps({
-        "metric": "ResNet-50 train img/s/chip (batch 32, fwd+bwd)",
+        "metric": "ResNet-50 full train step img/s/chip (batch 32, fwd+bwd+SGD)",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),

@@ -28,7 +28,6 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray
-from .ops.random_ops import GLOBAL_RNG
 from .symbol import _topo_order
 
 __all__ = ["Executor"]
@@ -37,6 +36,8 @@ __all__ = ["Executor"]
 def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_train, rng):
     """Interpret the graph as pure JAX ops (traced once under jit).
 
+    `rng` is a jax PRNG key (or None); callers inside jit build it from a
+    host seed so no device-side key chain is maintained between steps.
     Returns (outputs tuple, aux_updates tuple ordered like aux_names).
     """
     arg_env = dict(zip(arg_names, arg_vals))
@@ -90,8 +91,9 @@ class Executor:
         self._outputs_cache = None
         self._last_is_train = False
         self._monitor_callback = None
-        self._rng = GLOBAL_RNG.next_key()
-        self._step_rng = self._rng
+        from .ops.random_ops import HOST_RNG
+
+        self._step_seed = int(HOST_RNG.randint(0, 2 ** 31))
         self._aux_applied = False
         self._jit_fwd = {}
         self._jit_bwd = {}
@@ -241,7 +243,7 @@ class Executor:
             self.arg_dict[name]._set_data(v)
         self._last_is_train = bool(is_train)
         self._outputs_cache = None
-        self._step_rng = self._next_rng()
+        self._next_seed()
         self._aux_applied = False
         if not is_train:
             self._compute_forward(False)
@@ -252,20 +254,28 @@ class Executor:
             entries, order = self._entries, self._order
             an, xn = self._arg_names, self._aux_names
 
-            def f(arg_vals, aux_vals, rng):
+            def f(arg_vals, aux_vals, seed):
+                rng = jax.random.key(seed)
                 return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train, rng)
 
             self._jit_fwd[is_train] = jax.jit(f)
         return self._jit_fwd[is_train]
 
-    def _next_rng(self):
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
+    def _next_seed(self):
+        # host-side step seed: device-side key splitting costs an RTT per
+        # step on tunneled TPUs; the key is derived from this seed INSIDE
+        # the jitted executable
+        from .ops.random_ops import HOST_RNG
+
+        self._step_seed = int(HOST_RNG.randint(0, 2 ** 31))
+        return self._step_seed
 
     def _compute_forward(self, is_train):
         fn = self._fwd_fn(is_train)
         args = self._place(self._gather_args())
-        outs, aux_upd = fn(args, self._gather_aux(), self._step_rng)
+        import numpy as _np
+
+        outs, aux_upd = fn(args, self._gather_aux(), _np.uint32(self._step_seed))
         self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
         if is_train and not self._aux_applied:
             self._write_aux(aux_upd)
@@ -288,37 +298,138 @@ class Executor:
             self._compute_forward(self._last_is_train)
         return self._outputs_cache
 
+    # ------------------------------------------------------------------
+    # single-dispatch training step (fwd + bwd + optimizer update in ONE
+    # XLA executable with donated param/state buffers — the reference's
+    # bulk-exec + update_on_kvstore taken to its limit)
+    # ------------------------------------------------------------------
+    def _grad_core(self, diff_idx, nondiff_idx):
+        """Build the shared fwd+vjp core used by both backward() and the
+        fused step — ONE place owns the vals scatter and aux cotangents."""
+        entries, order = self._entries, self._order
+        an, xn = self._arg_names, self._aux_names
+
+        def core(diff_vals, nondiff_vals, aux_vals, rng, head_grads):
+            def fwd(dv):
+                vals = [None] * len(an)
+                for i, v in zip(diff_idx, dv):
+                    vals[i] = v
+                for i, v in zip(nondiff_idx, nondiff_vals):
+                    vals[i] = v
+                outs, aux_upd = _run_graph(entries, order, an, xn, tuple(vals),
+                                           aux_vals, True, rng)
+                return outs, aux_upd
+
+            (outs, aux_upd), vjp_fn = jax.vjp(fwd, diff_vals)
+            if head_grads is None:
+                cots = tuple(jnp.ones_like(o) for o in outs)
+            else:
+                cots = tuple(head_grads)
+            zero_aux = tuple(jnp.zeros_like(a) for a in aux_upd)
+            (grads,) = vjp_fn((cots, zero_aux))
+            return outs, aux_upd, grads
+
+        return core
+
+    def install_fused_update(self, updater, index_of_name):
+        """Arm the single-dispatch step.  After this, `backward()` with no
+        head grads defers, and `fused_update()` runs fwd+bwd+update in one
+        jitted call.  `index_of_name` maps arg name -> optimizer key."""
+        self._fused_updater = updater
+        self._fused_index_of_name = dict(index_of_name)
+        self._jit_step = None
+        self._pending_fused = False
+        # step-invariant structure, computed once (grad_req/args fixed at bind)
+        an = self._arg_names
+        diff_names = [n for n in an if self._grad_req.get(n, "null") != "null"]
+        diff_idx = [an.index(n) for n in diff_names]
+        self._fused_static = (
+            diff_names,
+            diff_idx,
+            [i for i in range(len(an)) if i not in set(diff_idx)],
+        )
+
+    def fused_update(self):
+        """Run the armed single-dispatch training step (see install_fused_update)."""
+        import numpy as _np
+
+        from .optimizer import _state_leaves
+
+        updater = self._fused_updater
+        opt = updater.optimizer
+        diff_names, diff_idx, nondiff_idx = self._fused_static
+        # ensure per-key optimizer state + counts (host side)
+        leaves_by_name = {}
+        scalars = _np.empty((len(diff_names), 3), dtype=_np.float32)
+        for row, n in enumerate(diff_names):
+            key = self._fused_index_of_name[n]
+            if key not in updater.states:
+                updater.states[key] = opt.create_state(key, self.arg_dict[n])
+            opt._update_count(key)
+            leaves_by_name[n] = _state_leaves(updater.states[key])
+            scalars[row, 0] = opt._get_lr(key)
+            scalars[row, 1] = opt._get_wd(key)
+            scalars[row, 2] = opt._index_update_count[key]
+        sig = tuple((n, tuple(l.shape for l in leaves_by_name[n])) for n in diff_names)
+        if self._jit_step is None or self._jit_step[1] != sig:
+            core = self._grad_core(diff_idx, nondiff_idx)
+
+            def step(diff_vals, nondiff_vals, aux_vals, state_tuples, seed, scalars_arr):
+                rng = jax.random.key(seed)
+                outs, aux_upd, grads = core(diff_vals, nondiff_vals, aux_vals, rng, None)
+                new_params, new_states = [], []
+                for i, (w, g, st) in enumerate(zip(diff_vals, grads, state_tuples)):
+                    nw, nst = opt._fused(w, g, st, scalars_arr[i, 0], scalars_arr[i, 1],
+                                         scalars_arr[i, 2])
+                    new_params.append(nw)
+                    new_states.append(nst)
+                return outs, aux_upd, tuple(new_params), tuple(new_states)
+
+            jitted = jax.jit(step, donate_argnums=(0, 3))
+            self._jit_step = (jitted, sig)
+        fn = self._jit_step[0]
+        all_vals = self._place(self._gather_args())
+        diff_vals = tuple(all_vals[i] for i in diff_idx)
+        nondiff_vals = tuple(all_vals[i] for i in nondiff_idx)
+        state_tuples = tuple(tuple(l.data for l in leaves_by_name[n]) for n in diff_names)
+        outs, aux_upd, new_params, new_states = fn(
+            diff_vals, nondiff_vals, self._gather_aux(), state_tuples,
+            _np.uint32(self._step_seed), scalars,
+        )
+        self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
+        if not self._aux_applied:
+            self._write_aux(aux_upd)
+            self._aux_applied = True
+        self._pending_fused = False
+        for n, nw, nst in zip(diff_names, new_params, new_states):
+            self.arg_dict[n]._set_data(nw)
+            for l, v in zip(leaves_by_name[n], nst):
+                l._set_data(v)
+
     def backward(self, out_grads=None):
-        """Fused forward+backward in one XLA executable; grads land per grad_req."""
+        """Fused forward+backward in one XLA executable; grads land per grad_req.
+
+        When a fused update is installed (see install_fused_update) and no
+        head gradients are given, backward defers — update() completes the
+        whole step in one dispatch.  grad_dict is NOT materialized on that
+        path (gradients live only inside the fused executable)."""
+        if getattr(self, "_fused_updater", None) is not None and out_grads is None:
+            self._pending_fused = True
+            return
         diff_names = [n for n in self._arg_names if self._grad_req.get(n, "null") != "null"]
         if not diff_names:
             return
         has_heads = out_grads is not None
         key = (True, has_heads)
         if key not in self._jit_bwd:
-            entries, order = self._entries, self._order
-            an, xn = self._arg_names, self._aux_names
+            an = self._arg_names
             diff_idx = [an.index(n) for n in diff_names]
             nondiff_idx = [i for i in range(len(an)) if i not in diff_idx]
+            core = self._grad_core(diff_idx, nondiff_idx)
 
-            def f(diff_vals, nondiff_vals, aux_vals, rng, head_grads):
-                def fwd(dv):
-                    vals = [None] * len(an)
-                    for i, v in zip(diff_idx, dv):
-                        vals[i] = v
-                    for i, v in zip(nondiff_idx, nondiff_vals):
-                        vals[i] = v
-                    outs, aux_upd = _run_graph(entries, order, an, xn, tuple(vals), aux_vals, True, rng)
-                    return outs, aux_upd
-
-                (outs, aux_upd), vjp_fn = jax.vjp(fwd, diff_vals, has_aux=False)
-                if head_grads is None:
-                    cots = tuple(jnp.ones_like(o) for o in outs)
-                else:
-                    cots = tuple(head_grads)
-                zero_aux = tuple(jnp.zeros_like(a) for a in aux_upd)
-                (grads,) = vjp_fn((cots, zero_aux))
-                return outs, aux_upd, grads
+            def f(diff_vals, nondiff_vals, aux_vals, seed, head_grads):
+                rng = jax.random.key(seed)
+                return core(diff_vals, nondiff_vals, aux_vals, rng, head_grads)
 
             self._jit_bwd[key] = (jax.jit(f), diff_names, diff_idx, nondiff_idx)
         fn, diff_names, diff_idx, nondiff_idx = self._jit_bwd[key]
@@ -330,7 +441,10 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             heads = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads)
-        outs, aux_upd, grads = fn(diff_vals, nondiff_vals, self._gather_aux(), self._step_rng, heads)
+        import numpy as _np
+
+        outs, aux_upd, grads = fn(diff_vals, nondiff_vals, self._gather_aux(),
+                                  _np.uint32(self._step_seed), heads)
         self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
         if not self._aux_applied:
             self._write_aux(aux_upd)
@@ -401,6 +515,10 @@ class Executor:
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
+        if callback is not None and getattr(self, "_fused_updater", None) is not None:
+            # monitors need materialized outputs/grads — the single-dispatch
+            # step keeps gradients inside the executable, so disarm it
+            self._fused_updater = None
 
     def debug_str(self):
         lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]

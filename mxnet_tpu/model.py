@@ -72,7 +72,20 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None, param_names=None):
-    """Local updater path (parity: model.py:101-125)."""
+    """Local updater path (parity: model.py:101-125).
+
+    TPU fast path: when no kvstore round-trip is involved, every parameter's
+    update is fused into ONE jitted call via Updater.update_batch — the
+    per-key loop would pay a device RTT per parameter."""
+    if kvstore is None and hasattr(updater, "update_batch"):
+        triples = []
+        for index, (arg_list, grad_list) in enumerate(zip(param_arrays, grad_arrays)):
+            if grad_list[0] is None:
+                continue
+            for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+                triples.append((index * num_device + k, g, w))
+        updater.update_batch(triples)
+        return
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:

@@ -271,6 +271,7 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
+            self._maybe_install_fused_update()
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
@@ -295,10 +296,37 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec_group.backward(out_grads=out_grads)
 
+    def _maybe_install_fused_update(self):
+        """Arm the single-dispatch fwd+bwd+update step when safe:
+        fused-capable optimizer, no kvstore round-trip, plain 'write'
+        grad_req, no input grads (those need materialized grad_dict)."""
+        exe = self._exec_group.execs[0]
+        if (
+            self._optimizer.fused_supported
+            and self._kvstore is None
+            and not self.inputs_need_grad
+            and all(exe._grad_req.get(n) == "write" for n in self._param_names)
+            and exe._monitor_callback is None
+        ):
+            index_of_name = {
+                name: i * len(self._context)
+                for i, name in enumerate(self._exec_group.param_names)
+            }
+            exe.install_fused_update(self._updater, index_of_name)
+
     def update(self):
         """Apply optimizer using accumulated grads (parity: module.py update:571)."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        exe = self._exec_group.execs[0]
+        if getattr(exe, "_pending_fused", False):
+            if getattr(exe, "_fused_updater", None) is not None:
+                exe.fused_update()
+                return
+            # disarmed between backward and update (e.g. monitor installed):
+            # materialize the deferred backward so grads are real
+            exe._pending_fused = False
+            exe.backward()
         if self._update_on_kvstore:
             _update_params_on_kvstore(
                 self._exec_group.param_arrays, self._exec_group.grad_arrays,
