@@ -1,0 +1,176 @@
+"""ImageRecordIter — RecordIO-packed image pipeline.
+
+Parity: reference src/io/iter_image_recordio_2.cc composition chain
+(record parser → decode/augment workers → BatchLoader → Normalize →
+Prefetcher, SURVEY.md §3.3).  The byte-level record scan runs in native
+C++ (src/recordio.cc); decode+augment run in a Python thread pool (PIL/cv2
+release the GIL); a background prefetch thread double-buffers batches ahead
+of the consumer feeding the device.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import array
+from .ops.random_ops import HOST_RNG
+from .recordio import unpack, _decode_img
+
+__all__ = ["ImageRecordIterImpl"]
+
+
+class ImageRecordIterImpl(DataIter):
+    """Iterator over an im2rec-packed .rec file (parity: ImageRecordIter)."""
+
+    def __init__(self, path_imgrec=None, data_shape=None, batch_size=1,
+                 label_width=1, shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, mean_img=None, scale=1.0,
+                 preprocess_threads=4, prefetch_buffer=4, round_batch=True,
+                 data_name="data", label_name="softmax_label", seed=0,
+                 part_index=0, num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        if path_imgrec is None or data_shape is None:
+            raise MXNetError("path_imgrec and data_shape are required")
+        from .native import NativeRecordReader, native_index
+
+        self.path = path_imgrec
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
+        self.scale = scale
+        self.data_name = data_name
+        self.label_name = label_name
+        self._rng = _np.random.RandomState(seed)
+        self._reader = NativeRecordReader(path_imgrec)
+        offsets = native_index(path_imgrec)
+        # sharded reading for distributed training (reference
+        # dmlc::InputSplit rank sharding, iter_image_recordio.cc)
+        self._offsets = offsets[part_index::num_parts]
+        if not self._offsets:
+            raise MXNetError("no records in shard %d/%d of %s" % (part_index, num_parts, path_imgrec))
+        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._queue = _queue.Queue(maxsize=prefetch_buffer)
+        self._producer = None
+        self._epoch_order = None
+        self._stop = threading.Event()
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [
+            DataDesc(label_name, (batch_size,) if label_width == 1 else (batch_size, label_width))
+        ]
+        self.reset()
+
+    # ------------------------------------------------------------------
+    def _decode_one(self, raw):
+        header, payload = unpack(raw)
+        img = _decode_img(payload)
+        img = _np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        c, h, w = self.data_shape
+        # crop/resize to target (random crop for training parity:
+        # reference image_aug_default.cc rand_crop)
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            # upscale small images with nearest repeat
+            ry = max(1, -(-h // ih))
+            rx = max(1, -(-w // iw))
+            img = _np.repeat(_np.repeat(img, ry, axis=0), rx, axis=1)
+            ih, iw = img.shape[:2]
+        if self.rand_crop and (ih > h or iw > w):
+            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = self._rng.randint(0, iw - w + 1)
+        else:
+            y0 = (ih - h) // 2
+            x0 = (iw - w) // 2
+        img = img[y0 : y0 + h, x0 : x0 + w]
+        if img.shape[2] < c:
+            img = _np.repeat(img, c, axis=2)
+        elif img.shape[2] > c:
+            img = img[:, :, :c]
+        if self.rand_mirror and self._rng.randint(2):
+            img = img[:, ::-1]
+        out = img.transpose(2, 0, 1).astype(_np.float32)
+        if self.mean.any():
+            out -= self.mean[:c].reshape(c, 1, 1)
+        if self.scale != 1.0:
+            out *= self.scale
+        label = header.label
+        if not _np.isscalar(label) and hasattr(label, "__len__"):
+            label = _np.asarray(label, dtype=_np.float32)[: self.label_width]
+        return out, label
+
+    def _produce(self, order):
+        try:
+            batch_data = _np.empty((self.batch_size,) + self.data_shape, dtype=_np.float32)
+            lshape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+            i = 0
+            batch_label = _np.zeros(lshape, dtype=_np.float32)
+            futures = []
+            for off in order:
+                if self._stop.is_set():
+                    return
+                raw = self._reader.read_at(off)
+                futures.append(self._pool.submit(self._decode_one, raw))
+                if len(futures) == self.batch_size:
+                    for j, fut in enumerate(futures):
+                        img, label = fut.result()
+                        batch_data[j] = img
+                        batch_label[j] = label
+                    self._queue.put((batch_data.copy(), batch_label.copy()))
+                    futures = []
+            # last partial batch: pad by wrapping (reference pad semantics)
+            if futures:
+                pad = self.batch_size - len(futures)
+                for j, fut in enumerate(futures):
+                    img, label = fut.result()
+                    batch_data[j] = img
+                    batch_label[j] = label
+                for j in range(len(futures), self.batch_size):
+                    batch_data[j] = batch_data[j - len(futures)]
+                    batch_label[j] = batch_label[j - len(futures)]
+                self._queue.put((batch_data.copy(), batch_label.copy(), pad))
+        finally:
+            self._queue.put(None)
+
+    def reset(self):
+        self._stop.set()
+        if self._producer is not None:
+            while self._producer.is_alive():
+                try:
+                    self._queue.get_nowait()
+                except _queue.Empty:
+                    pass
+                self._producer.join(timeout=0.01)
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except _queue.Empty:
+                    break
+        self._stop.clear()
+        order = list(self._offsets)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        self._producer = threading.Thread(target=self._produce, args=(order,), daemon=True)
+        self._producer.start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if len(item) == 3:
+            data, label, pad = item
+        else:
+            data, label = item
+            pad = 0
+        return DataBatch(data=[array(data)], label=[array(label)], pad=pad, index=None)
+
+    def __del__(self):
+        self._stop.set()

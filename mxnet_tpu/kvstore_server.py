@@ -1,0 +1,29 @@
+"""KVStore server/scheduler bootstrap.
+
+Parity: reference python/mxnet/kvstore_server.py:11-85 —
+`_init_kvstore_server_module` keeps non-worker roles inside the blocking
+server loop; importing mxnet_tpu in a process whose DMLC_ROLE is 'server'
+or 'scheduler' never returns to user code (it exits when the job stops),
+exactly like the reference's `MXKVStoreRunServer`.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["init_server_module"]
+
+
+def init_server_module():
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "worker":
+        return
+    from .parallel import dist
+
+    if role == "scheduler":
+        dist.run_scheduler()
+    elif role == "server":
+        dist.run_server()
+    else:
+        raise ValueError("unknown DMLC_ROLE %s" % role)
+    sys.exit(0)

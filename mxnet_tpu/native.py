@@ -1,0 +1,157 @@
+"""Native library loader (ctypes bridge to src/*.cc).
+
+The runtime's host-side hot paths are C++ (SURVEY.md requirement: native
+components for the IO/runtime layer, like the reference's dmlc-core/C++
+iterators).  The shared object is built on demand with g++ the first time
+it's needed and cached next to the package; `setup.py build_native` does
+the same ahead of time.  Pure-Python fallbacks keep everything working if
+no toolchain is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["get_recordio_lib"]
+
+_LOCK = threading.Lock()
+_LIB = {}
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
+
+
+def _build(name, sources):
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, "lib%s.so" % name)
+    srcs = [os.path.join(_SRC_DIR, s) for s in sources]
+    if os.path.exists(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
+    ):
+        return out
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out] + srcs
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
+def _load(name, sources):
+    with _LOCK:
+        if name in _LIB:
+            return _LIB[name]
+        try:
+            path = _build(name, sources)
+            lib = ctypes.CDLL(path)
+        except Exception:
+            lib = None
+        _LIB[name] = lib
+        return lib
+
+
+def get_recordio_lib():
+    """Load (building if needed) the native RecordIO engine; None if no
+    toolchain."""
+    lib = _load("recordio", ["recordio.cc"])
+    if lib is None:
+        return None
+    if not getattr(lib, "_rio_configured", False):
+        lib.rio_open_reader.restype = ctypes.c_void_p
+        lib.rio_open_reader.argtypes = [ctypes.c_char_p]
+        lib.rio_close_reader.argtypes = [ctypes.c_void_p]
+        lib.rio_seek.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.rio_tell.restype = ctypes.c_long
+        lib.rio_tell.argtypes = [ctypes.c_void_p]
+        lib.rio_read_batch.restype = ctypes.c_long
+        lib.rio_read_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.rio_index.restype = ctypes.c_long
+        lib.rio_index.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.c_long]
+        lib.rio_read_at.restype = ctypes.c_long
+        lib.rio_read_at.argtypes = [ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
+        lib.rio_open_writer.restype = ctypes.c_void_p
+        lib.rio_open_writer.argtypes = [ctypes.c_char_p]
+        lib.rio_write.restype = ctypes.c_long
+        lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
+        lib.rio_close_writer.argtypes = [ctypes.c_void_p]
+        lib._rio_configured = True
+    return lib
+
+
+class NativeRecordReader:
+    """Batched native reader over a .rec file."""
+
+    def __init__(self, path):
+        self._lib = get_recordio_lib()
+        if self._lib is None:
+            raise RuntimeError("native recordio unavailable")
+        self._h = self._lib.rio_open_reader(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+        self._buf_cap = 1 << 20
+        self._buf = ctypes.create_string_buffer(self._buf_cap)
+
+    def read_batch(self, n):
+        """Return a list of up to n record payloads (bytes); [] at EOF."""
+        out = []
+        sizes = (ctypes.c_long * n)()
+        while len(out) < n:
+            want = n - len(out)
+            got = self._lib.rio_read_batch(self._h, want, self._buf, self._buf_cap, sizes)
+            if got == -2:  # next record larger than buffer: grow and retry
+                self._buf_cap *= 4
+                self._buf = ctypes.create_string_buffer(self._buf_cap)
+                continue
+            if got == -1:
+                raise IOError("corrupt RecordIO stream")
+            if got == 0:  # EOF
+                break
+            off = 0
+            raw = self._buf.raw
+            for i in range(got):
+                out.append(raw[off : off + sizes[i]])
+                off += sizes[i]
+        return out
+
+    def read_at(self, offset):
+        while True:
+            got = self._lib.rio_read_at(self._h, offset, self._buf, self._buf_cap)
+            if got == -2:
+                self._buf_cap *= 4
+                self._buf = ctypes.create_string_buffer(self._buf_cap)
+                continue
+            if got == -1:
+                raise IOError("corrupt RecordIO record at %d" % offset)
+            return self._buf.raw[:got]
+
+    def seek(self, offset):
+        self._lib.rio_seek(self._h, offset)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_close_reader(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_index(path):
+    """Offsets of every record in the file (native full-file scan)."""
+    lib = get_recordio_lib()
+    if lib is None:
+        raise RuntimeError("native recordio unavailable")
+    cap = 1 << 16
+    while True:
+        offsets = (ctypes.c_long * cap)()
+        count = lib.rio_index(path.encode(), offsets, cap)
+        if count < 0:
+            raise IOError("corrupt RecordIO file %s" % path)
+        if count <= cap:
+            return list(offsets[:count])
+        cap = count

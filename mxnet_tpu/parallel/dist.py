@@ -1,0 +1,488 @@
+"""Distributed parameter-server backend.
+
+TPU-native replacement for the reference's ps-lite stack (SURVEY.md §2 ⚙9):
+  * Scheduler  ≙ ps::Postoffice + dmlc tracker — rank assignment, address
+    book, barriers, liveness (reference kvstore_dist.h:144-170).
+  * Server     ≙ KVStoreDistServer (reference kvstore_dist_server.h:136-228)
+    — per-key stores, sync-mode aggregation applying the optimizer once all
+    workers contributed, async-mode immediate updates, command channel
+    (kStopServer / kSyncMode / optimizer shipping).
+  * Worker     ≙ KVStoreDist — key sharding over servers: arrays above
+    MXNET_KVSTORE_BIGARRAY_BOUND elements are split evenly over ALL servers,
+    small keys go to hash(key) % num_servers (reference kvstore_dist.h:
+    276-320 EncodeKey).
+
+Topology comes from the reference's env contract: DMLC_ROLE,
+DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER, DMLC_NUM_SERVER.
+
+Transport is length-prefixed binary frames over TCP (numpy raw payloads —
+no pickling of tensor data).  The optimizer object shipped by
+`set_optimizer` IS pickled, mirroring the reference's python-pickled
+optimizer (python/mxnet/kvstore.py set_optimizer); this assumes the
+cluster is the user's own, as in the reference.
+
+On TPU pods the gradient path for `dist_sync` data-parallelism should
+normally be XLA collectives over ICI/DCN (one SPMD executable — see
+executor.py); this process-based PS exists for full capability parity:
+`dist_async` (Hogwild semantics have no collective mapping) and
+parameter-server-style topologies.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["Scheduler", "Server", "DistKVStore", "run_scheduler", "run_server"]
+
+# frame commands
+_REGISTER = 1
+_ADDRS = 2
+_BARRIER = 3
+_BARRIER_DONE = 4
+_INIT = 5
+_PUSH = 6
+_PULL = 7
+_VALUE = 8
+_COMMAND = 9
+_STOP = 10
+_ACK = 11
+_SETSYNC = 12
+
+BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
+
+
+# ----------------------------------------------------------------------
+# framing: [u32 total_len][u8 cmd][u32 meta_len][meta bytes][payload bytes]
+# ----------------------------------------------------------------------
+
+
+def _send_frame(sock, cmd, meta=b"", payload=b""):
+    header = struct.pack("<IBI", 1 + 4 + len(meta) + len(payload), cmd, len(meta))
+    sock.sendall(header + meta + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock):
+    (total,) = struct.unpack("<I", _recv_exact(sock, 4))
+    body = _recv_exact(sock, total)
+    cmd = body[0]
+    (meta_len,) = struct.unpack("<I", body[1:5])
+    meta = body[5 : 5 + meta_len]
+    payload = body[5 + meta_len :]
+    return cmd, meta, payload
+
+
+def _connect_retry(addr, timeout=60.0):
+    """Connect with retry — roles race at startup (slow jax imports)."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return socket.create_connection(addr, timeout=60)
+        except (ConnectionRefusedError, OSError):
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def _meta(**kwargs):
+    return repr(kwargs).encode()
+
+
+def _parse_meta(meta):
+    import ast
+
+    return ast.literal_eval(meta.decode()) if meta else {}
+
+
+# ----------------------------------------------------------------------
+# Scheduler — rank assignment + address book + barrier (Postoffice analog)
+# ----------------------------------------------------------------------
+
+
+class Scheduler:
+    def __init__(self, port, num_workers, num_servers):
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("", port))
+        self.sock.listen(128)
+        self._lock = threading.Condition()
+        self._server_addrs = {}
+        self._ranks = {"worker": 0, "server": 0}
+        self._barrier_waiters = []
+        self._stopped = False
+
+    def serve_forever(self):
+        """Register num_workers+num_servers nodes, then service barriers
+        until all workers disconnect."""
+        conns = []
+        while len(conns) < self.num_workers + self.num_servers:
+            conn, _ = self.sock.accept()
+            cmd, meta, _ = _recv_frame(conn)
+            assert cmd == _REGISTER
+            info = _parse_meta(meta)
+            role = info["role"]
+            with self._lock:
+                rank = self._ranks[role]
+                self._ranks[role] += 1
+                if role == "server":
+                    self._server_addrs[rank] = (info["host"], info["port"])
+            conns.append((conn, role, rank))
+        # everyone registered: broadcast address book + ranks
+        addrs = [self._server_addrs[r] for r in sorted(self._server_addrs)]
+        for conn, role, rank in conns:
+            _send_frame(conn, _ADDRS, _meta(rank=rank, servers=addrs))
+        # serve barriers on worker connections
+        threads = []
+        for conn, role, rank in conns:
+            if role != "worker":
+                continue
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                cmd, meta, _ = _recv_frame(conn)
+                if cmd == _BARRIER:
+                    with self._lock:
+                        self._barrier_waiters.append(conn)
+                        if len(self._barrier_waiters) == self.num_workers:
+                            for c in self._barrier_waiters:
+                                _send_frame(c, _BARRIER_DONE)
+                            self._barrier_waiters = []
+                            self._lock.notify_all()
+        except (ConnectionError, OSError):
+            pass
+
+
+# ----------------------------------------------------------------------
+# Server — sharded key-value store with sync/async update application
+# ----------------------------------------------------------------------
+
+
+class _KeyState:
+    __slots__ = ("key", "value", "version", "merge", "count", "cond")
+
+    def __init__(self, key, value):
+        self.key = key
+        self.value = value
+        self.version = 0
+        self.merge = None
+        self.count = 0
+        self.cond = threading.Condition()
+
+
+class Server:
+    """One parameter-server shard (reference KVStoreDistServer)."""
+
+    def __init__(self, port, num_workers):
+        self.num_workers = num_workers
+        self.sync_mode = False
+        self.updater = None  # (key:str, recv np, stored np) -> None
+        self.store = {}
+        self._store_lock = threading.Lock()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("", port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(128)
+        self._stop = threading.Event()
+
+    def serve_forever(self):
+        threads = []
+        while not self._stop.is_set():
+            try:
+                self.sock.settimeout(0.5)
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            threads.append(t)
+
+    def _get_state(self, key, value=None):
+        with self._store_lock:
+            if key not in self.store:
+                self.store[key] = _KeyState(key, value)
+            return self.store[key]
+
+    def _apply(self, st, recved):
+        """Apply an aggregated gradient / pushed value to the stored weight
+        (reference kvstore_dist_server.h:164-228 ApplyUpdates)."""
+        if self.updater is not None:
+            self.updater(st, recved)
+        else:
+            st.value = recved.copy()
+        st.version += 1
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                cmd, meta, payload = _recv_frame(conn)
+                info = _parse_meta(meta)
+                if cmd == _INIT:
+                    key = info["key"]
+                    arr = np.frombuffer(payload, dtype=info["dtype"]).reshape(info["shape"]).copy()
+                    st = self._get_state(key)
+                    with st.cond:
+                        if st.value is None:  # re-Init of existing key ignored
+                            st.value = arr
+                            st.version = 0
+                    _send_frame(conn, _ACK)
+                elif cmd == _PUSH:
+                    key = info["key"]
+                    arr = np.frombuffer(payload, dtype=info["dtype"]).reshape(info["shape"])
+                    st = self._get_state(key, np.zeros_like(arr))
+                    with st.cond:
+                        if self.sync_mode:
+                            if st.merge is None:
+                                st.merge = arr.copy()
+                                st.count = 1
+                            else:
+                                st.merge += arr
+                                st.count += 1
+                            if st.count == self.num_workers:
+                                self._apply(st, st.merge)
+                                st.merge = None
+                                st.count = 0
+                                st.cond.notify_all()
+                        else:
+                            self._apply(st, arr)
+                            st.cond.notify_all()
+                    _send_frame(conn, _ACK)
+                elif cmd == _PULL:
+                    key = info["key"]
+                    min_version = info.get("min_version", 0)
+                    st = self._get_state(key)
+                    with st.cond:
+                        while st.value is None or st.version < min_version:
+                            st.cond.wait(timeout=60)
+                        value = st.value
+                        version = st.version
+                    _send_frame(conn, _VALUE,
+                                _meta(shape=list(value.shape), dtype=str(value.dtype),
+                                      version=version),
+                                value.tobytes())
+                elif cmd == _SETSYNC:
+                    self.sync_mode = bool(info["sync"])
+                    _send_frame(conn, _ACK)
+                elif cmd == _COMMAND:
+                    # optimizer shipped from rank-0 worker (reference
+                    # set_optimizer pickling, kvstore.py)
+                    optimizer = pickle.loads(payload)
+                    from .. import optimizer as opt_mod
+                    from ..ndarray import NDArray, array
+
+                    updater = opt_mod.get_updater(optimizer)
+
+                    def apply_update(st_, recved, _updater=updater):
+                        w = array(st_.value)
+                        g = array(recved)
+                        _updater(st_.key, g, w)
+                        st_.value = np.asarray(w.asnumpy())
+
+                    self.updater = apply_update
+                    _send_frame(conn, _ACK)
+                elif cmd == _STOP:
+                    _send_frame(conn, _ACK)
+                    self._stop.set()
+                    return
+        except (ConnectionError, OSError):
+            pass
+
+
+# ----------------------------------------------------------------------
+# Worker client
+# ----------------------------------------------------------------------
+
+
+class DistKVStore:
+    """Distributed kvstore client (parity: reference KVStoreDist +
+    python/mxnet/kvstore.py for dist types)."""
+
+    def __init__(self, kv_type="dist_sync"):
+        from ..kvstore import KVStore  # local aggregation façade
+
+        self.type = kv_type
+        self._local = KVStore("local")
+        root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        self._sched = _connect_retry((root, port))
+        _send_frame(self._sched, _REGISTER, _meta(role="worker", host="", port=0))
+        cmd, meta, _ = _recv_frame(self._sched)
+        assert cmd == _ADDRS
+        info = _parse_meta(meta)
+        self._rank = info["rank"]
+        self._server_addrs = info["servers"]
+        self._servers = [_connect_retry(tuple(a)) for a in self._server_addrs]
+        self._server_locks = [threading.Lock() for _ in self._servers]
+        self._push_round = {}
+        self._updater = None
+        if "sync" in self.type and self._rank == 0:
+            # rank-0 flips servers to sync mode (reference kvstore.cc:30-34)
+            for i in range(len(self._servers)):
+                self._rpc(i, _SETSYNC, _meta(sync=True))
+        self.barrier()
+
+    # -- plumbing ------------------------------------------------------
+    def _rpc(self, server_i, cmd, meta=b"", payload=b"", want=(_ACK,)):
+        with self._server_locks[server_i]:
+            _send_frame(self._servers[server_i], cmd, meta, payload)
+            rcmd, rmeta, rpayload = _recv_frame(self._servers[server_i])
+        assert rcmd in want, (rcmd, want)
+        return rmeta, rpayload
+
+    def _shards(self, key, arr):
+        """Key→server placement (reference EncodeKey kvstore_dist.h:276-320):
+        big arrays split evenly over all servers, small ones hashed."""
+        flat = arr.reshape(-1)
+        n = len(self._servers)
+        if flat.size > BIGARRAY_BOUND and n > 1:
+            bounds = [(i * flat.size) // n for i in range(n + 1)]
+            return [(i, "%s#%d" % (key, i), flat[bounds[i]:bounds[i + 1]])
+                    for i in range(n) if bounds[i + 1] > bounds[i]]
+        # deterministic across processes — python's str hash is randomized
+        # per process, which would scatter the same key to different servers
+        import zlib
+
+        return [(zlib.crc32(str(key).encode()) % n, str(key), flat)]
+
+    # -- public api (parity: kvstore.py) --------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def barrier(self):
+        _send_frame(self._sched, _BARRIER)
+        cmd, _, _ = _recv_frame(self._sched)
+        assert cmd == _BARRIER_DONE
+
+    def init(self, key, value):
+        keys, vals = ([key], [value]) if not isinstance(key, (list, tuple)) else (list(key), list(value))
+        for k, v in zip(keys, vals):
+            arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+            if self._rank == 0:
+                for si, skey, shard in self._shards(k, arr):
+                    self._rpc(si, _INIT,
+                              _meta(key=skey, shape=list(shard.shape), dtype=str(shard.dtype)),
+                              np.ascontiguousarray(shard).tobytes())
+            self._push_round[k] = 0
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, vals = ([key], [value]) if not isinstance(key, (list, tuple)) else (list(key), list(value))
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                merged = v[0].copy()
+                for o in v[1:]:
+                    merged += o
+                arr = merged.asnumpy()
+            else:
+                arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+            for si, skey, shard in self._shards(k, arr):
+                self._rpc(si, _PUSH,
+                          _meta(key=skey, shape=list(shard.shape), dtype=str(shard.dtype)),
+                          np.ascontiguousarray(shard).tobytes())
+            self._push_round[k] = self._push_round.get(k, 0) + 1
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = ([key], [out]) if not isinstance(key, (list, tuple)) else (list(key), list(out))
+        for k, o in zip(keys, outs):
+            first = o[0] if isinstance(o, (list, tuple)) else o
+            shape = first.shape
+            total = int(np.prod(shape))
+            flat = np.empty((total,), dtype=np.float32)
+            min_version = self._push_round.get(k, 0) if "sync" in self.type else 0
+            pieces = self._shards(k, flat)
+            for si, skey, shard in pieces:
+                meta, payload = self._rpc(
+                    si, _PULL, _meta(key=skey, min_version=min_version), want=(_VALUE,)
+                )
+                info = _parse_meta(meta)
+                got = np.frombuffer(payload, dtype=info["dtype"])
+                shard[:] = got
+            value = flat.reshape(shape)
+            if isinstance(o, (list, tuple)):
+                for oo in o:
+                    oo[:] = value
+            else:
+                o[:] = value
+
+    def set_optimizer(self, optimizer):
+        if self._rank == 0:
+            blob = pickle.dumps(optimizer, 0)
+            for i in range(len(self._servers)):
+                self._rpc(i, _COMMAND, b"", blob)
+        self.barrier()
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier_before_exit(self):
+        self.barrier()
+
+    def close(self):
+        """Rank-0 stops servers (reference kStopServer on finalize)."""
+        self.barrier()
+        if self._rank == 0:
+            for i in range(len(self._servers)):
+                try:
+                    self._rpc(i, _STOP)
+                except Exception:
+                    pass
+
+    def save_optimizer_states(self, fname):
+        raise MXNetError("Cannot save states for distributed training")
+
+    def load_optimizer_states(self, fname):
+        raise MXNetError("Cannot load states for distributed training")
+
+
+# ----------------------------------------------------------------------
+# role entry points (used by kvstore_server bootstrap + launcher)
+# ----------------------------------------------------------------------
+
+
+def run_scheduler():
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    sched = Scheduler(port, int(os.environ["DMLC_NUM_WORKER"]), int(os.environ["DMLC_NUM_SERVER"]))
+    sched.serve_forever()
+
+
+def run_server():
+    root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    server = Server(0, int(os.environ["DMLC_NUM_WORKER"]))
+    sched = _connect_retry((root, port))
+    _send_frame(sched, _REGISTER, _meta(role="server", host="127.0.0.1", port=server.port))
+    cmd, meta, _ = _recv_frame(sched)
+    assert cmd == _ADDRS
+    server.serve_forever()

@@ -1,0 +1,158 @@
+// Native RecordIO engine.
+//
+// TPU-native equivalent of the reference's dmlc-core RecordIO reader/writer
+// plus the record-parsing half of src/io/iter_image_recordio_2.cc
+// (SURVEY.md §2 ⚙18): the byte-level hot path of the data pipeline lives in
+// C++ — sequential scan, batched reads (one Python call per batch, not per
+// record), index construction, and random access for shuffled epochs.
+//
+// Format (binary-compatible with the reference):
+//   [u32 magic=0xced7230a][u32 cflag:3|len:29][payload][pad to 4B]
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Reader {
+  FILE* f = nullptr;
+};
+
+struct Writer {
+  FILE* f = nullptr;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_open_reader(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+void rio_close_reader(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  if (r) {
+    if (r->f) std::fclose(r->f);
+    delete r;
+  }
+}
+
+void rio_seek(void* h, long offset) {
+  auto* r = static_cast<Reader*>(h);
+  std::fseek(r->f, offset, SEEK_SET);
+}
+
+long rio_tell(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  return std::ftell(r->f);
+}
+
+// Read up to `n` records into `out` (capacity `cap` bytes), record sizes into
+// `sizes`.  Returns the number of records read; -1 on format error; -2 if the
+// next record would overflow `cap` (caller grows the buffer and retries).
+long rio_read_batch(void* h, long n, char* out, long cap, long* sizes) {
+  auto* r = static_cast<Reader*>(h);
+  long count = 0;
+  long used = 0;
+  while (count < n) {
+    long record_start = std::ftell(r->f);
+    uint32_t header[2];
+    if (std::fread(header, 4, 2, r->f) != 2) break;  // EOF
+    if (header[0] != kMagic) return -1;
+    uint32_t len = header[1] & kLenMask;
+    uint32_t padded = (len + 3u) & ~3u;
+    if (used + (long)len > cap) {
+      std::fseek(r->f, record_start, SEEK_SET);
+      if (count == 0) return -2;
+      break;
+    }
+    if (len > 0 && std::fread(out + used, 1, len, r->f) != len) return -1;
+    if (padded != len) std::fseek(r->f, padded - len, SEEK_CUR);
+    sizes[count] = len;
+    used += len;
+    ++count;
+  }
+  return count;
+}
+
+// Scan the whole file, filling `offsets` (byte offset of each record header)
+// up to `cap` entries.  Returns total record count (which may exceed cap —
+// call again with a bigger buffer), or -1 on format error.
+long rio_index(const char* path, long* offsets, long cap) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  long count = 0;
+  for (;;) {
+    long pos = std::ftell(f);
+    uint32_t header[2];
+    if (std::fread(header, 4, 2, f) != 2) break;
+    if (header[0] != kMagic) {
+      std::fclose(f);
+      return -1;
+    }
+    uint32_t len = header[1] & kLenMask;
+    uint32_t padded = (len + 3u) & ~3u;
+    if (count < cap) offsets[count] = pos;
+    ++count;
+    std::fseek(f, padded, SEEK_CUR);
+  }
+  std::fclose(f);
+  return count;
+}
+
+// Random-access read of the record at `offset`.  Returns payload length,
+// -1 on format error, -2 if `cap` too small.
+long rio_read_at(void* h, long offset, char* out, long cap) {
+  auto* r = static_cast<Reader*>(h);
+  std::fseek(r->f, offset, SEEK_SET);
+  uint32_t header[2];
+  if (std::fread(header, 4, 2, r->f) != 2) return -1;
+  if (header[0] != kMagic) return -1;
+  uint32_t len = header[1] & kLenMask;
+  if ((long)len > cap) return -2;
+  if (len > 0 && std::fread(out, 1, len, r->f) != len) return -1;
+  return (long)len;
+}
+
+void* rio_open_writer(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+// Returns the byte offset the record was written at, or -1 on error.
+long rio_write(void* h, const char* data, long len) {
+  auto* w = static_cast<Writer*>(h);
+  long pos = std::ftell(w->f);
+  uint32_t header[2] = {kMagic, (uint32_t)len & kLenMask};
+  if (std::fwrite(header, 4, 2, w->f) != 2) return -1;
+  if (len > 0 && std::fwrite(data, 1, len, w->f) != (size_t)len) return -1;
+  uint32_t pad = ((len + 3u) & ~3u) - (uint32_t)len;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && std::fwrite(zeros, 1, pad, w->f) != pad) return -1;
+  return pos;
+}
+
+void rio_close_writer(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  if (w) {
+    if (w->f) std::fclose(w->f);
+    delete w;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,54 @@
+"""Worker script for the distributed kvstore invariant test.
+
+Parity: reference tests/nightly/dist_sync_kvstore.py:20-47 — every worker
+pushes ones*(rank+1) each round; after sync aggregation the pulled value
+must equal the closed-form sum over workers.  Covers a small key and a
+sharded >BIGARRAY_BOUND key (reference big_shape pattern), plus the
+server-side optimizer path (set_optimizer → pickled to servers).
+"""
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+
+kv = mx.kv.create("dist_sync")
+nw = kv.num_workers
+rank = kv.rank
+shape = (4, 4)
+big = (1200, 1100)  # 1.32M elements > BIGARRAY_BOUND → sharded over servers
+
+kv.init("small", mx.nd.ones(shape))
+kv.init("big", mx.nd.ones(big))
+S = nw * (nw + 1) / 2.0
+
+for r in range(3):
+    kv.push("small", mx.nd.ones(shape) * (rank + 1))
+    kv.push("big", mx.nd.ones(big) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("small", out)
+    assert np.allclose(out.asnumpy(), S), (r, out.asnumpy()[0, 0], S)
+    outb = mx.nd.zeros(big)
+    kv.pull("big", outb)
+    assert np.allclose(outb.asnumpy(), S), (r, outb.asnumpy()[0, 0], S)
+
+# server-side optimizer: w <- w - lr * sum(grads)  (reference dist server
+# applying the shipped optimizer once per aggregated round)
+kv.init("opt_key", mx.nd.ones(shape))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0))
+expected = 1.0
+for r in range(2):
+    kv.push("opt_key", mx.nd.ones(shape) * (rank + 1))
+    expected -= 0.1 * S
+    out = mx.nd.zeros(shape)
+    kv.pull("opt_key", out)
+    assert np.allclose(out.asnumpy(), expected, atol=1e-5), (out.asnumpy()[0, 0], expected)
+
+kv.barrier()
+kv.close()
+print("DIST_OK rank %d of %d" % (rank, nw))
+sys.stdout.flush()

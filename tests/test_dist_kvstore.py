@@ -1,0 +1,32 @@
+"""Distributed kvstore tests — multiple local processes via the launcher
+(reference pattern: tools/launch.py -n 2 python dist_sync_kvstore.py,
+tests/nightly/test_all.sh:37)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(n, s, script, timeout=180):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "-s", str(s), sys.executable, script],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+    return proc
+
+
+def test_dist_sync_kvstore_invariant():
+    proc = _run_launch(2, 2, os.path.join(REPO, "tests", "dist_check_script.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("DIST_OK") == 2, proc.stdout + proc.stderr
+
+
+def test_dist_single_server():
+    proc = _run_launch(2, 1, os.path.join(REPO, "tests", "dist_check_script.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("DIST_OK") == 2, proc.stdout + proc.stderr

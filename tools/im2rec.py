@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Pack images into RecordIO (parity: reference tools/im2rec.py).
+
+List-file format (reference-compatible): index\tlabel[\tlabel2...]\tpath
+Usage:
+    python tools/im2rec.py prefix image_root --list  # generate list
+    python tools/im2rec.py prefix image_root         # pack prefix.lst → prefix.rec
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def make_list(prefix, root, exts=(".jpg", ".jpeg", ".png")):
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    entries = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for fname in sorted(os.listdir(os.path.join(root, cls))):
+                if fname.lower().endswith(exts):
+                    entries.append((float(label), os.path.join(cls, fname)))
+    else:
+        for fname in sorted(os.listdir(root)):
+            if fname.lower().endswith(exts):
+                entries.append((0.0, fname))
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, path) in enumerate(entries):
+            f.write("%d\t%f\t%s\n" % (i, label, path))
+    print("wrote %s.lst with %d entries (%d classes)" % (prefix, len(entries), len(classes)))
+
+
+def pack(prefix, root, quality=95):
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(prefix + ".lst") as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            path = os.path.join(root, parts[-1])
+            with open(path, "rb") as img:
+                payload = img.read()
+            label = labels[0] if len(labels) == 1 else labels
+            header = recordio.IRHeader(0, label, idx, 0)
+            writer.write_idx(idx, recordio.pack(header, payload))
+            n += 1
+    writer.close()
+    print("packed %d images into %s.rec" % (n, prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prefix")
+    parser.add_argument("root")
+    parser.add_argument("--list", action="store_true", help="generate the .lst file only")
+    parser.add_argument("--quality", type=int, default=95)
+    args = parser.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root)
+        pack(args.prefix, args.root, args.quality)
+
+
+if __name__ == "__main__":
+    main()

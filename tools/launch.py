@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Distributed job launcher (parity: reference tools/launch.py, the
+dmlc_tracker ssh/local launcher — SURVEY.md §2.2).
+
+Local mode (the reference nightly-test pattern, tests/nightly/test_all.sh:37:
+n workers + s servers + scheduler all on localhost):
+
+    python tools/launch.py -n 2 -s 2 python my_dist_script.py
+
+SSH mode launches the same role set across hosts from a hostfile:
+
+    python tools/launch.py -n 4 -s 4 -H hosts --launcher ssh python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Launch a distributed job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=None)
+    parser.add_argument("-H", "--hostfile", type=str, default=None)
+    parser.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    parser.add_argument("--sync-dst-dir", type=str, default=None,
+                        help="(ssh) rsync working dir to this path on each host")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if args.num_servers is None:
+        args.num_servers = args.num_workers
+    if not args.command:
+        parser.error("no command given")
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(_free_port()),
+        # make the framework importable in spawned roles regardless of cwd
+        # (parity: reference tools/launch.py inserting curr_path)
+        "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+
+    if args.launcher == "local":
+        procs = []
+
+        def spawn(role):
+            env = dict(os.environ)
+            env.update(base_env)
+            env["DMLC_ROLE"] = role
+            if role != "worker":
+                # servers/scheduler block inside import (kvstore_server)
+                cmd = [sys.executable, "-c",
+                       "import mxnet_tpu.kvstore_server as s; s.init_server_module()"]
+            else:
+                cmd = args.command
+            return subprocess.Popen(cmd, env=env)
+
+        procs.append(spawn("scheduler"))
+        for _ in range(args.num_servers):
+            procs.append(spawn("server"))
+        workers = [spawn("worker") for _ in range(args.num_workers)]
+        rc = 0
+        for p in workers:
+            rc |= p.wait()
+        for p in procs:
+            p.terminate()
+        sys.exit(rc)
+
+    # ssh launcher
+    hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+    base_env["DMLC_PS_ROOT_URI"] = hosts[0]
+    procs = []
+
+    def ssh_spawn(host, role):
+        env_str = " ".join("%s=%s" % (k, v) for k, v in base_env.items())
+        env_str += " DMLC_ROLE=%s" % role
+        if role != "worker":
+            remote = ("python -c 'import mxnet_tpu.kvstore_server as s; "
+                      "s.init_server_module()'")
+        else:
+            remote = " ".join(args.command)
+        cwd = args.sync_dst_dir or os.getcwd()
+        return subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host,
+             "cd %s && env %s %s" % (cwd, env_str, remote)]
+        )
+
+    procs.append(ssh_spawn(hosts[0], "scheduler"))
+    for i in range(args.num_servers):
+        procs.append(ssh_spawn(hosts[i % len(hosts)], "server"))
+    workers = [ssh_spawn(hosts[i % len(hosts)], "worker") for i in range(args.num_workers)]
+    rc = 0
+    for p in workers:
+        rc |= p.wait()
+    for p in procs:
+        p.terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
