@@ -365,10 +365,12 @@ class Executor:
             key = self._fused_index_of_name[n]
             if key not in updater.states:
                 updater.states[key] = opt.create_state(key, self.arg_dict[n])
-            opt._update_count(key)
-            leaves_by_name[n] = _state_leaves(updater.states[key])
+            # lr/wd before _update_count — same scheduler step as the eager
+            # Optimizer.update path (reference optimizer.py order)
             scalars[row, 0] = opt._get_lr(key)
             scalars[row, 1] = opt._get_wd(key)
+            opt._update_count(key)
+            leaves_by_name[n] = _state_leaves(updater.states[key])
             scalars[row, 2] = opt._index_update_count[key]
         sig = tuple((n, tuple(l.shape for l in leaves_by_name[n])) for n in diff_names)
         if self._jit_step is None or self._jit_step[1] != sig:

@@ -201,9 +201,11 @@ class DataParallelExecutorGroup:
     @property
     def grad_arrays(self):
         """[[grad per device]] — single SPMD exec exposes one copy
-        (grads already globally reduced by XLA)."""
-        return [[self.execs[0].grad_dict[n]] for n in self.param_names
-                if n in self.execs[0].grad_dict]
+        (grads already globally reduced by XLA).  Params with grad_req
+        'null' (e.g. fixed_param_names) yield [None] placeholders so the
+        list stays index-aligned with param_arrays/param_names (the update
+        paths in model.py zip the two)."""
+        return [[self.execs[0].grad_dict.get(n)] for n in self.param_names]
 
     @property
     def param_arrays(self):

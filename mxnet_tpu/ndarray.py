@@ -439,15 +439,31 @@ def waitall():
 
 
 # ----------------------------------------------------------------------
-# serialization (parity: mx.nd.save/load → reference src/c_api/c_api.cc:218-271;
-# format here is a self-describing container, not the reference binary ABI)
+# serialization (parity: mx.nd.save/load → reference src/c_api/c_api.cc:218-271)
+#
+# Default on-disk layout is the REFERENCE binary NDArray-list format so
+# .params files interop with upstream MXNet both directions:
+#   u64 magic=0x112 (kMXAPINDArrayListMagic), u64 reserved=0,
+#   u64 count, per array (NDArray::Save, src/ndarray/ndarray.cc:641-664):
+#   u32 NDARRAY_V1_MAGIC, u32 ndim + i64 dims (V1 int64 TShape),
+#   Context (i32 dev_type, i32 dev_id), i32 type_flag, raw bytes;
+#   then u64 nkeys + (u64 len + bytes) per key.  Load also accepts the
+#   pre-V1 legacy TShape layout (u32 ndim + u32 dims,
+#   LegacyTShapeLoad ndarray.cc:666-682).
+# Arrays whose dtype the reference ABI cannot express (bfloat16, int64, ...)
+# or 0-dim arrays (reference Load treats ndim==0 as a none-NDArray and
+# stops reading, ndarray.cc:688-690) fall back to the self-describing
+# MXTPU001 container; load() sniffs both.
 # ----------------------------------------------------------------------
 
 _SAVE_MAGIC = b"MXTPU001"
+_NDLIST_MAGIC = 0x112  # kMXAPINDArrayListMagic
+_NDARRAY_V1_MAGIC = 0xF993FAC8  # per-array magic, int64 TShape
+_DTYPE_TO_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3, "int32": 4}
+_FLAG_TO_DTYPE = {v: k for k, v in _DTYPE_TO_FLAG.items()}
 
 
-def save(fname, data):
-    """Save list or dict of NDArray (parity: python/mxnet/ndarray.py save)."""
+def _split_save_arg(data):
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -456,16 +472,49 @@ def save(fname, data):
     else:
         keys = None
         arrays = list(data)
+    np_arrays = [a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+                 for a in arrays]
+    return keys, np_arrays
+
+
+def save(fname, data):
+    """Save list or dict of NDArray (parity: python/mxnet/ndarray.py save)."""
+    keys, np_arrays = _split_save_arg(data)
+    if all(a.dtype.name in _DTYPE_TO_FLAG and a.ndim > 0 for a in np_arrays):
+        return _save_reference_format(fname, keys, np_arrays)
+    return _save_container_format(fname, keys, np_arrays)
+
+
+def _save_reference_format(fname, keys, np_arrays):
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _NDLIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(np_arrays)))
+        for np_arr in np_arrays:
+            f.write(struct.pack("<II", _NDARRAY_V1_MAGIC, np_arr.ndim))
+            f.write(struct.pack("<%dq" % np_arr.ndim, *np_arr.shape))
+            f.write(struct.pack("<ii", 1, 0))  # Context: kCPU, dev_id 0
+            f.write(struct.pack("<i", _DTYPE_TO_FLAG[np_arr.dtype.name]))
+            f.write(_np.ascontiguousarray(np_arr).tobytes())
+        names = keys if keys is not None else []
+        f.write(struct.pack("<Q", len(names)))
+        for name in names:
+            b = name.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def _save_container_format(fname, keys, np_arrays):
     with open(fname, "wb") as f:
         f.write(_SAVE_MAGIC)
-        f.write(struct.pack("<q", len(arrays)))
+        f.write(struct.pack("<q", len(np_arrays)))
         f.write(struct.pack("<q", 1 if keys is not None else 0))
-        for i, arr in enumerate(arrays):
+        for i, np_arr in enumerate(np_arrays):
             name = (keys[i] if keys is not None else "").encode()
             f.write(struct.pack("<q", len(name)))
             f.write(name)
-            np_arr = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
-            dt = np_arr.dtype.str.encode()
+            # dtype by name ('bfloat16', 'float32', ...) — extension dtypes
+            # have an opaque .str ('|V2') that can't round-trip
+            dt = np_arr.dtype.name.encode()
             f.write(struct.pack("<q", len(dt)))
             f.write(dt)
             f.write(struct.pack("<q", np_arr.ndim))
@@ -477,24 +526,79 @@ def save(fname, data):
 
 
 def load(fname):
-    """Load NDArrays saved by :func:`save`."""
+    """Load NDArrays saved by :func:`save` or by reference MXNet's mx.nd.save."""
     with open(fname, "rb") as f:
         magic = f.read(8)
-        if magic != _SAVE_MAGIC:
-            raise MXNetError("Invalid NDArray file format: " + fname)
-        (num,) = struct.unpack("<q", f.read(8))
-        (has_keys,) = struct.unpack("<q", f.read(8))
-        keys, arrays = [], []
-        for _ in range(num):
-            (nlen,) = struct.unpack("<q", f.read(8))
-            keys.append(f.read(nlen).decode())
-            (dlen,) = struct.unpack("<q", f.read(8))
-            dt = _np.dtype(f.read(dlen).decode())
-            (ndim,) = struct.unpack("<q", f.read(8))
-            shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
-            (rlen,) = struct.unpack("<q", f.read(8))
-            np_arr = _np.frombuffer(f.read(rlen), dtype=dt).reshape(shape)
-            arrays.append(array(np_arr))
+        if magic == _SAVE_MAGIC:
+            return _load_container_format(f)
+        if len(magic) == 8 and struct.unpack("<Q", magic)[0] == _NDLIST_MAGIC:
+            return _load_reference_format(f)
+    raise MXNetError(
+        "Invalid NDArray file format in %s: neither the MXNet binary "
+        "NDArray-list format (magic 0x112) nor the MXTPU001 container" % fname)
+
+
+def _load_reference_format(f):
+    (_reserved,) = struct.unpack("<Q", f.read(8))
+    (num,) = struct.unpack("<Q", f.read(8))
+    arrays = []
+    for _ in range(num):
+        (first,) = struct.unpack("<I", f.read(4))
+        if first == _NDARRAY_V1_MAGIC:
+            (ndim,) = struct.unpack("<I", f.read(4))
+            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+        else:
+            # legacy TShape: `first` IS ndim, u32 dims (LegacyTShapeLoad)
+            ndim = first
+            shape = struct.unpack("<%dI" % ndim, f.read(4 * ndim)) if ndim else ()
+        if ndim == 0:
+            # reference: none-NDArray — no ctx/type/data bytes follow
+            arrays.append(array(_np.zeros((0,), dtype=_np.float32)))
+            continue
+        _dev_type, _dev_id = struct.unpack("<ii", f.read(8))
+        (type_flag,) = struct.unpack("<i", f.read(4))
+        if type_flag not in _FLAG_TO_DTYPE:
+            raise MXNetError("Unsupported dtype flag %d in NDArray file" % type_flag)
+        dt = _np.dtype(_FLAG_TO_DTYPE[type_flag])
+        count = int(_np.prod(shape))
+        np_arr = _np.frombuffer(f.read(dt.itemsize * count), dtype=dt).reshape(shape)
+        arrays.append(array(np_arr))
+    (nkeys,) = struct.unpack("<Q", f.read(8))
+    if nkeys == 0:
+        return arrays
+    if nkeys != num:
+        # reference hard-fails here too (CHECK keys->size()==data->size(),
+        # ndarray.cc:742-743) — silently dropping arrays would restore a
+        # checkpoint with missing params
+        raise MXNetError("Invalid NDArray file format: %d names for %d arrays"
+                         % (nkeys, num))
+    keys = []
+    for _ in range(nkeys):
+        (klen,) = struct.unpack("<Q", f.read(8))
+        keys.append(f.read(klen).decode())
+    return dict(zip(keys, arrays))
+
+
+def _load_container_format(f):
+    (num,) = struct.unpack("<q", f.read(8))
+    (has_keys,) = struct.unpack("<q", f.read(8))
+    keys, arrays = [], []
+    for _ in range(num):
+        (nlen,) = struct.unpack("<q", f.read(8))
+        keys.append(f.read(nlen).decode())
+        (dlen,) = struct.unpack("<q", f.read(8))
+        dt_name = f.read(dlen).decode()
+        try:
+            dt = _np.dtype(dt_name)
+        except TypeError:
+            import ml_dtypes
+
+            dt = _np.dtype(getattr(ml_dtypes, dt_name))
+        (ndim,) = struct.unpack("<q", f.read(8))
+        shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+        (rlen,) = struct.unpack("<q", f.read(8))
+        np_arr = _np.frombuffer(f.read(rlen), dtype=dt).reshape(shape)
+        arrays.append(array(np_arr))
     if has_keys:
         return dict(zip(keys, arrays))
     return arrays

@@ -182,8 +182,12 @@ def _infer_deconv(in_shapes, attrs):
     nf = int(_lit(attrs["num_filter"]))
     n = len(kernel)
     stride = _pair(attrs.get("stride"), n)
-    pad = _shape(attrs.get("pad")) or (0,) * n
-    adj = _shape(attrs.get("adj")) or (0,) * n
+    pad, adj = _deconv_pad_adj(
+        data[2:], kernel, stride,
+        _shape(attrs.get("pad")) or (0,) * n,
+        _shape(attrs.get("adj")) or (0,) * n,
+        _shape(attrs.get("target_shape")) or None,
+    )
     no_bias = _bool(attrs.get("no_bias", True))
     groups = int(_lit(attrs.get("num_group", 1)))
     wshape = (data[1], nf // groups) + kernel
@@ -197,19 +201,43 @@ def _infer_deconv(in_shapes, attrs):
     return shapes, [out]
 
 
+def _deconv_pad_adj(in_spatial, kernel, stride, pad, adj, target_shape):
+    """Resolve effective (pad, adj): `target_shape` overrides both
+    (reference DeconvolutionParam::InferPad, deconvolution-inl.h:94-116)."""
+    n = len(kernel)
+    if not target_shape:
+        return tuple(pad), tuple(adj)
+    o_pad, o_adj = [], []
+    for i in range(n):
+        total = stride[i] * (in_spatial[i] - 1) + kernel[i]
+        if total < target_shape[i]:
+            raise ValueError("Deconvolution: too big target shape %s" % (target_shape,))
+        total -= target_shape[i]
+        o_adj.append(total % 2)
+        o_pad.append((total + 1) // 2)
+    return tuple(o_pad), tuple(o_adj)
+
+
 @register("Deconvolution", inputs=("data", "weight", "bias"), infer_shape=_infer_deconv)
 def deconvolution(
     data, weight, bias=None, kernel=None, num_filter=None, stride=None, pad=None, adj=None,
-    num_group=1, no_bias=True, **kw
+    target_shape=None, num_group=1, no_bias=True, **kw
 ):
     """Transposed convolution (reference src/operator/deconvolution-inl.h)."""
     kernel = _shape(kernel)
     n = len(kernel)
     stride = _pair(stride, n)
-    p = _shape(pad) or (0,) * n
+    p, a = _deconv_pad_adj(
+        data.shape[2:], kernel, stride,
+        _shape(pad) or (0,) * n,
+        _shape(adj) or (0,) * n,
+        _shape(target_shape) or None,
+    )
     spatial = "".join("DHW"[3 - n + i] for i in range(n))
     dn = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
-    pairs = [(kernel[i] - 1 - p[i], kernel[i] - 1 - p[i]) for i in range(n)]
+    # adj extends the high-side padding, matching the shape rule
+    # out = stride*(in-1) + kernel - 2*pad + adj
+    pairs = [(kernel[i] - 1 - p[i], kernel[i] - 1 - p[i] + a[i]) for i in range(n)]
     out = lax.conv_general_dilated(
         data,
         weight,

@@ -510,13 +510,16 @@ class Updater:
         for index, grad, weight in triples:
             if index not in self.states:
                 self.states[index] = opt.create_state(index, weight)
+            # lr/wd BEFORE _update_count, matching the eager Optimizer.update
+            # order (reference optimizer.py computes _get_lr then
+            # _update_count) so schedulers agree between the two paths
+            lr, wd = opt._get_lr(index), opt._get_wd(index)
             opt._update_count(index)
-        for index, grad, weight in triples:
             leaves = _state_leaves(self.states[index])
             entries.append((
                 index, weight, leaves,
                 weight.data, grad.data, tuple(l.data for l in leaves),
-                opt._get_lr(index), opt._get_wd(index), opt._index_update_count[index],
+                lr, wd, opt._index_update_count[index],
             ))
         sig = tuple((e[0], tuple(l.shape for l in e[2])) for e in entries)
         if self._batch_fn is None or self._batch_sig != sig:

@@ -21,6 +21,7 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
 
 _MAGIC = 0xCED7230A
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
 _KMAX_REC = (1 << 29) - 1
 
 
@@ -74,31 +75,66 @@ class MXRecordIO:
     def tell(self):
         return self.handle.tell()
 
-    def write(self, buf):
-        assert self.writable
-        length = len(buf)
-        if length > _KMAX_REC:
-            raise MXNetError("Record too long: %d" % length)
-        self.handle.write(struct.pack("<II", _MAGIC, length))
-        self.handle.write(buf)
-        pad = (4 - length % 4) % 4
+    def _write_part(self, cflag, part):
+        self.handle.write(struct.pack("<II", _MAGIC, (cflag << 29) | len(part)))
+        self.handle.write(part)
+        pad = (4 - len(part) % 4) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
 
+    def write(self, buf):
+        """Write one record; payloads containing the magic word are split into
+        kFirst/kMiddle/kLast parts exactly like dmlc-core's RecordIOWriter so
+        files round-trip with reference-written .rec data (the magic bytes are
+        elided from the parts and re-inserted by :meth:`read`)."""
+        assert self.writable
+        if len(buf) > _KMAX_REC:
+            raise MXNetError("Record too long: %d" % len(buf))
+        buf = bytes(buf)
+        parts = []
+        start = 0
+        while True:
+            i = buf.find(_MAGIC_BYTES, start)
+            if i < 0:
+                parts.append(buf[start:])
+                break
+            parts.append(buf[start:i])
+            start = i + 4
+        if len(parts) == 1:
+            self._write_part(0, parts[0])  # standalone (cflag=kLen)
+        else:
+            for j, p in enumerate(parts):
+                cflag = 1 if j == 0 else (3 if j == len(parts) - 1 else 2)
+                self._write_part(cflag, p)
+
     def read(self):
+        """Read one logical record, reassembling multi-part records
+        (cflag 1/2/3) with the magic word restored between parts."""
         assert not self.writable
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", header)
-        if magic != _MAGIC:
-            raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
-        length = lrec & _KMAX_REC
-        buf = self.handle.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.handle.read(pad)
-        return buf
+        out = None
+        while True:
+            header = self.handle.read(8)
+            if len(header) < 8:
+                if out is not None:
+                    raise MXNetError("Truncated multi-part record in %s" % self.uri)
+                return None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
+            cflag = lrec >> 29
+            length = lrec & _KMAX_REC
+            buf = self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            if cflag in (0, 1):
+                out = buf
+            elif out is None:
+                raise MXNetError("Continuation part without a first part in %s" % self.uri)
+            else:
+                out = out + _MAGIC_BYTES + buf
+            if cflag in (0, 3):
+                return out
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -191,31 +227,51 @@ def unpack_img(s, iscolor=-1):
     return header, img
 
 
+def _encode_raw(img):
+    # shape-prefixed uncompressed fallback format
+    arr = _np.asarray(img, dtype=_np.uint8)
+    head = struct.pack("<III", 0xFEEDBEEF, arr.shape[0], arr.shape[1])
+    ch = arr.shape[2] if arr.ndim == 3 else 1
+    return head + struct.pack("<I", ch) + arr.tobytes()
+
+
 def _encode_img(img, quality, img_fmt):
+    ext = img_fmt.lower()
+    if not ext.startswith("."):
+        ext = "." + ext
+    if ext == ".raw":
+        return _encode_raw(img)
+    have_codec_lib = False
     try:
         import cv2
 
-        ext = img_fmt.lower()
-        params = [int(cv2.IMWRITE_JPEG_QUALITY), quality] if "jpg" in ext or "jpeg" in ext else []
-        ret, buf = cv2.imencode(img_fmt, img, params)
-        assert ret
-        return buf.tobytes()
+        have_codec_lib = True
+        params = [int(cv2.IMWRITE_JPEG_QUALITY), quality] if ext in (".jpg", ".jpeg") else []
+        ret, buf = cv2.imencode(ext, img, params)
+        if ret:
+            return buf.tobytes()
     except ImportError:
         pass
+    except Exception:
+        pass  # cv2 present but rejects this format — try PIL with the SAME format
     try:
         import io as _io
 
         from PIL import Image
 
+        have_codec_lib = True
+        fmt = {".jpg": "JPEG", ".jpeg": "JPEG"}.get(ext, ext[1:].upper())
         b = _io.BytesIO()
-        Image.fromarray(img).save(b, format="JPEG", quality=quality)
+        kw = {"quality": quality} if fmt == "JPEG" else {}
+        Image.fromarray(img).save(b, format=fmt, **kw)
         return b.getvalue()
     except ImportError:
-        # raw fallback: shape-prefixed uncompressed
-        arr = _np.asarray(img, dtype=_np.uint8)
-        head = struct.pack("<III", 0xFEEDBEEF, arr.shape[0], arr.shape[1])
-        ch = arr.shape[2] if arr.ndim == 3 else 1
-        return head + struct.pack("<I", ch) + arr.tobytes()
+        pass
+    except Exception as e:
+        raise MXNetError("cannot encode image as %s: %s" % (img_fmt, e))
+    if have_codec_lib:
+        raise MXNetError("no encoder available for image format %s" % img_fmt)
+    return _encode_raw(img)  # no cv2/PIL in this environment
 
 
 def _decode_img(s, iscolor=-1):

@@ -467,7 +467,11 @@ def _create(op_name, input_syms, attrs, name=None, aux_syms=None):
             in_name = "%s_%s" % (name, declared[len(inputs)])
             from .ops.tensor import _bool as _b
 
-            if declared[len(inputs)] == "bias" and _b(full_attrs.get("no_bias", False)):
+            # no_bias defaults True only for Deconvolution
+            # (deconvolution-inl.h:72 set_default(true); conv/FC default false)
+            if declared[len(inputs)] == "bias" and _b(
+                full_attrs.get("no_bias", op.name == "Deconvolution")
+            ):
                 break
             if declared[len(inputs)] in ("sequence_length",) and not _b(
                 full_attrs.get("use_sequence_length", False)

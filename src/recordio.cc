@@ -10,10 +10,12 @@
 //   [u32 magic=0xced7230a][u32 cflag:3|len:29][payload][pad to 4B]
 //
 // Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -59,37 +61,73 @@ long rio_tell(void* h) {
   return std::ftell(r->f);
 }
 
-// Read up to `n` records into `out` (capacity `cap` bytes), record sizes into
-// `sizes`.  Returns the number of records read; -1 on format error; -2 if the
-// next record would overflow `cap` (caller grows the buffer and retries).
+// Read one logical record at the current position, reassembling multi-part
+// records (cflag 1=first, 2=middle, 3=last; the elided magic word is restored
+// between parts, matching dmlc-core's RecordIOReader).  Appends payload at
+// out+used, subject to cap.  Returns payload length; -1 format error /
+// truncation; -2 capacity overflow (file position restored); -3 clean EOF.
+static long read_one_record(FILE* f, char* out, long used, long cap) {
+  long record_start = std::ftell(f);
+  long reclen = 0;
+  int parts = 0;
+  for (;;) {
+    uint32_t header[2];
+    if (std::fread(header, 4, 2, f) != 2) {
+      return parts == 0 ? -3 : -1;  // EOF mid-record = truncated file
+    }
+    if (header[0] != kMagic) return -1;
+    uint32_t cflag = header[1] >> 29;
+    uint32_t len = header[1] & kLenMask;
+    uint32_t padded = (len + 3u) & ~3u;
+    if (cflag == 2u || cflag == 3u) {
+      if (parts == 0) return -1;  // continuation without a first part
+      if (used + reclen + 4 > cap) {
+        std::fseek(f, record_start, SEEK_SET);
+        return -2;
+      }
+      const uint32_t m = kMagic;
+      std::memcpy(out + used + reclen, &m, 4);
+      reclen += 4;
+    }
+    if (used + reclen + (long)len > cap) {
+      std::fseek(f, record_start, SEEK_SET);
+      return -2;
+    }
+    if (len > 0 && std::fread(out + used + reclen, 1, len, f) != len) return -1;
+    if (padded != len) std::fseek(f, padded - len, SEEK_CUR);
+    reclen += len;
+    ++parts;
+    if (cflag == 0u || cflag == 3u) return reclen;
+  }
+}
+
+// Read up to `n` logical records into `out` (capacity `cap` bytes), record
+// sizes into `sizes`.  Returns the number of records read; -1 on format
+// error; -2 if the next record would overflow `cap` (caller grows the buffer
+// and retries).
 long rio_read_batch(void* h, long n, char* out, long cap, long* sizes) {
   auto* r = static_cast<Reader*>(h);
   long count = 0;
   long used = 0;
   while (count < n) {
-    long record_start = std::ftell(r->f);
-    uint32_t header[2];
-    if (std::fread(header, 4, 2, r->f) != 2) break;  // EOF
-    if (header[0] != kMagic) return -1;
-    uint32_t len = header[1] & kLenMask;
-    uint32_t padded = (len + 3u) & ~3u;
-    if (used + (long)len > cap) {
-      std::fseek(r->f, record_start, SEEK_SET);
+    long got = read_one_record(r->f, out, used, cap);
+    if (got == -3) break;  // EOF
+    if (got == -1) return -1;
+    if (got == -2) {
       if (count == 0) return -2;
       break;
     }
-    if (len > 0 && std::fread(out + used, 1, len, r->f) != len) return -1;
-    if (padded != len) std::fseek(r->f, padded - len, SEEK_CUR);
-    sizes[count] = len;
-    used += len;
+    sizes[count] = got;
+    used += got;
     ++count;
   }
   return count;
 }
 
-// Scan the whole file, filling `offsets` (byte offset of each record header)
-// up to `cap` entries.  Returns total record count (which may exceed cap —
-// call again with a bigger buffer), or -1 on format error.
+// Scan the whole file, filling `offsets` (byte offset of each logical
+// record's first-part header; continuation parts are skipped) up to `cap`
+// entries.  Returns total record count (which may exceed cap — call again
+// with a bigger buffer), or -1 on format error.
 long rio_index(const char* path, long* offsets, long cap) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
@@ -102,28 +140,27 @@ long rio_index(const char* path, long* offsets, long cap) {
       std::fclose(f);
       return -1;
     }
+    uint32_t cflag = header[1] >> 29;
     uint32_t len = header[1] & kLenMask;
     uint32_t padded = (len + 3u) & ~3u;
-    if (count < cap) offsets[count] = pos;
-    ++count;
+    if (cflag == 0u || cflag == 1u) {
+      if (count < cap) offsets[count] = pos;
+      ++count;
+    }
     std::fseek(f, padded, SEEK_CUR);
   }
   std::fclose(f);
   return count;
 }
 
-// Random-access read of the record at `offset`.  Returns payload length,
-// -1 on format error, -2 if `cap` too small.
+// Random-access read of the logical record at `offset` (multi-part records
+// reassembled).  Returns payload length, -1 on format error, -2 if `cap`
+// too small.
 long rio_read_at(void* h, long offset, char* out, long cap) {
   auto* r = static_cast<Reader*>(h);
   std::fseek(r->f, offset, SEEK_SET);
-  uint32_t header[2];
-  if (std::fread(header, 4, 2, r->f) != 2) return -1;
-  if (header[0] != kMagic) return -1;
-  uint32_t len = header[1] & kLenMask;
-  if ((long)len > cap) return -2;
-  if (len > 0 && std::fread(out, 1, len, r->f) != len) return -1;
-  return (long)len;
+  long got = read_one_record(r->f, out, 0, cap);
+  return got == -3 ? -1 : got;
 }
 
 void* rio_open_writer(const char* path) {
@@ -134,16 +171,51 @@ void* rio_open_writer(const char* path) {
   return w;
 }
 
+static bool write_part(FILE* f, uint32_t cflag, const char* data, uint32_t len) {
+  uint32_t header[2] = {kMagic, (cflag << 29) | (len & kLenMask)};
+  if (std::fwrite(header, 4, 2, f) != 2) return false;
+  if (len > 0 && std::fwrite(data, 1, len, f) != len) return false;
+  uint32_t pad = ((len + 3u) & ~3u) - len;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && std::fwrite(zeros, 1, pad, f) != pad) return false;
+  return true;
+}
+
+// Write one logical record; payloads containing the magic word are split
+// into first/middle/last parts (the magic bytes elided), exactly like
+// dmlc-core's RecordIOWriter, so readers can resync on the magic.
 // Returns the byte offset the record was written at, or -1 on error.
 long rio_write(void* h, const char* data, long len) {
   auto* w = static_cast<Writer*>(h);
+  if ((uint32_t)len > kLenMask) return -1;
   long pos = std::ftell(w->f);
-  uint32_t header[2] = {kMagic, (uint32_t)len & kLenMask};
-  if (std::fwrite(header, 4, 2, w->f) != 2) return -1;
-  if (len > 0 && std::fwrite(data, 1, len, w->f) != (size_t)len) return -1;
-  uint32_t pad = ((len + 3u) & ~3u) - (uint32_t)len;
-  static const char zeros[4] = {0, 0, 0, 0};
-  if (pad && std::fwrite(zeros, 1, pad, w->f) != pad) return -1;
+  const uint32_t m = kMagic;
+  const char* mb = reinterpret_cast<const char*>(&m);
+  // collect part boundaries at each occurrence of the magic word
+  std::vector<std::pair<long, long>> parts;  // (start, length)
+  long start = 0;
+  const char* end = data + len;
+  for (const char* p = data;;) {
+    const char* hit = std::search(p, end, mb, mb + 4);
+    if (hit == end) {
+      parts.emplace_back(start, len - start);
+      break;
+    }
+    parts.emplace_back(start, (long)(hit - data) - start);
+    start = (long)(hit - data) + 4;
+    p = hit + 4;
+  }
+  if (parts.size() == 1) {
+    if (!write_part(w->f, 0u, data, (uint32_t)len)) return -1;
+  } else {
+    for (size_t j = 0; j < parts.size(); ++j) {
+      uint32_t cflag = j == 0 ? 1u : (j + 1 == parts.size() ? 3u : 2u);
+      if (!write_part(w->f, cflag, data + parts[j].first,
+                      (uint32_t)parts[j].second)) {
+        return -1;
+      }
+    }
+  }
   return pos;
 }
 

@@ -174,3 +174,34 @@ def test_bucketing_module():
     default_exec = mod._buckets[20]._exec_group.execs[0]
     small_exec = mod._buckets[10]._exec_group.execs[0]
     assert default_exec.arg_dict["fc_shared_weight"] is small_exec.arg_dict["fc_shared_weight"]
+
+
+def test_module_fixed_params_stay_fixed():
+    """fixed_param_names must yield [None] grad placeholders so the update
+    paths stay aligned with param_arrays (ADVICE r1 high finding)."""
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight", "fc1_bias"])
+    mod.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    # grad_arrays aligned: one (possibly None) entry per param name
+    grads = mod._exec_group.grad_arrays
+    names = mod._exec_group.param_names
+    assert len(grads) == len(names)
+    fixed = {"fc1_weight", "fc1_bias"}
+    for n, g in zip(names, grads):
+        assert (g[0] is None) == (n in fixed), n
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    batch = next(iter(train))
+    for _ in range(3):
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for n in fixed:
+        np.testing.assert_array_equal(before[n], after[n])
+    # trainable params must have moved
+    assert not np.allclose(before["fc2_weight"], after["fc2_weight"])

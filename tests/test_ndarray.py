@@ -140,3 +140,67 @@ def test_wait_to_read():
     b = a * 2
     b.wait_to_read()
     mx.nd.waitall()
+
+
+def test_save_load_reference_binary_format(tmp_path):
+    """Default save format is the reference NDArray-list binary ABI
+    (magic 0x112): verify the exact byte layout round-trips and parses
+    with an independent struct-level reader."""
+    import struct
+
+    a = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    b = mx.nd.array(np.array([1, 2, 3], dtype=np.int32))
+    fname = str(tmp_path / "ref.params")
+    mx.nd.save(fname, {"arg:w": a, "aux:s": b})
+    raw = open(fname, "rb").read()
+    magic, reserved = struct.unpack_from("<QQ", raw, 0)
+    assert magic == 0x112 and reserved == 0
+    (count,) = struct.unpack_from("<Q", raw, 16)
+    assert count == 2
+    # per-array layout: NDARRAY_V1_MAGIC, u32 ndim, i64 dims (ndarray.cc:641-643)
+    v1, ndim = struct.unpack_from("<II", raw, 24)
+    assert v1 == 0xF993FAC8 and ndim == 2
+    assert struct.unpack_from("<2q", raw, 32) == (3, 4)
+    dev_type, dev_id, type_flag = struct.unpack_from("<iii", raw, 48)
+    assert (dev_type, dev_id, type_flag) == (1, 0, 0)  # kCPU, float32
+    loaded = mx.nd.load(fname)
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(), a.asnumpy())
+    np.testing.assert_array_equal(loaded["aux:s"].asnumpy(), b.asnumpy())
+    assert loaded["aux:s"].asnumpy().dtype == np.int32
+    # list form (no keys)
+    fname2 = str(tmp_path / "ref2.params")
+    mx.nd.save(fname2, [a])
+    out = mx.nd.load(fname2)
+    assert isinstance(out, list)
+    np.testing.assert_array_equal(out[0].asnumpy(), a.asnumpy())
+    # unsupported-by-ABI dtype falls back to the container format, still loads
+    c = mx.nd.array(np.arange(4, dtype=np.float32))
+    c = mx.nd.NDArray(c.data.astype("bfloat16"), ctx=c.context)
+    fname3 = str(tmp_path / "bf16.params")
+    mx.nd.save(fname3, {"c": c})
+    got = mx.nd.load(fname3)
+    assert str(got["c"].asnumpy().dtype) == "bfloat16"
+    # garbage file raises a clear error
+    bad = str(tmp_path / "bad.params")
+    open(bad, "wb").write(b"\x00" * 32)
+    with pytest.raises(Exception, match="NDArray file format"):
+        mx.nd.load(bad)
+
+
+def test_load_legacy_tshape_format(tmp_path):
+    """Files with the pre-V1 TShape layout (u32 ndim + u32 dims, no per-array
+    magic — LegacyTShapeLoad ndarray.cc:666-682) must load too."""
+    import struct
+
+    data = np.arange(6, dtype=np.float32).reshape(2, 3)
+    fname = str(tmp_path / "legacy.params")
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQQ", 0x112, 0, 1))
+        f.write(struct.pack("<I", 2))          # ndim (no V1 magic)
+        f.write(struct.pack("<2I", 2, 3))      # u32 dims
+        f.write(struct.pack("<iii", 1, 0, 0))  # ctx + float32
+        f.write(data.tobytes())
+        f.write(struct.pack("<Q", 1))
+        f.write(struct.pack("<Q", 5) + b"arg:w")
+    loaded = mx.nd.load(fname)
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(), data)
