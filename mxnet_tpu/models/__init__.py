@@ -7,9 +7,11 @@ from . import resnet
 from . import alexnet
 from . import vgg
 from . import inception_v3
+from . import ssd
 from .lenet import get_lenet
 from .mlp import get_mlp
 from .resnet import get_resnet
 from .alexnet import get_alexnet
 from .vgg import get_vgg
 from .inception_v3 import get_inception_v3
+from .ssd import get_ssd_vgg16, get_ssd_tiny

@@ -1,0 +1,179 @@
+"""SSD detector (reference example/ssd/symbol/{symbol_builder,common,
+vgg16_reduced}.py behavior, BASELINE config 4).
+
+Builds the multi-scale feature pyramid + multibox head on top of a reduced
+VGG-16 trunk (fc6/fc7 as dilated/1x1 convolutions), wires the contrib
+anchor ops (_contrib_MultiBoxPrior/Target/Detection), and groups the
+training losses exactly like the reference builder
+(example/ssd/symbol/symbol_builder.py:66-102):
+[cls_prob, loc_loss, cls_label, det].
+
+`get_ssd_tiny` is a scaled-down config (small trunk, two scales) for
+tests and CPU-mesh dry runs.
+"""
+from .. import symbol as sym
+from ..contrib import symbol as csym
+
+__all__ = ["get_ssd_vgg16", "get_ssd_tiny", "multibox_layer"]
+
+
+def _conv_act(data, name, num_filter, kernel=(1, 1), pad=(0, 0), stride=(1, 1)):
+    conv = sym.Convolution(data, kernel=kernel, pad=pad, stride=stride,
+                           num_filter=num_filter, name="%s_conv" % name)
+    return sym.Activation(conv, act_type="relu", name="%s_relu" % name)
+
+
+def _vgg16_reduced_trunk():
+    """Reduced VGG-16: conv trunk with fc6 → dilated 3x3 conv, fc7 → 1x1 conv
+    (reference example/ssd/symbol/vgg16_reduced.py:12-86)."""
+    data = sym.Variable("data")
+    body = data
+    layers = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+    relu4_3 = None
+    for i, (num, filt) in enumerate(layers):
+        for j in range(num):
+            body = sym.Convolution(body, kernel=(3, 3), pad=(1, 1), num_filter=filt,
+                                   name="conv%d_%d" % (i + 1, j + 1))
+            body = sym.Activation(body, act_type="relu",
+                                  name="relu%d_%d" % (i + 1, j + 1))
+        if i == 3:
+            relu4_3 = body  # feature scale 1 tap point
+        if i < 4:
+            conv_kw = {"pooling_convention": "full"} if i == 2 else {}
+            body = sym.Pooling(body, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                               name="pool%d" % (i + 1), **conv_kw)
+        else:
+            # pool5: 3x3 stride-1 (keeps resolution for the dilated fc6)
+            body = sym.Pooling(body, pool_type="max", kernel=(3, 3), stride=(1, 1),
+                               pad=(1, 1), name="pool5")
+    fc6 = sym.Convolution(body, kernel=(3, 3), pad=(6, 6), dilate=(6, 6),
+                          num_filter=1024, name="fc6")
+    relu6 = sym.Activation(fc6, act_type="relu", name="relu6")
+    fc7 = sym.Convolution(relu6, kernel=(1, 1), num_filter=1024, name="fc7")
+    relu7 = sym.Activation(fc7, act_type="relu", name="relu7")
+    return relu4_3, relu7
+
+
+def _extra_layers(body, num_filters, strides, pads, min_filter=128):
+    """1x1-reduce + 3x3 pyramid layers
+    (reference example/ssd/symbol/common.py multi_layer_feature;
+    vgg16_reduced_300 config strides (2,2,1,1), pads (1,1,0,0) from
+    example/ssd/symbol/symbol_factory.py)."""
+    layers = []
+    for k, nf in enumerate(num_filters):
+        name = "multi_feat_%d" % k
+        reduced = _conv_act(body, name + "_1x1", max(min_filter, nf // 2))
+        body = _conv_act(reduced, name + "_3x3", nf, kernel=(3, 3),
+                         pad=(pads[k], pads[k]), stride=(strides[k], strides[k]))
+        layers.append(body)
+    return layers
+
+
+def multibox_layer(from_layers, num_classes, sizes, ratios, normalization=-1,
+                   num_channels=(), clip=False, steps=()):
+    """Per-scale loc/cls heads + anchors, concatenated
+    (reference example/ssd/symbol/common.py:136-283).
+
+    num_classes EXCLUDES background; class 0 is reserved internally.
+    """
+    if not isinstance(normalization, (list, tuple)):
+        normalization = [normalization] * len(from_layers)
+    loc_layers, cls_layers, anchor_layers = [], [], []
+    nc = num_classes + 1
+    for k, layer in enumerate(from_layers):
+        name = "ssd_%d" % k
+        if normalization[k] > 0:
+            layer = sym.L2Normalization(layer, mode="channel",
+                                        name="%s_norm" % name)
+            from .. import initializer as init
+            scale = sym.Variable("%s_scale" % name,
+                                 shape=(1, num_channels[k], 1, 1),
+                                 init=init.Constant(float(normalization[k])))
+            layer = sym.broadcast_mul(scale, layer)
+        na = len(sizes[k]) + len(ratios[k]) - 1
+        loc = sym.Convolution(layer, kernel=(3, 3), pad=(1, 1),
+                              num_filter=na * 4, name="%s_loc_pred_conv" % name)
+        loc = sym.Flatten(sym.transpose(loc, axes=(0, 2, 3, 1)))
+        loc_layers.append(loc)
+        cls = sym.Convolution(layer, kernel=(3, 3), pad=(1, 1),
+                              num_filter=na * nc, name="%s_cls_pred_conv" % name)
+        cls = sym.Flatten(sym.transpose(cls, axes=(0, 2, 3, 1)))
+        cls_layers.append(cls)
+        step = (steps[k], steps[k]) if steps else (-1.0, -1.0)
+        anchors = csym.MultiBoxPrior(layer, sizes=tuple(sizes[k]),
+                                     ratios=tuple(ratios[k]), clip=clip,
+                                     steps=step, name="%s_anchors" % name)
+        anchor_layers.append(sym.Flatten(anchors))
+    loc_preds = sym.Concat(*loc_layers, dim=1, name="multibox_loc_pred")
+    cls_preds = sym.Concat(*cls_layers, dim=1)
+    cls_preds = sym.Reshape(cls_preds, shape=(0, -1, nc))
+    cls_preds = sym.transpose(cls_preds, axes=(0, 2, 1), name="multibox_cls_pred")
+    anchors = sym.Concat(*anchor_layers, dim=1)
+    anchors = sym.Reshape(anchors, shape=(0, -1, 4), name="multibox_anchors")
+    return loc_preds, cls_preds, anchors
+
+
+def _build_ssd(layers, num_classes, sizes, ratios, normalization, num_channels,
+               steps, mode, nms_thresh, force_suppress, nms_topk):
+    loc_preds, cls_preds, anchors = multibox_layer(
+        layers, num_classes, sizes, ratios, normalization=normalization,
+        num_channels=num_channels, clip=False, steps=steps)
+    if mode == "train":
+        label = sym.Variable("label")
+        tmp = csym.MultiBoxTarget(
+            anchors, label, cls_preds, overlap_threshold=0.5, ignore_label=-1,
+            negative_mining_ratio=3, negative_mining_thresh=0.5,
+            variances=(0.1, 0.1, 0.2, 0.2), name="multibox_target")
+        loc_target, loc_target_mask, cls_target = tmp[0], tmp[1], tmp[2]
+        cls_prob = sym.SoftmaxOutput(cls_preds, cls_target, ignore_label=-1,
+                                     use_ignore=True, multi_output=True,
+                                     normalization="valid", name="cls_prob")
+        loc_diff = loc_target_mask * (loc_preds - loc_target)
+        loc_loss_ = sym.smooth_l1(loc_diff, scalar=1.0, name="loc_loss_")
+        loc_loss = sym.MakeLoss(loc_loss_, normalization="valid", name="loc_loss")
+        cls_label = sym.MakeLoss(cls_target, grad_scale=0, name="cls_label")
+        det = csym.MultiBoxDetection(cls_prob, loc_preds, anchors,
+                                     name="detection", nms_threshold=nms_thresh,
+                                     force_suppress=force_suppress,
+                                     variances=(0.1, 0.1, 0.2, 0.2),
+                                     nms_topk=nms_topk)
+        det = sym.MakeLoss(det, grad_scale=0, name="det_out")
+        return sym.Group([cls_prob, loc_loss, cls_label, det])
+    cls_prob = sym.SoftmaxActivation(cls_preds, mode="channel", name="cls_prob")
+    return csym.MultiBoxDetection(cls_prob, loc_preds, anchors, name="detection",
+                                  nms_threshold=nms_thresh,
+                                  force_suppress=force_suppress,
+                                  variances=(0.1, 0.1, 0.2, 0.2),
+                                  nms_topk=nms_topk)
+
+
+def get_ssd_vgg16(num_classes=20, mode="train", nms_thresh=0.5,
+                  force_suppress=False, nms_topk=400):
+    """SSD-300 on reduced VGG-16 (reference example/ssd config for
+    vgg16_reduced_300: symbol_factory.py)."""
+    relu4_3, relu7 = _vgg16_reduced_trunk()
+    extra = _extra_layers(relu7, (512, 256, 256, 256), (2, 2, 1, 1), (1, 1, 0, 0))
+    layers = [relu4_3, relu7] + extra
+    sizes = [[0.1, 0.141], [0.2, 0.272], [0.37, 0.447], [0.54, 0.619],
+             [0.71, 0.79], [0.88, 0.961]]
+    ratios = [[1, 2, 0.5]] + [[1, 2, 0.5, 3, 1.0 / 3]] * 3 + [[1, 2, 0.5]] * 2
+    normalization = [20, -1, -1, -1, -1, -1]
+    num_channels = [512]
+    steps = [x / 300.0 for x in (8, 16, 32, 64, 100, 300)]
+    return _build_ssd(layers, num_classes, sizes, ratios, normalization,
+                      num_channels, steps, mode, nms_thresh, force_suppress,
+                      nms_topk)
+
+
+def get_ssd_tiny(num_classes=3, mode="train", nms_thresh=0.5, nms_topk=50):
+    """Two-scale miniature SSD for tests / CPU dry runs."""
+    data = sym.Variable("data")
+    body = _conv_act(data, "t1", 8, kernel=(3, 3), pad=(1, 1))
+    body = sym.Pooling(body, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                       name="tpool1")
+    s1 = _conv_act(body, "t2", 16, kernel=(3, 3), pad=(1, 1))
+    s2 = _conv_act(s1, "t3", 16, kernel=(3, 3), pad=(1, 1), stride=(2, 2))
+    sizes = [[0.3, 0.4], [0.6, 0.8]]
+    ratios = [[1, 2, 0.5]] * 2
+    return _build_ssd([s1, s2], num_classes, sizes, ratios, -1, (), (),
+                      mode, nms_thresh, False, nms_topk)

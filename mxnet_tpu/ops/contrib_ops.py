@@ -1,0 +1,384 @@
+"""Contrib operators (parity: reference src/operator/contrib/).
+
+TPU-native equivalents of the SSD anchor ops and CTCLoss:
+
+* ``_contrib_MultiBoxPrior``     — reference multibox_prior.cc:12-53
+* ``_contrib_MultiBoxTarget``    — reference multibox_target.cc:53-262
+* ``_contrib_MultiBoxDetection`` — reference multibox_detection.cc:26-150
+* ``_contrib_CTCLoss``           — reference ctc_loss-inl.h (warp-ctc semantics)
+
+Design notes (TPU-first): the reference implements these as sequential CPU/CUDA
+kernels with data-dependent loops.  Here everything is static-shape masked
+jnp/lax code so the ops trace into the surrounding XLA executable:
+
+* the greedy bipartite matching loop of MultiBoxTarget becomes a bounded
+  ``lax.fori_loop`` (one global argmax per iteration);
+* NMS in MultiBoxDetection becomes a bounded ``fori_loop`` over the
+  score-sorted detections with masked O(A) suppression per step;
+* CTC's alpha recursion is a ``lax.scan`` over time in log space, vmapped
+  over the batch.
+
+Known intentional divergence: when ``nms_topk`` truncates detections the
+reference leaves stale pre-sort rows in the tail of the output buffer
+(multibox_detection.cc:124-131); here those rows are set to -1 entirely.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .tensor import _bool, _lit
+
+_NEG = -1e30
+
+
+def _floats(v, default=None):
+    v = _lit(v)
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# ----------------------------------------------------------------------
+# MultiBoxPrior (reference src/operator/contrib/multibox_prior.cc:12-53;
+# shape: -inl.h:153-175 → (1, H*W*(num_sizes+num_ratios-1), 4))
+# ----------------------------------------------------------------------
+
+
+def _infer_mbprior(in_shapes, attrs):
+    data = in_shapes[0]
+    sizes = _floats(attrs.get("sizes", (1.0,)), (1.0,))
+    ratios = _floats(attrs.get("ratios", (1.0,)), (1.0,))
+    na = len(sizes) + len(ratios) - 1
+    return [data], [(1, data[2] * data[3] * na, 4)]
+
+
+@register("_contrib_MultiBoxPrior", inputs=("data",), infer_shape=_infer_mbprior)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Generate SSD prior (anchor) boxes from a feature map.
+
+    Anchor order per location matches the reference kernel
+    (multibox_prior.cc:24-52): all sizes at ratio 1 first, then
+    ratios[1:] at sizes[0]; locations row-major over (y, x).
+    """
+    sizes = _floats(sizes, (1.0,))
+    ratios = _floats(ratios, (1.0,))
+    steps = _floats(steps, (-1.0, -1.0))
+    offsets = _floats(offsets, (0.5, 0.5))
+    in_h, in_w = int(data.shape[2]), int(data.shape[3])
+    step_y = steps[0] if steps[0] > 0 else 1.0 / in_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / in_w
+    dt = data.dtype if jnp.issubdtype(data.dtype, jnp.floating) else jnp.float32
+    cy = (jnp.arange(in_h, dtype=dt) + offsets[0]) * step_y
+    cx = (jnp.arange(in_w, dtype=dt) + offsets[1]) * step_x
+    half_w = [s / 2.0 for s in sizes] + [sizes[0] * math.sqrt(r) / 2.0 for r in ratios[1:]]
+    half_h = [s / 2.0 for s in sizes] + [sizes[0] / math.sqrt(r) / 2.0 for r in ratios[1:]]
+    hw = jnp.asarray(half_w, dt)
+    hh = jnp.asarray(half_h, dt)
+    na = hw.shape[0]
+    gx = jnp.broadcast_to(cx[None, :, None], (in_h, in_w, na))
+    gy = jnp.broadcast_to(cy[:, None, None], (in_h, in_w, na))
+    out = jnp.stack([gx - hw, gy - hh, gx + hw, gy + hh], axis=-1).reshape(1, -1, 4)
+    if _bool(clip):
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ----------------------------------------------------------------------
+# IoU helpers (reference multibox_target-inl.h:115-143: raw-area union,
+# safe_divide → 0 when union is 0)
+# ----------------------------------------------------------------------
+
+
+def _iou_matrix(anchors, boxes):
+    """IoU between anchors (A,4) and boxes (L,4), both corner-encoded."""
+    iw = jnp.maximum(0.0, jnp.minimum(anchors[:, None, 2], boxes[None, :, 2])
+                     - jnp.maximum(anchors[:, None, 0], boxes[None, :, 0]))
+    ih = jnp.maximum(0.0, jnp.minimum(anchors[:, None, 3], boxes[None, :, 3])
+                     - jnp.maximum(anchors[:, None, 1], boxes[None, :, 1]))
+    inter = iw * ih
+    area_a = (anchors[:, 2] - anchors[:, 0]) * (anchors[:, 3] - anchors[:, 1])
+    area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union == 0.0, 0.0, inter / union)
+
+
+# ----------------------------------------------------------------------
+# MultiBoxTarget (reference src/operator/contrib/multibox_target.cc:53-262)
+# ----------------------------------------------------------------------
+
+
+def _infer_mbtarget(in_shapes, attrs):
+    ashape, lshape, pshape = in_shapes
+    num_anchor = ashape[1]
+    b = lshape[0]
+    return (list(in_shapes),
+            [(b, num_anchor * 4), (b, num_anchor * 4), (b, num_anchor)])
+
+
+def _target_one(lab, pred, anchors, overlap_threshold, ignore_label,
+                negative_mining_ratio, negative_mining_thresh, variances):
+    """Targets for one batch element. lab (L,>=5), pred (C,A), anchors (A,4)."""
+    A = anchors.shape[0]
+    L = lab.shape[0]
+    ious = _iou_matrix(anchors, lab[:, 1:5])  # (A, L)
+    # ground truths are valid until the first class == -1 row
+    # (multibox_target.cc:75-86)
+    valid = jnp.cumprod((lab[:, 0] != -1.0).astype(jnp.int32)).astype(bool)
+    has_gt = jnp.any(valid)
+
+    # --- stage 1: greedy bipartite matching (multibox_target.cc:92-131).
+    # Each round picks the globally best (anchor, gt) pair among the still
+    # unmatched; at most L rounds are ever productive.
+    def body(_, state):
+        a_matched, g_matched, match_gt, match_iou = state
+        masked = jnp.where(a_matched[:, None] | g_matched[None, :] | ~valid[None, :],
+                           _NEG, ious)
+        flat_idx = jnp.argmax(masked)
+        best_iou = masked.reshape(-1)[flat_idx]
+        ba, bg = flat_idx // L, flat_idx % L
+        ok = best_iou > 1e-6
+        a_matched = a_matched.at[ba].set(a_matched[ba] | ok)
+        g_matched = g_matched.at[bg].set(g_matched[bg] | ok)
+        match_gt = match_gt.at[ba].set(jnp.where(ok, bg.astype(jnp.int32), match_gt[ba]))
+        match_iou = match_iou.at[ba].set(jnp.where(ok, best_iou, match_iou[ba]))
+        return a_matched, g_matched, match_gt, match_iou
+
+    init = (jnp.zeros((A,), bool), jnp.zeros((L,), bool),
+            jnp.full((A,), -1, jnp.int32), jnp.full((A,), -1.0))
+    a_matched, g_matched, match_gt, match_iou = lax.fori_loop(0, L, body, init)
+
+    # --- stage 2: per-anchor threshold matching (multibox_target.cc:133-161).
+    masked_iou = jnp.where(valid[None, :], ious, _NEG)
+    best_gt_all = jnp.argmax(masked_iou, axis=1).astype(jnp.int32)
+    best_iou_all = jnp.max(masked_iou, axis=1)
+    match_gt = jnp.where(a_matched, match_gt, jnp.where(has_gt, best_gt_all, -1))
+    match_iou = jnp.where(a_matched, match_iou, jnp.where(has_gt, best_iou_all, -1.0))
+    if overlap_threshold > 0:
+        thresh_pos = (~a_matched) & has_gt & (best_iou_all > overlap_threshold)
+    else:
+        thresh_pos = jnp.zeros((A,), bool)
+    positive = a_matched | thresh_pos
+    num_positive = positive.sum()
+
+    # --- stage 3: negatives (multibox_target.cc:163-229)
+    if negative_mining_ratio > 0:
+        num_neg = jnp.minimum(
+            (num_positive.astype(jnp.float32) * negative_mining_ratio).astype(jnp.int32),
+            A - num_positive)
+        cand = (~positive) & (match_iou < negative_mining_thresh)
+        # hardest negatives = lowest background-class probability
+        m = pred.max(axis=0)
+        bg_prob = jnp.exp(pred[0] - m) / jnp.exp(pred - m[None, :]).sum(axis=0)
+        score = jnp.where(cand, -bg_prob, -jnp.inf)
+        order = jnp.argsort(-score, stable=True)
+        rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A, dtype=jnp.int32))
+        negative = cand & (rank < num_neg)
+    else:
+        negative = ~positive
+
+    # --- emit targets (multibox_target.cc:231-259)
+    mg = jnp.clip(match_gt, 0, L - 1)
+    g = lab[mg, 1:5]  # (A, 4) matched gt corners
+    al, at_, ar, ab_ = anchors[:, 0], anchors[:, 1], anchors[:, 2], anchors[:, 3]
+    aw, ah = ar - al, ab_ - at_
+    ax, ay = (al + ar) * 0.5, (at_ + ab_) * 0.5
+    gw = jnp.where(positive, g[:, 2] - g[:, 0], aw)
+    gh = jnp.where(positive, g[:, 3] - g[:, 1], ah)
+    gx, gy = (g[:, 0] + g[:, 2]) * 0.5, (g[:, 1] + g[:, 3]) * 0.5
+    vx, vy, vw, vh = variances
+    loc = jnp.stack([(gx - ax) / aw / vx, (gy - ay) / ah / vy,
+                     jnp.log(gw / aw) / vw, jnp.log(gh / ah) / vh], axis=-1)
+    posf = positive.astype(loc.dtype)
+    loc_target = (loc * posf[:, None]).reshape(-1)
+    loc_mask = jnp.broadcast_to(posf[:, None], (A, 4)).reshape(-1)
+    cls_target = jnp.where(positive, lab[mg, 0] + 1.0,
+                           jnp.where(negative, 0.0, ignore_label))
+    # batches without any valid gt are left untouched at their init values
+    # (multibox_target.cc:88: the whole body is skipped)
+    loc_target = jnp.where(has_gt, loc_target, 0.0)
+    loc_mask = jnp.where(has_gt, loc_mask, 0.0)
+    cls_target = jnp.where(has_gt, cls_target, ignore_label)
+    return loc_target, loc_mask, cls_target
+
+
+@register("_contrib_MultiBoxTarget", inputs=("anchor", "label", "cls_pred"),
+          num_outputs=3, infer_shape=_infer_mbtarget)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """Compute SSD training targets: [loc_target, loc_mask, cls_target].
+
+    ``minimum_negative_samples`` is accepted but unused, matching the
+    reference 0.10 kernel (multibox_target.cc never reads it).
+    Outputs carry no gradient (reference backward zeroes cls_pred grad,
+    multibox_target-inl.h:155-167).
+    """
+    anchors = anchor.reshape(-1, 4)
+    f = partial(_target_one, anchors=anchors,
+                overlap_threshold=float(_lit(overlap_threshold)),
+                ignore_label=float(_lit(ignore_label)),
+                negative_mining_ratio=float(_lit(negative_mining_ratio)),
+                negative_mining_thresh=float(_lit(negative_mining_thresh)),
+                variances=_floats(variances, (0.1, 0.1, 0.2, 0.2)))
+    loc_t, loc_m, cls_t = jax.vmap(f)(label, cls_pred)
+    return (lax.stop_gradient(loc_t), lax.stop_gradient(loc_m),
+            lax.stop_gradient(cls_t))
+
+
+# ----------------------------------------------------------------------
+# MultiBoxDetection (reference src/operator/contrib/multibox_detection.cc)
+# ----------------------------------------------------------------------
+
+
+def _infer_mbdet(in_shapes, attrs):
+    cshape, lshape, ashape = in_shapes
+    return list(in_shapes), [(cshape[0], ashape[1], 6)]
+
+
+def _detect_one(probs, locp, anchors, clip, threshold, variances,
+                nms_threshold, force_suppress, nms_topk):
+    """Decode one batch element. probs (C,A), locp (A*4,), anchors (A,4)."""
+    A = anchors.shape[0]
+    # predicted foreground class & score (multibox_detection.cc:85-99)
+    fg = probs[1:]  # (C-1, A)
+    score = fg.max(axis=0)
+    cid = fg.argmax(axis=0).astype(jnp.int32) + 1
+    cid = jnp.where(score < threshold, 0, cid)
+    valid = cid > 0
+    # decode locations (TransformLocations, multibox_detection.cc:26-51)
+    al, at_, ar, ab_ = anchors[:, 0], anchors[:, 1], anchors[:, 2], anchors[:, 3]
+    aw, ah = ar - al, ab_ - at_
+    ax, ay = (al + ar) * 0.5, (at_ + ab_) * 0.5
+    p = locp.reshape(A, 4)
+    vx, vy, vw, vh = variances
+    ox = p[:, 0] * vx * aw + ax
+    oy = p[:, 1] * vy * ah + ay
+    ow = jnp.exp(p[:, 2] * vw) * aw * 0.5
+    oh = jnp.exp(p[:, 3] * vh) * ah * 0.5
+    boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # stable sort by score desc, invalid rows to the back
+    # (compact-then-stable-sort of the reference collapses to this)
+    key = jnp.where(valid, score, -jnp.inf)
+    order = jnp.argsort(-key, stable=True)
+    cid_s, score_s, boxes_s = cid[order], score[order], boxes[order]
+    valid_s = valid[order]
+    if nms_topk > 0:
+        valid_s = valid_s & (jnp.arange(A) < nms_topk)
+    if 0 < nms_threshold <= 1:
+        iou = _nms_iou(boxes_s)  # (A, A)
+
+        def body(i, kept):
+            same_cls = jnp.full((A,), True) if force_suppress else (cid_s == cid_s[i])
+            sup = kept & (jnp.arange(A) > i) & (iou[i] >= nms_threshold) & same_cls
+            return kept & ~(sup & kept[i])
+
+        kept = lax.fori_loop(0, A, body, valid_s)
+    else:
+        kept = valid_s
+    out_id = jnp.where(kept, cid_s.astype(score_s.dtype) - 1.0, -1.0)
+    row = jnp.concatenate([out_id[:, None], score_s[:, None], boxes_s], axis=-1)
+    return jnp.where(valid_s[:, None], row, -1.0)
+
+
+def _nms_iou(boxes):
+    """Pairwise IoU, u<=0 → 0 (CalculateOverlap, multibox_detection.cc:54-61)."""
+    iw = jnp.maximum(0.0, jnp.minimum(boxes[:, None, 2], boxes[None, :, 2])
+                     - jnp.maximum(boxes[:, None, 0], boxes[None, :, 0]))
+    ih = jnp.maximum(0.0, jnp.minimum(boxes[:, None, 3], boxes[None, :, 3])
+                     - jnp.maximum(boxes[:, None, 1], boxes[None, :, 1]))
+    inter = iw * ih
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union <= 0.0, 0.0, inter / union)
+
+
+@register("_contrib_MultiBoxDetection", inputs=("cls_prob", "loc_pred", "anchor"),
+          infer_shape=_infer_mbdet)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):
+    """Convert SSD predictions to detections [id, score, xmin, ymin, xmax, ymax]."""
+    anchors = anchor.reshape(-1, 4)
+    f = partial(_detect_one, anchors=anchors, clip=_bool(clip),
+                threshold=float(_lit(threshold)),
+                variances=_floats(variances, (0.1, 0.1, 0.2, 0.2)),
+                nms_threshold=float(_lit(nms_threshold)),
+                force_suppress=_bool(force_suppress),
+                nms_topk=int(_lit(nms_topk)))
+    return lax.stop_gradient(jax.vmap(f)(cls_prob, loc_pred))
+
+
+# ----------------------------------------------------------------------
+# CTCLoss (reference src/operator/contrib/ctc_loss-inl.h; warp-ctc
+# forward-backward with blank=0, label padding=0)
+# ----------------------------------------------------------------------
+
+
+def _infer_ctc(in_shapes, attrs):
+    dshape, lshape = in_shapes
+    return list(in_shapes), [(dshape[1],), dshape]
+
+
+def _ctc_loss_one(lp, lab):
+    """Negative log likelihood for one sequence. lp (T, C) log-probs, lab (L,)."""
+    L = lab.shape[0]
+    S = 2 * L + 1
+    lab_i = lab.astype(jnp.int32)
+    # labels are packed with trailing zeros (LabelTensorToPackedVector,
+    # ctc_loss-inl.h:112-131); blank index is 0
+    lab_len = jnp.sum(jnp.cumprod((lab_i != 0).astype(jnp.int32)))
+    ext = jnp.zeros((S,), jnp.int32).at[1::2].set(lab_i)
+    prev2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    skip = (ext != 0) & (ext != prev2)
+    s_valid = jnp.arange(S) < (2 * lab_len + 1)
+
+    alpha0 = jnp.full((S,), _NEG, lp.dtype)
+    alpha0 = alpha0.at[0].set(lp[0, 0])
+    alpha0 = alpha0.at[1].set(jnp.where(lab_len > 0, lp[0, ext[1]], _NEG))
+
+    def step(alpha, lp_t):
+        a1 = jnp.concatenate([jnp.full((1,), _NEG, alpha.dtype), alpha[:-1]])
+        a2 = jnp.concatenate([jnp.full((2,), _NEG, alpha.dtype), alpha[:-2]])
+        a2 = jnp.where(skip, a2, _NEG)
+        m = jnp.maximum(alpha, jnp.maximum(a1, a2))
+        tot = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m))
+        new = jnp.where(s_valid, tot + lp_t[ext], _NEG)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, lp[1:])
+    end1 = alpha[2 * lab_len]
+    end2 = jnp.where(lab_len > 0, alpha[jnp.maximum(2 * lab_len - 1, 0)], _NEG)
+    m = jnp.maximum(end1, end2)
+    return -(m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m)))
+
+
+@register("_contrib_CTCLoss", inputs=("data", "label"), num_outputs=2,
+          aliases=("_contrib_ctc_loss",), infer_shape=_infer_ctc)
+def ctc_loss(data, label, **kw):
+    """CTC loss. data (T, B, C) unnormalized activations, label (B, L).
+
+    Outputs [loss (B,), grad (T, B, C)] like the reference
+    (ctc_loss-inl.h:228-230 lists outputs {"output", "grad"}); the loss
+    output is differentiable through JAX AD, grad is the precomputed
+    d(sum loss)/d(data) for reference-API parity.
+    """
+
+    def total(d):
+        lp = jax.nn.log_softmax(d, axis=-1)
+        losses = jax.vmap(_ctc_loss_one, in_axes=(1, 0))(lp, label)
+        return losses.sum(), losses
+
+    grad, losses = jax.grad(total, has_aux=True)(data)
+    return losses, lax.stop_gradient(grad)

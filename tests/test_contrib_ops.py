@@ -1,0 +1,278 @@
+"""Numeric tests for contrib ops vs independent numpy/torch oracles.
+
+The oracles transcribe the reference CPU kernels
+(reference src/operator/contrib/multibox_target.cc:53-262,
+multibox_detection.cc:26-150) in plain numpy, so any divergence in the
+XLA-friendly masked reimplementation shows up here.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import contrib
+
+
+def _np_iou(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    union = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return 0.0 if union == 0 else inter / union
+
+
+def _oracle_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2)):
+    B, L, _ = labels.shape
+    A = anchors.shape[0]
+    loc_t = np.zeros((B, A * 4))
+    loc_m = np.zeros((B, A * 4))
+    cls_t = np.full((B, A), ignore_label)
+    for n in range(B):
+        lab = labels[n]
+        nvalid = 0
+        for i in range(L):
+            if lab[i, 0] == -1.0:
+                break
+            nvalid += 1
+        if nvalid == 0:
+            continue
+        ious = np.array([[_np_iou(anchors[j], lab[k, 1:5]) for k in range(nvalid)]
+                         for j in range(A)])
+        gt_flags = [False] * nvalid
+        anchor_flags = [-1] * A
+        match = [(-1.0, -1)] * A
+        # bipartite
+        while not all(gt_flags):
+            best_a = best_g = -1
+            best = 1e-6
+            for j in range(A):
+                if anchor_flags[j] == 1:
+                    continue
+                for k in range(nvalid):
+                    if gt_flags[k]:
+                        continue
+                    if ious[j, k] > best:
+                        best_a, best_g, best = j, k, ious[j, k]
+            if best_a == -1:
+                break
+            match[best_a] = (best, best_g)
+            gt_flags[best_g] = True
+            anchor_flags[best_a] = 1
+        # threshold
+        if overlap_threshold > 0:
+            for j in range(A):
+                if anchor_flags[j] == 1:
+                    continue
+                k = int(np.argmax(ious[j]))
+                match[j] = (ious[j, k], k)
+                if ious[j, k] > overlap_threshold:
+                    anchor_flags[j] = 1
+        num_pos = sum(1 for f in anchor_flags if f == 1)
+        if negative_mining_ratio > 0:
+            num_neg = min(int(num_pos * negative_mining_ratio), A - num_pos)
+            if num_neg > 0:
+                C = cls_preds.shape[1]
+                cand = []
+                for j in range(A):
+                    if anchor_flags[j] == 1:
+                        continue
+                    if match[j][0] < 0:
+                        k = int(np.argmax(ious[j]))
+                        match[j] = (ious[j, k], k)
+                    if match[j][0] < negative_mining_thresh and anchor_flags[j] == -1:
+                        logits = cls_preds[n, :, j]
+                        e = np.exp(logits - logits.max())
+                        cand.append((-e[0] / e.sum(), j))
+                cand.sort(key=lambda t: (-t[0], t[1]))  # descending value, stable
+                for _, j in cand[:num_neg]:
+                    anchor_flags[j] = 0
+        else:
+            for j in range(A):
+                if anchor_flags[j] != 1:
+                    anchor_flags[j] = 0
+        vx, vy, vw, vh = variances
+        for i in range(A):
+            if anchor_flags[i] == 1:
+                k = match[i][1]
+                cls_t[n, i] = lab[k, 0] + 1
+                loc_m[n, i * 4:i * 4 + 4] = 1
+                al, at, ar, ab = anchors[i]
+                aw, ah = ar - al, ab - at
+                ax, ay = (al + ar) / 2, (at + ab) / 2
+                gl, gt, gr, gb = lab[k, 1:5]
+                gw, gh = gr - gl, gb - gt
+                gx, gy = (gl + gr) / 2, (gt + gb) / 2
+                loc_t[n, i * 4:i * 4 + 4] = [(gx - ax) / aw / vx, (gy - ay) / ah / vy,
+                                             math.log(gw / aw) / vw, math.log(gh / ah) / vh]
+            elif anchor_flags[i] == 0:
+                cls_t[n, i] = 0
+    return loc_t, loc_m, cls_t
+
+
+def _rand_boxes(rng, n):
+    xy = rng.uniform(0, 0.7, (n, 2))
+    wh = rng.uniform(0.05, 0.3, (n, 2))
+    return np.concatenate([xy, xy + wh], axis=1)
+
+
+def test_multibox_prior_oracle():
+    x = mx.nd.zeros((1, 3, 4, 5))
+    out = contrib.ndarray.MultiBoxPrior(
+        x, sizes=(0.4, 0.2), ratios=(1, 2), steps=(0.3, 0.2), offsets=(0.4, 0.6))
+    pn = out.asnumpy()
+    assert pn.shape == (1, 4 * 5 * 3, 4)
+    count = 0
+    for r in range(4):
+        cy = (r + 0.4) * 0.3
+        for c in range(5):
+            cx = (c + 0.6) * 0.2
+            whs = [(0.2, 0.2), (0.1, 0.1),
+                   (0.4 * math.sqrt(2) / 2, 0.4 / math.sqrt(2) / 2)]
+            for w, h in whs:
+                np.testing.assert_allclose(
+                    pn[0, count], [cx - w, cy - h, cx + w, cy + h], atol=1e-5)
+                count += 1
+
+
+def test_multibox_prior_clip_and_grad():
+    x = mx.nd.zeros((1, 3, 2, 2))
+    out = contrib.ndarray.MultiBoxPrior(x, sizes=(0.9,), clip=True).asnumpy()
+    assert out.min() >= 0.0 and out.max() <= 1.0
+    # symbolic path: prior of a conv feature map contributes no gradient
+    data = mx.sym.Variable("data")
+    sym = contrib.symbol.MultiBoxPrior(data, sizes=(0.5,))
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.ones((1, 3, 2, 2))},
+                  args_grad={"data": mx.nd.zeros((1, 3, 2, 2))})
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones(ex.outputs[0].shape))
+    assert np.abs(ex.grad_dict["data"].asnumpy()).max() == 0.0
+
+
+@pytest.mark.parametrize("mining", [-1.0, 2.0])
+def test_multibox_target_oracle(mining):
+    rng = np.random.RandomState(7)
+    B, L, A, C = 3, 4, 20, 5
+    anchors = _rand_boxes(rng, A).astype(np.float32)
+    labels = np.full((B, L, 5), -1.0, np.float32)
+    for b in range(B):
+        ngt = rng.randint(0, L)  # includes a zero-gt batch element sometimes
+        labels[b, :ngt, 0] = rng.randint(0, C - 1, ngt)
+        labels[b, :ngt, 1:5] = _rand_boxes(rng, ngt)
+    cls_preds = rng.randn(B, C, A).astype(np.float32)
+    loc_t, loc_m, cls_t = contrib.ndarray.MultiBoxTarget(
+        mx.nd.array(anchors[None]), mx.nd.array(labels), mx.nd.array(cls_preds),
+        overlap_threshold=0.5, negative_mining_ratio=mining,
+        negative_mining_thresh=0.5)
+    o_loc, o_msk, o_cls = _oracle_target(
+        anchors.astype(np.float64), labels.astype(np.float64), cls_preds,
+        negative_mining_ratio=mining)
+    np.testing.assert_allclose(cls_t.asnumpy(), o_cls, atol=1e-5)
+    np.testing.assert_allclose(loc_m.asnumpy(), o_msk, atol=1e-5)
+    np.testing.assert_allclose(loc_t.asnumpy(), o_loc, rtol=1e-4, atol=1e-4)
+
+
+def test_multibox_detection_oracle():
+    rng = np.random.RandomState(3)
+    B, C, A = 2, 4, 12
+    anchors = _rand_boxes(rng, A).astype(np.float32)
+    # make several overlapping anchors to exercise NMS
+    anchors[1] = anchors[0] + 0.01
+    anchors[2] = anchors[0] - 0.01
+    probs = rng.uniform(0, 1, (B, C, A)).astype(np.float32)
+    probs /= probs.sum(axis=1, keepdims=True)
+    locp = (rng.randn(B, A * 4) * 0.1).astype(np.float32)
+    out = contrib.ndarray.MultiBoxDetection(
+        mx.nd.array(probs), mx.nd.array(locp), mx.nd.array(anchors[None]),
+        threshold=0.3, nms_threshold=0.4, clip=True).asnumpy()
+    assert out.shape == (B, A, 6)
+    vx, vy, vw, vh = 0.1, 0.1, 0.2, 0.2
+    for b in range(B):
+        dets = []
+        for i in range(A):
+            score = probs[b, 1:, i].max()
+            cid = probs[b, 1:, i].argmax() + 1
+            if score < 0.3:
+                continue
+            al, at, ar, ab = anchors[i]
+            aw, ah = ar - al, ab - at
+            ax, ay = (al + ar) / 2, (at + ab) / 2
+            px, py, pw, ph = locp[b, i * 4:i * 4 + 4]
+            ox, oy = px * vx * aw + ax, py * vy * ah + ay
+            ow, oh = math.exp(pw * vw) * aw / 2, math.exp(ph * vh) * ah / 2
+            box = np.clip([ox - ow, oy - oh, ox + ow, oy + oh], 0, 1)
+            dets.append([cid - 1, score] + list(box))
+        dets.sort(key=lambda d: -d[1])
+        # greedy same-class NMS
+        for i in range(len(dets)):
+            if dets[i][0] < 0:
+                continue
+            for j in range(i + 1, len(dets)):
+                if dets[j][0] < 0 or dets[j][0] != dets[i][0]:
+                    continue
+                if _np_iou(dets[i][2:], dets[j][2:]) >= 0.4:
+                    dets[j][0] = -1
+        got = out[b]
+        assert np.all(got[len(dets):] == -1.0)
+        for i, d in enumerate(dets):
+            assert got[i, 0] == d[0]
+            np.testing.assert_allclose(got[i, 1:], d[1:], rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_detection_topk():
+    rng = np.random.RandomState(5)
+    anchors = _rand_boxes(rng, 8).astype(np.float32)
+    probs = rng.uniform(0.4, 1, (1, 3, 8)).astype(np.float32)
+    locp = np.zeros((1, 32), np.float32)
+    out = contrib.ndarray.MultiBoxDetection(
+        mx.nd.array(probs), mx.nd.array(locp), mx.nd.array(anchors[None]),
+        threshold=0.0, nms_threshold=0.9, nms_topk=3).asnumpy()
+    assert (out[0, 3:] == -1.0).all()
+
+
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    T, B, C, L = 12, 5, 7, 4
+    rng = np.random.RandomState(0)
+    acts = rng.randn(T, B, C).astype(np.float32)
+    labels = np.zeros((B, L), np.float32)
+    lens = [4, 2, 1, 3, 0]
+    for b, n in enumerate(lens):
+        labels[b, :n] = rng.randint(1, C, n)
+    loss, grad = contrib.ndarray.CTCLoss(mx.nd.array(acts), mx.nd.array(labels))
+    assert grad.shape == (T, B, C)
+    lp = F.log_softmax(torch.tensor(acts), dim=-1)
+    tgt = torch.tensor(np.concatenate(
+        [labels[b, :lens[b]] for b in range(B)]).astype(np.int64))
+    ref = F.ctc_loss(lp, tgt, torch.full((B,), T, dtype=torch.long),
+                     torch.tensor(lens), blank=0, reduction="none",
+                     zero_infinity=False)
+    np.testing.assert_allclose(loss.asnumpy(), ref.numpy(), rtol=1e-3, atol=1e-3)
+    # grad output matches torch autograd through log_softmax
+    lp2 = torch.tensor(acts, requires_grad=True)
+    F.ctc_loss(F.log_softmax(lp2, dim=-1), tgt,
+               torch.full((B,), T, dtype=torch.long), torch.tensor(lens),
+               blank=0, reduction="sum").backward()
+    np.testing.assert_allclose(grad.asnumpy(), lp2.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_ctc_loss_symbolic_grad():
+    # the loss output must be differentiable inside a bound graph
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    loss = contrib.symbol.CTCLoss(data, label, name="ctc")
+    sym = mx.sym.MakeLoss(mx.sym.sum(loss[0]))
+    rng = np.random.RandomState(1)
+    d = mx.nd.array(rng.randn(6, 2, 5).astype(np.float32))
+    lab = mx.nd.array(np.array([[1, 2, 0], [3, 0, 0]], np.float32))
+    ex = sym.bind(mx.cpu(), {"data": d, "label": lab},
+                  args_grad={"data": mx.nd.zeros(d.shape)},
+                  grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0

@@ -1,0 +1,64 @@
+"""SSD model tests (BASELINE config 4: SSD-VGG16 parity)."""
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.io as mio
+from mxnet_tpu.models.ssd import get_ssd_tiny, get_ssd_vgg16
+
+
+def test_ssd_vgg16_shapes():
+    # canonical SSD-300 anchor count is 8732 (reference example/ssd
+    # vgg16_reduced_300: 38^2*4 + 19^2*6 + 10^2*6 + 5^2*6 + 3^2*4 + 4)
+    net = get_ssd_vgg16(num_classes=20)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 300, 300), label=(1, 8, 5))
+    outs = dict(zip(net.list_outputs(), out_shapes))
+    assert outs["cls_prob_output"] == (1, 21, 8732)
+    assert outs["loc_loss_output"] == (1, 8732 * 4)
+    assert outs["det_out_output"] == (1, 8732, 6)
+
+
+def test_ssd_tiny_trains_and_loss_decreases():
+    rng = np.random.RandomState(0)
+    B = 4
+    net = get_ssd_tiny(num_classes=3)
+    data = rng.rand(B, 3, 16, 16).astype(np.float32)
+    label = np.full((B, 3, 5), -1.0, np.float32)
+    label[:, 0, 0] = rng.randint(0, 3, B)
+    label[:, 0, 1:3] = 0.1
+    label[:, 0, 3:5] = 0.6
+    it = mio.NDArrayIter({"data": data}, {"label": label}, batch_size=B)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    def loc_loss():
+        it.reset()
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True)
+        return float(mod.get_outputs()[1].asnumpy().sum())
+
+    first = loc_loss()
+    for _ in range(10):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    last = loc_loss()
+    assert np.isfinite(first) and np.isfinite(last)
+    assert last < first
+
+
+def test_ssd_tiny_inference_mode():
+    net = get_ssd_tiny(num_classes=3, mode="test")
+    rng = np.random.RandomState(1)
+    data = mx.nd.array(rng.rand(2, 3, 16, 16).astype(np.float32))
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 16, 16), grad_req="null")
+    ex.forward(is_train=False, data=data)
+    det = ex.outputs[0].asnumpy()
+    assert det.shape[2] == 6
+    # detections are [id, score, 4 box coords]; invalid rows are -1
+    assert ((det[..., 0] >= -1) & (det[..., 0] < 3)).all()
