@@ -33,11 +33,16 @@ from .symbol import _topo_order
 __all__ = ["Executor"]
 
 
-def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_train, rng):
+def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_train, rng,
+               boundary=None):
     """Interpret the graph as pure JAX ops (traced once under jit).
 
     `rng` is a jax PRNG key (or None); callers inside jit build it from a
     host seed so no device-side key chain is maintained between steps.
+    `boundary` is (replicated NamedSharding, {id(node): ctx_group}) — when
+    an edge crosses two ctx_groups a replicated sharding constraint is
+    applied, the SPMD analog of the reference's _CrossDeviceCopy insertion
+    at PlaceDevice boundaries (reference src/executor/graph_executor.cc:347-360).
     Returns (outputs tuple, aux_updates tuple ordered like aux_names).
     """
     arg_env = dict(zip(arg_names, arg_vals))
@@ -53,6 +58,15 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
             continue
         op = node.op
         ins = [env[id(src)][idx] for src, idx in node.inputs]
+        if boundary is not None:
+            repl, groups = boundary
+            my_group = groups.get(id(node))
+            ins = [
+                jax.lax.with_sharding_constraint(v, repl)
+                if groups.get(id(src)) is not None and groups.get(id(src)) != my_group
+                else v
+                for v, (src, idx) in zip(ins, node.inputs)
+            ]
         ins += [aux_updates[a.name] for a in node.aux_vars]
         kwargs = {k: v for k, v in node.attrs.items() if not k.startswith("__") and k != "ctx_group"}
         if op.need_is_train:
@@ -73,10 +87,98 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
     return outputs, aux_out
 
 
+def _auto_spec(shape, mesh, axis="model"):
+    """Pick a PartitionSpec sharding the largest dim divisible by the model
+    axis (params of a ctx_group are sharded, not placed — the SPMD
+    reinterpretation of reference PlaceDevice)."""
+    from .parallel.mesh import P
+
+    if axis not in mesh.axis_names:
+        return P()
+    m = mesh.shape[axis]
+    dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for d in dims:
+        if shape[d] % m == 0 and shape[d] >= m:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def _resolve_group2ctx(symbol, group2ctx, mesh):
+    """Map ctx_group annotations to mesh shardings.
+
+    Reference semantics (src/executor/graph_executor.cc:347-360): each
+    ctx_group is PLACED on the device from `group2ctx` and _CrossDeviceCopy
+    nodes move activations between groups.  Whole-array placement is an
+    MPMD pattern XLA SPMD does not express (and an anti-pattern on TPU);
+    the TPU-first translation is: build a 'model' mesh over the union of
+    group devices, SHARD each group's parameters across it, and put a
+    sharding constraint at group boundaries (the copy analog).  Memory per
+    device drops the way placement would drop it; numerics are identical.
+
+    Returns (mesh, param_shardings, node_groups); degrades to
+    (mesh, {}, None) with a warning when <2 distinct devices are given.
+    """
+    import logging as _logging
+
+    from .symbol import _topo_order as _topo
+
+    order = _topo(symbol._entries)
+    node_groups = {}
+    for node in order:
+        g = node.attrs.get("ctx_group") if node.attrs else None
+        if g is not None:
+            node_groups[id(node)] = g
+    if not node_groups:
+        _logging.warning("group2ctx given but symbol has no ctx_group annotations")
+        return mesh, {}, None
+    # param variables inherit the group of their first consumer op
+    param_groups = {}
+    for node in order:
+        if node.op is None:
+            continue
+        g = node_groups.get(id(node))
+        if g is None:
+            continue
+        for src, _ in node.inputs:
+            if src.op is None and not src.is_aux and src.name not in param_groups:
+                param_groups[src.name] = g
+    devices = []
+    for g, ctx in group2ctx.items():
+        d = ctx.jax_device()
+        if d not in devices:
+            devices.append(d)
+    if len(devices) < 2:
+        _logging.warning(
+            "group2ctx maps all groups onto one physical device; "
+            "running without model sharding")
+        return mesh, {}, None
+    if mesh is not None and "model" in mesh.axis_names:
+        model_mesh = mesh
+    elif mesh is not None:
+        # an existing mesh without a 'model' axis means the caller already
+        # chose a layout (e.g. DP over contexts); don't silently replace it
+        _logging.warning(
+            "group2ctx ignored: executor mesh %s has no 'model' axis — pass "
+            "a mesh like make_mesh({'data': -1, 'model': k}) to combine "
+            "data and model parallelism" % (mesh.axis_names,))
+        return mesh, {}, None
+    else:
+        import numpy as _np
+
+        from .parallel.mesh import Mesh
+
+        model_mesh = Mesh(_np.array(devices), ("model",))
+    shardings = {n: "auto" for n in param_groups}
+    return model_mesh, shardings, node_groups
+
+
 class Executor:
     """Bound computation graph (parity: python/mxnet/executor.py Executor)."""
 
-    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict, mesh=None):
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict, mesh=None,
+                 param_shardings=None, node_groups=None):
         self._symbol = symbol
         self._ctx = ctx
         self.arg_dict = arg_dict
@@ -99,10 +201,13 @@ class Executor:
         self._jit_bwd = {}
         self._data_sharding = None
         self._repl_sharding = None
+        self._param_shardings = dict(param_shardings or {})
+        self._node_groups = node_groups
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .parallel.mesh import NamedSharding, P
 
-            self._data_sharding = NamedSharding(mesh, P("data"))
+            batch_spec = P("data") if "data" in mesh.axis_names else P()
+            self._data_sharding = NamedSharding(mesh, batch_spec)
             self._repl_sharding = NamedSharding(mesh, P())
 
     # ------------------------------------------------------------------
@@ -110,10 +215,15 @@ class Executor:
     # ------------------------------------------------------------------
     @staticmethod
     def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None, mesh=None,
-                    shared_exec=None, **kwargs):
+                    shared_exec=None, group2ctx=None, param_shardings=None, **kwargs):
         """Allocate all arrays from shapes and bind
         (reference GraphExecutor simple_bind overload, executor.h:76)."""
         ctx = ctx or current_context()
+        node_groups = None
+        if group2ctx:
+            mesh, auto_shardings, node_groups = _resolve_group2ctx(symbol, group2ctx, mesh)
+            auto_shardings.update(param_shardings or {})
+            param_shardings = auto_shardings
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
         if arg_shapes is None:
             raise MXNetError("simple_bind: cannot infer shapes from %s" % kwargs)
@@ -142,13 +252,23 @@ class Executor:
                 aux_dict[name] = shared_aux[name]
             else:
                 aux_dict[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx)
-        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh)
+        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh,
+                        param_shardings=param_shardings, node_groups=node_groups)
 
     @staticmethod
     def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             group2ctx=None, shared_exec=None, mesh=None):
-        """Bind with user-provided arrays (reference Executor::Bind)."""
+             group2ctx=None, shared_exec=None, mesh=None, param_shardings=None):
+        """Bind with user-provided arrays (reference Executor::Bind).
+
+        `group2ctx` maps ctx_group names to Contexts: groups are sharded
+        over a 'model' mesh built from those devices (see _resolve_group2ctx
+        for the SPMD translation of reference PlaceDevice)."""
         ctx = ctx or current_context()
+        node_groups = None
+        if group2ctx:
+            mesh, auto_shardings, node_groups = _resolve_group2ctx(symbol, group2ctx, mesh)
+            auto_shardings.update(param_shardings or {})
+            param_shardings = auto_shardings
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         if isinstance(args, dict):
@@ -186,7 +306,8 @@ class Executor:
             aux_dict = dict(aux_states)
         else:
             aux_dict = dict(zip(aux_names, aux_states))
-        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh)
+        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh,
+                        param_shardings=param_shardings, node_groups=node_groups)
 
     # ------------------------------------------------------------------
     # data-path helpers
@@ -207,15 +328,32 @@ class Executor:
         return tuple(self.aux_dict[n].data for n in self._aux_names)
 
     def _place(self, vals):
-        """Apply mesh shardings: batch inputs over 'data', params replicated."""
+        """Apply mesh shardings: batch inputs over 'data', params per their
+        sharding spec ('model'-axis TP / group2ctx shards) or replicated."""
         if self._mesh is None:
             return vals
+        from .parallel.mesh import NamedSharding
+
         placed = []
         data_names = set(self._data_arg_names)
         for n, v in zip(self._arg_names, vals):
-            sh = self._data_sharding if n in data_names else self._repl_sharding
+            if n in data_names:
+                sh = self._data_sharding
+            elif n in self._param_shardings:
+                spec = self._param_shardings[n]
+                if spec == "auto":
+                    spec = _auto_spec(v.shape, self._mesh)
+                sh = NamedSharding(self._mesh, spec)
+            else:
+                sh = self._repl_sharding
             placed.append(jax.device_put(v, sh))
         return tuple(placed)
+
+    def _boundary(self):
+        """(replicated sharding, node→group) for cross-group constraints."""
+        if self._node_groups and self._mesh is not None:
+            return (self._repl_sharding, self._node_groups)
+        return None
 
     # ------------------------------------------------------------------
     # forward / backward (parity: MXExecutorForward/Backward)
@@ -253,10 +391,12 @@ class Executor:
         if is_train not in self._jit_fwd:
             entries, order = self._entries, self._order
             an, xn = self._arg_names, self._aux_names
+            boundary = self._boundary()
 
             def f(arg_vals, aux_vals, seed):
                 rng = jax.random.key(seed)
-                return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train, rng)
+                return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train,
+                                  rng, boundary=boundary)
 
             self._jit_fwd[is_train] = jax.jit(f)
         return self._jit_fwd[is_train]
@@ -308,6 +448,7 @@ class Executor:
         fused step — ONE place owns the vals scatter and aux cotangents."""
         entries, order = self._entries, self._order
         an, xn = self._arg_names, self._aux_names
+        boundary = self._boundary()
 
         def core(diff_vals, nondiff_vals, aux_vals, rng, head_grads):
             def fwd(dv):
@@ -317,7 +458,7 @@ class Executor:
                 for i, v in zip(nondiff_idx, nondiff_vals):
                     vals[i] = v
                 outs, aux_upd = _run_graph(entries, order, an, xn, tuple(vals),
-                                           aux_vals, True, rng)
+                                           aux_vals, True, rng, boundary=boundary)
                 return outs, aux_upd
 
             (outs, aux_upd), vjp_fn = jax.vjp(fwd, diff_vals)
@@ -513,6 +654,7 @@ class Executor:
             self._symbol, self._ctx, arg_dict,
             {n: NDArray(jnp.zeros_like(arg_dict[n].data), self._first_ctx) for n in self.grad_dict},
             dict(self._grad_req), dict(self.aux_dict), mesh=self._mesh,
+            param_shardings=self._param_shardings, node_groups=self._node_groups,
         )
 
     def set_monitor_callback(self, callback):

@@ -75,7 +75,7 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=logging, fixed_param_names=None, grad_req="write",
-                 state_names=None):
+                 state_names=None, mesh=None, param_shardings=None, group2ctx=None):
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -87,7 +87,9 @@ class DataParallelExecutorGroup:
         self.fixed_param_names = fixed_param_names or []
         self.state_names = state_names or []
         self.logger = logger
-        self.mesh = _make_mesh(contexts)
+        self.mesh = mesh if mesh is not None else _make_mesh(contexts)
+        self.param_shardings = param_shardings or {}
+        self.group2ctx = group2ctx
         self.batch_size = None
         self.slices = None
         self.execs = []
@@ -147,7 +149,8 @@ class DataParallelExecutorGroup:
         shared_exec = shared_group.execs[0] if shared_group is not None else None
         exe = Executor.simple_bind(
             self.symbol, self.contexts[0], grad_req=grad_req, mesh=self.mesh,
-            shared_exec=shared_exec, **shape_kwargs
+            shared_exec=shared_exec, group2ctx=self.group2ctx,
+            param_shardings=self.param_shardings, **shape_kwargs
         )
         self.execs = [exe]
 

@@ -33,8 +33,17 @@ class Module(BaseModule):
 
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, mesh=None, sharding_map=None, group2ctx=None):
+        """`mesh`/`sharding_map` expose user-facing tensor parallelism: pass
+        a `jax.sharding.Mesh` (e.g. parallel.mesh.make_mesh({'data': -1,
+        'model': 2})) plus {param_name: PartitionSpec} and the single SPMD
+        executable shards those params over the 'model' axis, XLA inserting
+        the ICI collectives.  `group2ctx` gives reference model-parallel
+        scripts the same effect from ctx_group annotations."""
         super().__init__(logger=logger)
+        self._mesh = mesh
+        self._sharding_map = dict(sharding_map or {})
+        self._group2ctx = group2ctx
         if context is None:
             context = [current_context()]
         if not isinstance(context, list):
@@ -194,7 +203,8 @@ class Module(BaseModule):
             self._symbol, self._context, self._work_load_list, data_shapes,
             label_shapes, self._param_names, for_training, inputs_need_grad,
             shared_group, logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req, state_names=self._state_names,
+            grad_req=grad_req, state_names=self._state_names, mesh=self._mesh,
+            param_shardings=self._sharding_map, group2ctx=self._group2ctx,
         )
         self._total_exec_bytes = 0
         if shared_module is not None:

@@ -70,8 +70,45 @@ def _dtype(v):
 # ----------------------------------------------------------------------
 
 
+def _infer_binary_unify(in_shapes, attrs):
+    """Broadcast-unify two shapes treating 0 dims as unknown (MXNet shape
+    convention: 0 = infer me — e.g. RNN begin_state zeros(shape=(0, H)),
+    reference src/operator/tensor/elemwise_binary_broadcast_op.h
+    BinaryBroadcastShape)."""
+    a, b = in_shapes
+    if a is None or b is None:
+        # don't guess from one side: broadcasting could enlarge the result,
+        # and callers get a clearer missing-input error from the infer loop
+        return list(in_shapes), None
+    la, lb = list(a), list(b)
+    n = max(len(la), len(lb))
+    pa = [1] * (n - len(la)) + la
+    pb = [1] * (n - len(lb)) + lb
+    out = []
+    for da, db in zip(pa, pb):
+        if da == 0 and db == 0:
+            out.append(0)
+        elif da == 0:
+            out.append(db)
+        elif db == 0:
+            out.append(da)
+        elif da == db or db == 1:
+            out.append(da)
+        elif da == 1:
+            out.append(db)
+        else:
+            raise ValueError("incompatible shapes %s, %s" % (a, b))
+    # write resolved shapes back so 0-dim producers (zeros/ones) get fixed
+    ra = tuple(o if d == 0 else d for d, o in zip(pa, out))[n - len(la):]
+    rb = tuple(o if d == 0 else d for d, o in zip(pb, out))[n - len(lb):]
+    if 0 in out:
+        return [ra, rb], None
+    return [ra, rb], [tuple(out)]
+
+
 def _reg_binary(name, fn, aliases=()):
-    register(name, inputs=("lhs", "rhs"), aliases=aliases)(fn)
+    register(name, inputs=("lhs", "rhs"), aliases=aliases,
+             infer_shape=_infer_binary_unify)(fn)
 
 
 _reg_binary("elemwise_add", lambda lhs, rhs: lhs + rhs, aliases=("_plus", "_Plus", "broadcast_add", "broadcast_plus"))
@@ -608,6 +645,24 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, **kw):
 
 def _infer_from_shape_attr(in_shapes, attrs):
     return [], [_shape(attrs.get("shape"))]
+
+
+def _infer_state_zeros(in_shapes, attrs):
+    data = in_shapes[0]
+    shp = _shape(attrs.get("shape"))
+    out = tuple(data[0] if d == 0 else d for d in shp) if data is not None else shp
+    return [data], [out]
+
+
+@register("_rnn_state_zeros", inputs=("data",), infer_shape=_infer_state_zeros)
+def _rnn_state_zeros(data, shape=None, dtype="float32", **kw):
+    """Zeros whose 0-dims resolve to data's batch dim — the shape-inference
+    carrier for RNN begin_state (reference rnn_cell.py begin_state uses
+    zeros(shape=(0, H)) with nnvm 0-means-unknown inference; here the batch
+    is taken structurally from the input symbol)."""
+    shp = _shape(shape)
+    out = tuple(data.shape[0] if d == 0 else d for d in shp)
+    return jnp.zeros(out, dtype=_dtype(dtype) or jnp.float32)
 
 
 @register("_zeros", inputs=(), infer_shape=_infer_from_shape_attr, aliases=("zeros",))

@@ -81,6 +81,18 @@ class BaseRNNCell:
             states.append(state)
         return states
 
+    def _batch_begin_state(self, ref_input):
+        """Default begin_state: zeros whose batch dim is taken structurally
+        from `ref_input` (a per-step (N, C) symbol) via _rnn_state_zeros —
+        replaces the reference's zeros(shape=(0, H)) + nnvm 0-dim inference
+        (reference rnn_cell.py begin_state)."""
+
+        def f(name=None, shape=None, **kw):
+            return getattr(symbol, "_rnn_state_zeros")(
+                ref_input, name=name, shape=shape, **kw)
+
+        return self.begin_state(func=f)
+
     def unpack_weights(self, args):
         """Split fused gate weights into per-gate arrays (parity: rnn_cell.py unpack_weights)."""
         args = args.copy()
@@ -121,7 +133,7 @@ class BaseRNNCell:
         self.reset()
         inputs, _ = _normalize_sequence(length, inputs, layout, False)
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = self._batch_begin_state(inputs[0])
         states = begin_state
         outputs = []
         for i in range(length):
@@ -394,7 +406,8 @@ class SequentialRNNCell(BaseRNNCell):
         self.reset()
         num_cells = len(self._cells)
         if begin_state is None:
-            begin_state = self.begin_state()
+            inputs, _ = _normalize_sequence(length, inputs, layout, False)
+            begin_state = self._batch_begin_state(inputs[0])
         p = 0
         next_states = []
         for i, cell in enumerate(self._cells):
@@ -443,10 +456,10 @@ class ModifierCell(BaseRNNCell):
     def state_info(self):
         return self.base_cell.state_info
 
-    def begin_state(self, init_sym=symbol.zeros, **kwargs):
+    def begin_state(self, func=symbol.zeros, **kwargs):
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(init_sym, **kwargs)
+        begin = self.base_cell.begin_state(func=func, **kwargs)
         self.base_cell._modified = True
         return begin
 
@@ -541,7 +554,7 @@ class BidirectionalCell(BaseRNNCell):
         self.reset()
         inputs, axis = _normalize_sequence(length, inputs, layout, False)
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = self._batch_begin_state(inputs[0])
         states = begin_state
         l_cell, r_cell = self._cells
         l_outputs, l_states = l_cell.unroll(

@@ -395,9 +395,10 @@ def _eval_shape_infer(node, in_shapes, aux_shapes):
     structs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
     if aux_shapes and all(s is not None for s in aux_shapes):
         structs += [jax.ShapeDtypeStruct(s, jnp.float32) for s in aux_shapes]
-    kwargs = dict(node.attrs)
-    kwargs.pop("__shape__", None)
-    kwargs.pop("__dtype__", None)
+    # same attr filter as the executor: dunder bookkeeping attrs and
+    # ctx_group placement hints never reach op kernels
+    kwargs = {k: v for k, v in node.attrs.items()
+              if not k.startswith("__") and k != "ctx_group"}
     if op.need_is_train:
         kwargs["is_train"] = False
     if op.need_rng:

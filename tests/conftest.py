@@ -16,3 +16,15 @@ import jax  # noqa: E402
 
 # the axon site config forces the TPU platform regardless of env; override.
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_name_manager():
+    """Reset auto-naming counters per test so tests that reference generated
+    names (fullyconnected0_weight, ...) don't depend on execution order."""
+    from mxnet_tpu.name import NameManager
+
+    NameManager._current.value = NameManager()
+    yield

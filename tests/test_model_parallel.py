@@ -1,0 +1,146 @@
+"""Model parallelism: group2ctx → 'model'-mesh sharding, Module TP API.
+
+Reference pattern: tests/python/unittest/test_model_parallel.py:16-48 binds
+one symbol with group2ctx={'dev1': cpu(0), 'dev2': cpu(1)} and checks
+numerics against a single-context bind.  Here the groups become shardings
+over a 'model' mesh axis (see executor._resolve_group2ctx) — same check.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.io as mio
+from mxnet_tpu.parallel.mesh import P, make_mesh
+
+
+def _grouped_net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="relu", name="act1")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=8, name="fc2")
+        act2 = mx.sym.Activation(fc2, act_type="relu", name="act2")
+        fc3 = mx.sym.FullyConnected(act2, num_hidden=4, name="fc3")
+    return fc3
+
+
+def _bind_and_run(net, group2ctx):
+    rng = np.random.RandomState(0)
+    shapes = {"data": (6, 10)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    names = net.list_arguments()
+    args = {n: mx.nd.array(rng.uniform(-1, 1, s).astype(np.float32))
+            for n, s in zip(names, arg_shapes)}
+    grads = {n: mx.nd.zeros(s) for n, s in zip(names, arg_shapes)}
+    ex = net.bind(mx.cpu(), dict(args), args_grad=grads, group2ctx=group2ctx)
+    ex.forward(is_train=True)
+    out_grad = mx.nd.array(rng.uniform(-1, 1, ex.outputs[0].shape).astype(np.float32))
+    ex.backward(out_grad)
+    return (ex.outputs[0].asnumpy(),
+            {n: g.asnumpy() for n, g in ex.grad_dict.items()})
+
+
+def test_group2ctx_matches_single_device():
+    net = _grouped_net()
+    out_ref, grads_ref = _bind_and_run(net, None)
+    out_mp, grads_mp = _bind_and_run(
+        net, {"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    np.testing.assert_allclose(out_mp, out_ref, rtol=1e-5, atol=1e-5)
+    for n in grads_ref:
+        np.testing.assert_allclose(grads_mp[n], grads_ref[n], rtol=1e-4,
+                                   atol=1e-5, err_msg=n)
+
+
+def test_group2ctx_actually_shards():
+    net = _grouped_net()
+    ex = net.simple_bind(mx.cpu(), data=(6, 10), grad_req="write",
+                         group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    assert ex._mesh is not None and "model" in ex._mesh.axis_names
+    # every group param got a sharding assignment
+    for name in ("fc1_weight", "fc2_weight", "fc3_weight"):
+        assert name in ex._param_shardings, name
+    # and the placed fc1 weight really is split over the model axis
+    placed = ex._place(ex._gather_args())
+    w = placed[ex._arg_names.index("fc1_weight")]
+    assert not w.sharding.is_fully_replicated
+    shard_shape = w.sharding.shard_shape(w.shape)
+    assert int(np.prod(shard_shape)) == int(np.prod(w.shape)) // 2
+
+
+def test_model_parallel_stacked_lstm():
+    # reference example/model-parallel-lstm/lstm.py:48-112: each LSTM layer
+    # in its own ctx_group, bound across devices
+    import mxnet_tpu.rnn as rnn
+
+    T, B, D, H = 5, 4, 8, 8
+
+    def build():
+        data = mx.sym.Variable("data")
+        with mx.AttrScope(ctx_group="layer0"):
+            cell0 = rnn.LSTMCell(H, prefix="l0_")
+            out, _ = cell0.unroll(T, data, layout="NTC", merge_outputs=True)
+        with mx.AttrScope(ctx_group="layer1"):
+            cell1 = rnn.LSTMCell(H, prefix="l1_")
+            out, _ = cell1.unroll(T, out, layout="NTC", merge_outputs=True)
+        return mx.sym.sum(out)
+
+    net = build()
+    rng = np.random.RandomState(3)
+    names = net.list_arguments()
+    arg_shapes, _, _ = net.infer_shape(data=(B, T, D))
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(names, arg_shapes)}
+
+    def run(group2ctx):
+        grads = {n: mx.nd.zeros(s) for n, s in zip(names, arg_shapes)}
+        ex = net.bind(mx.cpu(), dict(args), args_grad=grads,
+                      group2ctx=group2ctx)
+        ex.forward(is_train=True)
+        ex.backward()
+        return ex.outputs[0].asnumpy(), {n: g.asnumpy() for n, g in ex.grad_dict.items()}
+
+    out_ref, g_ref = run(None)
+    out_mp, g_mp = run({"layer0": mx.cpu(0), "layer1": mx.cpu(1)})
+    np.testing.assert_allclose(out_mp, out_ref, rtol=1e-4)
+    for n in g_ref:
+        np.testing.assert_allclose(g_mp[n], g_ref[n], rtol=1e-3, atol=1e-5,
+                                   err_msg=n)
+
+
+def test_module_tensor_parallel_matches_single():
+    rng = np.random.RandomState(1)
+    B, D, H, C = 8, 12, 16, 4
+    data = rng.rand(B, D).astype(np.float32)
+    label = rng.randint(0, C, B).astype(np.float32)
+
+    def build():
+        x = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(x, num_hidden=H, name="fc1")
+        h = mx.sym.Activation(h, act_type="tanh", name="t1")
+        h = mx.sym.FullyConnected(h, num_hidden=C, name="fc2")
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    def run(mesh, sharding_map):
+        mx.random.seed(42)
+        it = mio.NDArrayIter(data, label, batch_size=B)
+        mod = mx.mod.Module(build(), context=mx.cpu(), mesh=mesh,
+                            sharding_map=sharding_map)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2),
+                        force_init=True)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        for _ in range(4):
+            it.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    ref = run(None, None)
+    mesh = make_mesh({"data": 4, "model": 2})
+    tp = run(mesh, {"fc1_weight": P("model", None), "fc2_weight": P(None, "model")})
+    for k in ref:
+        np.testing.assert_allclose(tp[k], ref[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
