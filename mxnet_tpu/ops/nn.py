@@ -238,6 +238,9 @@ def deconvolution(
     # adj extends the high-side padding, matching the shape rule
     # out = stride*(in-1) + kernel - 2*pad + adj
     pairs = [(kernel[i] - 1 - p[i], kernel[i] - 1 - p[i] + a[i]) for i in range(n)]
+    # transposed conv = input-dilated CONVOLUTION: the kernel must be
+    # spatially mirrored since conv_general_dilated computes correlation
+    weight = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
     out = lax.conv_general_dilated(
         data,
         weight,
@@ -586,11 +589,20 @@ def softmax_output(data, label, **attrs):
     return _loss_vjp(_softmax_fwd, _softmax_bwd)(data, label, **attrs)
 
 
+def _reg_grad_scale(out, attrs):
+    # reference regression_output-inl.h:70-77: grad_scale / num_output,
+    # num_output = label.Size()/batch (outputs per sample)
+    num_output = 1
+    for d in out.shape[1:]:
+        num_output *= d
+    return float(_lit(attrs.get("grad_scale", 1.0))) / float(num_output)
+
+
 @register("LinearRegressionOutput", inputs=("data", "label"), infer_shape=_infer_reg_out)
 def linear_regression_output(data, label, **attrs):
     return _loss_vjp(
         lambda d, l, a: d,
-        lambda d, l, out, a: (out - l.reshape(out.shape)) * float(_lit(a.get("grad_scale", 1.0))),
+        lambda d, l, out, a: (out - l.reshape(out.shape)) * _reg_grad_scale(out, a),
     )(data, label, **attrs)
 
 
@@ -598,7 +610,7 @@ def linear_regression_output(data, label, **attrs):
 def logistic_regression_output(data, label, **attrs):
     return _loss_vjp(
         lambda d, l, a: jax.nn.sigmoid(d),
-        lambda d, l, out, a: (out - l.reshape(out.shape)) * float(_lit(a.get("grad_scale", 1.0))),
+        lambda d, l, out, a: (out - l.reshape(out.shape)) * _reg_grad_scale(out, a),
     )(data, label, **attrs)
 
 
@@ -606,7 +618,7 @@ def logistic_regression_output(data, label, **attrs):
 def mae_regression_output(data, label, **attrs):
     return _loss_vjp(
         lambda d, l, a: d,
-        lambda d, l, out, a: jnp.sign(out - l.reshape(out.shape)) * float(_lit(a.get("grad_scale", 1.0))),
+        lambda d, l, out, a: jnp.sign(out - l.reshape(out.shape)) * _reg_grad_scale(out, a),
     )(data, label, **attrs)
 
 

@@ -1,0 +1,694 @@
+"""Op-level numeric sweep over the registry.
+
+Model: reference tests/python/unittest/test_operator.py (3,567 LoC of
+check_numeric_gradient / check_symbolic_forward per op) using the ported
+fixtures in mxnet_tpu/test_utils.py.  Table-driven: every table row is one
+op vs an independent numpy/scipy/torch oracle; `test_zz_registry_coverage`
+asserts the sweep plus the dedicated test files touch >=80% of all
+registered ops.
+"""
+import math
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+S = mx.sym
+RS = np.random.RandomState
+
+
+def _fwd(sym, location, expected, rtol=1e-5, atol=1e-6, aux=None):
+    tu.check_symbolic_forward(sym, location, expected, rtol=rtol, atol=atol,
+                              aux_states=aux, ctx=mx.cpu())
+
+
+def _ngrad(sym, location, rtol=0.05, atol=1e-3, eps=1e-3):
+    tu.check_numeric_gradient(sym, location, numeric_eps=eps, rtol=rtol,
+                              atol=atol, ctx=mx.cpu())
+
+
+# ======================================================================
+# unary elementwise
+# name -> (numpy fn, (low, high), grad-checkable)
+# ======================================================================
+UNARY_OPS = {
+    "abs": (np.abs, (-2, 2), False),
+    "sign": (np.sign, (-2, 2), False),
+    "round": (np.round, (-2, 2), False),
+    "rint": (np.rint, (-2, 2), False),
+    "ceil": (np.ceil, (-2, 2), False),
+    "floor": (np.floor, (-2, 2), False),
+    "trunc": (np.trunc, (-2, 2), False),
+    "fix": (np.trunc, (-2, 2), False),
+    "square": (np.square, (-2, 2), True),
+    "sqrt": (np.sqrt, (0.5, 4), True),
+    "rsqrt": (lambda x: 1 / np.sqrt(x), (0.5, 4), True),
+    "cbrt": (np.cbrt, (0.5, 4), True),
+    "rcbrt": (lambda x: 1 / np.cbrt(x), (0.5, 4), True),
+    "exp": (np.exp, (-1, 1), True),
+    "log": (np.log, (0.5, 4), True),
+    "log10": (np.log10, (0.5, 4), True),
+    "log2": (np.log2, (0.5, 4), True),
+    "log1p": (np.log1p, (-0.5, 1), True),
+    "expm1": (np.expm1, (-1, 1), True),
+    "sin": (np.sin, (-2, 2), True),
+    "cos": (np.cos, (-2, 2), True),
+    "tan": (np.tan, (-1, 1), True),
+    "arcsin": (np.arcsin, (-0.9, 0.9), True),
+    "arccos": (np.arccos, (-0.9, 0.9), True),
+    "arctan": (np.arctan, (-2, 2), True),
+    "sinh": (np.sinh, (-1.5, 1.5), True),
+    "cosh": (np.cosh, (-1.5, 1.5), True),
+    "tanh": (np.tanh, (-2, 2), True),
+    "arcsinh": (np.arcsinh, (-2, 2), True),
+    "arccosh": (np.arccosh, (1.2, 3), True),
+    "arctanh": (np.arctanh, (-0.9, 0.9), True),
+    "degrees": (np.degrees, (-2, 2), True),
+    "radians": (np.radians, (-2, 2), True),
+    "gamma": (sps.gamma, (0.5, 3), True),
+    "gammaln": (sps.gammaln, (0.5, 3), True),
+    "erf": (sps.erf, (-2, 2), True),
+    "relu": (lambda x: np.maximum(x, 0), (-2, 2), False),
+    "sigmoid": (lambda x: 1 / (1 + np.exp(-x)), (-3, 3), True),
+    "softsign": (lambda x: x / (1 + np.abs(x)), (-2, 2), True),
+    "negative": (np.negative, (-2, 2), True),
+    "reciprocal": (lambda x: 1 / x, (0.5, 3), True),
+    "BlockGrad": (lambda x: x, (-2, 2), False),
+    "identity": (lambda x: x, (-2, 2), True),
+    "zeros_like": (np.zeros_like, (-2, 2), False),
+    "ones_like": (np.ones_like, (-2, 2), False),
+    "Flatten": (lambda x: x.reshape(x.shape[0], -1), (-2, 2), True),
+}
+
+
+@pytest.mark.parametrize("name", sorted(UNARY_OPS))
+def test_unary_forward_and_grad(name):
+    np_fn, (lo, hi), gradable = UNARY_OPS[name]
+    rng = RS(hash(name) % (2 ** 31))
+    x = rng.uniform(lo, hi, (3, 4)).astype(np.float32)
+    sym = getattr(S, name)(S.Variable("x"))
+    _fwd(sym, {"x": x}, [np_fn(x)], rtol=1e-4, atol=1e-5)
+    if gradable:
+        _ngrad(sym, {"x": x})
+
+
+# ======================================================================
+# binary elementwise (+ broadcasting) and scalar variants
+# ======================================================================
+BINARY_OPS = {
+    "elemwise_add": (np.add, True),
+    "elemwise_sub": (np.subtract, True),
+    "elemwise_mul": (np.multiply, True),
+    "elemwise_div": (np.divide, True),
+    "_power": (np.power, True),
+    "_maximum": (np.maximum, False),
+    "_minimum": (np.minimum, False),
+    "_mod": (np.mod, False),
+    "_hypot": (np.hypot, True),
+    "_equal": (lambda a, b: (a == b).astype(np.float32), False),
+    "_not_equal": (lambda a, b: (a != b).astype(np.float32), False),
+    "_greater": (lambda a, b: (a > b).astype(np.float32), False),
+    "_greater_equal": (lambda a, b: (a >= b).astype(np.float32), False),
+    "_lesser": (lambda a, b: (a < b).astype(np.float32), False),
+    "_lesser_equal": (lambda a, b: (a <= b).astype(np.float32), False),
+}
+
+
+@pytest.mark.parametrize("name", sorted(BINARY_OPS))
+def test_binary_forward_and_grad(name):
+    np_fn, gradable = BINARY_OPS[name]
+    rng = RS(hash(name) % (2 ** 31))
+    a = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    b = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    sym = getattr(S, name)(S.Variable("a"), S.Variable("b"))
+    _fwd(sym, {"a": a, "b": b}, [np_fn(a, b)], rtol=1e-4, atol=1e-5)
+    # broadcasting variant
+    b2 = rng.uniform(0.5, 2, (1, 4)).astype(np.float32)
+    _fwd(sym, {"a": a, "b": b2}, [np_fn(a, b2)], rtol=1e-4, atol=1e-5)
+    if gradable:
+        _ngrad(sym, {"a": a, "b": b})
+
+
+SCALAR_OPS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_power_scalar": lambda x, s: np.power(x, s),
+    "_rpower_scalar": lambda x, s: np.power(s, x),
+    "_maximum_scalar": lambda x, s: np.maximum(x, s),
+    "_minimum_scalar": lambda x, s: np.minimum(x, s),
+    "_mod_scalar": lambda x, s: np.mod(x, s),
+    "_equal_scalar": lambda x, s: (x == s).astype(np.float32),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(np.float32),
+    "_greater_scalar": lambda x, s: (x > s).astype(np.float32),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(np.float32),
+    "_lesser_scalar": lambda x, s: (x < s).astype(np.float32),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(np.float32),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCALAR_OPS))
+def test_scalar_ops(name):
+    np_fn = SCALAR_OPS[name]
+    rng = RS(hash(name) % (2 ** 31))
+    x = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    sym = getattr(S, name)(S.Variable("x"), scalar=1.5)
+    _fwd(sym, {"x": x}, [np_fn(x, 1.5)], rtol=1e-4, atol=1e-5)
+
+
+def test_add_n():
+    rng = RS(0)
+    arrs = [rng.rand(2, 3).astype(np.float32) for _ in range(4)]
+    sym = S.add_n(*[S.Variable("x%d" % i) for i in range(4)])
+    _fwd(sym, {("x%d" % i): a for i, a in enumerate(arrs)}, [sum(arrs)])
+    _ngrad(sym, {("x%d" % i): a for i, a in enumerate(arrs)})
+
+
+def test_smooth_l1():
+    x = np.array([[-2.0, -0.4, 0.0, 0.3, 1.7]], np.float32)
+    exp = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    _fwd(S.smooth_l1(S.Variable("x"), scalar=1.0), {"x": x}, [exp])
+
+
+# ======================================================================
+# reductions
+# ======================================================================
+REDUCE_OPS = {
+    "sum": np.sum,
+    "mean": np.mean,
+    "prod": np.prod,
+    "nansum": np.nansum,
+    "nanprod": np.nanprod,
+    "max": np.max,
+    "min": np.min,
+}
+
+
+@pytest.mark.parametrize("name", sorted(REDUCE_OPS))
+@pytest.mark.parametrize("axis,keepdims", [(None, False), (1, False), ((0, 2), True)])
+def test_reduce_ops(name, axis, keepdims):
+    np_fn = REDUCE_OPS[name]
+    rng = RS(5)
+    x = rng.uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    if name.startswith("nan"):
+        x[0, 0, 0] = np.nan
+    sym = getattr(S, name)(S.Variable("x"), axis=axis, keepdims=keepdims)
+    exp = np_fn(x, axis=axis, keepdims=keepdims)
+    _fwd(sym, {"x": x}, [np.asarray(exp)], rtol=1e-4, atol=1e-5)
+
+
+def test_norm_argmax_argmin_argmax_channel():
+    rng = RS(2)
+    x = rng.randn(3, 5).astype(np.float32)
+    _fwd(S.norm(S.Variable("x")), {"x": x},
+         [np.array([np.sqrt((x ** 2).sum())])], rtol=1e-5, atol=1e-6)
+    _fwd(S.argmax(S.Variable("x"), axis=1), {"x": x},
+         [np.argmax(x, 1).astype(np.float32)])
+    _fwd(S.argmin(S.Variable("x"), axis=0), {"x": x},
+         [np.argmin(x, 0).astype(np.float32)])
+    _fwd(S.argmax_channel(S.Variable("x")), {"x": x},
+         [np.argmax(x, -1).astype(np.float32)])
+
+
+# ======================================================================
+# shape / indexing / ordering ops
+# ======================================================================
+
+
+def test_shape_manipulation_ops():
+    rng = RS(3)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    _fwd(S.Reshape(S.Variable("x"), shape=(3, 8)), {"x": x}, [x.reshape(3, 8)])
+    _fwd(S.Reshape(S.Variable("x"), shape=(0, -1)), {"x": x}, [x.reshape(2, 12)])
+    _fwd(S.transpose(S.Variable("x"), axes=(2, 0, 1)), {"x": x},
+         [x.transpose(2, 0, 1)])
+    _fwd(S.SwapAxis(S.Variable("x"), dim1=0, dim2=2), {"x": x},
+         [x.swapaxes(0, 2)])
+    _fwd(S.expand_dims(S.Variable("x"), axis=1), {"x": x}, [x[:, None]])
+    _fwd(S.squeeze(S.expand_dims(S.Variable("x"), axis=1)), {"x": x}, [x])
+    _fwd(S.flip(S.Variable("x"), axis=1), {"x": x}, [x[:, ::-1]])
+    _fwd(S.tile(S.Variable("x"), reps=(2, 1, 2)), {"x": x}, [np.tile(x, (2, 1, 2))])
+    _fwd(S.repeat(S.Variable("x"), repeats=2, axis=1), {"x": x},
+         [np.repeat(x, 2, 1)])
+    _fwd(S.slice(S.Variable("x"), begin=(0, 1, 1), end=(2, 3, 4)), {"x": x},
+         [x[0:2, 1:3, 1:4]])
+    _fwd(S.slice_axis(S.Variable("x"), axis=2, begin=1, end=3), {"x": x},
+         [x[:, :, 1:3]])
+    _fwd(S.broadcast_to(S.Variable("y"), shape=(3, 4)), {"y": x[0, :, :1]},
+         [np.broadcast_to(x[0, :, :1], (3, 4))])
+    _fwd(S.broadcast_axis(S.Variable("y"), axis=1, size=5), {"y": x[:, :1, :]},
+         [np.broadcast_to(x[:, :1, :], (2, 5, 4))])
+    _fwd(S.Cast(S.Variable("x"), dtype="int32"), {"x": x},
+         [x.astype(np.int32)])
+    _fwd(S.clip(S.Variable("x"), a_min=-0.5, a_max=0.5), {"x": x},
+         [np.clip(x, -0.5, 0.5)])
+
+
+def test_concat_stack_split_pad_crop():
+    rng = RS(4)
+    a = rng.randn(2, 3).astype(np.float32)
+    b = rng.randn(2, 3).astype(np.float32)
+    _fwd(S.Concat(S.Variable("a"), S.Variable("b"), dim=1),
+         {"a": a, "b": b}, [np.concatenate([a, b], 1)])
+    _ngrad(S.Concat(S.Variable("a"), S.Variable("b"), dim=0), {"a": a, "b": b})
+    _fwd(S.stack(S.Variable("a"), S.Variable("b"), axis=1),
+         {"a": a, "b": b}, [np.stack([a, b], 1)])
+    parts = S.SliceChannel(S.Variable("a"), num_outputs=3, axis=1)
+    _fwd(parts, {"a": a}, [a[:, 0:1], a[:, 1:2], a[:, 2:3]])
+    x = rng.randn(1, 2, 3, 3).astype(np.float32)
+    _fwd(S.Pad(S.Variable("x"), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=1.0),
+         {"x": x},
+         [np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), constant_values=1.0)])
+    _fwd(S.Crop(S.Variable("x"), offset=(1, 0), h_w=(2, 2), num_args=1),
+         {"x": x}, [x[:, :, 1:3, 0:2]])
+
+
+def test_indexing_ops():
+    rng = RS(6)
+    w = rng.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 4, 1], np.float32)
+    _fwd(S.take(S.Variable("w"), S.Variable("i"), axis=0),
+         {"w": w, "i": idx}, [w[idx.astype(int)]])
+    d = rng.randn(4, 6).astype(np.float32)
+    bi = np.array([1, 0, 5, 3], np.float32)
+    _fwd(S.batch_take(S.Variable("d"), S.Variable("i")),
+         {"d": d, "i": bi}, [d[np.arange(4), bi.astype(int)]])
+    _fwd(S.one_hot(S.Variable("i"), depth=5, on_value=2.0, off_value=-1.0),
+         {"i": idx}, [np.eye(5)[idx.astype(int)] * 3.0 - 1.0])
+    data = rng.randn(3, 4).astype(np.float32)
+    gidx = np.array([[0, 1, 2], [1, 3, 0]], np.float32)
+    _fwd(S.gather_nd(S.Variable("d"), S.Variable("i")),
+         {"d": data, "i": gidx}, [data[gidx[0].astype(int), gidx[1].astype(int)]])
+    upd = rng.randn(3).astype(np.float32)
+    exp = np.zeros((3, 4), np.float32)
+    np.add.at(exp, (gidx[0].astype(int), gidx[1].astype(int)), upd)
+    _fwd(S.scatter_nd(S.Variable("u"), S.Variable("i"), shape=(3, 4)),
+         {"u": upd, "i": gidx}, [exp])
+    pk = np.array([1, 0, 3], np.float32)
+    _fwd(S.pick(S.Variable("d"), S.Variable("i"), axis=1),
+         {"d": data, "i": pk}, [data[np.arange(3), pk.astype(int)]])
+    cond = (rng.rand(3, 4) > 0.5).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    _fwd(S.where(S.Variable("c"), S.Variable("d"), S.Variable("y")),
+         {"c": cond, "d": data, "y": y}, [np.where(cond > 0, data, y)])
+    emb_i = np.array([[1, 0], [3, 2]], np.float32)
+    _fwd(S.Embedding(S.Variable("i"), S.Variable("w"), input_dim=5, output_dim=3),
+         {"i": emb_i, "w": w}, [w[emb_i.astype(int)]])
+
+
+def test_ordering_ops():
+    rng = RS(7)
+    x = rng.randn(3, 6).astype(np.float32)
+    _fwd(S.sort(S.Variable("x"), axis=1), {"x": x}, [np.sort(x, 1)])
+    _fwd(S.sort(S.Variable("x"), axis=1, is_ascend=False), {"x": x},
+         [-np.sort(-x, 1)])
+    _fwd(S.argsort(S.Variable("x"), axis=1), {"x": x},
+         [np.argsort(x, 1).astype(np.float32)])
+    k = 2
+    topv = -np.sort(-x, 1)[:, :k]
+    topi = np.argsort(-x, 1)[:, :k].astype(np.float32)
+    _fwd(S.topk(S.Variable("x"), axis=1, k=k, ret_typ="value"), {"x": x}, [topv])
+    _fwd(S.topk(S.Variable("x"), axis=1, k=k, ret_typ="indices"), {"x": x}, [topi])
+
+
+def test_init_ops():
+    ctx = mx.cpu()
+    assert np.array_equal(mx.nd.zeros((2, 3), ctx=ctx).asnumpy(), np.zeros((2, 3)))
+    assert np.array_equal(mx.nd.ones((2, 3), ctx=ctx).asnumpy(), np.ones((2, 3)))
+    assert np.array_equal(mx.nd.full((2, 2), 3.5, ctx=ctx).asnumpy(),
+                          np.full((2, 2), 3.5, np.float32))
+    assert np.array_equal(mx.nd.eye(3, ctx=ctx).asnumpy(), np.eye(3, dtype=np.float32))
+    assert np.array_equal(mx.nd.arange(1, 7, 2, ctx=ctx).asnumpy(),
+                          np.arange(1, 7, 2, dtype=np.float32))
+
+
+def test_dot_and_linalg():
+    rng = RS(8)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 5).astype(np.float32)
+    _fwd(S.dot(S.Variable("a"), S.Variable("b")), {"a": a, "b": b}, [a @ b],
+         rtol=1e-4, atol=1e-5)
+    _fwd(S.dot(S.Variable("a"), S.Variable("b2"), transpose_b=True),
+         {"a": a, "b2": b.T.copy()}, [a @ b], rtol=1e-4, atol=1e-5)
+    _ngrad(S.dot(S.Variable("a"), S.Variable("b")), {"a": a, "b": b})
+    ba = rng.randn(2, 3, 4).astype(np.float32)
+    bb = rng.randn(2, 4, 5).astype(np.float32)
+    _fwd(S.batch_dot(S.Variable("a"), S.Variable("b")), {"a": ba, "b": bb},
+         [ba @ bb], rtol=1e-4, atol=1e-5)
+    _fwd(getattr(S, "_linalg_gemm2")(S.Variable("a"), S.Variable("b"), alpha=2.0),
+         {"a": ba, "b": bb}, [2.0 * (ba @ bb)], rtol=1e-4, atol=1e-5)
+    spd = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)
+    _fwd(getattr(S, "_linalg_potrf")(S.Variable("a")), {"a": spd},
+         [np.linalg.cholesky(spd)], rtol=1e-5, atol=1e-6)
+    m = rng.randn(3, 4).astype(np.float32)
+    _fwd(getattr(S, "_linalg_syrk")(S.Variable("a")), {"a": m}, [m @ m.T],
+         rtol=1e-4, atol=1e-5)
+
+
+# ======================================================================
+# NN layer ops vs torch oracles
+# ======================================================================
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+
+def test_fully_connected():
+    rng = RS(9)
+    x = rng.randn(4, 6).astype(np.float32)
+    w = rng.randn(5, 6).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    sym = S.FullyConnected(S.Variable("x"), S.Variable("w"), S.Variable("b"),
+                           num_hidden=5)
+    _fwd(sym, {"x": x, "w": w, "b": b}, [x @ w.T + b], rtol=1e-4, atol=1e-5)
+    _ngrad(sym, {"x": x, "w": w, "b": b})
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (2, 2), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_convolution_vs_torch(stride, pad, dilate, groups):
+    rng = RS(10)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    w = rng.randn(6, 4 // groups, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    sym = S.Convolution(S.Variable("x"), S.Variable("w"), S.Variable("b"),
+                        kernel=(3, 3), num_filter=6, stride=stride, pad=pad,
+                        dilate=dilate, num_group=groups)
+    exp = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                   stride=stride, padding=pad, dilation=dilate,
+                   groups=groups).numpy()
+    _fwd(sym, {"x": x, "w": w, "b": b}, [exp], rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grad():
+    rng = RS(11)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    sym = S.Convolution(S.Variable("x"), S.Variable("w"), S.Variable("b"),
+                        kernel=(3, 3), num_filter=3)
+    _ngrad(sym, {"x": x, "w": w, "b": b}, rtol=0.06, atol=2e-2, eps=1e-2)
+
+
+def test_deconvolution_vs_torch():
+    rng = RS(12)
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 4, 3, 3).astype(np.float32)
+    sym = S.Deconvolution(S.Variable("x"), S.Variable("w"), kernel=(3, 3),
+                          num_filter=4, stride=(2, 2), pad=(1, 1), adj=(1, 1))
+    exp = F.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                             padding=1, output_padding=1).numpy()
+    _fwd(sym, {"x": x, "w": w}, [exp], rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_vs_torch(pool_type):
+    rng = RS(13)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    sym = S.Pooling(S.Variable("x"), kernel=(2, 2), stride=(2, 2),
+                    pool_type=pool_type)
+    t = torch.tensor(x)
+    exp = (F.max_pool2d(t, 2, 2) if pool_type == "max"
+           else F.avg_pool2d(t, 2, 2)).numpy()
+    _fwd(sym, {"x": x}, [exp], rtol=1e-4, atol=1e-5)
+    gsym = S.Pooling(S.Variable("x"), kernel=(2, 2), global_pool=True,
+                     pool_type=pool_type)
+    gexp = (F.adaptive_max_pool2d(t, 1) if pool_type == "max"
+            else F.adaptive_avg_pool2d(t, 1)).numpy()
+    _fwd(gsym, {"x": x}, [gexp], rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_inference_vs_formula():
+    rng = RS(14)
+    x = rng.randn(3, 4, 2, 2).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.5
+    eps = 1e-3
+    sym = S.BatchNorm(S.Variable("x"), S.Variable("gamma"), S.Variable("beta"),
+                      eps=eps, fix_gamma=False, name="bn")
+    exp = (gamma[None, :, None, None] * (x - mean[None, :, None, None])
+           / np.sqrt(var[None, :, None, None] + eps) + beta[None, :, None, None])
+    _fwd(sym, {"x": x, "gamma": gamma, "beta": beta}, [exp], rtol=1e-3,
+         atol=1e-4, aux={"bn_moving_mean": mean, "bn_moving_var": var})
+
+
+def test_instance_norm_l2norm_lrn():
+    rng = RS(15)
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+    eps = 1e-3
+    exp = F.instance_norm(torch.tensor(x), weight=torch.tensor(gamma),
+                          bias=torch.tensor(beta), eps=eps).numpy()
+    _fwd(S.InstanceNorm(S.Variable("x"), S.Variable("g"), S.Variable("b"),
+                        eps=eps),
+         {"x": x, "g": gamma, "b": beta}, [exp], rtol=1e-3, atol=1e-4)
+    for mode, axes in [("instance", (1, 2, 3)), ("channel", (1,)),
+                       ("spatial", (2, 3))]:
+        nrm = np.sqrt((x ** 2).sum(axis=axes, keepdims=True) + 1e-10)
+        _fwd(S.L2Normalization(S.Variable("x"), mode=mode), {"x": x},
+             [x / nrm], rtol=1e-4, atol=1e-5)
+    nsize, alpha, beta_, k = 3, 1e-3, 0.75, 2.0
+    exp = F.local_response_norm(torch.tensor(x), nsize, alpha=alpha,
+                                beta=beta_, k=k).numpy()
+    _fwd(S.LRN(S.Variable("x"), nsize=nsize, alpha=alpha, beta=beta_, knorm=k),
+         {"x": x}, [exp], rtol=1e-3, atol=1e-4)
+
+
+def test_activations_and_softmax():
+    rng = RS(16)
+    x = rng.randn(3, 5).astype(np.float32)
+    for act, np_fn in [
+        ("relu", lambda v: np.maximum(v, 0)),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("tanh", np.tanh),
+        ("softrelu", lambda v: np.log1p(np.exp(v))),
+    ]:
+        _fwd(S.Activation(S.Variable("x"), act_type=act), {"x": x},
+             [np_fn(x)], rtol=1e-4, atol=1e-5)
+    _fwd(S.LeakyReLU(S.Variable("x"), act_type="leaky", slope=0.1), {"x": x},
+         [np.where(x > 0, x, 0.1 * x)], rtol=1e-4, atol=1e-5)
+    _fwd(S.LeakyReLU(S.Variable("x"), act_type="elu", slope=0.3), {"x": x},
+         [np.where(x > 0, x, 0.3 * (np.exp(x) - 1))], rtol=1e-4, atol=1e-5)
+    sm = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    _fwd(S.softmax(S.Variable("x"), axis=1), {"x": x}, [sm], rtol=1e-5,
+         atol=1e-6)
+    _fwd(S.log_softmax(S.Variable("x"), axis=1), {"x": x}, [np.log(sm)],
+         rtol=1e-4, atol=1e-5)
+    x4 = rng.randn(2, 3, 2, 2).astype(np.float32)
+    ch = np.exp(x4) / np.exp(x4).sum(1, keepdims=True)
+    _fwd(S.SoftmaxActivation(S.Variable("x"), mode="channel"), {"x": x4},
+         [ch], rtol=1e-5, atol=1e-6)
+    flat = x4.reshape(2, -1)
+    inst = (np.exp(flat) / np.exp(flat).sum(1, keepdims=True)).reshape(x4.shape)
+    _fwd(S.SoftmaxActivation(S.Variable("x")), {"x": x4}, [inst], rtol=1e-5,
+         atol=1e-6)
+
+
+def test_dropout_modes():
+    rng = RS(17)
+    x = rng.randn(4, 5).astype(np.float32)
+    # inference: identity
+    _fwd(S.Dropout(S.Variable("x"), p=0.5), {"x": x}, [x])
+    # training: mask is 0-or-scaled, mean roughly preserved
+    ex = S.Dropout(S.Variable("x"), p=0.5).bind(
+        mx.cpu(), {"x": mx.nd.array(np.ones((200, 200), np.float32))})
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert set(np.round(np.unique(out), 5)).issubset({0.0, 2.0})
+    assert abs(out.mean() - 1.0) < 0.05
+
+
+def test_loss_op_gradients():
+    """Loss layer backward semantics vs the reference closed forms:
+    SoftmaxOutput default normalization='null' → grad = p - onehot
+    (reference src/operator/softmax_output-inl.h:131-173); regression
+    outputs divide by num_output = label.Size()/batch (reference
+    src/operator/regression_output-inl.h:70-77).  All ignore incoming
+    head grads."""
+    rng = RS(18)
+    x = rng.randn(4, 5).astype(np.float32)
+    lbl = np.array([1, 0, 3, 2], np.float32)
+    p = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[lbl.astype(int)]
+    tu.check_symbolic_backward(
+        S.SoftmaxOutput(S.Variable("x"), S.Variable("l"), name="sm"),
+        {"x": x, "l": lbl}, [np.ones_like(x)],
+        {"x": p - onehot}, rtol=1e-4, atol=1e-5,
+        grad_req={"x": "write", "l": "null"}, ctx=mx.cpu())
+    tu.check_symbolic_backward(
+        S.SoftmaxOutput(S.Variable("x"), S.Variable("l"),
+                        normalization="batch", name="smb"),
+        {"x": x, "l": lbl}, [np.ones_like(x)],
+        {"x": (p - onehot) / 4.0}, rtol=1e-4, atol=1e-5,
+        grad_req={"x": "write", "l": "null"}, ctx=mx.cpu())
+    y = rng.rand(4, 5).astype(np.float32)
+    no = 5.0  # num_output per sample
+    tu.check_symbolic_backward(
+        S.LinearRegressionOutput(S.Variable("x"), S.Variable("l")),
+        {"x": x, "l": y}, [np.ones_like(x)], {"x": (x - y) / no},
+        rtol=1e-4, atol=1e-5, grad_req={"x": "write", "l": "null"}, ctx=mx.cpu())
+    sig = 1 / (1 + np.exp(-x))
+    tu.check_symbolic_backward(
+        S.LogisticRegressionOutput(S.Variable("x"), S.Variable("l")),
+        {"x": x, "l": y}, [np.ones_like(x)], {"x": (sig - y) / no},
+        rtol=1e-4, atol=1e-5, grad_req={"x": "write", "l": "null"}, ctx=mx.cpu())
+    tu.check_symbolic_backward(
+        S.MAERegressionOutput(S.Variable("x"), S.Variable("l")),
+        {"x": x, "l": y}, [np.ones_like(x)], {"x": np.sign(x - y) / no},
+        rtol=1e-4, atol=1e-5, grad_req={"x": "write", "l": "null"}, ctx=mx.cpu())
+    # MakeLoss: forward passes data through, backward seeds grad_scale
+    g = rng.rand(3, 4).astype(np.float32)
+    tu.check_symbolic_backward(
+        S.MakeLoss(S.Variable("x"), grad_scale=2.0), {"x": g},
+        [np.ones_like(g)], {"x": np.full_like(g, 2.0)},
+        rtol=1e-5, atol=1e-6, ctx=mx.cpu())
+
+
+def test_svm_output():
+    rng = RS(19)
+    x = rng.randn(3, 4).astype(np.float32)
+    lbl = np.array([0, 2, 1], np.float32)
+    sym = S.SVMOutput(S.Variable("x"), S.Variable("l"), margin=1.0)
+    _fwd(sym, {"x": x, "l": lbl}, [x])
+
+
+def test_sequence_ops():
+    rng = RS(20)
+    x = rng.randn(4, 3, 2).astype(np.float32)  # (T, B, C)
+    lens = np.array([2, 4, 3], np.float32)
+    exp = x.copy()
+    for b, n in enumerate(lens.astype(int)):
+        exp[n:, b] = 0.0
+    _fwd(S.SequenceMask(S.Variable("x"), S.Variable("len"),
+                        use_sequence_length=True),
+         {"x": x, "len": lens}, [exp])
+    _fwd(S.SequenceMask(S.Variable("x")), {"x": x}, [x])
+    last = np.stack([x[int(n) - 1, b] for b, n in enumerate(lens)], 0)
+    _fwd(S.SequenceLast(S.Variable("x"), S.Variable("len"),
+                        use_sequence_length=True),
+         {"x": x, "len": lens}, [last])
+    _fwd(S.SequenceLast(S.Variable("x")), {"x": x}, [x[-1]])
+    rev = x.copy()
+    for b, n in enumerate(lens.astype(int)):
+        rev[:n, b] = x[:n, b][::-1]
+    _fwd(S.SequenceReverse(S.Variable("x"), S.Variable("len"),
+                           use_sequence_length=True),
+         {"x": x, "len": lens}, [rev])
+    _fwd(S.SequenceReverse(S.Variable("x")), {"x": x}, [x[::-1]])
+
+
+def test_upsampling_and_embedding_grad():
+    rng = RS(21)
+    x = rng.randn(1, 2, 3, 3).astype(np.float32)
+    exp = x.repeat(2, axis=2).repeat(2, axis=3)
+    _fwd(S.UpSampling(S.Variable("x"), scale=2, sample_type="nearest",
+                      num_args=1), {"x": x}, [exp])
+    w = rng.randn(6, 4).astype(np.float32)
+    idx = np.array([[0, 3], [5, 1]], np.float32)
+    sym = S.Embedding(S.Variable("i"), S.Variable("w"), input_dim=6,
+                      output_dim=4)
+    tu.check_numeric_gradient(sym, {"i": idx, "w": w}, grad_nodes=["w"],
+                              rtol=0.05, atol=1e-3, ctx=mx.cpu())
+
+
+# ======================================================================
+# random samplers — moment checks (reference test_random.py pattern)
+# ======================================================================
+
+
+def _moments(name, kwargs, mean, std, shape=(40000,), rtol=0.1):
+    mx.random.seed(77)
+    arr = getattr(mx.nd, name)(shape=shape, ctx=mx.cpu(), **kwargs).asnumpy()
+    assert abs(arr.mean() - mean) < max(rtol * max(abs(mean), 0.1), 0.05), name
+    assert abs(arr.std() - std) < max(rtol * std, 0.08), name
+
+
+def test_random_moments():
+    _moments("uniform", {"low": -1.0, "high": 3.0}, 1.0, 4.0 / math.sqrt(12))
+    _moments("normal", {"loc": 2.0, "scale": 3.0}, 2.0, 3.0)
+    _moments("random_gamma", {"alpha": 4.0, "beta": 2.0}, 8.0,
+             math.sqrt(4) * 2.0)
+    _moments("random_exponential", {"lam": 4.0}, 0.25, 0.25)
+    _moments("random_poisson", {"lam": 6.0}, 6.0, math.sqrt(6.0))
+    _moments("random_negative_binomial", {"k": 5, "p": 0.4}, 5 * 0.6 / 0.4,
+             math.sqrt(5 * 0.6) / 0.4)
+    _moments("random_generalized_negative_binomial",
+             {"mu": 3.0, "alpha": 0.2}, 3.0, math.sqrt(3.0 + 0.2 * 9.0))
+
+
+def test_multinomial_and_shuffle():
+    mx.random.seed(5)
+    probs = mx.nd.array(np.array([[0.1, 0.2, 0.7]] * 1, np.float32))
+    draws = np.concatenate([
+        getattr(mx.nd, "sample_multinomial")(probs, shape=4000).asnumpy()
+        for _ in range(1)], axis=None)
+    freqs = np.bincount(draws.astype(int), minlength=3) / draws.size
+    np.testing.assert_allclose(freqs, [0.1, 0.2, 0.7], atol=0.04)
+    x = mx.nd.array(np.arange(100, dtype=np.float32))
+    sh = getattr(mx.nd, "_shuffle")(x).asnumpy()
+    assert not np.array_equal(sh, np.arange(100))
+    assert np.array_equal(np.sort(sh), np.arange(100))
+
+
+# ======================================================================
+# coverage gate
+# ======================================================================
+
+# ops exercised by dedicated test files rather than the tables above
+COVERED_ELSEWHERE = {
+    "_contrib_MultiBoxPrior", "_contrib_MultiBoxTarget",
+    "_contrib_MultiBoxDetection", "_contrib_CTCLoss",  # test_contrib_ops.py
+    "_rnn_state_zeros",          # test_model_parallel.py stacked LSTM
+    "_shuffle", "sample_multinomial",
+    "zeros", "ones", "full", "eye", "arange",  # test_init_ops via mx.nd
+    "uniform", "normal", "random_gamma", "random_exponential",
+    "random_poisson", "random_negative_binomial",
+    "random_generalized_negative_binomial",
+}
+
+TABLE_COVERED = (
+    set(UNARY_OPS) | set(BINARY_OPS) | set(SCALAR_OPS) | set(REDUCE_OPS)
+    | {
+        "add_n", "smooth_l1", "norm", "argmax", "argmin", "argmax_channel",
+        "Reshape", "transpose", "SwapAxis", "expand_dims", "squeeze", "flip",
+        "tile", "repeat", "slice", "slice_axis", "broadcast_to",
+        "broadcast_axis", "Cast", "clip", "Concat", "stack", "SliceChannel",
+        "Pad", "Crop", "take", "batch_take", "one_hot", "gather_nd",
+        "scatter_nd", "pick", "where", "Embedding", "sort", "argsort", "topk",
+        "dot", "batch_dot", "_linalg_gemm2", "_linalg_potrf", "_linalg_syrk",
+        "FullyConnected", "Convolution", "Deconvolution", "Pooling",
+        "BatchNorm", "InstanceNorm", "L2Normalization", "LRN", "Activation",
+        "LeakyReLU", "softmax", "log_softmax", "SoftmaxActivation", "Dropout",
+        "SoftmaxOutput", "LinearRegressionOutput", "LogisticRegressionOutput",
+        "MAERegressionOutput", "SVMOutput", "MakeLoss", "SequenceMask",
+        "SequenceLast", "SequenceReverse", "UpSampling",
+    }
+)
+
+
+def test_zz_registry_coverage():
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+
+    covered_names = TABLE_COVERED | COVERED_ELSEWHERE
+    groups = {}
+    for name, op in OP_REGISTRY.items():
+        groups.setdefault(id(op), set()).add(name)
+    total = len(groups)
+    covered = sum(1 for names in groups.values() if names & covered_names)
+    frac = covered / total
+    missing = sorted(min(n) for n in groups.values() if not (n & covered_names))
+    assert frac >= 0.8, (
+        "op test coverage %.1f%% < 80%%; uncovered: %s" % (100 * frac, missing))
