@@ -7,12 +7,19 @@ buffers) — matching how the reference's 181.53 img/s baseline was measured
 (train_imagenet.py full steps on 1x P100, reference docs/how_to/perf.md:
 181-190).
 
+Config: bf16 compute with fp32 master weights (Module compute_dtype —
+the multi-precision recipe) at batch 512, the throughput-optimal point on
+a v5e chip.  The model is BatchNorm-heavy and HBM-bandwidth bound: the
+compiled forward touches ~22 GB per 256-image step, so throughput rides
+the 819 GB/s HBM roofline (~27% MXU utilization), not the systolic array.
+
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "img/s", "vs_baseline": N}
 
 Methodology note: on the tunneled TPU platform `block_until_ready` can
-return early, so the timed loop is fenced by NDArray.wait_to_read (scalar
-host transfer), amortized over N steps.
+return early and a full-output device→host pull costs ~100 ms RTT, so the
+timed loop is fenced once by a ONE-element weight transfer, amortized over
+N steps.
 """
 import json
 import time
@@ -20,8 +27,8 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 181.53  # 1x P100, reference docs/how_to/perf.md:181-190
-BATCH = 32
-STEPS = 30
+BATCH = 512
+STEPS = 12
 
 
 def main():
@@ -30,7 +37,7 @@ def main():
 
     mx.random.seed(0)
     net = resnet(50)
-    mod = mx.mod.Module(net, context=mx.tpu())
+    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
     mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
              label_shapes=[("softmax_label", (BATCH,))])
     mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2))
@@ -43,7 +50,8 @@ def main():
     )
 
     def fence():
-        mod._exec_group.execs[0].arg_dict["fc1_weight"].wait_to_read()
+        x = mod._exec_group.execs[0].arg_dict["fc1_weight"].data
+        np.asarray(x[(0,) * x.ndim])  # 1-element transfer = real sync
 
     for _ in range(3):  # compile + settle
         mod.forward_backward(batch)
@@ -58,7 +66,7 @@ def main():
     dt = (time.time() - t0) / STEPS
     img_s = BATCH / dt
     print(json.dumps({
-        "metric": "ResNet-50 full train step img/s/chip (batch 32, fwd+bwd+SGD)",
+        "metric": "ResNet-50 full train step img/s/chip (bf16+fp32 master, batch 512, fwd+bwd+SGD)",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),

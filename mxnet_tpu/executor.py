@@ -34,7 +34,7 @@ __all__ = ["Executor"]
 
 
 def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_train, rng,
-               boundary=None):
+               boundary=None, cast=None):
     """Interpret the graph as pure JAX ops (traced once under jit).
 
     `rng` is a jax PRNG key (or None); callers inside jit build it from a
@@ -43,10 +43,27 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
     an edge crosses two ctx_groups a replicated sharding constraint is
     applied, the SPMD analog of the reference's _CrossDeviceCopy insertion
     at PlaceDevice boundaries (reference src/executor/graph_executor.cc:347-360).
+    `cast` is (compute_dtype, keep_fp32_names): float args/aux are cast to
+    the compute dtype ON ENTRY to the executable (labels and other names in
+    the keep set stay fp32) and outputs/aux-updates are cast back on exit.
+    Because the cast sits inside the traced function, `jax.vjp` returns
+    fp32 gradients for the fp32 master parameters automatically — the
+    multi-precision training recipe (reference python/mxnet/optimizer.py
+    multi-precision SGD) with XLA doing conv/matmul in bf16 on the MXU.
     Returns (outputs tuple, aux_updates tuple ordered like aux_names).
     """
-    arg_env = dict(zip(arg_names, arg_vals))
-    aux_env = dict(zip(aux_names, aux_vals))
+
+    def _to_compute(name, v):
+        if cast is None:
+            return v
+        cdt, keep = cast
+        if name in keep or not jnp.issubdtype(v.dtype, jnp.floating):
+            return v
+        return v.astype(cdt)
+
+    out_dtypes = {n: v.dtype for n, v in zip(aux_names, aux_vals)}
+    arg_env = {n: _to_compute(n, v) for n, v in zip(arg_names, arg_vals)}
+    aux_env = {n: _to_compute(n, v) for n, v in zip(aux_names, aux_vals)}
     env = {}
     aux_updates = dict(aux_env)
     for i, node in enumerate(order):
@@ -84,6 +101,11 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
         env[id(node)] = res
     outputs = tuple(env[id(nd)][ix] for nd, ix in entries)
     aux_out = tuple(aux_updates[n] for n in aux_names)
+    if cast is not None:
+        outputs = tuple(
+            o.astype(jnp.float32) if jnp.issubdtype(o.dtype, jnp.floating) else o
+            for o in outputs)
+        aux_out = tuple(a.astype(out_dtypes[n]) for n, a in zip(aux_names, aux_out))
     return outputs, aux_out
 
 
@@ -178,8 +200,11 @@ class Executor:
     """Bound computation graph (parity: python/mxnet/executor.py Executor)."""
 
     def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict, mesh=None,
-                 param_shardings=None, node_groups=None):
+                 param_shardings=None, node_groups=None, compute_dtype=None,
+                 fp32_names=()):
         self._symbol = symbol
+        self._compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+        self._fp32_names = frozenset(fp32_names)
         self._ctx = ctx
         self.arg_dict = arg_dict
         self.grad_dict = grad_dict
@@ -215,7 +240,8 @@ class Executor:
     # ------------------------------------------------------------------
     @staticmethod
     def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None, mesh=None,
-                    shared_exec=None, group2ctx=None, param_shardings=None, **kwargs):
+                    shared_exec=None, group2ctx=None, param_shardings=None,
+                    compute_dtype=None, fp32_names=(), **kwargs):
         """Allocate all arrays from shapes and bind
         (reference GraphExecutor simple_bind overload, executor.h:76)."""
         ctx = ctx or current_context()
@@ -230,6 +256,13 @@ class Executor:
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         type_dict = type_dict or {}
+        if type_dict:
+            # propagate the given dtypes through the graph so untyped params
+            # are allocated in the inferred dtype (reference simple_bind
+            # InferType, graph_executor.cc:793-806)
+            arg_types, _, _ = symbol.infer_type(**type_dict)
+            inferred = dict(zip(arg_names, arg_types))
+            type_dict = {n: type_dict.get(n, inferred[n]) for n in arg_names}
         arg_dict, grad_dict = {}, {}
         req_dict = _norm_grad_req(grad_req, arg_names)
         shared = shared_exec.arg_dict if shared_exec is not None else {}
@@ -253,7 +286,8 @@ class Executor:
             else:
                 aux_dict[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx)
         return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh,
-                        param_shardings=param_shardings, node_groups=node_groups)
+                        param_shardings=param_shardings, node_groups=node_groups,
+                        compute_dtype=compute_dtype, fp32_names=fp32_names)
 
     @staticmethod
     def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None,
@@ -355,6 +389,12 @@ class Executor:
             return (self._repl_sharding, self._node_groups)
         return None
 
+    def _cast(self):
+        """(compute_dtype, keep-fp32 names) for mixed-precision, or None."""
+        if self._compute_dtype is None:
+            return None
+        return (self._compute_dtype, self._fp32_names)
+
     # ------------------------------------------------------------------
     # forward / backward (parity: MXExecutorForward/Backward)
     # ------------------------------------------------------------------
@@ -392,11 +432,12 @@ class Executor:
             entries, order = self._entries, self._order
             an, xn = self._arg_names, self._aux_names
             boundary = self._boundary()
+            cast = self._cast()
 
             def f(arg_vals, aux_vals, seed):
                 rng = jax.random.key(seed)
                 return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train,
-                                  rng, boundary=boundary)
+                                  rng, boundary=boundary, cast=cast)
 
             self._jit_fwd[is_train] = jax.jit(f)
         return self._jit_fwd[is_train]
@@ -449,6 +490,7 @@ class Executor:
         entries, order = self._entries, self._order
         an, xn = self._arg_names, self._aux_names
         boundary = self._boundary()
+        cast = self._cast()
 
         def core(diff_vals, nondiff_vals, aux_vals, rng, head_grads):
             def fwd(dv):
@@ -458,7 +500,8 @@ class Executor:
                 for i, v in zip(nondiff_idx, nondiff_vals):
                     vals[i] = v
                 outs, aux_upd = _run_graph(entries, order, an, xn, tuple(vals),
-                                           aux_vals, True, rng, boundary=boundary)
+                                           aux_vals, True, rng, boundary=boundary,
+                                           cast=cast)
                 return outs, aux_upd
 
             (outs, aux_upd), vjp_fn = jax.vjp(fwd, diff_vals)

@@ -75,7 +75,8 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=logging, fixed_param_names=None, grad_req="write",
-                 state_names=None, mesh=None, param_shardings=None, group2ctx=None):
+                 state_names=None, mesh=None, param_shardings=None, group2ctx=None,
+                 compute_dtype=None):
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -90,6 +91,7 @@ class DataParallelExecutorGroup:
         self.mesh = mesh if mesh is not None else _make_mesh(contexts)
         self.param_shardings = param_shardings or {}
         self.group2ctx = group2ctx
+        self.compute_dtype = compute_dtype
         self.batch_size = None
         self.slices = None
         self.execs = []
@@ -150,7 +152,10 @@ class DataParallelExecutorGroup:
         exe = Executor.simple_bind(
             self.symbol, self.contexts[0], grad_req=grad_req, mesh=self.mesh,
             shared_exec=shared_exec, group2ctx=self.group2ctx,
-            param_shardings=self.param_shardings, **shape_kwargs
+            param_shardings=self.param_shardings,
+            compute_dtype=self.compute_dtype,
+            # labels keep fp32: class ids above 256 are not bf16-exact
+            fp32_names=tuple(self.label_names or ()), **shape_kwargs
         )
         self.execs = [exe]
 

@@ -266,18 +266,56 @@ class Symbol:
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):
+        """Forward dtype propagation (parity: MXImperativeInvoke FInferType;
+        reference src/c_api/c_api_ndarray.cc SetShapeType).
+
+        Unknown variables default to float32; op outputs follow numpy-style
+        promotion of their inputs, with `dtype`-attr ops (Cast, init ops)
+        and index-producing ops (arg*/topk-indices) overriding."""
         arg_names = self.list_arguments()
         known = {}
         if args:
             for n, t in zip(arg_names, args):
                 if t is not None:
-                    known[n] = _np.dtype(t)
-        known.update({k: _np.dtype(v) for k, v in kwargs.items() if v is not None})
-        arg_types = []
-        for n in arg_names:
-            arg_types.append(known.get(n, _np.dtype(_np.float32)))
-        out_types = [_np.dtype(_np.float32)] * len(self._entries)
-        aux_types = [_np.dtype(_np.float32)] * len(self.list_auxiliary_states())
+                    known[n] = jnp.dtype(t)
+        known.update({k: jnp.dtype(v) for k, v in kwargs.items() if v is not None})
+        order = _topo_order(self._entries)
+        node_types = {}
+        var_types = {}
+        for node in order:
+            if node.op is None:
+                t = known.get(node.name)
+                if t is None and "__dtype__" in node.attrs:
+                    t = jnp.dtype(node.attrs["__dtype__"])
+                var_types[node.name] = t  # None = not yet known
+                node_types[(id(node), 0)] = t
+                continue
+            in_types = [node_types.get((id(src), idx)) for src, idx in node.inputs]
+            known_in = [t for t in in_types if t is not None]
+            if "dtype" in node.attrs and node.attrs["dtype"]:
+                out_t = jnp.dtype(str(node.attrs["dtype"]))
+            elif known_in:
+                out_t = _np.result_type(*known_in)
+            else:
+                out_t = _np.dtype(_np.float32)
+            # same-dtype unification: untyped variable inputs (params) adopt
+            # the op's resolved dtype — the one-pass analog of nnvm's
+            # bidirectional InferType (reference graph_executor.cc:793-806)
+            for (src, idx), t in zip(node.inputs, in_types):
+                if t is None and src.op is None:
+                    node_types[(id(src), idx)] = out_t
+                    var_types[src.name] = out_t
+            # current kernels emit float32 for index-valued outputs
+            if node.op.name in ("argmax", "argmin", "argmax_channel", "argsort"):
+                out_t = _np.dtype(_np.float32)
+            for a in node.aux_vars:
+                var_types.setdefault(a.name, _np.dtype(_np.float32))
+            for i in range(node.num_outputs):
+                node_types[(id(node), i)] = out_t
+        f32 = _np.dtype(_np.float32)
+        arg_types = [var_types.get(n) or f32 for n in arg_names]
+        out_types = [node_types[(id(nd), ix)] or f32 for nd, ix in self._entries]
+        aux_types = [var_types.get(n) or f32 for n in self.list_auxiliary_states()]
         return arg_types, out_types, aux_types
 
     # ------------------------------------------------------------------

@@ -1,0 +1,83 @@
+"""Mixed precision: infer_type propagation + bf16 compute with fp32 masters.
+
+Reference analogs: tests/python/train/test_dtype.py (fp16 training) and the
+multi-precision SGD path (reference python/mxnet/optimizer.py:311+).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.io as mio
+
+
+def _mlp():
+    x = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(x, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_infer_type_propagation():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type(data="float16")
+    types = dict(zip(net.list_arguments(), arg_types))
+    assert str(types["fc1_weight"]) == "float16"
+    assert str(types["fc2_bias"]) == "float16"
+    assert str(out_types[0]) == "float16"
+    # Cast overrides propagation
+    c = mx.sym.Cast(mx.sym.Variable("x"), dtype="float64")
+    _, ot, _ = c.infer_type(x="float32")
+    assert str(ot[0]) == "float64"
+
+
+def test_simple_bind_type_dict():
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(4, 10), type_dict={"data": "float16"})
+    assert all(str(a.dtype) == "float16" for a in ex.arg_dict.values())
+    ex.forward(is_train=False, data=mx.nd.array(
+        np.zeros((4, 10), np.float16)))
+    assert str(ex.outputs[0].dtype) == "float16"
+
+
+def test_bf16_compute_trains_with_fp32_masters():
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 10).astype("float32")
+    y = np.argmax(X @ rng.randn(10, 3), 1).astype("float32")
+    it = mio.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(), compute_dtype="bfloat16")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(8):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    params, _ = mod.get_params()
+    # master weights stay fp32 (multi-precision recipe)
+    assert all(str(v.dtype) == "float32" for v in params.values())
+    acc = mod.score(mio.NDArrayIter(X, y, batch_size=32), "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+def test_bf16_outputs_are_fp32_and_close_to_fp32_run():
+    rng = np.random.RandomState(1)
+    X = rng.randn(8, 10).astype("float32")
+
+    def run(cd):
+        mx.random.seed(3)
+        net = _mlp()
+        mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype=cd)
+        mod.bind(data_shapes=[("data", (8, 10))], for_training=False,
+                 label_shapes=None)
+        mod.init_params(mx.init.Xavier(), force_init=True)
+        mod.forward(mio.DataBatch(data=[mx.nd.array(X)], label=None),
+                    is_train=False)
+        return mod.get_outputs()[0].asnumpy()
+
+    ref = run(None)
+    bf = run("bfloat16")
+    assert bf.dtype == np.float32  # outputs cast back on exit
+    np.testing.assert_allclose(bf, ref, atol=0.05)
