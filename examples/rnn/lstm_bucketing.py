@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing (parity: reference
+example/rnn/lstm_bucketing.py; BASELINE config 3).
+
+Trains a 2-layer LSTM LM with BucketingModule + BucketSentenceIter.  Uses
+PTB text files if --data-dir points at them (ptb.train.txt / ptb.valid.txt,
+one sentence per line); otherwise falls back to a synthetic corpus so the
+script runs out of the box.
+
+The LSTM is the fused `RNN` op (lax.scan) — per-bucket compile time is
+independent of the bucket's sequence length.
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+
+parser = argparse.ArgumentParser(description="Train an LSTM LM with bucketing")
+parser.add_argument("--data-dir", type=str, default="")
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--num-epochs", type=int, default=5)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--gpus", type=str, default="")
+parser.add_argument("--kv-store", type=str, default="device")
+parser.add_argument("--disp-batches", type=int, default=50)
+BUCKETS = [10, 20, 30, 40, 50, 60]
+START_LABEL = 1
+INVALID_LABEL = 0
+
+
+def tokenize_text(fname, vocab=None, start_label=START_LABEL,
+                  invalid_label=INVALID_LABEL):
+    """(parity: example/rnn/lstm_bucketing.py tokenize_text)"""
+    with open(fname) as f:
+        lines = [l.split() for l in f.read().splitlines() if l.strip()]
+    return mx.rnn.encode_sentences(lines, vocab=vocab, start_label=start_label,
+                                   invalid_label=invalid_label)
+
+
+def synthetic_corpus(n_sentences=2000, vocab_size=200, seed=0):
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n_sentences):
+        n = rng.randint(5, max(BUCKETS))
+        # markov-ish sequences so the LM has something to learn
+        s = [int(rng.randint(START_LABEL + 1, vocab_size))]
+        for _ in range(n - 1):
+            s.append((s[-1] * 31 + 7) % (vocab_size - START_LABEL - 1)
+                     + START_LABEL + 1)
+        sents.append(s)
+    return sents, vocab_size
+
+
+def main():
+    args = parser.parse_args()
+    train_file = os.path.join(args.data_dir, "ptb.train.txt")
+    if args.data_dir and os.path.exists(train_file):
+        train_sent, vocab = tokenize_text(train_file)
+        val_sent, _ = tokenize_text(
+            os.path.join(args.data_dir, "ptb.valid.txt"), vocab=vocab)
+        vocab_size = len(vocab) + START_LABEL + 1
+    else:
+        print("no PTB data found; using a synthetic corpus")
+        train_sent, vocab_size = synthetic_corpus(2000)
+        val_sent, _ = synthetic_corpus(200, seed=1)
+
+    data_train = rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                        buckets=BUCKETS,
+                                        invalid_label=INVALID_LABEL)
+    data_val = rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                      buckets=BUCKETS,
+                                      invalid_label=INVALID_LABEL)
+
+    cell = rnn.FusedRNNCell(args.num_hidden, num_layers=args.num_layers,
+                            mode="lstm", prefix="lstm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        output, _ = cell.unroll(seq_len, inputs=embed, layout="NTC",
+                                merge_outputs=True)
+        pred = mx.sym.Reshape(output, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    contexts = (mx.cpu() if not args.gpus
+                else [mx.tpu(int(i)) for i in args.gpus.split(",")])
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen, default_bucket_key=data_train.default_bucket_key,
+        context=contexts)
+    model.fit(
+        train_data=data_train, eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(INVALID_LABEL),
+        kvstore=args.kv_store, optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                          "wd": 1e-5},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))
+
+
+if __name__ == "__main__":
+    main()

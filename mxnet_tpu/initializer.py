@@ -245,6 +245,63 @@ class LSTMBias(Initializer):
     _init_bias = _init_weight
 
 
+@register
+class FusedRNN(Initializer):
+    """Init the packed FusedRNN parameter vector (parity: initializer.py
+    FusedRNN:655): weights get `init` (default Uniform), biases zero, and
+    LSTM forget-gate i2h biases get `forget_bias`.  Layout per reference
+    rnn_cell.py _slice_weights (see ops/rnn_op.py)."""
+
+    def __init__(self, init=None, num_hidden=None, num_layers=None, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__(init=init.dumps() if hasattr(init, "dumps") else init,
+                         num_hidden=num_hidden, num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional, forget_bias=forget_bias)
+        self._init = init or Uniform(0.07)
+        if isinstance(self._init, str):
+            import json as _json
+
+            name, kwargs = _json.loads(self._init)
+            self._init = _INIT_REGISTRY[name.lower()](**kwargs)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        from .ops.rnn_op import _GATES
+
+        g = _GATES[self._mode]
+        h = self._num_hidden
+        l = self._num_layers
+        d = 2 if self._bidirectional else 1
+        flat = _np.zeros((int(_np.prod(arr.shape)),), dtype="float32")
+        # infer input size from total length (reference unpack_weights:624)
+        c = flat.size // d // h // g - (l - 1) * (h + d * h + 2) - h - 2
+        pos = 0
+        for layer in range(l):
+            inp = c if layer == 0 else d * h
+            for _dir in range(d):
+                for rows, cols in ((g * h, inp), (g * h, h)):
+                    block = _np.zeros((rows, cols), dtype="float32")
+                    self._init._init_weight(name, block)
+                    flat[pos:pos + rows * cols] = block.ravel()
+                    pos += rows * cols
+        for layer in range(l):
+            for _dir in range(d):
+                for _ in range(2):  # i2h bias then h2h bias
+                    block = _np.zeros((g * h,), dtype="float32")
+                    self._init._init_weight(name, block)
+                    if self._mode == "lstm":
+                        # both bias halves get forget_bias, matching the
+                        # reference FusedRNN init (initializer.py:698-700)
+                        block[h:2 * h] = self._forget_bias
+                    flat[pos:pos + g * h] = block
+                    pos += g * h
+        arr[:] = flat.reshape(arr.shape)
+
+
 class Load:
     """Init from a dict of arrays (parity: initializer.py Load)."""
 

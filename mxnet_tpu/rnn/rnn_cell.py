@@ -283,11 +283,12 @@ class GRUCell(BaseRNNCell):
 class FusedRNNCell(BaseRNNCell):
     """Fused multi-layer RNN (parity: rnn_cell.py FusedRNNCell ≙ cuDNN RNN op).
 
-    TPU-native: `unroll` builds the stacked/bidirectional graph directly —
-    the whole loop compiles into one XLA executable, which is the fused
-    regime the reference needed cuDNN for.  Weights use the packed layout
-    so unpack/pack interop with the unfused cells (reference weight
-    pack/unpack between fused and unfused).
+    TPU-native: `unroll` emits ONE `RNN` registry op whose time loop is a
+    `lax.scan` (ops/rnn_op.py) — compile time is independent of sequence
+    length, the property BucketingModule needs; the reference used cuDNN
+    for the same reason (reference src/operator/cudnn_rnn-inl.h).  Weights
+    live in the reference packed layout so unpack/pack interop with the
+    unfused cells holds.
     """
 
     def __init__(self, num_hidden, num_layers=1, mode="lstm", bidirectional=False,
@@ -304,6 +305,12 @@ class FusedRNNCell(BaseRNNCell):
         self._get_next_state = get_next_state
         self._forget_bias = forget_bias
         self._directions = ["l", "r"] if bidirectional else ["l"]
+        from ..initializer import FusedRNN as _FusedRNNInit
+
+        self._parameter = self.params.get(
+            "parameters",
+            init=_FusedRNNInit(None, num_hidden, num_layers, mode,
+                               bidirectional, forget_bias))
 
     @property
     def state_info(self):
@@ -347,11 +354,96 @@ class FusedRNNCell(BaseRNNCell):
                 stack.add(DropoutCell(self._dropout, prefix="%s_dropout%d_" % (self._prefix, i)))
         return stack
 
+    def _slice_weights(self, arr, li, lh):
+        """Slice the packed vector into per-cell arrays
+        (parity: rnn_cell.py _slice_weights:579-616)."""
+        args = {}
+        gate_names = self._gate_names
+        b = len(self._directions)
+        p = 0
+        for layer in range(self._num_layers):
+            for direction in self._directions:
+                for gate in gate_names:
+                    name = "%s%s%d_i2h%s_weight" % (self._prefix, direction, layer, gate)
+                    size = (b * lh * lh) if layer > 0 else (li * lh)
+                    shape = (lh, b * lh) if layer > 0 else (lh, li)
+                    args[name] = arr[p:p + size].reshape(shape)
+                    p += size
+                for gate in gate_names:
+                    name = "%s%s%d_h2h%s_weight" % (self._prefix, direction, layer, gate)
+                    args[name] = arr[p:p + lh * lh].reshape((lh, lh))
+                    p += lh * lh
+        for layer in range(self._num_layers):
+            for direction in self._directions:
+                for kind in ("i2h", "h2h"):
+                    for gate in gate_names:
+                        name = "%s%s%d_%s%s_bias" % (self._prefix, direction, layer, kind, gate)
+                        args[name] = arr[p:p + lh]
+                        p += lh
+        assert p == arr.size, "Invalid parameters size for FusedRNNCell"
+        return args
+
+    def unpack_weights(self, args):
+        args = args.copy()
+        arr = args.pop(self._parameter.name)
+        b = len(self._directions)
+        m = self._num_gates
+        h = self._num_hidden
+        num_input = arr.size // b // h // m - (self._num_layers - 1) * (h + b * h + 2) - h - 2
+        nargs = self._slice_weights(arr, num_input, h)
+        args.update({name: nd.copy() for name, nd in nargs.items()})
+        return args
+
+    def pack_weights(self, args):
+        from .. import ndarray as nd
+
+        args = args.copy()
+        b = len(self._directions)
+        m = self._num_gates
+        h = self._num_hidden
+        w0 = args["%sl0_i2h%s_weight" % (self._prefix, self._gate_names[0])]
+        num_input = w0.shape[1]
+        total = (num_input + h + 2) * (h * m * b) + \
+            (self._num_layers - 1) * m * h * (h + b * h + 2) * b
+        arr = nd.zeros((total,))
+        for name, block in self._slice_weights(arr, num_input, h).items():
+            block[:] = args.pop(name)
+        args[self._parameter.name] = arr
+        return args
+
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
-        return self.unfuse().unroll(length, inputs=inputs, begin_state=begin_state,
-                                    input_prefix=input_prefix, layout=layout,
-                                    merge_outputs=merge_outputs)
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, True)
+        if axis == 1:  # RNN op wants (T, N, C)
+            inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            # state zeros take their batch dim from a batch-major view
+            begin_state = self._batch_begin_state(
+                symbol.swapaxes(inputs, dim1=0, dim2=1))
+        states = begin_state
+        kwargs = {"state": states[0]}
+        if self._mode == "lstm":
+            kwargs["state_cell"] = states[1]
+        rnn = symbol.RNN(inputs, self._parameter, state_size=self._num_hidden,
+                         num_layers=self._num_layers,
+                         bidirectional=self._bidirectional, p=self._dropout,
+                         state_outputs=self._get_next_state, mode=self._mode,
+                         name=self._prefix + "rnn", **kwargs)
+        attr = {"__layout__": "LNC"}
+        if not self._get_next_state:
+            outputs, states = rnn, []
+        elif self._mode == "lstm":
+            rnn[1]._set_attr(**attr)
+            rnn[2]._set_attr(**attr)
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            rnn[1]._set_attr(**attr)
+            outputs, states = rnn[0], [rnn[1]]
+        if axis == 1:
+            outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
+        outputs, _ = _normalize_sequence(length, outputs, layout, merge_outputs)
+        return outputs, states
 
     def __call__(self, inputs, states):
         raise NotImplementedError("FusedRNNCell cannot be stepped. Please use unroll")

@@ -516,6 +516,10 @@ def _create(op_name, input_syms, attrs, name=None, aux_syms=None):
                 full_attrs.get("use_sequence_length", False)
             ):
                 break
+            if declared[len(inputs)] == "state_cell" and str(
+                full_attrs.get("mode", "lstm")
+            ) != "lstm":
+                break
             if declared[len(inputs)] == "gamma" and op.name == "LeakyReLU" and str(
                 full_attrs.get("act_type", "leaky")
             ) != "prelu":

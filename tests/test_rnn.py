@@ -1,0 +1,159 @@
+"""RNN toolkit tests (reference tests/python/unittest/test_rnn.py pattern):
+fused RNN op vs unfused cell unrolls, cell numerics vs numpy oracles."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.rnn as rnn
+
+
+def _run_sym(sym, args_np, out_grad=None):
+    args = {k: mx.nd.array(v) for k, v in args_np.items()}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args_np.items()}
+    ex = sym.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    outs = [o.asnumpy() for o in ex.outputs]
+    g = None
+    if out_grad is not None:
+        ex.backward(mx.nd.array(out_grad))
+        g = {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+    return outs, g
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "rnn_relu", "lstm", "gru"])
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_fused_matches_unfused(mode, bidirectional):
+    T, N, C, H, L = 5, 3, 4, 6, 2
+    fused = rnn.FusedRNNCell(H, num_layers=L, mode=mode,
+                             bidirectional=bidirectional, prefix="rnn_",
+                             get_next_state=True)
+    fsym = fused.unroll(T, mx.sym.Variable("data"), layout="TNC",
+                        merge_outputs=True)[0]
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-0.3, 0.3, (T, N, C)).astype(np.float32)
+    # materialize fused params with the FusedRNN initializer
+    arg_shapes, _, _ = fsym.infer_shape(data=(T, N, C))
+    names = fsym.list_arguments()
+    shapes = dict(zip(names, arg_shapes))
+    params = {}
+    init = mx.init.FusedRNN(mx.init.Uniform(0.1), H, L, mode, bidirectional)
+    for n, s in shapes.items():
+        if n == "data":
+            continue
+        arr = mx.nd.zeros(s)
+        init._init_weight(n, arr)
+        params[n] = arr.asnumpy()
+    fout, _ = _run_sym(fsym, {"data": data, **params})
+
+    # unfused stack with the SAME weights via unpack_weights
+    stack = fused.unfuse()
+    usym = stack.unroll(T, mx.sym.Variable("data"), layout="TNC",
+                        merge_outputs=True)[0]
+    uargs = stack.pack_weights(fused.unpack_weights(
+        {"rnn_parameters": mx.nd.array(params["rnn_parameters"])}))
+    uargs = {k: v.asnumpy() for k, v in uargs.items()}
+    unames = set(usym.list_arguments()) - {"data"}
+    assert unames == set(uargs), (sorted(unames), sorted(uargs))
+    uout, _ = _run_sym(usym, {"data": data, **uargs})
+    np.testing.assert_allclose(fout[0], uout[0], rtol=1e-4, atol=1e-5)
+
+
+def test_fused_state_outputs_and_grad():
+    T, N, C, H, L = 4, 2, 3, 5, 2
+    fused = rnn.FusedRNNCell(H, num_layers=L, mode="lstm", prefix="f_",
+                             get_next_state=True)
+    outputs, states = fused.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                                   merge_outputs=True)
+    assert len(states) == 2
+    sym = mx.sym.Group([outputs] + states)
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(N, T, C))
+    assert out_shapes[0] == (N, T, H)
+    assert out_shapes[1] == (L, N, H) and out_shapes[2] == (L, N, H)
+    # gradient flows through the scan to data and parameters
+    loss = mx.sym.MakeLoss(mx.sym.sum(outputs))
+    rng = np.random.RandomState(1)
+    shapes = dict(zip(loss.list_arguments(), loss.infer_shape(data=(N, T, C))[0]))
+    args = {n: mx.nd.array(rng.uniform(-0.2, 0.2, s).astype(np.float32))
+            for n, s in shapes.items()}
+    grads = {n: mx.nd.zeros(s) for n, s in shapes.items()}
+    ex = loss.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward()
+    for n, g in ex.grad_dict.items():
+        gn = g.asnumpy()
+        assert np.isfinite(gn).all(), n
+        assert np.abs(gn).max() > 0, n
+
+
+def test_fused_numeric_gradient():
+    from mxnet_tpu import test_utils as tu
+    T, N, C, H = 3, 2, 3, 4
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="g_")
+    out, _ = fused.unroll(T, mx.sym.Variable("data"), layout="TNC",
+                          merge_outputs=True)
+    rng = np.random.RandomState(2)
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    psize = rnn_param_size(C, H, 1, "lstm")
+    tu.check_numeric_gradient(
+        out, {"data": rng.uniform(-0.3, 0.3, (T, N, C)).astype(np.float32),
+              "g_parameters": rng.uniform(-0.2, 0.2, (psize,)).astype(np.float32)},
+        rtol=0.05, atol=2e-3, numeric_eps=1e-2, ctx=mx.cpu())
+
+
+def test_lstm_cell_vs_numpy_oracle():
+    """Single LSTM step numerics vs a transcribed numpy LSTM."""
+    N, C, H = 3, 4, 5
+    cell = rnn.LSTMCell(H, prefix="l_")
+    out, states = cell.unroll(2, mx.sym.Variable("data"), layout="NTC",
+                              merge_outputs=True)
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-0.5, 0.5, (N, 2, C)).astype(np.float32)
+    wi = rng.uniform(-0.3, 0.3, (4 * H, C)).astype(np.float32)
+    wh = rng.uniform(-0.3, 0.3, (4 * H, H)).astype(np.float32)
+    bi = rng.uniform(-0.1, 0.1, (4 * H,)).astype(np.float32)
+    bh = rng.uniform(-0.1, 0.1, (4 * H,)).astype(np.float32)
+    outs, _ = _run_sym(out, {"data": x, "l_i2h_weight": wi, "l_h2h_weight": wh,
+                             "l_i2h_bias": bi, "l_h2h_bias": bh})
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((N, H)); c = np.zeros((N, H))
+    exp = []
+    for t in range(2):
+        gates = x[:, t] @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = np.split(gates, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        exp.append(h.copy())
+    np.testing.assert_allclose(outs[0], np.stack(exp, 1), rtol=1e-4, atol=1e-5)
+
+
+def test_gru_cell_vs_numpy_oracle():
+    N, C, H = 2, 3, 4
+    cell = rnn.GRUCell(H, prefix="g_")
+    out, _ = cell.unroll(2, mx.sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-0.5, 0.5, (N, 2, C)).astype(np.float32)
+    wi = rng.uniform(-0.3, 0.3, (3 * H, C)).astype(np.float32)
+    wh = rng.uniform(-0.3, 0.3, (3 * H, H)).astype(np.float32)
+    bi = rng.uniform(-0.1, 0.1, (3 * H,)).astype(np.float32)
+    bh = rng.uniform(-0.1, 0.1, (3 * H,)).astype(np.float32)
+    outs, _ = _run_sym(out, {"data": x, "g_i2h_weight": wi, "g_h2h_weight": wh,
+                             "g_i2h_bias": bi, "g_h2h_bias": bh})
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((N, H))
+    exp = []
+    for t in range(2):
+        gi = x[:, t] @ wi.T + bi
+        gh = h @ wh.T + bh
+        r = sig(gi[:, :H] + gh[:, :H])
+        z = sig(gi[:, H:2 * H] + gh[:, H:2 * H])
+        cand = np.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+        h = (1 - z) * cand + z * h
+        exp.append(h.copy())
+    np.testing.assert_allclose(outs[0], np.stack(exp, 1), rtol=1e-4, atol=1e-5)
