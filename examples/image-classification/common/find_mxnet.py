@@ -1,0 +1,12 @@
+"""Make the framework importable as `mxnet_tpu` from the examples tree
+(parity: reference example/image-classification/common/find_mxnet.py,
+which inserted the source checkout into sys.path)."""
+import os
+import sys
+
+try:
+    import mxnet_tpu  # noqa: F401
+except ImportError:
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+    sys.path.insert(0, repo)
+    import mxnet_tpu  # noqa: F401

@@ -1,0 +1,166 @@
+"""Training-loop plumbing shared by the image-classification examples
+(parity: reference example/image-classification/common/fit.py:45-215 —
+same argument surface, same Module.fit wiring; devices resolve to
+mx.tpu() instead of mx.gpu())."""
+import logging
+import time
+
+import mxnet_tpu as mx
+
+
+def _get_lr_scheduler(args, kv):
+    if "lr_factor" not in args or args.lr_factor >= 1:
+        return (args.lr, None)
+    epoch_size = args.num_examples // args.batch_size
+    if "dist" in args.kv_store:
+        epoch_size //= kv.num_workers
+    begin_epoch = args.load_epoch if args.load_epoch else 0
+    step_epochs = [int(l) for l in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin_epoch >= s:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjust learning rate to %e for epoch %d", lr, begin_epoch)
+    steps = [epoch_size * (x - begin_epoch) for x in step_epochs
+             if x - begin_epoch > 0]
+    if not steps:
+        return (lr, None)
+    return (lr, mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                     factor=args.lr_factor))
+
+
+def _load_model(args, rank=0):
+    if "load_epoch" not in args or args.load_epoch is None:
+        return (None, None, None)
+    assert args.model_prefix is not None
+    model_prefix = args.model_prefix
+    if rank > 0:
+        model_prefix += "-%d" % rank
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        model_prefix, args.load_epoch)
+    logging.info("Loaded model %s_%04d.params", model_prefix, args.load_epoch)
+    return (sym, arg_params, aux_params)
+
+
+def _save_model(args, rank=0):
+    if args.model_prefix is None:
+        return None
+    return mx.callback.do_checkpoint(
+        args.model_prefix if rank == 0 else "%s-%d" % (args.model_prefix, rank))
+
+
+def add_fit_args(parser):
+    """(parity: fit.py add_fit_args:45-87)"""
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", type=str, help="the neural network to use")
+    train.add_argument("--num-layers", type=int,
+                       help="number of layers, required by e.g. resnet")
+    train.add_argument("--gpus", type=str,
+                       help="list of accelerator chips to run on, e.g. 0 or "
+                            "0,2. empty means using cpu (gpu ids alias tpu "
+                            "chips here)")
+    train.add_argument("--kv-store", type=str, default="device",
+                       help="key-value store type")
+    train.add_argument("--num-epochs", type=int, default=100)
+    train.add_argument("--lr", type=float, default=0.1)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str)
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=0.0001)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str)
+    parser.add_argument("--monitor", dest="monitor", type=int, default=0)
+    train.add_argument("--load-epoch", type=int)
+    train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--test-io", type=int, default=0)
+    train.add_argument("--compute-dtype", type=str, default=None,
+                       help="bf16 compute with fp32 masters: 'bfloat16' "
+                            "(TPU-native extension)")
+    return train
+
+
+def fit(args, network, data_loader, **kwargs):
+    """(parity: fit.py fit:89-215)"""
+    kv = mx.kvstore.create(args.kv_store)
+    head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
+    logging.basicConfig(level=logging.DEBUG, format=head)
+    logging.info("start with arguments %s", args)
+
+    (train, val) = data_loader(args, kv)
+    if args.test_io:
+        tic = time.time()
+        for i, batch in enumerate(train):
+            for j in batch.data:
+                j.wait_to_read()
+            if (i + 1) % args.disp_batches == 0:
+                logging.info("Batch [%d]\tSpeed: %.2f samples/sec", i,
+                             args.disp_batches * args.batch_size / (time.time() - tic))
+                tic = time.time()
+        return
+
+    if "arg_params" in kwargs and "aux_params" in kwargs:
+        arg_params = kwargs["arg_params"]
+        aux_params = kwargs["aux_params"]
+    else:
+        sym, arg_params, aux_params = _load_model(args, kv.rank)
+        if sym is not None:
+            assert sym.tojson() == network.tojson()
+
+    checkpoint = _save_model(args, kv.rank)
+
+    devs = mx.cpu() if args.gpus is None or args.gpus == "" else [
+        mx.tpu(int(i)) for i in args.gpus.split(",")]
+
+    lr, lr_scheduler = _get_lr_scheduler(args, kv)
+
+    model = mx.mod.Module(context=devs, symbol=network,
+                          compute_dtype=args.compute_dtype)
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "momentum": args.mom,
+        "wd": args.wd,
+        "lr_scheduler": lr_scheduler,
+        "multi_precision": True,
+    }
+    if args.optimizer not in ("sgd", "nag", "dcasgd", "sgld"):
+        optimizer_params.pop("momentum")
+        optimizer_params.pop("multi_precision")
+
+    monitor = mx.mon.Monitor(args.monitor, pattern=".*") if args.monitor > 0 else None
+
+    if args.network == "alexnet":
+        initializer = mx.init.Normal()
+    else:
+        initializer = mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                     magnitude=2)
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy", top_k=args.top_k))
+
+    batch_end_callbacks = [mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches)]
+    if "batch_end_callback" in kwargs:
+        cbs = kwargs["batch_end_callback"]
+        batch_end_callbacks += cbs if isinstance(cbs, list) else [cbs]
+
+    model.fit(train,
+              begin_epoch=args.load_epoch if args.load_epoch else 0,
+              num_epoch=args.num_epochs,
+              eval_data=val,
+              eval_metric=eval_metrics,
+              kvstore=kv,
+              optimizer=args.optimizer,
+              optimizer_params=optimizer_params,
+              initializer=initializer,
+              arg_params=arg_params,
+              aux_params=aux_params,
+              batch_end_callback=batch_end_callbacks,
+              epoch_end_callback=checkpoint,
+              allow_missing=True,
+              monitor=monitor)
+    return model

@@ -1,0 +1,108 @@
+"""Train MNIST (parity: reference example/image-classification/train_mnist.py;
+BASELINE config 1 — "runs unmodified via mx.tpu()").
+
+Data: reads the standard ubyte.gz files from --data-dir if present
+(train-images-idx3-ubyte.gz etc. — this environment has no egress, so no
+download); otherwise generates a deterministic synthetic digit set with
+the same shapes so the script always runs.
+"""
+import argparse
+import gzip
+import logging
+import os
+import struct
+
+import numpy as np
+
+logging.basicConfig(level=logging.DEBUG)
+
+from common import find_mxnet, fit  # noqa: F401,E402
+import mxnet_tpu as mx  # noqa: E402
+
+
+def read_data(label, image, data_dir):
+    with gzip.open(os.path.join(data_dir, label)) as flbl:
+        struct.unpack(">II", flbl.read(8))
+        label = np.frombuffer(flbl.read(), dtype=np.int8)
+    with gzip.open(os.path.join(data_dir, image), "rb") as fimg:
+        _, num, rows, cols = struct.unpack(">IIII", fimg.read(16))
+        image = np.frombuffer(fimg.read(), dtype=np.uint8).reshape(
+            len(label), rows, cols)
+    return (label, image)
+
+
+def synthetic_mnist(n, seed):
+    """Deterministic MNIST-shaped digits: class templates + jitter + noise.
+
+    Templates come from a FIXED seed so train/val draw from the same
+    distribution; `seed` only controls the sample jitter."""
+    templates = np.random.RandomState(42).rand(10, 28, 28) > 0.5
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.int8)
+    imgs = np.zeros((n, 28, 28), np.uint8)
+    for i, l in enumerate(labels):
+        img = templates[l].astype(np.float32) * 220
+        dx, dy = rng.randint(-1, 2, 2)
+        img = np.roll(np.roll(img, dx, 0), dy, 1)
+        img += rng.rand(28, 28) * 30
+        imgs[i] = np.clip(img, 0, 255).astype(np.uint8)
+    return labels, imgs
+
+
+def to4d(img):
+    return img.reshape(img.shape[0], 1, 28, 28).astype(np.float32) / 255
+
+
+def get_mnist_iter(args, kv):
+    if args.data_dir and os.path.exists(
+            os.path.join(args.data_dir, "train-images-idx3-ubyte.gz")):
+        (train_lbl, train_img) = read_data(
+            "train-labels-idx1-ubyte.gz", "train-images-idx3-ubyte.gz",
+            args.data_dir)
+        (val_lbl, val_img) = read_data(
+            "t10k-labels-idx1-ubyte.gz", "t10k-images-idx3-ubyte.gz",
+            args.data_dir)
+    else:
+        logging.info("no MNIST files in %r; using synthetic digits",
+                     args.data_dir)
+        train_lbl, train_img = synthetic_mnist(args.num_examples, seed=0)
+        val_lbl, val_img = synthetic_mnist(args.num_examples // 6, seed=1)
+    train = mx.io.NDArrayIter(to4d(train_img), train_lbl, args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(to4d(val_img), val_lbl, args.batch_size)
+    return (train, val)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default="data")
+    parser.add_argument("--add_stn", action="store_true")
+    fit.add_fit_args(parser)
+    parser.set_defaults(
+        network="mlp",
+        num_epochs=20,
+        disp_batches=100,
+        lr=0.05,
+        lr_step_epochs="10",
+    )
+    return parser
+
+
+def get_network(args):
+    from mxnet_tpu.models import get_lenet, get_mlp
+
+    if args.network == "mlp":
+        return get_mlp(num_classes=args.num_classes)
+    if args.network == "lenet":
+        return get_lenet(num_classes=args.num_classes)
+    raise ValueError("unknown network %s" % args.network)
+
+
+if __name__ == "__main__":
+    args = build_parser().parse_args()
+    sym = get_network(args)
+    fit.fit(args, sym, get_mnist_iter)

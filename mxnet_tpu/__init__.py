@@ -39,6 +39,7 @@ from . import kvstore as kv
 from .kvstore import KVStore
 from . import callback
 from . import monitor
+from . import monitor as mon
 from .monitor import Monitor
 from . import profiler
 from . import module

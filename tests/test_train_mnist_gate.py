@@ -1,0 +1,45 @@
+"""Reference-script convergence gate (reference tests/nightly/test_all.sh:43-66:
+train_mnist must reach val acc >= 0.99).
+
+Drives the actual examples/image-classification/train_mnist.py machinery —
+build_parser + common/fit.fit — i.e. the reference-shaped script surface,
+unmodified, against the module API.
+"""
+import os
+import sys
+
+import numpy as np
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "image-classification")
+
+
+def _run(network, extra=()):
+    sys.path.insert(0, EXAMPLES)
+    try:
+        import train_mnist
+        from common import fit as common_fit
+
+        args = train_mnist.build_parser().parse_args([
+            "--network", network, "--num-epochs", "3",
+            "--num-examples", "3000", "--batch-size", "64", "--lr", "0.01",
+            "--data-dir", "", *extra])
+        sym = train_mnist.get_network(args)
+        model = common_fit.fit(args, sym, train_mnist.get_mnist_iter)
+        _, val = train_mnist.get_mnist_iter(args, None)
+        import mxnet_tpu as mx
+
+        acc = model.score(val, mx.metric.Accuracy())[0][1]
+        return acc
+    finally:
+        sys.path.remove(EXAMPLES)
+
+
+def test_mnist_gate_mlp():
+    acc = _run("mlp")
+    assert acc >= 0.99, acc
+
+
+def test_mnist_gate_lenet():
+    acc = _run("lenet")
+    assert acc >= 0.99, acc
