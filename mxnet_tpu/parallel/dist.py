@@ -55,8 +55,18 @@ _COMMAND = 9
 _STOP = 10
 _ACK = 11
 _SETSYNC = 12
+_HEARTBEAT = 13
+_DEADNODES = 14
+_DEADNODES_R = 15
+_ERROR = 16
 
 BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
+# liveness knobs (reference analog: ps-lite heartbeats + CheckDeadNodes,
+# kvstore_dist.h:158-170)
+HEARTBEAT_INTERVAL = float(os.environ.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "2"))
+DEAD_NODE_TIMEOUT = float(os.environ.get("MXNET_KVSTORE_DEAD_TIMEOUT", "15"))
+BARRIER_TIMEOUT = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT", "300"))
+PULL_TIMEOUT = float(os.environ.get("MXNET_KVSTORE_PULL_TIMEOUT", "60"))
 
 
 # ----------------------------------------------------------------------
@@ -69,10 +79,15 @@ def _send_frame(sock, cmd, meta=b"", payload=b""):
     sock.sendall(header + meta + payload)
 
 
-def _recv_exact(sock, n):
+def _recv_exact(sock, n, started=False):
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if not buf and not started:
+                raise  # clean timeout between frames
+            continue  # mid-frame: keep reading, never desync the stream
         if not chunk:
             raise ConnectionError("peer closed")
         buf.extend(chunk)
@@ -81,7 +96,7 @@ def _recv_exact(sock, n):
 
 def _recv_frame(sock):
     (total,) = struct.unpack("<I", _recv_exact(sock, 4))
-    body = _recv_exact(sock, total)
+    body = _recv_exact(sock, total, started=True)
     cmd = body[0]
     (meta_len,) = struct.unpack("<I", body[1:5])
     meta = body[5 : 5 + meta_len]
@@ -128,11 +143,21 @@ class Scheduler:
         self._server_addrs = {}
         self._ranks = {"worker": 0, "server": 0}
         self._barrier_waiters = []
+        self._last_seen = {}  # node id "role:rank" -> monotonic timestamp
+        self._left = set()  # nodes whose connection closed
         self._stopped = False
 
+    def _dead_nodes(self):
+        now = time.monotonic()
+        dead = sorted(self._left)
+        for node, seen in self._last_seen.items():
+            if node not in self._left and now - seen > DEAD_NODE_TIMEOUT:
+                dead.append(node)
+        return dead
+
     def serve_forever(self):
-        """Register num_workers+num_servers nodes, then service barriers
-        until all workers disconnect."""
+        """Register num_workers+num_servers nodes, then service barriers,
+        heartbeats, and dead-node queries until all workers disconnect."""
         conns = []
         while len(conns) < self.num_workers + self.num_servers:
             conn, _ = self.sock.accept()
@@ -145,26 +170,30 @@ class Scheduler:
                 self._ranks[role] += 1
                 if role == "server":
                     self._server_addrs[rank] = (info["host"], info["port"])
+                self._last_seen["%s:%d" % (role, rank)] = time.monotonic()
             conns.append((conn, role, rank))
         # everyone registered: broadcast address book + ranks
         addrs = [self._server_addrs[r] for r in sorted(self._server_addrs)]
         for conn, role, rank in conns:
             _send_frame(conn, _ADDRS, _meta(rank=rank, servers=addrs))
-        # serve barriers on worker connections
+        # serve every node's connection (workers barrier, all heartbeat)
         threads = []
         for conn, role, rank in conns:
-            if role != "worker":
-                continue
-            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t = threading.Thread(target=self._serve_conn,
+                                 args=(conn, role, rank), daemon=True)
             t.start()
-            threads.append(t)
+            if role == "worker":
+                threads.append(t)
         for t in threads:
             t.join()
 
-    def _serve_conn(self, conn):
+    def _serve_conn(self, conn, role, rank):
+        node = "%s:%d" % (role, rank)
         try:
             while True:
                 cmd, meta, _ = _recv_frame(conn)
+                with self._lock:
+                    self._last_seen[node] = time.monotonic()
                 if cmd == _BARRIER:
                     with self._lock:
                         self._barrier_waiters.append(conn)
@@ -173,8 +202,21 @@ class Scheduler:
                                 _send_frame(c, _BARRIER_DONE)
                             self._barrier_waiters = []
                             self._lock.notify_all()
+                elif cmd == _DEADNODES:
+                    with self._lock:
+                        _send_frame(conn, _DEADNODES_R, _meta(dead=self._dead_nodes()))
+                # _HEARTBEAT: timestamp already refreshed above
         except (ConnectionError, OSError):
-            pass
+            with self._lock:
+                # a closed connection counts as dead unless the job is done
+                self._left.add(node)
+                waiters = list(self._barrier_waiters)
+            # wake any barrier waiters so they can observe the dead node
+            for c in waiters:
+                try:
+                    _send_frame(c, _DEADNODES_R, _meta(dead=self._dead_nodes()))
+                except Exception:
+                    pass
 
 
 # ----------------------------------------------------------------------
@@ -276,15 +318,29 @@ class Server:
                     key = info["key"]
                     min_version = info.get("min_version", 0)
                     st = self._get_state(key)
+                    deadline = time.monotonic() + PULL_TIMEOUT
+                    timed_out = False
                     with st.cond:
                         while st.value is None or st.version < min_version:
-                            st.cond.wait(timeout=60)
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                timed_out = True
+                                break
+                            st.cond.wait(timeout=remaining)
                         value = st.value
                         version = st.version
-                    _send_frame(conn, _VALUE,
-                                _meta(shape=list(value.shape), dtype=str(value.dtype),
-                                      version=version),
-                                value.tobytes())
+                    if timed_out:
+                        # never serve a stale value silently (round-1 review:
+                        # dist.py:280 proceeded with possibly-stale data)
+                        _send_frame(conn, _ERROR, _meta(
+                            msg="pull timeout for key %r: version %d < required %d "
+                                "after %.0fs (a worker likely died)"
+                                % (key, version, min_version, PULL_TIMEOUT)))
+                    else:
+                        _send_frame(conn, _VALUE,
+                                    _meta(shape=list(value.shape), dtype=str(value.dtype),
+                                          version=version),
+                                    value.tobytes())
                 elif cmd == _SETSYNC:
                     self.sync_mode = bool(info["sync"])
                     _send_frame(conn, _ACK)
@@ -332,12 +388,15 @@ class DistKVStore:
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
         self._sched = _connect_retry((root, port))
+        self._sched_send_lock = threading.Lock()
+        self._sched_recv_lock = threading.Lock()
         _send_frame(self._sched, _REGISTER, _meta(role="worker", host="", port=0))
         cmd, meta, _ = _recv_frame(self._sched)
         assert cmd == _ADDRS
         info = _parse_meta(meta)
         self._rank = info["rank"]
         self._server_addrs = info["servers"]
+        _start_heartbeat(self._sched, self._sched_send_lock)
         self._servers = [_connect_retry(tuple(a)) for a in self._server_addrs]
         self._server_locks = [threading.Lock() for _ in self._servers]
         self._push_round = {}
@@ -353,6 +412,9 @@ class DistKVStore:
         with self._server_locks[server_i]:
             _send_frame(self._servers[server_i], cmd, meta, payload)
             rcmd, rmeta, rpayload = _recv_frame(self._servers[server_i])
+        if rcmd == _ERROR:
+            raise MXNetError("server %d: %s"
+                             % (server_i, _parse_meta(rmeta).get("msg", "error")))
         assert rcmd in want, (rcmd, want)
         return rmeta, rpayload
 
@@ -380,10 +442,46 @@ class DistKVStore:
     def num_workers(self):
         return self._num_workers
 
-    def barrier(self):
-        _send_frame(self._sched, _BARRIER)
-        cmd, _, _ = _recv_frame(self._sched)
-        assert cmd == _BARRIER_DONE
+    def check_dead_nodes(self):
+        """Nodes the scheduler considers dead (reference CheckDeadNodes via
+        ps::Postoffice::GetDeadNodes, kvstore_dist.h:161-162)."""
+        with self._sched_recv_lock:
+            with self._sched_send_lock:
+                _send_frame(self._sched, _DEADNODES)
+            while True:
+                cmd, meta, _ = _recv_frame(self._sched)
+                if cmd == _DEADNODES_R:
+                    return _parse_meta(meta).get("dead", [])
+
+    def barrier(self, timeout=None):
+        """Global worker barrier.  Raises (instead of hanging forever) when
+        the scheduler reports dead nodes or `timeout` elapses."""
+        timeout = BARRIER_TIMEOUT if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        with self._sched_recv_lock:
+            with self._sched_send_lock:
+                _send_frame(self._sched, _BARRIER)
+            self._sched.settimeout(max(HEARTBEAT_INTERVAL * 2, 1.0))
+            try:
+                while True:
+                    try:
+                        cmd, meta, _ = _recv_frame(self._sched)
+                    except socket.timeout:
+                        if time.monotonic() > deadline:
+                            raise MXNetError(
+                                "barrier timed out after %.0fs" % timeout)
+                        with self._sched_send_lock:
+                            _send_frame(self._sched, _DEADNODES)
+                        continue
+                    if cmd == _BARRIER_DONE:
+                        return
+                    if cmd == _DEADNODES_R:
+                        dead = _parse_meta(meta).get("dead", [])
+                        if dead:
+                            raise MXNetError(
+                                "barrier aborted: dead nodes %s" % (dead,))
+            finally:
+                self._sched.settimeout(None)
 
     def init(self, key, value):
         keys, vals = ([key], [value]) if not isinstance(key, (list, tuple)) else (list(key), list(value))
@@ -477,6 +575,23 @@ def run_scheduler():
     sched.serve_forever()
 
 
+def _start_heartbeat(sock, send_lock, stop_event=None):
+    """Send-only heartbeat loop on a scheduler connection."""
+
+    def beat():
+        while stop_event is None or not stop_event.is_set():
+            time.sleep(HEARTBEAT_INTERVAL)
+            try:
+                with send_lock:
+                    _send_frame(sock, _HEARTBEAT)
+            except (OSError, ConnectionError):
+                return
+
+    t = threading.Thread(target=beat, daemon=True)
+    t.start()
+    return t
+
+
 def run_server():
     root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
@@ -485,4 +600,5 @@ def run_server():
     _send_frame(sched, _REGISTER, _meta(role="server", host="127.0.0.1", port=server.port))
     cmd, meta, _ = _recv_frame(sched)
     assert cmd == _ADDRS
+    _start_heartbeat(sched, threading.Lock(), server._stop)
     server.serve_forever()

@@ -1,0 +1,37 @@
+"""Worker script: dist_async semantics (reference kvstore_dist_server.h:
+200-210 — server applies each push immediately, no aggregation barrier)."""
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+
+kv = mx.kv.create("dist_async")
+assert kv.type == "dist_async"
+rank = kv.rank
+nw = kv.num_workers
+shape = (4, 4)
+
+kv.init("w", mx.nd.ones(shape))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0))
+
+# each push is applied immediately and independently (Hogwild); pushes are
+# synchronous RPCs, so after the barrier every worker's update landed
+kv.push("w", mx.nd.ones(shape) * (rank + 1))
+kv.barrier()
+out = mx.nd.zeros(shape)
+kv.pull("w", out)
+S = nw * (nw + 1) / 2.0
+expected = 1.0 - 0.1 * S
+assert np.allclose(out.asnumpy(), expected, atol=1e-5), (out.asnumpy()[0, 0], expected)
+
+# async pull does not gate on a version: a second pull returns instantly
+kv.pull("w", out)
+kv.barrier()
+kv.close()
+print("ASYNC_OK rank %d" % rank)
+sys.stdout.flush()

@@ -1,0 +1,28 @@
+"""Worker script: dead-node detection. Rank 1 exits WITHOUT reaching the
+barrier; rank 0's barrier must abort with a dead-node error instead of
+hanging forever (reference CheckDeadNodes, kvstore_dist.h:158-170)."""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+
+kv = mx.kv.create("dist_sync")
+if kv.rank == 1:
+    # simulate a crash: vanish without saying goodbye
+    print("DYING rank 1")
+    sys.stdout.flush()
+    import os
+
+    os._exit(0)
+
+try:
+    kv.barrier(timeout=30)
+    print("BARRIER_PASSED_UNEXPECTEDLY")
+except MXNetError as e:
+    assert "dead" in str(e) or "timed out" in str(e), e
+    print("DEAD_DETECTED: %s" % e)
+sys.stdout.flush()

@@ -8,10 +8,11 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_launch(n, s, script, timeout=180):
+def _run_launch(n, s, script, timeout=240, extra_env=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", str(n), "-s", str(s), sys.executable, script],
@@ -30,3 +31,27 @@ def test_dist_single_server():
     proc = _run_launch(2, 1, os.path.join(REPO, "tests", "dist_check_script.py"))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("DIST_OK") == 2, proc.stdout + proc.stderr
+
+
+def test_dist_sync_4workers_bigarray_sharding():
+    # 4 workers x 2 servers; BIGARRAY bound lowered so the big key shards
+    # (reference dist_sync_kvstore.py:17 big_shape, closed-form invariant)
+    proc = _run_launch(4, 2, os.path.join(REPO, "tests", "dist_check_script.py"),
+                       extra_env={"MXNET_KVSTORE_BIGARRAY_BOUND": "10000"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("DIST_OK") == 4, proc.stdout + proc.stderr
+
+
+def test_dist_async():
+    proc = _run_launch(2, 2, os.path.join(REPO, "tests", "dist_async_script.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("ASYNC_OK") == 2, proc.stdout + proc.stderr
+
+
+def test_dead_node_detection():
+    proc = _run_launch(
+        2, 1, os.path.join(REPO, "tests", "dist_dead_node_script.py"),
+        extra_env={"MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.5",
+                   "MXNET_KVSTORE_DEAD_TIMEOUT": "3"})
+    assert "DEAD_DETECTED" in proc.stdout, proc.stdout + proc.stderr
+    assert "BARRIER_PASSED_UNEXPECTEDLY" not in proc.stdout, proc.stdout
