@@ -452,11 +452,17 @@ class Executor:
         return self._step_seed
 
     def _compute_forward(self, is_train):
+        from . import profiler
+
+        compiled = is_train in self._jit_fwd
         fn = self._fwd_fn(is_train)
         args = self._place(self._gather_args())
         import numpy as _np
 
-        outs, aux_upd = fn(args, self._gather_aux(), _np.uint32(self._step_seed))
+        with profiler.span("forward(is_train=%s)%s"
+                           % (is_train, "" if compiled else " +compile"),
+                           cat="executor"):
+            outs, aux_upd = fn(args, self._gather_aux(), _np.uint32(self._step_seed))
         self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
         if is_train and not self._aux_applied:
             self._write_aux(aux_upd)
@@ -578,10 +584,13 @@ class Executor:
         diff_vals = tuple(all_vals[i] for i in diff_idx)
         nondiff_vals = tuple(all_vals[i] for i in nondiff_idx)
         state_tuples = tuple(tuple(l.data for l in leaves_by_name[n]) for n in diff_names)
-        outs, aux_upd, new_params, new_states = fn(
-            diff_vals, nondiff_vals, self._gather_aux(), state_tuples,
-            _np.uint32(self._step_seed), scalars,
-        )
+        from . import profiler
+
+        with profiler.span("fused_step(fwd+bwd+update)", cat="executor"):
+            outs, aux_upd, new_params, new_states = fn(
+                diff_vals, nondiff_vals, self._gather_aux(), state_tuples,
+                _np.uint32(self._step_seed), scalars,
+            )
         self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
         if not self._aux_applied:
             self._write_aux(aux_upd)
@@ -629,8 +638,11 @@ class Executor:
             heads = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads)
         import numpy as _np
 
-        outs, aux_upd, grads = fn(diff_vals, nondiff_vals, self._gather_aux(),
-                                  _np.uint32(self._step_seed), heads)
+        from . import profiler
+
+        with profiler.span("forward_backward", cat="executor"):
+            outs, aux_upd, grads = fn(diff_vals, nondiff_vals, self._gather_aux(),
+                                      _np.uint32(self._step_seed), heads)
         self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
         if not self._aux_applied:
             self._write_aux(aux_upd)

@@ -364,6 +364,23 @@ def _ctc_loss_one(lp, lab):
     return -(m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m)))
 
 
+def _infer_blockwise_attn(in_shapes, attrs):
+    return list(in_shapes), [in_shapes[0]]
+
+
+@register("_contrib_BlockwiseAttention", inputs=("query", "key", "value"),
+          infer_shape=_infer_blockwise_attn)
+def contrib_blockwise_attention(query, key, value, block_size=128,
+                                causal=False, **kw):
+    """Memory-efficient blockwise attention over (B, T, H, D) inputs —
+    the long-context kernel (see parallel/ring_attention.py; SURVEY §5
+    mandate).  O(T·block) live memory instead of O(T²)."""
+    from ..parallel.ring_attention import blockwise_attention
+
+    return blockwise_attention(query, key, value, int(_lit(block_size)),
+                               causal=_bool(causal))
+
+
 @register("_contrib_CTCLoss", inputs=("data", "label"), num_outputs=2,
           aliases=("_contrib_ctc_loss",), infer_shape=_infer_ctc)
 def ctc_loss(data, label, **kw):

@@ -1,0 +1,135 @@
+"""Ring attention — sequence/context parallelism over a 'seq' mesh axis.
+
+The survey-mandated long-context capability (SURVEY.md §5: "ring-attention/
+context-parallel sharding of attention over ICI"), absent from the 2017
+reference.  Design follows the ring-attention recipe: Q stays put, K/V
+shards rotate around the ring via `ppermute` (ICI neighbor exchange), and
+each step folds one K/V block into a numerically-stable online-softmax
+accumulator (flash-attention style), so peak memory is O(T_local²) per
+device instead of O(T²) and the sequence scales with the ring size.
+
+Two entry points:
+  * ring_attention(q, k, v, axis_name, ...)    — for use INSIDE shard_map
+  * ring_attention_sharded(mesh, q, k, v, ...) — host-level wrapper that
+    builds the shard_map over `seq_axis` (and batch over 'data' if present)
+
+Shapes: (batch, seq, heads, head_dim), seq sharded over `axis_name`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .collectives import shard_map
+from .mesh import NamedSharding, P
+
+__all__ = ["ring_attention", "ring_attention_sharded", "blockwise_attention"]
+
+
+def _attn_block(q, k_blk, v_blk, bias, o, l, m, scale):
+    """Fold one K/V block into the online-softmax state.
+
+    q (B,Tq,H,D); k_blk/v_blk (B,Tk,H,D); bias broadcastable (B,H,Tq,Tk)
+    or None; o (B,Tq,H,D) f32; l/m (B,H,Tq) f32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
+    return o_new, l_new, m_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Attention over the full (ring-distributed) sequence.
+
+    Call inside `shard_map` with the seq dim sharded over `axis_name`.
+    Each of the `n` ring steps computes one (T_local x T_local) block and
+    rotates K/V one hop (`lax.ppermute` — rides ICI on a TPU torus).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    neg = jnp.float32(-1e30)
+
+    perm = [(i, (i - 1) % n) for i in range(n)]  # receive the next block
+
+    def step(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        if causal:
+            # global block index currently held: (my + i) mod n
+            blk = (my + i) % n
+            q_pos = my * t + jnp.arange(t)
+            k_pos = blk * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, neg)[None, None]
+        else:
+            bias = None
+        o, l, m = _attn_block(q, k_blk, v_blk, bias, o, l, m, scale)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, l, m, k_blk, v_blk)
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), neg)
+    o, l, m, _, _ = lax.fori_loop(0, n, step, (o0, l0, m0, k, v))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(mesh, q, k, v, seq_axis="seq", batch_axis=None,
+                           causal=False, scale=None):
+    """Host-level ring attention: shards (B,T,H,D) arrays over the mesh and
+    runs the ring inside one shard_map-ped jit."""
+    batch = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(batch, seq_axis, None, None)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def f(qs, ks, vs):
+        return ring_attention(qs, ks, vs, seq_axis, causal=causal, scale=scale)
+
+    sh = NamedSharding(mesh, spec)
+    return f(jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh))
+
+
+def blockwise_attention(q, k, v, block_size, causal=False, scale=None):
+    """Single-device blockwise attention (lax.scan over K/V blocks with the
+    same online-softmax state) — the memory-efficient long-context kernel
+    for sequences that fit one chip but not O(T²) attention memory."""
+    b, t, h, d = q.shape
+    assert t % block_size == 0, (t, block_size)
+    nb = t // block_size
+    scale = (d ** -0.5) if scale is None else scale
+    neg = jnp.float32(-1e30)
+    kb = k.reshape(b, nb, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, nb, block_size, h, d).transpose(1, 0, 2, 3, 4)
+
+    def step(carry, blk):
+        o, l, m, i = carry
+        k_blk, v_blk = blk
+        if causal:
+            q_pos = jnp.arange(t)
+            k_pos = i * block_size + jnp.arange(block_size)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, neg)[None, None]
+        else:
+            bias = None
+        o, l, m = _attn_block(q, k_blk, v_blk, bias, o, l, m, scale)
+        return (o, l, m, i + 1), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), neg)
+    (o, l, m, _), _ = lax.scan(step, (o0, l0, m0, 0), (kb, vb))
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

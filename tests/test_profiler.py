@@ -1,0 +1,50 @@
+"""Profiler produces a non-empty chrome trace for the real training path
+(round-1 review: record_span had zero call sites — dump was always empty)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.io as mio
+from mxnet_tpu import profiler
+
+
+def test_profile_training_path(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 10).astype(np.float32)
+    y = rng.randint(0, 3, 64).astype(np.float32)
+    it = mio.NDArrayIter(X, y, batch_size=32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+
+    profiler.profiler_set_state("run")
+    for batch in it:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].asnumpy()
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert len(events) > 0
+    names = {e["name"] for e in events}
+    # the fused single-dispatch step and the eval forward both show up
+    assert any("fused_step" in n for n in names), names
+    assert any("forward" in n for n in names), names
+    # spans have sane timing fields
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0
+    assert os.path.exists(fname)
