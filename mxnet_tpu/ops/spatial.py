@@ -1,0 +1,225 @@
+"""Spatial operator family (reference src/operator/{grid_generator,
+bilinear_sampler,spatial_transformer,roi_pooling,correlation}*).
+
+All ops are pure jnp/lax code with static shapes: dynamic per-ROI/per-grid
+indexing becomes clipped gathers + masks, the correlation displacement loop
+unrolls over the (static) neighborhood grid, and everything differentiates
+through JAX AD (the reference hand-writes each backward kernel).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .tensor import _bool, _lit, _shape
+
+# ----------------------------------------------------------------------
+# GridGenerator (reference src/operator/grid_generator-inl.h:60-117)
+# ----------------------------------------------------------------------
+
+
+def _infer_grid(in_shapes, attrs):
+    data = in_shapes[0]
+    ttype = str(attrs.get("transform_type", "affine"))
+    if ttype == "affine":
+        h, w = _shape(attrs["target_shape"])
+        return [data], [(data[0], 2, h, w)]
+    b, _, h, w = data
+    return [data], [(b, 2, h, w)]
+
+
+@register("GridGenerator", inputs=("data",), infer_shape=_infer_grid)
+def grid_generator(data, transform_type="affine", target_shape=None, **kw):
+    """Generate a [-1,1]-normalized sampling grid from an affine matrix
+    (B,6) or an optical flow (B,2,H,W)."""
+    ttype = str(transform_type)
+    if ttype == "affine":
+        h, w = _shape(target_shape)
+        b = data.shape[0]
+        xs = -1.0 + jnp.arange(w, dtype=data.dtype) * (2.0 / (w - 1))
+        ys = -1.0 + jnp.arange(h, dtype=data.dtype) * (2.0 / (h - 1))
+        gx = jnp.broadcast_to(xs[None, :], (h, w)).reshape(-1)
+        gy = jnp.broadcast_to(ys[:, None], (h, w)).reshape(-1)
+        grid_dst = jnp.stack([gx, gy, jnp.ones_like(gx)])  # (3, H*W)
+        out = jnp.matmul(data.reshape(b, 2, 3), grid_dst)  # (B, 2, H*W)
+        return out.reshape(b, 2, h, w)
+    # warp: grid_src = (flow + dst_coords) / ((size-1)/2) - 1
+    b, _, h, w = data.shape
+    gx = jnp.broadcast_to(jnp.arange(w, dtype=data.dtype)[None, :], (h, w))
+    gy = jnp.broadcast_to(jnp.arange(h, dtype=data.dtype)[:, None], (h, w))
+    dst = jnp.stack([gx, gy])[None]  # (1, 2, H, W)
+    denom = jnp.asarray([(w - 1) / 2.0, (h - 1) / 2.0],
+                        data.dtype).reshape(1, 2, 1, 1)
+    return (data + dst) / denom - 1.0
+
+
+# ----------------------------------------------------------------------
+# BilinearSampler (reference src/operator/bilinear_sampler.cc:8-58)
+# ----------------------------------------------------------------------
+
+
+def _infer_sampler(in_shapes, attrs):
+    data, grid = in_shapes[0], in_shapes[1]
+    return list(in_shapes), [(data[0], data[1], grid[2], grid[3])]
+
+
+def _bilinear_sample(data, x_real, y_real):
+    """Sample data (B,C,H,W) at real pixel coords (B,Ho,Wo); OOB -> 0."""
+    b, c, h, w = data.shape
+    x0 = jnp.floor(x_real).astype(jnp.int32)
+    y0 = jnp.floor(y_real).astype(jnp.int32)
+    wx = 1.0 - (x_real - x0)  # top-left x weight
+    wy = 1.0 - (y_real - y0)
+
+    def tap(yy, xx):
+        valid = (xx >= 0) & (xx <= w - 1) & (yy >= 0) & (yy <= h - 1)
+        yc = jnp.clip(yy, 0, h - 1)
+        xc = jnp.clip(xx, 0, w - 1)
+        # gather per batch: (B,C,Ho,Wo)
+        v = data[jnp.arange(b)[:, None, None], :, yc, xc]  # (B,Ho,Wo,C)
+        v = jnp.moveaxis(v, -1, 1)
+        return v * valid[:, None].astype(data.dtype)
+
+    out = (tap(y0, x0) * (wy * wx)[:, None]
+           + tap(y0, x0 + 1) * (wy * (1 - wx))[:, None]
+           + tap(y0 + 1, x0) * ((1 - wy) * wx)[:, None]
+           + tap(y0 + 1, x0 + 1) * ((1 - wy) * (1 - wx))[:, None])
+    return out
+
+
+@register("BilinearSampler", inputs=("data", "grid"), infer_shape=_infer_sampler)
+def bilinear_sampler(data, grid, **kw):
+    """Sample data at grid ([-1,1] x/y channels); out-of-bounds reads 0."""
+    _, _, h, w = data.shape
+    x_real = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    y_real = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    return _bilinear_sample(data, x_real, y_real)
+
+
+# ----------------------------------------------------------------------
+# SpatialTransformer (reference src/operator/spatial_transformer-inl.h:
+# affine GridGenerator + BilinearSampler)
+# ----------------------------------------------------------------------
+
+
+def _infer_st(in_shapes, attrs):
+    data = in_shapes[0]
+    h, w = _shape(attrs["target_shape"])
+    return [data, (data[0], 6)], [(data[0], data[1], h, w)]
+
+
+@register("SpatialTransformer", inputs=("data", "loc"), infer_shape=_infer_st)
+def spatial_transformer(data, loc, target_shape=None, transform_type="affine",
+                        sampler_type="bilinear", **kw):
+    assert str(transform_type) == "affine" and str(sampler_type) == "bilinear"
+    grid = grid_generator(loc.astype(data.dtype), transform_type="affine",
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+# ----------------------------------------------------------------------
+# ROIPooling (reference src/operator/roi_pooling.cc:25-105)
+# ----------------------------------------------------------------------
+
+
+def _infer_roi(in_shapes, attrs):
+    data, rois = in_shapes[0], in_shapes[1]
+    ph, pw = _shape(attrs["pooled_size"])
+    return list(in_shapes), [(rois[0], data[1], ph, pw)]
+
+
+@register("ROIPooling", inputs=("data", "rois"), infer_shape=_infer_roi)
+def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0, **kw):
+    """Max-pool each ROI into a fixed (ph, pw) grid.  rois are (N, 5):
+    [batch_index, x1, y1, x2, y2] in image coordinates; boundaries follow
+    the reference rounding (round starts/ends, floor/ceil bin edges,
+    malformed ROIs forced to 1x1, empty bins emit 0)."""
+    ph, pw = _shape(pooled_size)
+    scale = float(_lit(spatial_scale))
+    b, c, h, w = data.shape
+    batch_ind = rois[:, 0].astype(jnp.int32)
+    start_w = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    start_h = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    end_w = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    end_h = jnp.round(rois[:, 4] * scale).astype(jnp.int32)
+    roi_h = jnp.maximum(end_h - start_h + 1, 1).astype(data.dtype)
+    roi_w = jnp.maximum(end_w - start_w + 1, 1).astype(data.dtype)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+    roi_data = data[jnp.clip(batch_ind, 0, b - 1)]  # (N, C, H, W)
+    hs = jnp.arange(h)
+    ws = jnp.arange(w)
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+    out_bins = []
+    for i in range(ph):
+        row = []
+        for j in range(pw):
+            hstart = jnp.clip(jnp.floor(i * bin_h).astype(jnp.int32) + start_h, 0, h)
+            hend = jnp.clip(jnp.ceil((i + 1) * bin_h).astype(jnp.int32) + start_h, 0, h)
+            wstart = jnp.clip(jnp.floor(j * bin_w).astype(jnp.int32) + start_w, 0, w)
+            wend = jnp.clip(jnp.ceil((j + 1) * bin_w).astype(jnp.int32) + start_w, 0, w)
+            hmask = (hs[None, :] >= hstart[:, None]) & (hs[None, :] < hend[:, None])
+            wmask = (ws[None, :] >= wstart[:, None]) & (ws[None, :] < wend[:, None])
+            mask = (hmask[:, :, None] & wmask[:, None, :])[:, None]  # (N,1,H,W)
+            masked = jnp.where(mask, roi_data, neg)
+            mx = masked.max(axis=(2, 3))
+            empty = (hend <= hstart) | (wend <= wstart)
+            row.append(jnp.where(empty[:, None], 0.0, mx))
+        out_bins.append(jnp.stack(row, axis=-1))
+    return jnp.stack(out_bins, axis=-2)  # (N, C, ph, pw)
+
+
+# ----------------------------------------------------------------------
+# Correlation (reference src/operator/correlation.cc:22-62, -inl.h:79-97)
+# ----------------------------------------------------------------------
+
+
+def _corr_geometry(h, w, attrs):
+    ks = int(_lit(attrs.get("kernel_size", 1)))
+    md = int(_lit(attrs.get("max_displacement", 1)))
+    s1 = int(_lit(attrs.get("stride1", 1)))
+    s2 = int(_lit(attrs.get("stride2", 1)))
+    pad = int(_lit(attrs.get("pad_size", 0)))
+    kr = (ks - 1) // 2
+    border = md + kr
+    top_h = -((h + 2 * pad - border * 2) // -s1)
+    top_w = -((w + 2 * pad - border * 2) // -s1)
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    return ks, md, s1, s2, pad, kr, border, top_h, top_w, ngr, ngw
+
+
+def _infer_corr(in_shapes, attrs):
+    d1 = in_shapes[0]
+    _, _, _, _, _, _, _, th, tw, _, ngw = _corr_geometry(d1[2], d1[3], attrs)
+    return list(in_shapes), [(d1[0], ngw * ngw, th, tw)]
+
+
+@register("Correlation", inputs=("data1", "data2"), infer_shape=_infer_corr)
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True, **kw):
+    """FlowNet correlation layer: one output channel per displacement in
+    the (2r+1)^2 neighborhood; patch products (or |diff|) averaged over
+    kernel window x channels."""
+    attrs = {"kernel_size": kernel_size, "max_displacement": max_displacement,
+             "stride1": stride1, "stride2": stride2, "pad_size": pad_size}
+    b, c, h, w = data1.shape
+    ks, md, s1, s2, pad, kr, border, th, tw, ngr, ngw = _corr_geometry(h, w, attrs)
+    mult = _bool(is_multiply)
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    sumelems = ks * ks * c
+    chans = []
+    for tc in range(ngw * ngw):
+        dx = (tc % ngw - ngr) * s2
+        dy = (tc // ngw - ngr) * s2
+        shifted = jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+        cmap = (p1 * shifted if mult else jnp.abs(p1 - shifted)).sum(axis=1)
+        # kernel-window sum then subsample at y1 = i*s1 + md (window start)
+        if ks > 1:
+            cmap = lax.reduce_window(cmap, 0.0, lax.add, (1, ks, ks),
+                                     (1, 1, 1), "VALID")
+        sub = cmap[:, md:md + th * s1:s1, md:md + tw * s1:s1]
+        chans.append(sub / sumelems)
+    return jnp.stack(chans, axis=1)

@@ -657,6 +657,13 @@ COVERED_ELSEWHERE = {
     "uniform", "normal", "random_gamma", "random_exponential",
     "random_poisson", "random_negative_binomial",
     "random_generalized_negative_binomial",
+    # test_spatial_ops.py
+    "GridGenerator", "BilinearSampler", "SpatialTransformer", "ROIPooling",
+    "Correlation",
+    # test_rnn.py / test_bucketing_lstm.py
+    "RNN",
+    # test_ring_attention.py
+    "_contrib_BlockwiseAttention",
 }
 
 TABLE_COVERED = (

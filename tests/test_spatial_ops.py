@@ -1,0 +1,212 @@
+"""Spatial op family vs transcribed numpy oracles of the reference CPU
+kernels (grid_generator-inl.h, bilinear_sampler.cc, roi_pooling.cc,
+correlation.cc) and torch grid_sample/affine_grid where semantics align."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+S = mx.sym
+
+
+def _run(sym, args, grad_for=None):
+    nd_args = {k: mx.nd.array(v) for k, v in args.items()}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    ex = sym.bind(mx.cpu(), nd_args, args_grad=grads)
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    if grad_for:
+        ex.backward(mx.nd.ones(out.shape))
+        return out, {k: ex.grad_dict[k].asnumpy() for k in grad_for}
+    return out, None
+
+
+def _np_affine_grid(theta, h, w):
+    b = theta.shape[0]
+    xs = -1 + np.arange(w) * 2.0 / (w - 1)
+    ys = -1 + np.arange(h) * 2.0 / (h - 1)
+    gx, gy = np.meshgrid(xs, ys)
+    dst = np.stack([gx.ravel(), gy.ravel(), np.ones(h * w)])  # (3, HW)
+    return (theta.reshape(b, 2, 3) @ dst).reshape(b, 2, h, w)
+
+
+def _np_bilinear(data, grid):
+    b, c, h, w = data.shape
+    _, _, oh, ow = grid.shape
+    out = np.zeros((b, c, oh, ow), np.float32)
+    for n in range(b):
+        for i in range(oh):
+            for j in range(ow):
+                x = (grid[n, 0, i, j] + 1) * (w - 1) / 2
+                y = (grid[n, 1, i, j] + 1) * (h - 1) / 2
+                x0, y0 = int(math.floor(x)), int(math.floor(y))
+                wx, wy = 1 - (x - x0), 1 - (y - y0)
+                for dy, dx, wt in [(0, 0, wy * wx), (0, 1, wy * (1 - wx)),
+                                   (1, 0, (1 - wy) * wx), (1, 1, (1 - wy) * (1 - wx))]:
+                    yy, xx = y0 + dy, x0 + dx
+                    if 0 <= yy <= h - 1 and 0 <= xx <= w - 1:
+                        out[n, :, i, j] += data[n, :, yy, xx] * wt
+    return out
+
+
+def test_grid_generator_affine_and_warp():
+    rng = np.random.RandomState(0)
+    theta = rng.uniform(-1, 1, (2, 6)).astype(np.float32)
+    out, _ = _run(S.GridGenerator(S.Variable("d"), transform_type="affine",
+                                  target_shape=(4, 5)), {"d": theta})
+    np.testing.assert_allclose(out, _np_affine_grid(theta, 4, 5),
+                               rtol=1e-5, atol=1e-6)
+    flow = rng.uniform(-1, 1, (2, 2, 3, 4)).astype(np.float32)
+    out, _ = _run(S.GridGenerator(S.Variable("d"), transform_type="warp"),
+                  {"d": flow})
+    gx, gy = np.meshgrid(np.arange(4), np.arange(3))
+    dst = np.stack([gx, gy])[None]
+    exp = (flow + dst) / np.array([(4 - 1) / 2, (3 - 1) / 2]).reshape(1, 2, 1, 1) - 1
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_sampler_vs_oracle_and_torch():
+    rng = np.random.RandomState(1)
+    data = rng.rand(2, 3, 5, 6).astype(np.float32)
+    grid = rng.uniform(-1.3, 1.3, (2, 2, 4, 4)).astype(np.float32)
+    out, grads = _run(S.BilinearSampler(S.Variable("d"), S.Variable("g")),
+                      {"d": data, "g": grid}, grad_for=["d", "g"])
+    np.testing.assert_allclose(out, _np_bilinear(data, grid), rtol=1e-4,
+                               atol=1e-5)
+    torch = pytest.importorskip("torch")
+    tg = torch.tensor(np.moveaxis(grid, 1, -1))  # torch wants (B,Ho,Wo,2)
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(data), tg, mode="bilinear", padding_mode="zeros",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    assert np.abs(grads["d"]).max() > 0 and np.abs(grads["g"]).max() > 0
+
+
+def test_spatial_transformer_identity_and_torch():
+    rng = np.random.RandomState(2)
+    data = rng.rand(2, 3, 6, 6).astype(np.float32)
+    ident = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out, _ = _run(S.SpatialTransformer(S.Variable("d"), S.Variable("loc"),
+                                       target_shape=(6, 6),
+                                       transform_type="affine",
+                                       sampler_type="bilinear"),
+                  {"d": data, "loc": ident})
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-5)
+    theta = (ident + rng.uniform(-0.2, 0.2, (2, 6))).astype(np.float32)
+    out, _ = _run(S.SpatialTransformer(S.Variable("d"), S.Variable("loc"),
+                                       target_shape=(4, 5),
+                                       transform_type="affine",
+                                       sampler_type="bilinear"),
+                  {"d": data, "loc": theta})
+    torch = pytest.importorskip("torch")
+    tgrid = torch.nn.functional.affine_grid(
+        torch.tensor(theta.reshape(2, 2, 3)), (2, 3, 4, 5), align_corners=True)
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(data), tgrid, mode="bilinear", padding_mode="zeros",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def _np_roi_pool(data, rois, pooled, scale):
+    b, c, h, w = data.shape
+    ph, pw = pooled
+    n = rois.shape[0]
+    out = np.zeros((n, c, ph, pw), np.float32)
+    for r in range(n):
+        bi = int(rois[r, 0])
+        sw, sh = int(round(rois[r, 1] * scale)), int(round(rois[r, 2] * scale))
+        ew, eh = int(round(rois[r, 3] * scale)), int(round(rois[r, 4] * scale))
+        rh, rw = max(eh - sh + 1, 1), max(ew - sw + 1, 1)
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(int(math.floor(i * rh / ph)) + sh, 0), h)
+                he = min(max(int(math.ceil((i + 1) * rh / ph)) + sh, 0), h)
+                ws_ = min(max(int(math.floor(j * rw / pw)) + sw, 0), w)
+                we = min(max(int(math.ceil((j + 1) * rw / pw)) + sw, 0), w)
+                if he <= hs or we <= ws_:
+                    out[r, :, i, j] = 0
+                else:
+                    out[r, :, i, j] = data[bi, :, hs:he, ws_:we].max(axis=(1, 2))
+    return out
+
+
+def test_roi_pooling_vs_oracle():
+    rng = np.random.RandomState(3)
+    data = rng.randn(2, 4, 12, 16).astype(np.float32)
+    rois = np.array([
+        [0, 0, 0, 7, 5],
+        [0, 4, 2, 15, 11],
+        [1, 1, 1, 10, 10],
+        [1, 6, 6, 6, 6],   # degenerate 1x1 ROI
+    ], np.float32)
+    sym = S.ROIPooling(S.Variable("d"), S.Variable("r"), pooled_size=(3, 3),
+                       spatial_scale=1.0)
+    out, grads = _run(sym, {"d": data, "r": rois}, grad_for=["d"])
+    np.testing.assert_allclose(out, _np_roi_pool(data, rois, (3, 3), 1.0),
+                               rtol=1e-5, atol=1e-6)
+    assert np.abs(grads["d"]).max() > 0
+    # spatial_scale path
+    sym2 = S.ROIPooling(S.Variable("d"), S.Variable("r"), pooled_size=(2, 2),
+                        spatial_scale=0.5)
+    out2, _ = _run(sym2, {"d": data, "r": rois * np.array([1, 2, 2, 2, 2])})
+    np.testing.assert_allclose(
+        out2, _np_roi_pool(data, rois * np.array([1, 2, 2, 2, 2]), (2, 2), 0.5),
+        rtol=1e-5, atol=1e-6)
+
+
+def _np_correlation(d1, d2, ks, md, s1, s2, pad, mult):
+    b, c, h, w = d1.shape
+    kr = (ks - 1) // 2
+    border = md + kr
+    th = math.ceil((h + 2 * pad - 2 * border) / s1)
+    tw = math.ceil((w + 2 * pad - 2 * border) / s1)
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((b, ngw * ngw, th, tw), np.float32)
+    sumelems = ks * ks * c
+    for n in range(b):
+        for i in range(th):
+            for j in range(tw):
+                x1, y1 = j * s1 + md, i * s1 + md
+                for tc in range(ngw * ngw):
+                    dx = (tc % ngw - ngr) * s2
+                    dy = (tc // ngw - ngr) * s2
+                    acc = 0.0
+                    for hh in range(ks):
+                        for ww in range(ks):
+                            a = p1[n, :, y1 + hh, x1 + ww]
+                            bb = p2[n, :, y1 + dy + hh, x1 + dx + ww]
+                            acc += (a * bb).sum() if mult else np.abs(a - bb).sum()
+                    out[n, tc, i, j] = acc / sumelems
+    return out
+
+
+@pytest.mark.parametrize("mult", [True, False])
+def test_correlation_vs_oracle(mult):
+    rng = np.random.RandomState(4)
+    d1 = rng.randn(2, 3, 8, 8).astype(np.float32)
+    d2 = rng.randn(2, 3, 8, 8).astype(np.float32)
+    sym = S.Correlation(S.Variable("a"), S.Variable("b"), kernel_size=1,
+                        max_displacement=2, stride1=1, stride2=1, pad_size=2,
+                        is_multiply=mult)
+    out, grads = _run(sym, {"a": d1, "b": d2}, grad_for=["a", "b"])
+    exp = _np_correlation(d1, d2, 1, 2, 1, 1, 2, mult)
+    assert out.shape == exp.shape
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+    assert np.abs(grads["a"]).max() > 0
+
+
+def test_correlation_kernel3_stride2():
+    rng = np.random.RandomState(5)
+    d1 = rng.randn(1, 2, 12, 12).astype(np.float32)
+    d2 = rng.randn(1, 2, 12, 12).astype(np.float32)
+    sym = S.Correlation(S.Variable("a"), S.Variable("b"), kernel_size=3,
+                        max_displacement=2, stride1=2, stride2=2, pad_size=3)
+    out, _ = _run(sym, {"a": d1, "b": d2})
+    exp = _np_correlation(d1, d2, 3, 2, 2, 2, 3, True)
+    assert out.shape == exp.shape
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
