@@ -38,6 +38,8 @@ from . import kvstore
 from . import kvstore as kv
 from .kvstore import KVStore
 from . import callback
+from . import predict
+from .predict import Predictor
 from . import monitor
 from . import monitor as mon
 from .monitor import Monitor

@@ -38,7 +38,8 @@ from .context import Context, cpu, current_context
 from .ops.registry import OP_REGISTRY, get_op
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
-           "concatenate", "load", "save", "waitall", "imresize", "onehot_encode"]
+           "concatenate", "load", "loads", "save", "waitall", "imresize",
+           "onehot_encode", "from_dlpack"]
 
 _DTYPE_ALIASES = {None: jnp.float32}
 
@@ -118,6 +119,23 @@ class NDArray:
 
     def __str__(self):
         return str(self.asnumpy())
+
+    # ------------------------------------------------------------------
+    # DLPack interop (reference include/mxnet/ndarray.h:401 SetDLTensor;
+    # zero-copy exchange with numpy/torch/jax ecosystems)
+    # ------------------------------------------------------------------
+    def __dlpack__(self, *args, **kwargs):
+        return self.data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self.data.__dlpack_device__()
+
+    def to_dlpack_for_read(self):
+        """The array itself — any DLPack consumer accepts it via
+        `from_dlpack(nd)` (capsule protocol)."""
+        return self
+
+    to_dlpack_for_write = to_dlpack_for_read
 
     # ------------------------------------------------------------------
     # host transfer / sync (reference WaitToRead / asnumpy)
@@ -477,6 +495,13 @@ def _split_save_arg(data):
     return keys, np_arrays
 
 
+def from_dlpack(ext_array, ctx=None):
+    """Zero-copy import of any DLPack-capable array (torch/numpy/jax/...)."""
+    from .context import current_context
+
+    return NDArray(jnp.from_dlpack(ext_array), ctx or current_context())
+
+
 def save(fname, data):
     """Save list or dict of NDArray (parity: python/mxnet/ndarray.py save)."""
     keys, np_arrays = _split_save_arg(data)
@@ -528,11 +553,23 @@ def _save_container_format(fname, keys, np_arrays):
 def load(fname):
     """Load NDArrays saved by :func:`save` or by reference MXNet's mx.nd.save."""
     with open(fname, "rb") as f:
-        magic = f.read(8)
-        if magic == _SAVE_MAGIC:
-            return _load_container_format(f)
-        if len(magic) == 8 and struct.unpack("<Q", magic)[0] == _NDLIST_MAGIC:
-            return _load_reference_format(f)
+        return _load_fileobj(f, fname)
+
+
+def loads(buf):
+    """Load NDArrays from raw bytes (the predict-API path: reference
+    MXPredCreate takes the .params file CONTENT, c_predict_api.cc:44)."""
+    import io
+
+    return _load_fileobj(io.BytesIO(buf), "<bytes>")
+
+
+def _load_fileobj(f, fname):
+    magic = f.read(8)
+    if magic == _SAVE_MAGIC:
+        return _load_container_format(f)
+    if len(magic) == 8 and struct.unpack("<Q", magic)[0] == _NDLIST_MAGIC:
+        return _load_reference_format(f)
     raise MXNetError(
         "Invalid NDArray file format in %s: neither the MXNet binary "
         "NDArray-list format (magic 0x112) nor the MXTPU001 container" % fname)

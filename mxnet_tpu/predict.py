@@ -1,0 +1,129 @@
+"""Deployment-only inference API.
+
+Parity: reference c_predict_api (src/c_api/c_predict_api.cc:44-265 —
+MXPredCreate / MXPredCreatePartialOut / MXPredSetInput / MXPredForward /
+MXPredPartialForward / MXPredGetOutput / MXPredReshape): load
+(symbol JSON + param bytes), bind a forward-only executor, run.  No
+optimizer / kvstore / module machinery is touched — this is the path an
+inference service embeds.
+
+Partial forward ≙ `output_names` / `output_layer`: the reference steps the
+graph node-by-node on the engine; under the one-XLA-executable design the
+equivalent is selecting internal entries as extra outputs (feature
+extraction), which compiles a prefix executable.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as nd
+from . import symbol as sym
+from .base import MXNetError
+from .context import cpu, current_context
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """One bound inference session (reference PredictorHandle)."""
+
+    def __init__(self, symbol_json, param_bytes, input_shapes, ctx=None,
+                 output_names=None, type_dict=None):
+        """symbol_json: JSON string (or dict of a loaded graph);
+        param_bytes: raw .params file content (reference binary NDArray-list
+        ABI or the native container); input_shapes: {name: shape}."""
+        self._ctx = ctx or current_context()
+        net = sym.load_json(symbol_json) if isinstance(symbol_json, str) else symbol_json
+        if output_names:
+            internals = net.get_internals()
+            avail = internals.list_outputs()
+            picked = []
+            for name in output_names:
+                if name not in avail:
+                    raise MXNetError("output %r not found; internals: %s..."
+                                     % (name, avail[:20]))
+                picked.append(internals[name])
+            net = sym.Group(picked) if len(picked) > 1 else picked[0]
+        self._symbol = net
+        save_dict = nd.loads(param_bytes) if isinstance(param_bytes, bytes) \
+            else dict(param_bytes)
+        self._arg_params, self._aux_params = {}, {}
+        for k, v in save_dict.items():
+            if k.startswith("arg:"):
+                self._arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                self._aux_params[k[4:]] = v
+            else:  # plain names accepted too
+                self._arg_params[k] = v
+        self._bind(dict(input_shapes), type_dict)
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, ctx=None,
+                        output_names=None):
+        """Convenience: load prefix-symbol.json + prefix-%04d.params."""
+        with open("%s-symbol.json" % prefix) as f:
+            json_str = f.read()
+        with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+            params = f.read()
+        return cls(json_str, params, input_shapes, ctx=ctx,
+                   output_names=output_names)
+
+    def _bind(self, input_shapes, type_dict=None):
+        self._input_names = list(input_shapes)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes from %s" % input_shapes)
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in input_shapes:
+                args[name] = nd.zeros(shape, ctx=self._ctx)
+            elif name in self._arg_params:
+                p = self._arg_params[name]
+                if tuple(p.shape) != tuple(shape):
+                    raise MXNetError("param %s shape %s != expected %s"
+                                     % (name, p.shape, shape))
+                args[name] = p
+            elif name.endswith("label"):
+                # labels are dead inputs at inference; zero-fill (the
+                # reference predictor does the same for aux label args)
+                args[name] = nd.zeros(shape, ctx=self._ctx)
+            else:
+                raise MXNetError("missing parameter %s" % name)
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name not in self._aux_params:
+                raise MXNetError("missing aux state %s" % name)
+            aux[name] = self._aux_params[name]
+        self._exec = self._symbol.bind(self._ctx, args, args_grad=None,
+                                       grad_req="null", aux_states=aux)
+
+    # -- the C predict API surface --------------------------------------
+    def set_input(self, name, data):
+        """MXPredSetInput (c_predict_api.cc:243)."""
+        if name not in self._input_names:
+            raise MXNetError("unknown input %s (inputs: %s)"
+                             % (name, self._input_names))
+        self._exec.arg_dict[name][:] = _np.asarray(data, dtype=_np.float32)
+
+    def forward(self, **inputs):
+        """MXPredForward (c_predict_api.cc:258); inputs may be given inline."""
+        for name, data in inputs.items():
+            self.set_input(name, data)
+        self._exec.forward(is_train=False)
+        return self
+
+    def get_output(self, index=0):
+        """MXPredGetOutput → numpy."""
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self._exec.outputs)
+
+    def reshape(self, input_shapes):
+        """MXPredReshape (c_predict_api.cc:150-210): rebind with new input
+        shapes, parameters shared."""
+        self._bind(dict(input_shapes))
+        return self

@@ -1,0 +1,85 @@
+"""Predict/deploy API (c_predict_api parity) + DLPack interop."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.io as mio
+
+
+def _train_and_checkpoint(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(128, 1, 8, 8).astype(np.float32)
+    y = rng.randint(0, 4, 128).astype(np.float32)
+    it = mio.NDArrayIter(X, y, batch_size=32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="conv1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    return prefix, X, mod
+
+
+def test_predictor_matches_module(tmp_path):
+    prefix, X, mod = _train_and_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(prefix, 1,
+                                        {"data": (32, 1, 8, 8)}, ctx=mx.cpu())
+    out = pred.forward(data=X[:32]).get_output(0)
+    it = mio.NDArrayIter(X[:32], None, batch_size=32)
+    batch = next(iter(it))
+    mod.forward(batch, is_train=False)
+    mod_out = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, mod_out, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_partial_forward_features(tmp_path):
+    # feature extraction = partial forward (MXPredCreatePartialOut analog)
+    prefix, X, _ = _train_and_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 1, {"data": (4, 1, 8, 8)}, ctx=mx.cpu(),
+        output_names=["relu1_output", "fc1_output"])
+    pred.forward(data=X[:4])
+    assert pred.num_outputs == 2
+    feats = pred.get_output(0)
+    logits = pred.get_output(1)
+    assert feats.shape == (4, 4, 6, 6)
+    assert logits.shape == (4, 4)
+
+
+def test_predictor_reshape(tmp_path):
+    prefix, X, _ = _train_and_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(prefix, 1, {"data": (4, 1, 8, 8)},
+                                        ctx=mx.cpu())
+    a = pred.forward(data=X[:4]).get_output(0)
+    pred.reshape({"data": (32, 1, 8, 8)})
+    b = pred.forward(data=X[:32]).get_output(0)
+    np.testing.assert_allclose(a, b[:4], rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_from_bytes(tmp_path):
+    prefix, X, _ = _train_and_checkpoint(tmp_path)
+    with open(prefix + "-symbol.json") as f:
+        js = f.read()
+    with open(prefix + "-0001.params", "rb") as f:
+        raw = f.read()
+    pred = mx.Predictor(js, raw, {"data": (2, 1, 8, 8)}, ctx=mx.cpu())
+    out = pred.forward(data=X[:2]).get_output(0)
+    assert out.shape == (2, 4) and np.isfinite(out).all()
+
+
+def test_dlpack_torch_and_numpy():
+    torch = pytest.importorskip("torch")
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4), ctx=mx.cpu())
+    t = torch.from_dlpack(x)
+    np.testing.assert_array_equal(t.numpy(), x.asnumpy())
+    n = np.from_dlpack(x)
+    np.testing.assert_array_equal(n, x.asnumpy())
+    # round trip from torch
+    src = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    back = mx.nd.from_dlpack(src)
+    np.testing.assert_array_equal(back.asnumpy(), src.numpy())
