@@ -23,11 +23,12 @@ def _mlp(num_classes=3):
 
 
 def test_module_fit_converges():
+    mx.random.seed(7)  # init + shuffle draw from the host RNG
     X, y = _toy_data()
     train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
     val = mx.io.NDArrayIter(X, y, batch_size=32)
     mod = mx.mod.Module(_mlp(), context=mx.cpu())
-    mod.fit(train, eval_data=val, optimizer="sgd",
+    mod.fit(train, eval_data=val, optimizer="sgd", initializer=mx.init.Xavier(),
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9}, num_epoch=5)
     score = mod.score(val, "acc")
     assert score[0][1] > 0.95, score
