@@ -40,6 +40,7 @@ from .kvstore import KVStore
 from . import callback
 from . import predict
 from .predict import Predictor
+from . import image
 from . import monitor
 from . import monitor as mon
 from .monitor import Monitor

@@ -1,0 +1,171 @@
+"""ImageDetRecordIter — detection record pipeline with bbox-aware
+augmenters (parity: reference src/io/iter_image_det_recordio.cc +
+image_det_aug_default.cc).
+
+Record label layout (the im2rec detection-list convention the reference
+parser reads): [header_width A, object_width B, <A-2 extra header floats>,
+then per object: id, xmin, ymin, xmax, ymax, <B-5 extras>] with
+coordinates normalized to [0, 1].  Batch labels are (batch, max_objects,
+object_width) padded with -1 — exactly what _contrib_MultiBoxTarget
+consumes (SSD training path, BASELINE config 4).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import array
+from .recordio import _decode_img, unpack
+
+__all__ = ["ImageDetRecordIterImpl"]
+
+
+def _parse_det_label(flat):
+    flat = _np.asarray(flat, _np.float32).reshape(-1)
+    a = int(flat[0])
+    b = int(flat[1])
+    objs = flat[a:]
+    if objs.size % b:
+        raise MXNetError("malformed detection label: %d floats, width %d"
+                         % (objs.size, b))
+    return objs.reshape(-1, b), b
+
+
+def _flip_boxes(objs):
+    out = objs.copy()
+    out[:, 1] = 1.0 - objs[:, 3]
+    out[:, 3] = 1.0 - objs[:, 1]
+    return out
+
+
+def _crop_boxes(objs, x0, y0, cw, ch, emit_center=True):
+    """Adjust normalized boxes for a crop window (also normalized); keep
+    objects whose center stays inside (image_det_aug_default.cc emit rule)."""
+    if objs.size == 0:
+        return objs
+    cx = (objs[:, 1] + objs[:, 3]) / 2
+    cy = (objs[:, 2] + objs[:, 4]) / 2
+    keep = ((cx >= x0) & (cx <= x0 + cw) & (cy >= y0) & (cy <= y0 + ch)
+            if emit_center else _np.ones(len(objs), bool))
+    objs = objs[keep].copy()
+    objs[:, 1] = _np.clip((objs[:, 1] - x0) / cw, 0, 1)
+    objs[:, 3] = _np.clip((objs[:, 3] - x0) / cw, 0, 1)
+    objs[:, 2] = _np.clip((objs[:, 2] - y0) / ch, 0, 1)
+    objs[:, 4] = _np.clip((objs[:, 4] - y0) / ch, 0, 1)
+    return objs
+
+
+class ImageDetRecordIterImpl(DataIter):
+    """Detection iterator over an im2rec-packed .rec with bbox labels."""
+
+    def __init__(self, path_imgrec=None, data_shape=None, batch_size=1,
+                 label_pad_width=None, label_pad_value=-1.0, shuffle=False,
+                 rand_mirror=False, rand_crop_prob=0.0, min_crop_scale=0.3,
+                 max_crop_scale=1.0, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, seed=0,
+                 data_name="data", label_name="label", part_index=0,
+                 num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        if path_imgrec is None or data_shape is None:
+            raise MXNetError("path_imgrec and data_shape are required")
+        from .native import NativeRecordReader, native_index
+
+        self.data_shape = tuple(data_shape)
+        self.shuffle = shuffle
+        self.rand_mirror = rand_mirror
+        self.rand_crop_prob = rand_crop_prob
+        self.crop_scale = (min_crop_scale, max_crop_scale)
+        self.mean = _np.array([mean_r, mean_g, mean_b], _np.float32)
+        self.std = _np.array([std_r, std_g, std_b], _np.float32)
+        self.scale = scale
+        self._rng = _np.random.RandomState(seed)
+        self._reader = NativeRecordReader(path_imgrec)
+        self._offsets = native_index(path_imgrec)[part_index::num_parts]
+        if not self._offsets:
+            raise MXNetError("no records in %s" % path_imgrec)
+        # first pass: find max objects + object width for padding
+        self._obj_width = None
+        max_objs = 0
+        for off in self._offsets:
+            header, _ = unpack(self._reader.read_at(off))
+            objs, bw = _parse_det_label(header.label)
+            max_objs = max(max_objs, len(objs))
+            if self._obj_width is None:
+                self._obj_width = bw
+            elif self._obj_width != bw:
+                raise MXNetError("inconsistent object widths in %s" % path_imgrec)
+        self.max_objects = max(label_pad_width or 0, max_objs, 1)
+        self.label_pad_value = float(label_pad_value)
+        self.data_name, self.label_name = data_name, label_name
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(
+            label_name, (batch_size, self.max_objects, self._obj_width))]
+        self._order = None
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        self._order = _np.arange(len(self._offsets))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def _load_one(self, off):
+        header, payload = unpack(self._reader.read_at(off))
+        objs, _ = _parse_det_label(header.label)
+        img = _np.asarray(_decode_img(payload))
+        if img.ndim == 2:
+            img = img[:, :, None]
+        img = img.astype(_np.float32)
+        # bbox-aware random crop (image_det_aug_default.cc crop samplers)
+        if self.rand_crop_prob > 0 and self._rng.rand() < self.rand_crop_prob:
+            s = self._rng.uniform(*self.crop_scale)
+            cw, ch = s, s
+            x0 = self._rng.uniform(0, 1 - cw)
+            y0 = self._rng.uniform(0, 1 - ch)
+            h, w = img.shape[:2]
+            px0, py0 = int(x0 * w), int(y0 * h)
+            pw, ph_ = max(int(cw * w), 1), max(int(ch * h), 1)
+            img = img[py0:py0 + ph_, px0:px0 + pw]
+            objs = _crop_boxes(objs, x0, y0, cw, ch)
+        # mirror flips boxes too (image_det_aug_default.cc HorizontalFlip)
+        if self.rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+            objs = _flip_boxes(objs)
+        c, th, tw = self.data_shape
+        try:
+            import cv2
+
+            img = cv2.resize(img, (tw, th))
+        except ImportError:
+            from PIL import Image
+
+            img = _np.asarray(
+                Image.fromarray(img.astype(_np.uint8)).resize((tw, th)),
+                _np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        img = (img - self.mean) / self.std * self.scale
+        return img.transpose(2, 0, 1), objs
+
+    def next(self):
+        n = len(self._offsets)
+        if self._cursor >= n:
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = _np.zeros((self.batch_size, c, h, w), _np.float32)
+        labels = _np.full((self.batch_size, self.max_objects, self._obj_width),
+                          self.label_pad_value, _np.float32)
+        pad = 0
+        for i in range(self.batch_size):
+            if self._cursor >= n:
+                pad = self.batch_size - i
+                break
+            img, objs = self._load_one(self._offsets[int(self._order[self._cursor])])
+            data[i] = img
+            k = min(len(objs), self.max_objects)
+            if k:
+                labels[i, :k] = objs[:k]
+            self._cursor += 1
+        return DataBatch(data=[array(data)], label=[array(labels)], pad=pad)

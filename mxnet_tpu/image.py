@@ -1,0 +1,358 @@
+"""mx.image — composable image pipeline (parity: reference
+python/mxnet/image.py: imdecode + augmenter closures :311-500 + ImageIter
+:502).  Augmenters are plain callables `aug(np.ndarray HWC float32) ->
+ndarray`; `CreateAugmenter` builds the reference's default list.  All
+host-side (numpy/cv2) — decode/augment happen on CPU feeding the device,
+as in the reference."""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import array
+from .recordio import MXIndexedRecordIO, MXRecordIO, _decode_img, unpack
+
+__all__ = [
+    "imdecode", "imread", "scale_down", "resize_short", "fixed_crop",
+    "random_crop", "center_crop", "color_normalize", "random_size_crop",
+    "ResizeAug", "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+    "CenterCropAug", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "ColorJitterAug", "LightingAug",
+    "ColorNormalizeAug", "HorizontalFlipAug", "CastAug", "CreateAugmenter",
+    "ImageIter",
+]
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode an image byte buffer to HWC uint8 (parity: image.py imdecode)."""
+    img = _decode_img(buf if isinstance(buf, bytes) else bytes(buf), iscolor=flag)
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def _resize(img, w, h, interp=1):
+    try:
+        import cv2
+
+        return cv2.resize(img, (w, h), interpolation=interp or 1)
+    except ImportError:  # PIL fallback
+        from PIL import Image
+
+        out = np.asarray(Image.fromarray(img.astype(np.uint8)).resize((w, h)))
+        return out
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit src_size (parity: image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize the shorter edge to `size` (parity: image.py resize_short)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return _resize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop (parity: image.py random_size_crop)."""
+    h, w = src.shape[:2]
+    area = h * w
+    for _ in range(10):
+        new_area = _pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = _pyrandom.uniform(*ratio)
+        new_w = int(round((new_area * new_ratio) ** 0.5))
+        new_h = int(round((new_area / new_ratio) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+# ----------------------------------------------------------------------
+# augmenter closures (parity: image.py:311-500)
+# ----------------------------------------------------------------------
+
+
+def ResizeAug(size, interp=2):
+    def aug(src):
+        return resize_short(src, size, interp)
+    return aug
+
+
+def ForceResizeAug(size, interp=2):
+    def aug(src):
+        return _resize(src, size[0], size[1], interp)
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return random_crop(src, size, interp)[0]
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return random_size_crop(src, size, min_area, ratio, interp)[0]
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return center_crop(src, size, interp)[0]
+    return aug
+
+
+def BrightnessJitterAug(brightness):
+    def aug(src):
+        alpha = 1.0 + _pyrandom.uniform(-brightness, brightness)
+        return src * alpha
+    return aug
+
+
+def ContrastJitterAug(contrast):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def aug(src):
+        alpha = 1.0 + _pyrandom.uniform(-contrast, contrast)
+        gray = (src * coef).sum() * (3.0 / src.size)
+        return src * alpha + gray * (1.0 - alpha)
+    return aug
+
+
+def SaturationJitterAug(saturation):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def aug(src):
+        alpha = 1.0 + _pyrandom.uniform(-saturation, saturation)
+        gray = (src * coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    augs = []
+    if brightness > 0:
+        augs.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        augs.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        augs.append(SaturationJitterAug(saturation))
+
+    def aug(src):
+        _pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+    return aug
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    """PCA lighting noise (parity: image.py LightingAug)."""
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(eigvec * alpha, eigval)
+        return src + rgb.astype(src.dtype)
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return color_normalize(src, np.asarray(mean, np.float32),
+                               np.asarray(std, np.float32) if std is not None else None)
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if _pyrandom.random() < p:
+            return src[:, ::-1]
+        return src
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return src.astype(np.float32)
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Default augmenter list (parity: image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and np.any(np.asarray(mean) > 0):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Pure-python image iterator over a .rec file or an image list
+    (parity: image.py ImageIter:502)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist is not None
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else CreateAugmenter(
+            self.data_shape, **kwargs)
+        self.imgrec = None
+        self.imglist = None
+        if path_imgrec:
+            self.imgrec = MXRecordIO(path_imgrec, "r")
+            self._records = []
+            while True:
+                raw = self.imgrec.read()
+                if raw is None:
+                    break
+                self._records.append(raw)
+        else:
+            entries = []
+            if imglist is not None:
+                for item in imglist:
+                    entries.append((np.asarray(item[0], np.float32).reshape(-1),
+                                    item[1]))
+            else:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        label = np.asarray([float(x) for x in parts[1:-1]],
+                                           np.float32)
+                        entries.append((label, os.path.join(path_root, parts[-1])))
+            self.imglist = entries
+        self._order = None
+        self._cursor = 0
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(
+            label_name,
+            (batch_size,) if label_width == 1 else (batch_size, label_width))]
+        self.data_name, self.label_name = data_name, label_name
+        self.reset()
+
+    def _num(self):
+        return len(self._records) if self.imgrec is not None else len(self.imglist)
+
+    def reset(self):
+        self._order = np.arange(self._num())
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        self._cursor = 0
+
+    def _read_one(self, idx):
+        if self.imgrec is not None:
+            header, payload = unpack(self._records[idx])
+            label = np.atleast_1d(np.asarray(header.label, np.float32))
+            img = imdecode(payload)
+        else:
+            label, src = self.imglist[idx]
+            img = imread(src) if isinstance(src, str) else np.asarray(src)
+        img = img.astype(np.float32)
+        for aug in self.auglist:
+            img = aug(img)
+        return img, label
+
+    def next(self):
+        n = self._num()
+        if self._cursor >= n:
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        labels = np.zeros((self.batch_size, self.label_width), np.float32)
+        pad = 0
+        for i in range(self.batch_size):
+            if self._cursor >= n:
+                pad = self.batch_size - i
+                break
+            img, label = self._read_one(int(self._order[self._cursor]))
+            data[i] = img.transpose(2, 0, 1)
+            labels[i, :] = label[:self.label_width]
+            self._cursor += 1
+        label_out = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch(data=[array(data)], label=[array(label_out)], pad=pad)

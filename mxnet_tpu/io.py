@@ -24,6 +24,7 @@ from .ndarray import NDArray, array
 __all__ = [
     "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
     "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
+    "ImageDetRecordIter",
 ]
 
 
@@ -454,3 +455,11 @@ def ImageRecordIter(**kwargs):
     from .image_io import ImageRecordIterImpl
 
     return ImageRecordIterImpl(**kwargs)
+
+
+def ImageDetRecordIter(**kwargs):
+    """Detection record iterator with bbox-aware augmentation
+    (reference src/io/iter_image_det_recordio.cc) — see det_io.py."""
+    from .det_io import ImageDetRecordIterImpl
+
+    return ImageDetRecordIterImpl(**kwargs)
