@@ -41,6 +41,7 @@ from . import callback
 from . import predict
 from .predict import Predictor
 from . import image
+from . import rtc
 from . import monitor
 from . import monitor as mon
 from .monitor import Monitor

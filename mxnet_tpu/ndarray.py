@@ -659,6 +659,10 @@ def _make_nd_function(op):
                 res_ctx = a.ctx
                 break
         res_ctx = ctx or res_ctx or current_context()
+        if op.params:
+            from .ops.params import validate_attrs
+
+            validate_attrs(op, kwargs)
         result = op.fn(*jax_args, **kwargs)
         n_main = op.num_outputs(kwargs) if callable(op.num_outputs) else op.num_outputs
         if isinstance(result, tuple):

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register
+from . import params as P
 from .tensor import _axis, _bool, _dtype, _lit, _shape
 
 # ----------------------------------------------------------------------
@@ -93,6 +94,8 @@ def _infer_fc(in_shapes, attrs):
     "FullyConnected",
     inputs=("data", "weight", "bias"),
     infer_shape=_infer_fc,
+    params={"num_hidden": P.Int(required=True, low=1, desc="output dimension"),
+            "no_bias": P.Bool(), "flatten": P.Bool()},
 )
 def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True, **kw):
     if _bool(flatten):
@@ -134,7 +137,12 @@ def _infer_conv(in_shapes, attrs):
     return shapes, [out]
 
 
-@register("Convolution", inputs=("data", "weight", "bias"), infer_shape=_infer_conv)
+@register("Convolution", inputs=("data", "weight", "bias"), infer_shape=_infer_conv,
+          params={"kernel": P.Shape(required=True, low=1, desc="conv kernel (h, w)"),
+                  "num_filter": P.Int(required=True, low=1, desc="number of output filters"),
+                  "stride": P.Shape(low=1), "pad": P.Shape(low=0),
+                  "dilate": P.Shape(low=1), "num_group": P.Int(default=1, low=1),
+                  "no_bias": P.Bool()})
 def convolution(
     data,
     weight,
@@ -279,7 +287,11 @@ def _infer_pool(in_shapes, attrs):
     return [data], [tuple(data[:2]) + spatial]
 
 
-@register("Pooling", infer_shape=_infer_pool, aliases=("Pooling_v1",))
+@register("Pooling", infer_shape=_infer_pool, aliases=("Pooling_v1",),
+          params={"kernel": P.Shape(low=1), "stride": P.Shape(low=1),
+                  "pad": P.Shape(low=0), "global_pool": P.Bool(),
+                  "pool_type": P.Enum(("max", "avg", "sum")),
+                  "pooling_convention": P.Enum(("valid", "full"))})
 def pooling(
     data, kernel=None, pool_type="max", stride=None, pad=None, global_pool=False,
     pooling_convention="valid", **kw
@@ -485,7 +497,10 @@ def softmax_activation(data, mode="instance", **kw):
 # ----------------------------------------------------------------------
 
 
-@register("Dropout", need_is_train=True, need_rng=True)
+@register("Dropout", need_is_train=True, need_rng=True,
+          params={"p": P.Float(default=0.5, low=0.0, high=1.0,
+                               desc="fraction zeroed"),
+                  "mode": P.Enum(("training", "always"))})
 def dropout(data, p=0.5, mode="training", is_train=False, rng=None, **kw):
     p = float(_lit(p))
     if (not is_train and str(mode) != "always") or p <= 0.0 or rng is None:

@@ -48,6 +48,7 @@ class Op:
         "need_rng",
         "variadic",
         "doc",
+        "params",
     )
 
     def __init__(
@@ -64,6 +65,7 @@ class Op:
         need_rng=False,
         variadic=False,
         doc="",
+        params=None,
     ):
         self.name = name
         self.fn = fn
@@ -77,6 +79,8 @@ class Op:
         self.need_rng = need_rng
         self.variadic = variadic
         self.doc = doc
+        # declarative parameter specs (dmlc::Parameter analog, ops/params.py)
+        self.params = params
 
 
 def register(name, **kwargs):

@@ -1,0 +1,104 @@
+"""Runtime kernel registration — the RTC analog.
+
+Parity: reference mx.rtc (include/mxnet/mxrtc.h:26, src/common/mxrtc.cc:
+117-140) compiles user CUDA source with NVRTC at runtime and launches it on
+NDArrays.  The TPU-native equivalent (SURVEY.md ⚙21 mapping) registers a
+user-supplied JAX-traceable function — plain jnp code or a Pallas kernel —
+as a first-class framework operator at runtime: it immediately appears as
+`mx.nd.<name>` and `mx.sym.<name>`, participates in jitted graphs, and
+differentiates through JAX AD (or a custom_vjp the user attaches).
+
+    import mxnet_tpu as mx
+    def scaled_add(a, b, scale=1.0, **kw):
+        return a + float(scale) * b
+    mx.rtc.register_kernel("scaled_add", scaled_add, inputs=("a", "b"))
+    out = mx.nd.scaled_add(x, y, scale=2.0)
+
+For hand-tiled TPU kernels pass a function built on jax.experimental.pallas
+(`pl.pallas_call`); the registration path is identical.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ops.registry import OP_REGISTRY, Op
+
+__all__ = ["register_kernel", "unregister_kernel", "Rtc"]
+
+
+def register_kernel(name, fn, inputs=("data",), num_outputs=1,
+                    infer_shape=None, aliases=(), need_is_train=False,
+                    need_rng=False, variadic=False, force=False):
+    """Register `fn(*arrays, **attrs) -> array(s)` as operator `name`.
+
+    The function must be JAX-traceable (jnp/lax/pallas).  Returns the Op.
+    """
+    if not callable(fn):
+        raise MXNetError("register_kernel needs a callable, got %r" % (fn,))
+    if name in OP_REGISTRY and not force:
+        raise MXNetError(
+            "operator %r already registered (pass force=True to replace)" % name)
+    op = Op(name, fn, inputs=inputs, num_outputs=num_outputs,
+            infer_shape=infer_shape, aliases=aliases,
+            need_is_train=need_is_train, need_rng=need_rng, variadic=variadic,
+            doc=fn.__doc__ or "runtime-registered kernel")
+    OP_REGISTRY[name] = op
+    for alias in aliases:
+        OP_REGISTRY[alias] = op
+    # surface on the generated namespaces immediately
+    from . import ndarray as _nd
+    from . import symbol as _sym
+    from .ndarray import _make_nd_function
+    from .symbol import _make_sym_function
+
+    for mod, maker in ((_nd, _make_nd_function), (_sym, _make_sym_function)):
+        f = maker(op)
+        for n in (name,) + tuple(aliases):
+            setattr(mod, n, f)
+    return op
+
+
+def unregister_kernel(name):
+    op = OP_REGISTRY.pop(name, None)
+    if op is None:
+        return False
+    for alias in op.aliases:
+        OP_REGISTRY.pop(alias, None)
+    from . import ndarray as _nd
+    from . import symbol as _sym
+
+    for mod in (_nd, _sym):
+        for n in (name,) + tuple(op.aliases):
+            if hasattr(mod, n):
+                delattr(mod, n)
+    return True
+
+
+class Rtc:
+    """API-compatibility shim for reference `mx.rtc.Rtc(name, inputs,
+    outputs, kernel)` (python/mxnet/rtc.py).  CUDA source cannot run on a
+    TPU; pass a python callable instead of a kernel string, or use
+    :func:`register_kernel`."""
+
+    def __init__(self, name, inputs, outputs, kernel):
+        if isinstance(kernel, str):
+            raise MXNetError(
+                "mx.rtc with CUDA source is not supported on TPU; pass a "
+                "JAX-traceable callable (jnp/lax/pallas) instead, or use "
+                "mx.rtc.register_kernel — see rtc.py docstring")
+        self._input_names = [i[0] if isinstance(i, (list, tuple)) else i
+                             for i in inputs]
+        self._op = register_kernel(name, kernel,
+                                   inputs=tuple(self._input_names), force=True)
+        self.name = name
+
+    def push(self, ins, outs, *grid_block):
+        """Run the kernel (reference Rtc.push; grid/block dims ignored —
+        XLA/Pallas own the scheduling)."""
+        from . import ndarray as _nd
+
+        fn = getattr(_nd, self.name)
+        res = fn(*ins)
+        res = res if isinstance(res, tuple) else (res,)
+        for o, r in zip(outs, res):
+            o[:] = r
+        return outs

@@ -493,6 +493,10 @@ def _create(op_name, input_syms, attrs, name=None, aux_syms=None):
     scope_attrs = attribute.current().get(None)
     full_attrs = dict(scope_attrs)
     full_attrs.update(attrs)
+    if op.params:
+        from .ops.params import validate_attrs
+
+        validate_attrs(op, full_attrs)
     inputs = []
     for s in input_syms:
         if len(s._entries) != 1:
