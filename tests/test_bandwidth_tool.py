@@ -1,0 +1,29 @@
+"""Comm bandwidth tool (reference tools/bandwidth/measure.py analog)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools",
+                                "bandwidth"))
+import measure  # noqa: E402
+
+
+def test_measure_device_allreduce_on_cpu_mesh():
+    res = measure.measure_device_allreduce([("a", 1 << 16), ("b", 1 << 14)],
+                                           num_iters=3)
+    assert res["devices"] >= 2
+    assert res["gbps_per_device"] > 0
+    assert res["bytes"] >= 4 * ((1 << 16) + (1 << 14)) * 0.9
+
+
+def test_measure_local_kvstore():
+    res = measure.measure_kvstore("local", [("a", 4096)], num_iters=2)
+    assert res["gbps_per_device"] > 0
+
+
+def test_param_sizes_resnet():
+    sizes = measure._param_sizes("resnet", 18)
+    total = sum(s for _, s in sizes)
+    # ResNet-18 has ~11.7M params
+    assert 10e6 < total < 14e6, total

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Communication bandwidth benchmark (parity: reference
+tools/bandwidth/measure.py — "GB/s per GPU per kvstore type", README:30-40).
+
+Measures the gradient-aggregation path for a model-sized parameter set:
+
+  * kv_store='device'    — ICI/XLA all-reduce over the device mesh (the
+    SPMD path that replaced CommDevice P2P reduction)
+  * kv_store='local'     — in-process KVStore push/pull façade
+  * kv_store='dist_sync' — TCP parameter-server push+pull (needs the
+    launcher env, tools/launch.py)
+
+Reports per-device algorithm bandwidth 2(n-1)/n * bytes / time — the
+convention the reference README uses, comparable to its ~11.1 GB/s
+resnet-200 number.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _param_sizes(network, num_layers):
+    """Parameter element-counts for a named model (no compute, just shapes)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    builders = {
+        "resnet": lambda: models.resnet.resnet(num_layers or 50),
+        "vgg": lambda: models.get_vgg(num_layers=num_layers or 16),
+        "alexnet": models.get_alexnet,
+        "inception-v3": models.get_inception_v3,
+        "lenet": models.get_lenet,
+        "mlp": models.get_mlp,
+    }
+    net = builders[network]()
+    image = (3, 299, 299) if network == "inception-v3" else (
+        (1, 28, 28) if network in ("lenet", "mlp") else (3, 224, 224))
+    if network == "mlp":
+        arg_shapes, _, _ = net.infer_shape(data=(1, 784))
+    else:
+        arg_shapes, _, _ = net.infer_shape(data=(1,) + image)
+    names = net.list_arguments()
+    return [(n, int(np.prod(s))) for n, s in zip(names, arg_shapes)
+            if n not in ("data", "softmax_label")]
+
+
+def measure_device_allreduce(sizes, num_iters=10, devices=None):
+    """All-reduce bandwidth over the mesh (the kvstore='device' data path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.collectives import mesh_allreduce
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+
+    devices = devices or jax.devices()
+    n = len(devices)
+    if n < 2:
+        raise RuntimeError("need >= 2 devices for allreduce bandwidth")
+    mesh = data_parallel_mesh(devices)
+    arrays = [jnp.zeros((n, max(1, sz // n)), jnp.float32) for _, sz in sizes]
+    total_bytes = sum(a.nbytes for a in arrays)
+
+    def run():
+        outs = mesh_allreduce(mesh, arrays)
+        jax.block_until_ready(outs)
+        np.asarray(outs[0]).ravel()[:1]  # real fence on tunneled backends
+
+    run()  # compile
+    t0 = time.time()
+    for _ in range(num_iters):
+        run()
+    dt = (time.time() - t0) / num_iters
+    algo_bytes = 2.0 * (n - 1) / n * total_bytes
+    return {"kv_store": "device", "devices": n, "bytes": total_bytes,
+            "time_s": dt, "gbps_per_device": algo_bytes / dt / 1e9}
+
+
+def measure_kvstore(kv_type, sizes, num_iters=10):
+    """Push+pull bandwidth through the KVStore API (local or dist_*)."""
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(kv_type)
+    arrays = [mx.nd.ones((sz,)) for _, sz in sizes]
+    outs = [mx.nd.zeros((sz,)) for _, sz in sizes]
+    for i, a in enumerate(arrays):
+        kv.init(i, a)
+    total_bytes = sum(4 * sz for _, sz in sizes)
+
+    def run():
+        for i, (a, o) in enumerate(zip(arrays, outs)):
+            kv.push(i, a)
+            kv.pull(i, o)
+        outs[0].wait_to_read()
+
+    run()
+    t0 = time.time()
+    for _ in range(num_iters):
+        run()
+    dt = (time.time() - t0) / num_iters
+    nw = getattr(kv, "num_workers", 1)
+    return {"kv_store": kv_type, "workers": nw, "bytes": total_bytes,
+            "time_s": dt, "gbps_per_device": 2.0 * total_bytes / dt / 1e9}
+
+
+def main():
+    parser = argparse.ArgumentParser(description="measure comm bandwidth")
+    parser.add_argument("--network", type=str, default="resnet")
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--kv-store", type=str, default="device",
+                        choices=["device", "local", "dist_sync", "dist_async"])
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--size-mb", type=float, default=0,
+                        help="override: one flat buffer of this size")
+    args = parser.parse_args()
+    if args.size_mb > 0:
+        sizes = [("flat", int(args.size_mb * 1e6 / 4))]
+    else:
+        sizes = _param_sizes(args.network, args.num_layers)
+    if args.kv_store == "device":
+        res = measure_device_allreduce(sizes, args.num_iters)
+    else:
+        res = measure_kvstore(args.kv_store, sizes, args.num_iters)
+    print("%s: %d params, %.1f MB, %.3f ms/round, %.2f GB/s per device"
+          % (res["kv_store"], len(sizes), res["bytes"] / 1e6,
+             res["time_s"] * 1e3, res["gbps_per_device"]))
+
+
+if __name__ == "__main__":
+    main()
