@@ -399,3 +399,287 @@ def ctc_loss(data, label, **kw):
 
     grad, losses = jax.grad(total, has_aux=True)(data)
     return losses, lax.stop_gradient(grad)
+
+
+# ----------------------------------------------------------------------
+# FFT / IFFT (reference src/operator/contrib/{fft,ifft}-inl.h — cuFFT
+# batched 1-D transforms; complex stored interleaved [re, im] in the last
+# dim, inverse unnormalized like cuFFT C2R)
+# ----------------------------------------------------------------------
+
+
+def _infer_fft(in_shapes, attrs):
+    d = in_shapes[0]
+    return [d], [tuple(d[:-1]) + (d[-1] * 2,)]
+
+
+@register("_contrib_fft", inputs=("data",), infer_shape=_infer_fft)
+def contrib_fft(data, compute_size=128, **kw):
+    """Batched 1-D FFT over the last dim; output interleaves [re, im]."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (data.shape[-1] * 2,)).astype(data.dtype)
+
+
+def _infer_ifft(in_shapes, attrs):
+    d = in_shapes[0]
+    return [d], [tuple(d[:-1]) + (d[-1] // 2,)]
+
+
+@register("_contrib_ifft", inputs=("data",), infer_shape=_infer_ifft)
+def contrib_ifft(data, compute_size=128, **kw):
+    """Inverse of _contrib_fft; UNNORMALIZED like cuFFT (ifft(fft(x)) ==
+    n*x — the reference told users to rescale manually)."""
+    n = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (n, 2)).astype(jnp.float32)
+    z = c[..., 0] + 1j * c[..., 1]
+    return (jnp.fft.ifft(z, axis=-1).real * n).astype(data.dtype)
+
+
+# ----------------------------------------------------------------------
+# quantize / dequantize (reference src/operator/contrib/quantize-inl.h,
+# dequantize-inl.h — affine uint8 quantization with explicit ranges)
+# ----------------------------------------------------------------------
+
+
+def _infer_quantize(in_shapes, attrs):
+    d = in_shapes[0]
+    return [d, (1,), (1,)], [d, (1,), (1,)]
+
+
+@register("_contrib_quantize", inputs=("data", "min_range", "max_range"),
+          num_outputs=3, infer_shape=_infer_quantize)
+def contrib_quantize(data, min_range, max_range, out_type="uint8", **kw):
+    """f32 -> uint8 with scale 255/(max-min) (quantize-inl.h:29-44)."""
+    scale = 255.0 / (max_range[0] - min_range[0])
+    q = jnp.floor((data - min_range[0]) * scale + 0.5)
+    q = jnp.clip(q, 0, 255).astype(jnp.uint8)
+    return lax.stop_gradient(q), min_range, max_range
+
+
+@register("_contrib_dequantize", inputs=("data", "min_range", "max_range"),
+          infer_shape=lambda s, a: (list(s), [s[0]]))
+def contrib_dequantize(data, min_range, max_range, out_type="float32", **kw):
+    scale = (max_range[0] - min_range[0]) / 255.0
+    return data.astype(jnp.float32) * scale + min_range[0]
+
+
+# ----------------------------------------------------------------------
+# CountSketch (reference src/operator/contrib/count_sketch-inl.h: random
+# feature projection out[b, h[i]] += s[i] * x[b, i])
+# ----------------------------------------------------------------------
+
+
+def _infer_count_sketch(in_shapes, attrs):
+    d = in_shapes[0]
+    out_dim = int(_lit(attrs["out_dim"]))
+    return list(in_shapes), [tuple(d[:-1]) + (out_dim,)]
+
+
+@register("_contrib_count_sketch", inputs=("data", "h", "s"),
+          infer_shape=_infer_count_sketch)
+def contrib_count_sketch(data, h, s, out_dim=None, processing_batch_size=32, **kw):
+    out_dim = int(_lit(out_dim))
+    lead = data.shape[:-1]
+    flat = data.reshape(-1, data.shape[-1])
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(flat.dtype)
+    out = jnp.zeros((flat.shape[0], out_dim), flat.dtype)
+    out = out.at[:, idx].add(flat * sign[None, :])
+    return out.reshape(lead + (out_dim,))
+
+
+# ----------------------------------------------------------------------
+# Proposal (reference src/operator/contrib/proposal.cc — RCNN region
+# proposals: shifted anchors + bbox deltas + clip + min-size filter +
+# score sort + greedy NMS, padded by cycling kept boxes)
+# ----------------------------------------------------------------------
+
+
+def _infer_proposal(in_shapes, attrs):
+    cls = in_shapes[0]
+    post = int(_lit(attrs.get("rpn_post_nms_top_n", 300)))
+    ins = list(in_shapes)
+    outs = [(post, 5)]
+    if _bool(attrs.get("output_score", False)):
+        outs.append((post, 1))
+    return ins, outs
+
+
+def _generate_anchors(base_size, ratios, scales):
+    """py-faster-rcnn anchor enumeration (proposal-inl.h:254-293)."""
+    import numpy as _onp
+
+    w = h = float(base_size)
+    x_ctr = 0.5 * (w - 1.0)
+    y_ctr = 0.5 * (h - 1.0)
+    size = w * h
+    out = []
+    for ratio in ratios:
+        size_ratio = _onp.floor(size / ratio)
+        new_w0 = _onp.floor(_onp.sqrt(size_ratio) + 0.5)
+        new_h0 = _onp.floor(new_w0 * ratio + 0.5)
+        for scale in scales:
+            nw, nh = new_w0 * scale, new_h0 * scale
+            out.append([x_ctr - 0.5 * (nw - 1), y_ctr - 0.5 * (nh - 1),
+                        x_ctr + 0.5 * (nw - 1), y_ctr + 0.5 * (nh - 1)])
+    return _onp.asarray(out, _onp.float32)
+
+
+@register("_contrib_Proposal",
+          inputs=("cls_prob", "bbox_pred", "im_info"),
+          num_outputs=lambda a: 2 if _bool(a.get("output_score", False)) else 1,
+          infer_shape=_infer_proposal)
+def contrib_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                     rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                     scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                     feature_stride=16, output_score=False, iou_loss=False,
+                     **kw):
+    """Single-batch RPN proposals (batch index column is 0)."""
+    fs = int(_lit(feature_stride))
+    pre_n = int(_lit(rpn_pre_nms_top_n))
+    post_n = int(_lit(rpn_post_nms_top_n))
+    min_size = float(_lit(rpn_min_size))
+    thresh = float(_lit(threshold))
+    num_anchors = cls_prob.shape[1] // 2
+    hgt, wid = cls_prob.shape[2], cls_prob.shape[3]
+    count = num_anchors * hgt * wid
+    pre_n = min(pre_n if pre_n > 0 else count, count)
+    post_n = min(post_n, pre_n)
+
+    base = jnp.asarray(_generate_anchors(fs, _floats(ratios), _floats(scales)))
+    shift_x = jnp.arange(wid, dtype=jnp.float32) * fs
+    shift_y = jnp.arange(hgt, dtype=jnp.float32) * fs
+    # index layout h*(W*A) + w*A + a (proposal.cc:330-341)
+    anchors = (base[None, None] + jnp.stack(
+        [jnp.broadcast_to(shift_x[None, :, None], (hgt, wid, 1)),
+         jnp.broadcast_to(shift_y[:, None, None], (hgt, wid, 1)),
+         jnp.broadcast_to(shift_x[None, :, None], (hgt, wid, 1)),
+         jnp.broadcast_to(shift_y[:, None, None], (hgt, wid, 1))], axis=-1)
+    ).reshape(-1, 4)
+    scores = jnp.transpose(cls_prob[0, num_anchors:], (1, 2, 0)).reshape(-1)
+    deltas = jnp.transpose(
+        bbox_pred[0].reshape(num_anchors, 4, hgt, wid), (2, 3, 0, 1)
+    ).reshape(-1, 4)
+    im_h, im_w, im_scale = im_info[0, 0], im_info[0, 1], im_info[0, 2]
+    # BBoxTransformInv (proposal.cc:18-72)
+    ws = anchors[:, 2] - anchors[:, 0] + 1.0
+    hs = anchors[:, 3] - anchors[:, 1] + 1.0
+    ctr_x = anchors[:, 0] + 0.5 * (ws - 1.0)
+    ctr_y = anchors[:, 1] + 0.5 * (hs - 1.0)
+    if _bool(iou_loss):
+        x1 = anchors[:, 0] + deltas[:, 0]
+        y1 = anchors[:, 1] + deltas[:, 1]
+        x2 = anchors[:, 2] + deltas[:, 2]
+        y2 = anchors[:, 3] + deltas[:, 3]
+    else:
+        pcx = deltas[:, 0] * ws + ctr_x
+        pcy = deltas[:, 1] * hs + ctr_y
+        pw = jnp.exp(deltas[:, 2]) * ws
+        ph = jnp.exp(deltas[:, 3]) * hs
+        x1, y1 = pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0)
+        x2, y2 = pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)
+    x1 = jnp.clip(x1, 0.0, im_w - 1.0)
+    y1 = jnp.clip(y1, 0.0, im_h - 1.0)
+    x2 = jnp.clip(x2, 0.0, im_w - 1.0)
+    y2 = jnp.clip(y2, 0.0, im_h - 1.0)
+    # padded grid positions beyond real im size are invalidated
+    real_h = (im_h / fs).astype(jnp.int32)
+    real_w = (im_w / fs).astype(jnp.int32)
+    gy = jnp.repeat(jnp.arange(hgt), wid * num_anchors)
+    gx = jnp.tile(jnp.repeat(jnp.arange(wid), num_anchors), hgt)
+    valid = (gy < real_h) & (gx < real_w)
+    # FilterBox (proposal.cc:126-139)
+    ms = min_size * im_scale
+    small = ((x2 - x1 + 1.0) < ms) | ((y2 - y1 + 1.0) < ms)
+    x1 = jnp.where(small, x1 - ms / 2, x1)
+    y1 = jnp.where(small, y1 - ms / 2, y1)
+    x2 = jnp.where(small, x2 + ms / 2, x2)
+    y2 = jnp.where(small, y2 + ms / 2, y2)
+    scores = jnp.where(small | ~valid, -1.0, scores)
+    order = jnp.argsort(-scores, stable=True)[:pre_n]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)[order]
+    s_sorted = scores[order]
+    # greedy NMS (proposal.cc:195-246): +1 area convention, keep order
+    area = (boxes[:, 2] - boxes[:, 0] + 1.0) * (boxes[:, 3] - boxes[:, 1] + 1.0)
+
+    def body(i, kept):
+        iw = jnp.maximum(0.0, jnp.minimum(boxes[:, 2], boxes[i, 2])
+                         - jnp.maximum(boxes[:, 0], boxes[i, 0]) + 1.0)
+        ih = jnp.maximum(0.0, jnp.minimum(boxes[:, 3], boxes[i, 3])
+                         - jnp.maximum(boxes[:, 1], boxes[i, 1]) + 1.0)
+        inter = iw * ih
+        iou = inter / (area + area[i] - inter)
+        sup = kept & (jnp.arange(pre_n) > i) & (iou > thresh)
+        return kept & ~(sup & kept[i])
+
+    kept = lax.fori_loop(0, pre_n, body, jnp.ones((pre_n,), bool))
+    # also honor post_n truncation during NMS (out_size cap)
+    rank = jnp.cumsum(kept.astype(jnp.int32)) - 1
+    kept = kept & (rank < post_n)
+    n_kept = jnp.maximum(kept.sum(), 1)
+    slots = jnp.zeros((pre_n,), jnp.int32).at[
+        jnp.where(kept, rank, pre_n - 1)].set(jnp.arange(pre_n, dtype=jnp.int32))
+    pick = slots[jnp.arange(post_n, dtype=jnp.int32) % n_kept]
+    rois = jnp.concatenate([jnp.zeros((post_n, 1)), boxes[pick]], axis=1)
+    rois = lax.stop_gradient(rois)
+    if _bool(output_score):
+        return rois, lax.stop_gradient(s_sorted[pick][:, None])
+    return rois
+
+
+# ----------------------------------------------------------------------
+# PSROIPooling (reference src/operator/contrib/psroi_pooling-inl.h /
+# .cu — R-FCN position-sensitive ROI average pooling; the reference CPU
+# kernel is NOT_IMPLEMENTED, semantics follow the CUDA kernel)
+# ----------------------------------------------------------------------
+
+
+def _infer_psroi(in_shapes, attrs):
+    data, rois = in_shapes
+    od = int(_lit(attrs["output_dim"]))
+    ps = int(_lit(attrs["pooled_size"]))
+    return list(in_shapes), [(rois[0], od, ps, ps)]
+
+
+@register("_contrib_PSROIPooling", inputs=("data", "rois"),
+          infer_shape=_infer_psroi)
+def contrib_psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                          pooled_size=None, group_size=0, **kw):
+    scale = float(_lit(spatial_scale))
+    od = int(_lit(output_dim))
+    ps = int(_lit(pooled_size))
+    gs = int(_lit(group_size)) or ps
+    b, c, h, w = data.shape
+    assert c == od * gs * gs, (c, od, gs)
+    batch_ind = jnp.clip(rois[:, 0].astype(jnp.int32), 0, b - 1)
+    start_w = jnp.round(rois[:, 1]) * scale
+    start_h = jnp.round(rois[:, 2]) * scale
+    end_w = jnp.round(rois[:, 3] + 1.0) * scale
+    end_h = jnp.round(rois[:, 4] + 1.0) * scale
+    roi_h = jnp.maximum(end_h - start_h, 0.1)
+    roi_w = jnp.maximum(end_w - start_w, 0.1)
+    bin_h = roi_h / ps
+    bin_w = roi_w / ps
+    roi_data = data[batch_ind].reshape(-1, od, gs, gs, h, w)
+    hsr = jnp.arange(h)
+    wsr = jnp.arange(w)
+    rows = []
+    for i in range(ps):
+        cols = []
+        for j in range(ps):
+            hstart = jnp.clip(jnp.floor(i * bin_h + start_h).astype(jnp.int32), 0, h)
+            hend = jnp.clip(jnp.ceil((i + 1) * bin_h + start_h).astype(jnp.int32), 0, h)
+            wstart = jnp.clip(jnp.floor(j * bin_w + start_w).astype(jnp.int32), 0, w)
+            wend = jnp.clip(jnp.ceil((j + 1) * bin_w + start_w).astype(jnp.int32), 0, w)
+            hmask = (hsr[None] >= hstart[:, None]) & (hsr[None] < hend[:, None])
+            wmask = (wsr[None] >= wstart[:, None]) & (wsr[None] < wend[:, None])
+            mask = (hmask[:, :, None] & wmask[:, None, :]).astype(data.dtype)
+            gh = min(i * gs // ps, gs - 1)
+            gw = min(j * gs // ps, gs - 1)
+            plane = roi_data[:, :, gh, gw]  # (N, od, H, W)
+            summed = (plane * mask[:, None]).sum(axis=(2, 3))
+            cnt = mask.sum(axis=(1, 2))[:, None]
+            cols.append(jnp.where(cnt > 0, summed / jnp.maximum(cnt, 1), 0.0))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)

@@ -1,0 +1,184 @@
+"""Contrib batch 2 vs oracles: FFT/IFFT, quantize, CountSketch, Proposal,
+PSROIPooling (reference src/operator/contrib/)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+C = mx.contrib.ndarray
+
+
+def test_fft_ifft_roundtrip_and_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8).astype(np.float32)
+    f = C.fft(mx.nd.array(x)).asnumpy()
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-4, atol=1e-4)
+    # unnormalized inverse, cuFFT convention: ifft(fft(x)) = n * x
+    r = C.ifft(mx.nd.array(f)).asnumpy()
+    np.testing.assert_allclose(r, x * 8, rtol=1e-4, atol=1e-4)
+    # 4-D path (reference supports 2D and 4D)
+    x4 = rng.randn(1, 2, 3, 4).astype(np.float32)
+    f4 = C.fft(mx.nd.array(x4))
+    assert f4.shape == (1, 2, 3, 8)
+
+
+def test_quantize_dequantize():
+    x = np.linspace(-0.8, 0.9, 17).astype(np.float32)
+    q, mn, mxr = C.quantize(mx.nd.array(x), mx.nd.array([-1.0]),
+                            mx.nd.array([1.0]))
+    qn = q.asnumpy()
+    assert qn.dtype == np.uint8
+    scale = 255.0 / 2.0
+    np.testing.assert_array_equal(
+        qn, np.floor((x + 1.0) * scale + 0.5).clip(0, 255).astype(np.uint8))
+    d = C.dequantize(q, mn, mxr).asnumpy()
+    np.testing.assert_allclose(d, x, atol=2.0 / 255 + 1e-6)
+
+
+def test_count_sketch():
+    rng = np.random.RandomState(1)
+    n, d, od = 4, 10, 6
+    x = rng.randn(n, d).astype(np.float32)
+    h = rng.randint(0, od, (1, d)).astype(np.float32)
+    s = rng.choice([-1.0, 1.0], (1, d)).astype(np.float32)
+    out = C.count_sketch(mx.nd.array(x), mx.nd.array(h), mx.nd.array(s),
+                         out_dim=od).asnumpy()
+    exp = np.zeros((n, od), np.float32)
+    for i in range(d):
+        exp[:, int(h[0, i])] += s[0, i] * x[:, i]
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-5)
+
+
+def _np_proposal(cls_prob, bbox_pred, im_info, fs, scales, ratios, pre_n,
+                 post_n, thresh, min_size):
+    """Transcription of the reference CPU kernel (proposal.cc:255-410)."""
+    A = cls_prob.shape[1] // 2
+    H, W = cls_prob.shape[2:]
+    # anchors
+    base_size = fs
+    w = h = float(base_size)
+    xc = yc = 0.5 * (w - 1)
+    size = w * h
+    base = []
+    for ratio in ratios:
+        sr = math.floor(size / ratio)
+        nw0 = math.floor(math.sqrt(sr) + 0.5)
+        nh0 = math.floor(nw0 * ratio + 0.5)
+        for s in scales:
+            nw, nh = nw0 * s, nh0 * s
+            base.append([xc - 0.5 * (nw - 1), yc - 0.5 * (nh - 1),
+                         xc + 0.5 * (nw - 1), yc + 0.5 * (nh - 1)])
+    props = np.zeros((A * H * W, 5), np.float32)
+    for a in range(A):
+        for j in range(H):
+            for k in range(W):
+                idx = j * W * A + k * A + a
+                props[idx, :4] = np.array(base[a]) + [k * fs, j * fs, k * fs, j * fs]
+                props[idx, 4] = cls_prob[0, A + a, j, k]
+    imh, imw, imsc = im_info[0]
+    real_h, real_w = int(imh / fs), int(imw / fs)
+    for a in range(A):
+        for j in range(H):
+            for k in range(W):
+                idx = j * W * A + k * A + a
+                x1, y1, x2, y2 = props[idx, :4]
+                bw, bh = x2 - x1 + 1, y2 - y1 + 1
+                cx, cy = x1 + 0.5 * (bw - 1), y1 + 0.5 * (bh - 1)
+                dx, dy, dw, dh = bbox_pred[0, a * 4:(a + 1) * 4, j, k]
+                pcx, pcy = dx * bw + cx, dy * bh + cy
+                pw, ph = math.exp(dw) * bw, math.exp(dh) * bh
+                box = [pcx - 0.5 * (pw - 1), pcy - 0.5 * (ph - 1),
+                       pcx + 0.5 * (pw - 1), pcy + 0.5 * (ph - 1)]
+                box = [min(max(box[0], 0), imw - 1), min(max(box[1], 0), imh - 1),
+                       min(max(box[2], 0), imw - 1), min(max(box[3], 0), imh - 1)]
+                props[idx, :4] = box
+                if j >= real_h or k >= real_w:
+                    props[idx, 4] = -1
+    ms = min_size * imsc
+    for i in range(len(props)):
+        iw = props[i, 2] - props[i, 0] + 1
+        ih = props[i, 3] - props[i, 1] + 1
+        if iw < ms or ih < ms:
+            props[i, 0] -= ms / 2
+            props[i, 1] -= ms / 2
+            props[i, 2] += ms / 2
+            props[i, 3] += ms / 2
+            props[i, 4] = -1
+    order = np.argsort(-props[:, 4], kind="stable")[:pre_n]
+    dets = props[order]
+    area = (dets[:, 2] - dets[:, 0] + 1) * (dets[:, 3] - dets[:, 1] + 1)
+    suppressed = np.zeros(len(dets), bool)
+    keep = []
+    for i in range(len(dets)):
+        if len(keep) >= post_n or suppressed[i]:
+            continue
+        keep.append(i)
+        for j in range(i + 1, len(dets)):
+            if suppressed[j]:
+                continue
+            iw = min(dets[i, 2], dets[j, 2]) - max(dets[i, 0], dets[j, 0]) + 1
+            ih = min(dets[i, 3], dets[j, 3]) - max(dets[i, 1], dets[j, 1]) + 1
+            inter = max(0, iw) * max(0, ih)
+            if inter / (area[i] + area[j] - inter) > thresh:
+                suppressed[j] = True
+    out = np.zeros((post_n, 5), np.float32)
+    scores = np.zeros((post_n, 1), np.float32)
+    for i in range(post_n):
+        idx = keep[i % len(keep)]
+        out[i, 1:] = dets[idx, :4]
+        scores[i, 0] = dets[idx, 4]
+    return out, scores
+
+
+def test_proposal_vs_oracle():
+    rng = np.random.RandomState(2)
+    A, H, W = 3, 4, 5
+    fs = 8
+    scales, ratios = (4.0, 8.0), (0.5, 1.0)
+    nA = len(scales) * len(ratios)
+    cls_prob = rng.uniform(0, 1, (1, 2 * nA, H, W)).astype(np.float32)
+    bbox_pred = (rng.randn(1, 4 * nA, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[H * fs, W * fs, 1.0]], np.float32)
+    rois, scores = C.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, scales=scales, ratios=ratios, feature_stride=fs,
+        output_score=True)
+    exp, exp_scores = _np_proposal(cls_prob, bbox_pred, im_info, fs, scales,
+                                   ratios, 30, 8, 0.7, 4)
+    np.testing.assert_allclose(rois.asnumpy(), exp, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(scores.asnumpy(), exp_scores, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_psroi_pooling():
+    rng = np.random.RandomState(3)
+    od, gs = 2, 3
+    data = rng.randn(1, od * gs * gs, 9, 9).astype(np.float32)
+    rois = np.array([[0, 0, 0, 8, 8], [0, 2, 3, 7, 8]], np.float32)
+    out = C.PSROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                         spatial_scale=1.0, output_dim=od,
+                         pooled_size=gs, group_size=gs).asnumpy()
+    assert out.shape == (2, od, gs, gs)
+    # numpy oracle
+    for r, roi in enumerate(rois):
+        sw, sh = round(roi[1]) * 1.0, round(roi[2]) * 1.0
+        ew, eh = round(roi[3] + 1) * 1.0, round(roi[4] + 1) * 1.0
+        bh, bw = max(eh - sh, 0.1) / gs, max(ew - sw, 0.1) / gs
+        for ct in range(od):
+            for i in range(gs):
+                for j in range(gs):
+                    hs = int(np.clip(math.floor(i * bh + sh), 0, 9))
+                    he = int(np.clip(math.ceil((i + 1) * bh + sh), 0, 9))
+                    ws = int(np.clip(math.floor(j * bw + sw), 0, 9))
+                    we = int(np.clip(math.ceil((j + 1) * bw + sw), 0, 9))
+                    c = (ct * gs + i) * gs + j
+                    region = data[0, c, hs:he, ws:we]
+                    exp = region.mean() if region.size else 0.0
+                    np.testing.assert_allclose(out[r, ct, i, j], exp,
+                                               rtol=1e-4, atol=1e-5)

@@ -664,6 +664,10 @@ COVERED_ELSEWHERE = {
     "RNN",
     # test_ring_attention.py
     "_contrib_BlockwiseAttention",
+    # test_contrib_ops2.py
+    "_contrib_fft", "_contrib_ifft", "_contrib_quantize",
+    "_contrib_dequantize", "_contrib_count_sketch", "_contrib_Proposal",
+    "_contrib_PSROIPooling",
 }
 
 TABLE_COVERED = (
