@@ -73,12 +73,19 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         grad_reqs = [grad_reqs] * len(variables)
     for var, grad_arr, req in zip(variables, gradients, grad_reqs):
         _STATE.marked[id(var)] = (var, grad_arr, req)
-        var._autograd_marked = True
 
 
 def _record(fn, inputs, outputs):
     if _STATE.is_training:
         _STATE.tape.append((fn, [id(x) for x in inputs], inputs, [id(y) for y in outputs], outputs))
+
+
+# install the imperative recording hook (reference: MXImperativeInvoke
+# calls AutogradRuntime::RecordImperativeFCompute when training,
+# c_api_ndarray.cc:374-378)
+from .. import ndarray as _nd_mod  # noqa: E402
+
+_nd_mod._RECORD_HOOK = _record
 
 
 def backward(outputs, out_grads=None, retain_graph=False):

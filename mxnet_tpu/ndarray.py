@@ -43,6 +43,12 @@ __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
 
 _DTYPE_ALIASES = {None: jnp.float32}
 
+# installed by contrib.autograd: callable(replay_fn, in_ndarrays, out_ndarrays)
+# recording imperative ops onto the autograd tape when a train_section is
+# active (reference AutogradRuntime::RecordImperativeFCompute,
+# src/ndarray/autograd.cc:82)
+_RECORD_HOOK = None
+
 
 def _as_jax(value, dtype=None):
     if isinstance(value, NDArray):
@@ -228,8 +234,26 @@ class NDArray:
             lhs, rhs = self.data, _as_jax(other)
             if reverse:
                 lhs, rhs = rhs, lhs
-            return NDArray(get_op(op_name).fn(lhs, rhs), self._ctx)
-        return NDArray(get_op(scalar_name).fn(self.data, scalar=float(other)), self._ctx)
+            out = NDArray(get_op(op_name).fn(lhs, rhs), self._ctx)
+            if _RECORD_HOOK is not None:
+                fn = get_op(op_name).fn
+                if isinstance(other, NDArray):
+                    ins = [other, self] if reverse else [self, other]
+                    _RECORD_HOOK(fn, ins, [out])
+                else:  # raw jax operand captured as a constant
+                    const = _as_jax(other)
+                    if reverse:
+                        _RECORD_HOOK(lambda x, _c=const, _f=fn: _f(_c, x),
+                                     [self], [out])
+                    else:
+                        _RECORD_HOOK(lambda x, _c=const, _f=fn: _f(x, _c),
+                                     [self], [out])
+            return out
+        out = NDArray(get_op(scalar_name).fn(self.data, scalar=float(other)), self._ctx)
+        if _RECORD_HOOK is not None:
+            _RECORD_HOOK(lambda x, _f=get_op(scalar_name).fn, _s=float(other):
+                         _f(x, scalar=_s), [self], [out])
+        return out
 
     def __add__(self, o):
         return self._binary(o, "elemwise_add", "_plus_scalar")
@@ -672,6 +696,18 @@ def _make_nd_function(op):
                 boxed = boxed[0]
         else:
             boxed = NDArray(result, res_ctx)
+        if _RECORD_HOOK is not None:
+            nd_ins = [a for a in args if isinstance(a, NDArray)]
+            nd_outs = list(boxed) if isinstance(boxed, tuple) else [boxed]
+            # non-NDArray args are captured as constants in the replay fn
+            spec = [None if isinstance(a, NDArray) else _as_jax(a) for a in args]
+
+            def _replay(*xs, _f=op.fn, _kw=dict(kwargs), _spec=spec):
+                it = iter(xs)
+                vals = [next(it) if s is None else s for s in _spec]
+                return _f(*vals, **_kw)
+
+            _RECORD_HOOK(_replay, nd_ins, nd_outs)
         if out is not None:
             if isinstance(boxed, tuple):
                 for o, b in zip(out if isinstance(out, (list, tuple)) else [out], boxed):

@@ -153,3 +153,12 @@ def Custom(*args, op_type=None, **kwargs):
     from .ndarray import _make_nd_function
 
     return _make_nd_function(op)(*args, **kwargs)
+
+
+# surface Custom on the generated namespaces (parity: mx.nd.Custom /
+# mx.sym.Custom are registry-generated in the reference)
+from . import ndarray as _nd_mod  # noqa: E402
+from . import symbol as _sym_mod  # noqa: E402
+
+_nd_mod.Custom = Custom
+_sym_mod.Custom = Custom
