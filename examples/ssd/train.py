@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Train SSD (parity: reference example/ssd/train.py surface; BASELINE
+config 4).
+
+Data: an im2rec detection .rec via --rec-path; without one, a synthetic
+colored-rectangle detection set is generated on the fly (each image
+contains axis-aligned rectangles whose color encodes the class), so the
+script runs out of the box with no downloads.
+
+Network: --network vgg16_reduced (SSD-300) or tiny (two-scale test net).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+logging.basicConfig(level=logging.INFO)
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models.ssd import get_ssd_tiny, get_ssd_vgg16  # noqa: E402
+
+parser = argparse.ArgumentParser(description="Train an SSD detector")
+parser.add_argument("--rec-path", type=str, default="",
+                    help="im2rec detection .rec file")
+parser.add_argument("--network", type=str, default="tiny",
+                    choices=["tiny", "vgg16_reduced"])
+parser.add_argument("--data-shape", type=int, default=0,
+                    help="square input size (default: 16 tiny / 300 vgg)")
+parser.add_argument("--batch-size", type=int, default=8)
+parser.add_argument("--num-classes", type=int, default=3)
+parser.add_argument("--num-epochs", type=int, default=5)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--gpus", type=str, default="")
+parser.add_argument("--model-prefix", type=str, default="")
+parser.add_argument("--num-synthetic", type=int, default=256)
+
+
+def synthetic_det_batch_iter(n, num_classes, data_shape, batch_size, seed=0):
+    """In-memory detection batches: rectangles whose channel encodes class."""
+    rng = np.random.RandomState(seed)
+    c, h, w = data_shape
+    imgs = np.zeros((n, c, h, w), np.float32)
+    labels = np.full((n, 2, 5), -1.0, np.float32)
+    for i in range(n):
+        for o in range(rng.randint(1, 3)):
+            cls = rng.randint(0, num_classes)
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            bw, bh = rng.uniform(0.25, 0.45, 2)
+            x2, y2 = min(x1 + bw, 1.0), min(y1 + bh, 1.0)
+            px = [int(v * (w - 1)) for v in (x1, x2)]
+            py = [int(v * (h - 1)) for v in (y1, y2)]
+            imgs[i, cls % c, py[0]:py[1] + 1, px[0]:px[1] + 1] = 1.0
+            labels[i, o] = [cls, x1, y1, x2, y2]
+    return mx.io.NDArrayIter({"data": imgs}, {"label": labels},
+                             batch_size=batch_size, shuffle=True,
+                             label_name="label")
+
+
+def main():
+    args = parser.parse_args()
+    size = args.data_shape or (16 if args.network == "tiny" else 300)
+    shape = (3, size, size)
+    if args.rec_path:
+        it = mx.io.ImageDetRecordIter(path_imgrec=args.rec_path,
+                                      data_shape=shape,
+                                      batch_size=args.batch_size,
+                                      shuffle=True, rand_mirror=True)
+    else:
+        logging.info("no --rec-path; generating synthetic rectangles")
+        it = synthetic_det_batch_iter(args.num_synthetic, args.num_classes,
+                                      shape, args.batch_size)
+    net = (get_ssd_tiny(num_classes=args.num_classes) if args.network == "tiny"
+           else get_ssd_vgg16(num_classes=args.num_classes))
+    ctx = (mx.cpu() if not args.gpus
+           else [mx.tpu(int(i)) for i in args.gpus.split(",")])
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=ctx)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9, "wd": 5e-4})
+    metric = mx.metric.Loss(name="cls_loss")
+    for epoch in range(args.num_epochs):
+        it.reset()
+        metric.reset()
+        loc_sum, nb = 0.0, 0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            outs = mod.get_outputs()
+            loc_sum += float(outs[1].asnumpy().sum())
+            nb += 1
+        logging.info("Epoch[%d] loc_loss=%.4f", epoch, loc_sum / max(nb, 1))
+        if args.model_prefix:
+            mod.save_checkpoint(args.model_prefix, epoch + 1)
+
+
+if __name__ == "__main__":
+    main()

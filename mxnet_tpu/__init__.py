@@ -42,6 +42,7 @@ from . import predict
 from .predict import Predictor
 from . import image
 from . import rtc
+from . import config
 from . import monitor
 from . import monitor as mon
 from .monitor import Monitor

@@ -1,0 +1,96 @@
+"""Runtime configuration registry — the documented env-var surface.
+
+Parity: the reference documents ~25 runtime env vars read via
+`dmlc::GetEnv` (reference docs/how_to/env_var.md); this module is the
+equivalent single source of truth.  Each variable is declared once with
+type, default, and description; `describe()` renders the table and
+`get(name)` is the typed accessor the rest of the framework uses (or can
+migrate to — modules that read os.environ at import time list their
+variable here for documentation even when they read it directly).
+
+Many reference knobs (engine thread pools, GPU memory pool, bulk-exec
+segment sizes) have no analog because XLA/PJRT owns those resources —
+they are listed as `absorbed` so users migrating scripts get an answer
+instead of silence.
+"""
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+__all__ = ["EnvVar", "REGISTRY", "ABSORBED", "get", "describe"]
+
+EnvVar = namedtuple("EnvVar", ["name", "type", "default", "desc"])
+
+REGISTRY = [
+    # ---- distributed kvstore (parallel/dist.py) ----
+    EnvVar("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 20,
+           "Arrays above this many elements shard over ALL servers "
+           "(reference kvstore_dist.h EncodeKey)"),
+    EnvVar("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 2.0,
+           "Seconds between node heartbeats to the scheduler"),
+    EnvVar("MXNET_KVSTORE_DEAD_TIMEOUT", float, 15.0,
+           "Seconds without a heartbeat before a node is reported dead "
+           "(reference ps-lite CheckDeadNodes)"),
+    EnvVar("MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
+           "Barrier wait limit; the barrier raises instead of hanging"),
+    EnvVar("MXNET_KVSTORE_PULL_TIMEOUT", float, 60.0,
+           "Version-gated pull wait limit; servers reply with an error "
+           "instead of serving stale values"),
+    # ---- topology (set by tools/launch.py, reference dmlc tracker) ----
+    EnvVar("DMLC_ROLE", str, "worker", "Node role: worker/server/scheduler"),
+    EnvVar("DMLC_PS_ROOT_URI", str, "127.0.0.1", "Scheduler host"),
+    EnvVar("DMLC_PS_ROOT_PORT", int, 9091, "Scheduler port"),
+    EnvVar("DMLC_NUM_WORKER", int, 1, "Worker count"),
+    EnvVar("DMLC_NUM_SERVER", int, 1, "Server count"),
+    # ---- JAX/XLA passthrough the test/dev flows rely on ----
+    EnvVar("JAX_PLATFORMS", str, "", "Force a JAX backend, e.g. 'cpu'"),
+    EnvVar("XLA_FLAGS", str, "",
+           "XLA options; --xla_force_host_platform_device_count=8 gives a "
+           "virtual multi-chip CPU mesh for testing"),
+]
+
+# reference env vars whose role XLA/PJRT absorbed — accepted, ignored,
+# documented (reference docs/how_to/env_var.md)
+ABSORBED = {
+    "MXNET_CPU_WORKER_NTHREADS": "XLA thread pools",
+    "MXNET_GPU_WORKER_NTHREADS": "PJRT device streams",
+    "MXNET_CPU_PRIORITY_NTHREADS": "XLA scheduling",
+    "MXNET_EXEC_ENABLE_INPLACE": "XLA buffer assignment",
+    "NNVM_EXEC_MATCH_RANGE": "XLA memory planner",
+    "MXNET_EXEC_NUM_TEMP": "XLA temp allocation",
+    "MXNET_GPU_MEM_POOL_RESERVE": "PJRT allocator",
+    "MXNET_ENGINE_TYPE": "PJRT async dispatch (no engine choice)",
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": "whole-graph jit (always bulk)",
+    "MXNET_EXEC_BULK_EXEC_TRAIN": "whole-graph jit (always bulk)",
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": "XLA collectives",
+    "MXNET_ENABLE_GPU_P2P": "ICI collectives",
+    "MXNET_BACKWARD_DO_MIRROR": "use jax.checkpoint/remat in custom ops",
+}
+
+_BY_NAME = {v.name: v for v in REGISTRY}
+
+
+def get(name, default=None):
+    """Typed read of a registered variable (reference dmlc::GetEnv)."""
+    spec = _BY_NAME.get(name)
+    if spec is None:
+        raise KeyError("unknown config variable %s (see config.REGISTRY; "
+                       "absorbed-by-XLA vars: %s)" % (name, sorted(ABSORBED)))
+    raw = os.environ.get(name)
+    if raw is None:
+        return spec.default if default is None else default
+    return spec.type(raw)
+
+
+def describe():
+    """Render the env-var table (the docs/how_to/env_var.md analog)."""
+    lines = ["%-36s %-8s %-12s %s" % ("variable", "type", "default", "description")]
+    for v in REGISTRY:
+        lines.append("%-36s %-8s %-12s %s"
+                     % (v.name, v.type.__name__, v.default, v.desc))
+    lines.append("")
+    lines.append("absorbed by XLA/PJRT (accepted, ignored):")
+    for k, why in sorted(ABSORBED.items()):
+        lines.append("  %-34s -> %s" % (k, why))
+    return "\n".join(lines)

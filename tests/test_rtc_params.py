@@ -95,3 +95,19 @@ def test_declarative_params_coerce_strings():
     net = mx.sym.FullyConnected(d, num_hidden="7")
     _, out_shapes, _ = net.infer_shape(data=(2, 3))
     assert out_shapes[0] == (2, 7)
+
+
+def test_config_registry():
+    from mxnet_tpu import config
+    assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 1 << 20
+    import os
+    os.environ["MXNET_KVSTORE_DEAD_TIMEOUT"] = "7.5"
+    try:
+        assert config.get("MXNET_KVSTORE_DEAD_TIMEOUT") == 7.5
+    finally:
+        del os.environ["MXNET_KVSTORE_DEAD_TIMEOUT"]
+    with pytest.raises(KeyError, match="absorbed"):
+        config.get("MXNET_ENGINE_TYPE_TYPO")
+    table = config.describe()
+    assert "MXNET_KVSTORE_BARRIER_TIMEOUT" in table
+    assert "absorbed" in table
