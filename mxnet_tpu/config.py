@@ -29,7 +29,7 @@ REGISTRY = [
            "(reference kvstore_dist.h EncodeKey)"),
     EnvVar("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 2.0,
            "Seconds between node heartbeats to the scheduler"),
-    EnvVar("MXNET_KVSTORE_DEAD_TIMEOUT", float, 15.0,
+    EnvVar("MXNET_KVSTORE_DEAD_TIMEOUT", float, 60.0,
            "Seconds without a heartbeat before a node is reported dead "
            "(reference ps-lite CheckDeadNodes)"),
     EnvVar("MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,

@@ -64,7 +64,7 @@ BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
 # liveness knobs (reference analog: ps-lite heartbeats + CheckDeadNodes,
 # kvstore_dist.h:158-170)
 HEARTBEAT_INTERVAL = float(os.environ.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "2"))
-DEAD_NODE_TIMEOUT = float(os.environ.get("MXNET_KVSTORE_DEAD_TIMEOUT", "15"))
+DEAD_NODE_TIMEOUT = float(os.environ.get("MXNET_KVSTORE_DEAD_TIMEOUT", "60"))
 BARRIER_TIMEOUT = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT", "300"))
 PULL_TIMEOUT = float(os.environ.get("MXNET_KVSTORE_PULL_TIMEOUT", "60"))
 
