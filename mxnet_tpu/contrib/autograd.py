@@ -33,8 +33,13 @@ _STATE = _TapeState()
 
 def set_is_training(is_train):
     """Toggle training/recording (parity: contrib/autograd.py set_is_training)."""
+    from .. import ndarray as _nd_mod
+
     prev = _STATE.is_training
     _STATE.is_training = bool(is_train)
+    # the imperative recording hook is installed only while recording, so
+    # the common not-recording path pays a single `is None` check per op
+    _nd_mod._RECORD_HOOK = _record if is_train else None
     if not is_train:
         _STATE.tape = []
     return prev
@@ -76,16 +81,11 @@ def mark_variables(variables, gradients, grad_reqs="write"):
 
 
 def _record(fn, inputs, outputs):
+    # installed into ndarray._RECORD_HOOK by set_is_training(True)
+    # (reference: MXImperativeInvoke calls RecordImperativeFCompute when
+    # training, c_api_ndarray.cc:374-378)
     if _STATE.is_training:
         _STATE.tape.append((fn, [id(x) for x in inputs], inputs, [id(y) for y in outputs], outputs))
-
-
-# install the imperative recording hook (reference: MXImperativeInvoke
-# calls AutogradRuntime::RecordImperativeFCompute when training,
-# c_api_ndarray.cc:374-378)
-from .. import ndarray as _nd_mod  # noqa: E402
-
-_nd_mod._RECORD_HOOK = _record
 
 
 def backward(outputs, out_grads=None, retain_graph=False):

@@ -84,17 +84,20 @@ class ImageDetRecordIterImpl(DataIter):
         self._offsets = native_index(path_imgrec)[part_index::num_parts]
         if not self._offsets:
             raise MXNetError("no records in %s" % path_imgrec)
-        # first pass: find max objects + object width for padding
-        self._obj_width = None
-        max_objs = 0
-        for off in self._offsets:
-            header, _ = unpack(self._reader.read_at(off))
-            objs, bw = _parse_det_label(header.label)
-            max_objs = max(max_objs, len(objs))
-            if self._obj_width is None:
-                self._obj_width = bw
-            elif self._obj_width != bw:
-                raise MXNetError("inconsistent object widths in %s" % path_imgrec)
+        # object width from the first record; max_objects needs a full
+        # label scan ONLY when no label_pad_width fixes the shape
+        header0, _ = unpack(self._reader.read_at(self._offsets[0]))
+        objs0, self._obj_width = _parse_det_label(header0.label)
+        if label_pad_width:
+            max_objs = len(objs0)
+        else:
+            max_objs = 0
+            for off in self._offsets:
+                header, _ = unpack(self._reader.read_at(off))
+                objs, bw = _parse_det_label(header.label)
+                max_objs = max(max_objs, len(objs))
+                if self._obj_width != bw:
+                    raise MXNetError("inconsistent object widths in %s" % path_imgrec)
         self.max_objects = max(label_pad_width or 0, max_objs, 1)
         self.label_pad_value = float(label_pad_value)
         self.data_name, self.label_name = data_name, label_name
@@ -134,16 +137,9 @@ class ImageDetRecordIterImpl(DataIter):
             img = img[:, ::-1]
             objs = _flip_boxes(objs)
         c, th, tw = self.data_shape
-        try:
-            import cv2
+        from .image import _resize
 
-            img = cv2.resize(img, (tw, th))
-        except ImportError:
-            from PIL import Image
-
-            img = _np.asarray(
-                Image.fromarray(img.astype(_np.uint8)).resize((tw, th)),
-                _np.float32)
+        img = _resize(img, tw, th)
         if img.ndim == 2:
             img = img[:, :, None]
         img = (img - self.mean) / self.std * self.scale

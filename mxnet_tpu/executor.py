@@ -109,6 +109,31 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
     return outputs, aux_out
 
 
+# op → input slots whose values are indices, not magnitudes
+_INDEX_ARG_SLOTS = {
+    "Embedding": (0,), "take": (1,), "batch_take": (1,), "one_hot": (0,),
+    "gather_nd": (1,), "scatter_nd": (1,), "pick": (1,),
+    "SequenceLast": (1,), "SequenceMask": (1,), "SequenceReverse": (1,),
+}
+
+
+def _index_like_args(symbol):
+    """Variable args fed into an index slot of any consumer op."""
+    keep = set()
+    for node in _topo_order(symbol._entries):
+        if node.op is None:
+            continue
+        slots = _INDEX_ARG_SLOTS.get(node.op.name)
+        if not slots:
+            continue
+        for i in slots:
+            if i < len(node.inputs):
+                src, _ = node.inputs[i]
+                if src.op is None:
+                    keep.add(src.name)
+    return keep
+
+
 def _auto_spec(shape, mesh, axis="model"):
     """Pick a PartitionSpec sharding the largest dim divisible by the model
     axis (params of a ctx_group are sharded, not placed — the SPMD
@@ -204,7 +229,12 @@ class Executor:
                  fp32_names=()):
         self._symbol = symbol
         self._compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
-        self._fp32_names = frozenset(fp32_names)
+        fp32 = set(fp32_names)
+        if self._compute_dtype is not None:
+            # args consumed as INDICES (token ids, gather positions) must
+            # not round through bf16 — ids > 256 are not bf16-exact
+            fp32 |= _index_like_args(symbol)
+        self._fp32_names = frozenset(fp32)
         self._ctx = ctx
         self.arg_dict = arg_dict
         self.grad_dict = grad_dict

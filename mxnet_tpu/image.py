@@ -14,7 +14,7 @@ import numpy as np
 from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
 from .ndarray import array
-from .recordio import MXIndexedRecordIO, MXRecordIO, _decode_img, unpack
+from .recordio import _decode_img, unpack
 
 __all__ = [
     "imdecode", "imread", "scale_down", "resize_short", "fixed_crop",
@@ -286,13 +286,12 @@ class ImageIter(DataIter):
         self.imgrec = None
         self.imglist = None
         if path_imgrec:
-            self.imgrec = MXRecordIO(path_imgrec, "r")
-            self._records = []
-            while True:
-                raw = self.imgrec.read()
-                if raw is None:
-                    break
-                self._records.append(raw)
+            # stream via the indexed native reader — an ImageNet-scale .rec
+            # must not be buffered into RAM
+            from .native import NativeRecordReader, native_index
+
+            self.imgrec = NativeRecordReader(path_imgrec)
+            self._offsets = native_index(path_imgrec)
         else:
             entries = []
             if imglist is not None:
@@ -317,7 +316,7 @@ class ImageIter(DataIter):
         self.reset()
 
     def _num(self):
-        return len(self._records) if self.imgrec is not None else len(self.imglist)
+        return len(self._offsets) if self.imgrec is not None else len(self.imglist)
 
     def reset(self):
         self._order = np.arange(self._num())
@@ -327,7 +326,7 @@ class ImageIter(DataIter):
 
     def _read_one(self, idx):
         if self.imgrec is not None:
-            header, payload = unpack(self._records[idx])
+            header, payload = unpack(self.imgrec.read_at(self._offsets[idx]))
             label = np.atleast_1d(np.asarray(header.label, np.float32))
             img = imdecode(payload)
         else:

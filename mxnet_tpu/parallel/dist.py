@@ -145,7 +145,15 @@ class Scheduler:
         self._barrier_waiters = []
         self._last_seen = {}  # node id "role:rank" -> monotonic timestamp
         self._left = set()  # nodes whose connection closed
+        self._send_locks = {}  # id(conn) -> Lock serializing frame sends
         self._stopped = False
+
+    def _send(self, conn, cmd, meta=b""):
+        """Serialize sends per connection — a dead-node wakeup and a
+        barrier reply racing on one socket would interleave mid-frame."""
+        lock = self._send_locks.setdefault(id(conn), threading.Lock())
+        with lock:
+            _send_frame(conn, cmd, meta)
 
     def _dead_nodes(self):
         now = time.monotonic()
@@ -175,7 +183,7 @@ class Scheduler:
         # everyone registered: broadcast address book + ranks
         addrs = [self._server_addrs[r] for r in sorted(self._server_addrs)]
         for conn, role, rank in conns:
-            _send_frame(conn, _ADDRS, _meta(rank=rank, servers=addrs))
+            self._send(conn, _ADDRS, _meta(rank=rank, servers=addrs))
         # serve every node's connection (workers barrier, all heartbeat)
         threads = []
         for conn, role, rank in conns:
@@ -199,12 +207,13 @@ class Scheduler:
                         self._barrier_waiters.append(conn)
                         if len(self._barrier_waiters) == self.num_workers:
                             for c in self._barrier_waiters:
-                                _send_frame(c, _BARRIER_DONE)
+                                self._send(c, _BARRIER_DONE)
                             self._barrier_waiters = []
                             self._lock.notify_all()
                 elif cmd == _DEADNODES:
                     with self._lock:
-                        _send_frame(conn, _DEADNODES_R, _meta(dead=self._dead_nodes()))
+                        dead = self._dead_nodes()
+                    self._send(conn, _DEADNODES_R, _meta(dead=dead))
                 # _HEARTBEAT: timestamp already refreshed above
         except (ConnectionError, OSError):
             with self._lock:
@@ -214,7 +223,7 @@ class Scheduler:
             # wake any barrier waiters so they can observe the dead node
             for c in waiters:
                 try:
-                    _send_frame(c, _DEADNODES_R, _meta(dead=self._dead_nodes()))
+                    self._send(c, _DEADNODES_R, _meta(dead=self._dead_nodes()))
                 except Exception:
                     pass
 

@@ -395,6 +395,8 @@ class FusedRNNCell(BaseRNNCell):
         return args
 
     def pack_weights(self, args):
+        import numpy as _np
+
         from .. import ndarray as nd
 
         args = args.copy()
@@ -405,10 +407,13 @@ class FusedRNNCell(BaseRNNCell):
         num_input = w0.shape[1]
         total = (num_input + h + 2) * (h * m * b) + \
             (self._num_layers - 1) * m * h * (h + b * h + 2) * b
-        arr = nd.zeros((total,))
-        for name, block in self._slice_weights(arr, num_input, h).items():
-            block[:] = args.pop(name)
-        args[self._parameter.name] = arr
+        # pack on the host: numpy slice-reshapes stay write-through views
+        # (NDArray .reshape detaches from the buffer)
+        flat = _np.zeros((total,), _np.float32)
+        for name, block in self._slice_weights(flat, num_input, h).items():
+            v = args.pop(name)
+            block[:] = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+        args[self._parameter.name] = nd.array(flat)
         return args
 
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",

@@ -62,6 +62,31 @@ def test_bf16_compute_trains_with_fp32_masters():
     assert acc > 0.9, acc
 
 
+def test_bf16_keeps_index_args_fp32():
+    # review finding: token ids > 256 are not bf16-exact; args feeding
+    # index slots (Embedding data etc.) must stay fp32 under compute_dtype
+    V, E = 2000, 8
+    data = mx.sym.Variable("data")
+    net = mx.sym.Embedding(data, input_dim=V, output_dim=E, name="emb")
+    ex = mx.executor.Executor.simple_bind(net, mx.cpu(), grad_req="null",
+                                          compute_dtype="bfloat16",
+                                          data=(4,))
+    assert "data" in ex._fp32_names
+    ids = np.array([0, 257, 1001, 1999], np.float32)  # not bf16-exact
+    w = np.random.RandomState(0).randn(V, E).astype(np.float32)
+    ex.arg_dict["data"][:] = ids
+    ex.arg_dict["emb_weight"][:] = w
+    ex.forward(is_train=False)
+    # rows must come from the EXACT ids (a bf16 cast would fetch 1000/1002)
+    exp = w[ids.astype(int)]
+    got = ex.outputs[0].asnumpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-2, atol=1e-2)  # bf16 values
+    # and specifically row identity, not just proximity
+    for r in range(4):
+        best = np.argmin(np.abs(w - got[r]).sum(axis=1))
+        assert best == int(ids[r]), (r, best, ids[r])
+
+
 def test_bf16_outputs_are_fp32_and_close_to_fp32_run():
     rng = np.random.RandomState(1)
     X = rng.randn(8, 10).astype("float32")

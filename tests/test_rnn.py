@@ -100,6 +100,21 @@ def test_fused_numeric_gradient():
         rtol=0.05, atol=2e-3, numeric_eps=1e-2, ctx=mx.cpu())
 
 
+def test_pack_unpack_roundtrip():
+    # review finding: NDArray slice .reshape detached the write-through
+    # view, silently zeroing the packed weight section
+    T, C, H, L = 3, 4, 5, 2
+    fused = rnn.FusedRNNCell(H, num_layers=L, mode="lstm", prefix="rt_")
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    rng = np.random.RandomState(9)
+    params = mx.nd.array(rng.uniform(-1, 1, (rnn_param_size(C, H, L, "lstm"),))
+                         .astype(np.float32))
+    unpacked = fused.unpack_weights({"rt_parameters": params})
+    repacked = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["rt_parameters"].asnumpy(),
+                               params.asnumpy(), rtol=1e-6)
+
+
 def test_lstm_cell_vs_numpy_oracle():
     """Single LSTM step numerics vs a transcribed numpy LSTM."""
     N, C, H = 3, 4, 5
