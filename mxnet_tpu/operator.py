@@ -18,7 +18,7 @@ from .base import MXNetError
 from .ndarray import NDArray
 from .ops.registry import Op, OP_REGISTRY
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+__all__ = ["CustomOp", "CustomOpProp", "PythonOp", "NDArrayOp", "NativeOp", "register", "get_all_registered_operators"]
 
 
 class CustomOp:
@@ -153,6 +153,93 @@ def Custom(*args, op_type=None, **kwargs):
     from .ndarray import _make_nd_function
 
     return _make_nd_function(op)(*args, **kwargs)
+
+
+class PythonOp:
+    """Legacy python-op base (parity: reference operator.py PythonOp:19).
+
+    Subclass, override forward/backward/infer_shape/list_*, then call the
+    instance with input symbols to get a Symbol.  Internally adapted onto
+    the CustomOp bridge: forward/backward trace into the jitted graph when
+    written with mx.nd ops.
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+        self._counter = [0]
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    # -- override points (reference PythonOp) ---------------------------
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray operator (parity: reference operator.py NDArrayOp:226).
+
+    The reference registered engine callbacks; here get_symbol wraps the
+    instance in a one-off CustomOp registration so the op participates in
+    the jitted graph like any other.
+    """
+
+    def get_symbol(self, *args, **kwargs):
+        name = kwargs.pop("name", None)
+        outer = self
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=outer.need_top_grad_)
+
+            def list_arguments(self):
+                return outer.list_arguments()
+
+            def list_outputs(self):
+                return outer.list_outputs()
+
+            def infer_shape(self, in_shape):
+                ins, outs = outer.infer_shape(in_shape)
+                return ins, outs, []
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                class _Adapter(CustomOp):
+                    def forward(self, is_train, req, in_data, out_data, aux):
+                        outer.forward(in_data, out_data)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        outer.backward(out_grad, in_data, out_data, in_grad)
+
+                return _Adapter()
+
+        reg_name = "_ndarray_op_%s_%d" % (type(self).__name__, id(self))
+        register(reg_name)(_Prop)
+        from .symbol import _create
+
+        return _create("Custom:" + reg_name, list(args),
+                       {k: v for k, v in kwargs.items()}, name=name)
+
+
+NativeOp = NDArrayOp  # the C-callback variant collapses onto the same bridge
 
 
 # surface Custom on the generated namespaces (parity: mx.nd.Custom /

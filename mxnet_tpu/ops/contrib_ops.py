@@ -683,3 +683,121 @@ def contrib_psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
             cols.append(jnp.where(cnt > 0, summed / jnp.maximum(cnt, 1), 0.0))
         rows.append(jnp.stack(cols, axis=-1))
     return jnp.stack(rows, axis=-2)
+
+
+# ----------------------------------------------------------------------
+# DeformableConvolution (reference src/operator/contrib/
+# deformable_convolution-inl.h — DCN v1: per-tap learned offsets feed a
+# bilinear deformable-im2col, then the usual weight GEMM)
+# ----------------------------------------------------------------------
+
+
+def _infer_deform_conv(in_shapes, attrs):
+    from .nn import _infer_conv
+
+    data = in_shapes[0]
+    kernel = tuple(int(x) for x in _lit(attrs["kernel"]))
+    stride = _lit(attrs.get("stride")) or (1, 1)
+    pad = _lit(attrs.get("pad")) or (0, 0)
+    dilate = _lit(attrs.get("dilate")) or (1, 1)
+    dg = int(_lit(attrs.get("num_deformable_group", 1)))
+    conv_in = [data] + [s for s in in_shapes[2:]]
+    shapes, outs = _infer_conv([data] + list(in_shapes[2:]), attrs)
+    ho, wo = outs[0][2], outs[0][3]
+    off = (data[0], 2 * dg * kernel[0] * kernel[1], ho, wo)
+    return [shapes[0], off] + shapes[1:], outs
+
+
+@register("_contrib_DeformableConvolution",
+          inputs=("data", "offset", "weight", "bias"),
+          infer_shape=_infer_deform_conv)
+def contrib_deformable_convolution(data, offset, weight, bias=None,
+                                   kernel=None, num_filter=None, stride=None,
+                                   pad=None, dilate=None, num_group=1,
+                                   num_deformable_group=1, no_bias=False,
+                                   **kw):
+    """2-D deformable convolution.  offset is (B, 2*DG*kh*kw, Ho, Wo) with
+    (y, x) pairs per kernel tap per deformable group; sampling is bilinear
+    with zero padding outside the image (deformable_im2col semantics)."""
+    from .tensor import _shape as _sh
+
+    kh, kw_ = _sh(kernel)
+    sh, sw = _sh(stride) or (1, 1)
+    ph, pw = _sh(pad) or (0, 0)
+    dh, dw = _sh(dilate) or (1, 1)
+    dg = int(_lit(num_deformable_group))
+    g = int(_lit(num_group))
+    b, c, h, w = data.shape
+    ho = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (w + 2 * pw - (dw * (kw_ - 1) + 1)) // sw + 1
+    base_y = jnp.arange(ho) * sh - ph  # top-left of each output's window
+    base_x = jnp.arange(wo) * sw - pw
+    off = offset.reshape(b, dg, kh * kw_, 2, ho, wo)
+    cols = []  # per-tap sampled feature maps
+    for ki in range(kh):
+        for kj in range(kw_):
+            tap = ki * kw_ + kj
+            oy = off[:, :, tap, 0]  # (B, DG, Ho, Wo)
+            ox = off[:, :, tap, 1]
+            y = base_y[None, None, :, None] + ki * dh + oy
+            x = base_x[None, None, None, :] + kj * dw + ox
+            # bilinear sample each deformable group's channel block
+            per_g = []
+            cg = c // dg
+            for d in range(dg):
+                from .spatial import _bilinear_sample
+
+                block = data[:, d * cg:(d + 1) * cg]
+                per_g.append(_bilinear_sample(block, x[:, d], y[:, d]))
+            cols.append(jnp.concatenate(per_g, axis=1))  # (B, C, Ho, Wo)
+    # (B, kh*kw, C, Ho, Wo) -> group GEMM with weight (O, C/g, kh, kw)
+    col = jnp.stack(cols, axis=1)
+    o = weight.shape[0]
+    wmat = weight.reshape(g, o // g, c // g, kh * kw_)
+    colg = col.reshape(b, kh * kw_, g, c // g, ho, wo)
+    out = jnp.einsum("bkgchw,gock->bgohw", colg, wmat)
+    out = out.reshape(b, o, ho, wo)
+    if bias is not None and not _bool(no_bias):
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# ----------------------------------------------------------------------
+# MultiProposal (reference src/operator/contrib/multi_proposal-inl.h —
+# Proposal over every image in the batch; rois carry the batch index)
+# ----------------------------------------------------------------------
+
+
+def _infer_multi_proposal(in_shapes, attrs):
+    cls = in_shapes[0]
+    post = int(_lit(attrs.get("rpn_post_nms_top_n", 300)))
+    outs = [(cls[0] * post, 5)]
+    if _bool(attrs.get("output_score", False)):
+        outs.append((cls[0] * post, 1))
+    return list(in_shapes), outs
+
+
+@register("_contrib_MultiProposal",
+          inputs=("cls_prob", "bbox_pred", "im_info"),
+          num_outputs=lambda a: 2 if _bool(a.get("output_score", False)) else 1,
+          infer_shape=_infer_multi_proposal)
+def contrib_multi_proposal(cls_prob, bbox_pred, im_info, **attrs):
+    """Batched Proposal: runs the single-image op per batch element and
+    stamps the batch index into roi column 0."""
+    b = cls_prob.shape[0]
+    outs, scores = [], []
+    want_score = _bool(attrs.get("output_score", False))
+    for i in range(b):
+        res = contrib_proposal(cls_prob[i:i + 1], bbox_pred[i:i + 1],
+                               im_info[i:i + 1], **attrs)
+        if want_score:
+            rois, sc = res
+            scores.append(sc)
+        else:
+            rois = res
+        rois = rois.at[:, 0].set(float(i))
+        outs.append(rois)
+    rois = jnp.concatenate(outs, axis=0)
+    if want_score:
+        return rois, jnp.concatenate(scores, axis=0)
+    return rois

@@ -182,3 +182,77 @@ def test_psroi_pooling():
                     exp = region.mean() if region.size else 0.0
                     np.testing.assert_allclose(out[r, ct, i, j], exp,
                                                rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    out = C.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w), mx.nd.array(b),
+        kernel=(3, 3), num_filter=6).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=(3, 3), num_filter=6).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    # constant offset (dy=0, dx=1): equivalent to convolving x shifted
+    # left by one (with zero fill on the right edge)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    off[:, 1::2] = 1.0  # x offsets
+    out = C.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), num_filter=3, no_bias=True).asnumpy()
+    xs = np.zeros_like(x)
+    xs[..., :-1] = x[..., 1:]
+    ref = mx.nd.Convolution(mx.nd.array(xs), mx.nd.array(w),
+                            kernel=(3, 3), num_filter=3, no_bias=True).asnumpy()
+    # interior matches exactly; the right edge differs (zero fill vs crop)
+    np.testing.assert_allclose(out[..., :, :-1], ref[..., :, :-1],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_gradients_flow():
+    rng = np.random.RandomState(8)
+    x = mx.nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    off = mx.nd.array((rng.randn(1, 18, 4, 4) * 0.3).astype(np.float32))
+    w = mx.nd.array(rng.randn(2, 2, 3, 3).astype(np.float32))
+    sym = mx.sym.MakeLoss(mx.sym.sum(mx.contrib.symbol.DeformableConvolution(
+        mx.sym.Variable("x"), mx.sym.Variable("off"), mx.sym.Variable("w"),
+        kernel=(3, 3), num_filter=2, no_bias=True)))
+    args = {"x": x, "off": off, "w": w}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    ex = sym.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward()
+    for n in ("x", "off", "w"):
+        g = ex.grad_dict[n].asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0, n
+
+
+def test_multi_proposal_batches():
+    rng = np.random.RandomState(9)
+    nA = 4
+    cls_prob = rng.uniform(0, 1, (2, 2 * nA, 3, 3)).astype(np.float32)
+    bbox_pred = (rng.randn(2, 4 * nA, 3, 3) * 0.1).astype(np.float32)
+    im_info = np.array([[24, 24, 1.0], [24, 24, 1.0]], np.float32)
+    rois = C.MultiProposal(mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+                           mx.nd.array(im_info), rpn_pre_nms_top_n=12,
+                           rpn_post_nms_top_n=4, rpn_min_size=2,
+                           scales=(4.0, 8.0), ratios=(0.5, 1.0),
+                           feature_stride=8).asnumpy()
+    assert rois.shape == (8, 5)
+    assert (rois[:4, 0] == 0).all() and (rois[4:, 0] == 1).all()
+    # per-image results equal the single-image op
+    single = C.Proposal(mx.nd.array(cls_prob[1:2]), mx.nd.array(bbox_pred[1:2]),
+                        mx.nd.array(im_info[1:2]), rpn_pre_nms_top_n=12,
+                        rpn_post_nms_top_n=4, rpn_min_size=2,
+                        scales=(4.0, 8.0), ratios=(0.5, 1.0),
+                        feature_stride=8).asnumpy()
+    np.testing.assert_allclose(rois[4:, 1:], single[:, 1:], rtol=1e-5)

@@ -134,3 +134,29 @@ def test_autograd_head_grads_and_reset():
         y2 = x * 2.0
     ag.backward([y2])
     np.testing.assert_allclose(gx.asnumpy(), [2.0, 2.0])
+
+
+def test_ndarray_op_legacy_bridge():
+    """Legacy NDArrayOp subclass builds a working symbol (reference
+    operator.py NDArrayOp:226 pattern)."""
+
+    class Square(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * in_data[0]
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 2.0 * in_data[0]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    op = Square()
+    sym = op(mx.sym.Variable("x"))
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    args = {"x": mx.nd.array(x)}
+    grads = {"x": mx.nd.zeros(x.shape)}
+    ex = sym.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x ** 2)
+    ex.backward(mx.nd.array(np.full(x.shape, 3.0, np.float32)))
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 6.0 * x)
