@@ -801,3 +801,90 @@ def contrib_multi_proposal(cls_prob, bbox_pred, im_info, **attrs):
     if want_score:
         return rois, jnp.concatenate(scores, axis=0)
     return rois
+
+
+# ----------------------------------------------------------------------
+# DeformablePSROIPooling (reference src/operator/contrib/
+# deformable_psroi_pooling.cu:70-141 — R-FCN deformable variant: each bin
+# averages sample_per_part² bilinear taps, optionally shifted by learned
+# per-part normalized offsets (trans) scaled by trans_std)
+# ----------------------------------------------------------------------
+
+
+def _infer_dpsroi(in_shapes, attrs):
+    rois = in_shapes[1]
+    od = int(_lit(attrs["output_dim"]))
+    ps = int(_lit(attrs["pooled_size"]))
+    return list(in_shapes), [(rois[0], od, ps, ps)]
+
+
+@register("_contrib_DeformablePSROIPooling",
+          inputs=("data", "rois", "trans"), infer_shape=_infer_dpsroi)
+def contrib_deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                                     output_dim=None, group_size=None,
+                                     pooled_size=None, part_size=0,
+                                     sample_per_part=1, trans_std=0.0,
+                                     no_trans=False, **kw):
+    scale = float(_lit(spatial_scale))
+    od = int(_lit(output_dim))
+    gs = int(_lit(group_size))
+    ps = int(_lit(pooled_size))
+    spp = int(_lit(sample_per_part))
+    tstd = float(_lit(trans_std))
+    ntr = _bool(no_trans)
+    part = int(_lit(part_size)) or ps
+    b, c, h, w = data.shape
+    n = rois.shape[0]
+    batch_ind = jnp.clip(rois[:, 0].astype(jnp.int32), 0, b - 1)
+    start_w = jnp.round(rois[:, 1]) * scale - 0.5
+    start_h = jnp.round(rois[:, 2]) * scale - 0.5
+    end_w = (jnp.round(rois[:, 3]) + 1.0) * scale - 0.5
+    end_h = (jnp.round(rois[:, 4]) + 1.0) * scale - 0.5
+    roi_w = jnp.maximum(end_w - start_w, 0.1)
+    roi_h = jnp.maximum(end_h - start_h, 0.1)
+    bin_h, bin_w = roi_h / ps, roi_w / ps
+    sub_h, sub_w = bin_h / spp, bin_w / spp
+    num_classes = 1 if ntr else trans.shape[1] // 2
+    ch_per_class = od // num_classes
+    roi_data = data[batch_ind].reshape(n, od, gs, gs, h, w)
+    rows = []
+    for ph in range(ps):
+        cols = []
+        for pw in range(ps):
+            gh = min(max(ph * gs // ps, 0), gs - 1)
+            gw = min(max(pw * gs // ps, 0), gs - 1)
+            part_h = min(ph * part // ps, part - 1)
+            part_w = min(pw * part // ps, part - 1)
+            if ntr:
+                tx = ty = jnp.zeros((n, 1))
+            else:
+                # trans (N, 2*num_classes, part, part); class per out chan
+                cls = jnp.arange(od) // ch_per_class  # (od,)
+                tx = trans[:, 2 * cls, part_h, part_w] * tstd  # (N, od)
+                ty = trans[:, 2 * cls + 1, part_h, part_w] * tstd
+            wstart = pw * bin_w[:, None] + start_w[:, None] + tx * roi_w[:, None]
+            hstart = ph * bin_h[:, None] + start_h[:, None] + ty * roi_h[:, None]
+            plane = roi_data[:, :, gh, gw]  # (N, od, H, W)
+            acc = jnp.zeros((n, plane.shape[1]) if ntr else (n, od))
+            cnt = jnp.zeros_like(acc)
+            for ih in range(spp):
+                for iw in range(spp):
+                    xs = wstart + iw * sub_w[:, None]
+                    ys = hstart + ih * sub_h[:, None]
+                    valid = ((xs >= -0.5) & (xs <= w - 0.5)
+                             & (ys >= -0.5) & (ys <= h - 0.5))
+                    xc = jnp.clip(xs, 0.0, w - 1.0)
+                    yc = jnp.clip(ys, 0.0, h - 1.0)
+                    from .spatial import _bilinear_sample
+
+                    # sample each output channel at its own point:
+                    # (N, od, H, W) at per-(N, od) coords
+                    v = _bilinear_sample(
+                        plane.reshape(n * plane.shape[1], 1, h, w),
+                        xc.reshape(-1, 1, 1), yc.reshape(-1, 1, 1)
+                    ).reshape(n, plane.shape[1])
+                    acc = acc + jnp.where(valid, v, 0.0)
+                    cnt = cnt + valid.astype(acc.dtype)
+            cols.append(jnp.where(cnt > 0, acc / jnp.maximum(cnt, 1), 0.0))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)

@@ -256,3 +256,67 @@ def test_multi_proposal_batches():
                         scales=(4.0, 8.0), ratios=(0.5, 1.0),
                         feature_stride=8).asnumpy()
     np.testing.assert_allclose(rois[4:, 1:], single[:, 1:], rtol=1e-5)
+
+
+def test_deformable_psroi_no_trans_matches_sampled_oracle():
+    rng = np.random.RandomState(10)
+    od, gs, ps, spp = 2, 2, 2, 2
+    data = rng.randn(1, od * gs * gs, 8, 8).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out = C.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.zeros((1, 2, ps, ps)),
+        spatial_scale=1.0, output_dim=od, group_size=gs, pooled_size=ps,
+        sample_per_part=spp, no_trans=True).asnumpy()
+    assert out.shape == (1, od, ps, ps)
+
+    def bilin(plane, y, x):
+        y0, x0 = int(math.floor(y)), int(math.floor(x))
+        wy, wx = y - y0, x - x0
+        v = 0.0
+        for dy, dx, wt in [(0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
+                           (1, 0, wy * (1 - wx)), (1, 1, wy * wx)]:
+            yy, xx = y0 + dy, x0 + dx
+            if 0 <= yy < 8 and 0 <= xx < 8:
+                v += plane[yy, xx] * wt
+        return v
+
+    sw, sh = round(1) * 1.0 - 0.5, round(1) * 1.0 - 0.5
+    ew, eh = (round(6) + 1) * 1.0 - 0.5, (round(6) + 1) * 1.0 - 0.5
+    rw, rh = max(ew - sw, 0.1), max(eh - sh, 0.1)
+    bh, bw = rh / ps, rw / ps
+    sbh, sbw = bh / spp, bw / spp
+    for ct in range(od):
+        for i in range(ps):
+            for j in range(ps):
+                gh = min(max(i * gs // ps, 0), gs - 1)
+                gw = min(max(j * gs // ps, 0), gs - 1)
+                cidx = (ct * gs + gh) * gs + gw
+                tot, cntv = 0.0, 0
+                for ih in range(spp):
+                    for iw in range(spp):
+                        x = j * bw + sw + iw * sbw
+                        y = i * bh + sh + ih * sbh
+                        if -0.5 <= x <= 7.5 and -0.5 <= y <= 7.5:
+                            tot += bilin(data[0, cidx],
+                                         min(max(y, 0), 7), min(max(x, 0), 7))
+                            cntv += 1
+                exp = tot / cntv if cntv else 0.0
+                np.testing.assert_allclose(out[0, ct, i, j], exp,
+                                           rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_trans_shifts():
+    rng = np.random.RandomState(11)
+    od, gs, ps = 2, 1, 1
+    data = rng.randn(1, od, 8, 8).astype(np.float32)
+    rois = np.array([[0, 2, 2, 5, 5]], np.float32)
+    base = C.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.zeros((1, 2, 1, 1)),
+        spatial_scale=1.0, output_dim=od, group_size=gs, pooled_size=ps,
+        sample_per_part=2, trans_std=0.1, no_trans=False).asnumpy()
+    shifted = C.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois),
+        mx.nd.array(np.ones((1, 2, 1, 1), np.float32)),
+        spatial_scale=1.0, output_dim=od, group_size=gs, pooled_size=ps,
+        sample_per_part=2, trans_std=0.1, no_trans=False).asnumpy()
+    assert not np.allclose(base, shifted)  # offsets move the samples

@@ -136,3 +136,33 @@ def test_det_iter_feeds_multibox_target(tmp_path):
     loc_t, loc_m, cls_t = mx.contrib.ndarray.MultiBoxTarget(
         anchors, batch.label[0], mx.nd.zeros((1, 3, anchors.shape[1])))
     assert (cls_t.asnumpy() == 2).sum() > 0  # class 1 -> target id 2 somewhere
+
+
+def test_im2rec_detection_list_roundtrip(tmp_path):
+    """im2rec-packed detection list -> ImageDetRecordIter."""
+    cv2 = pytest.importorskip("cv2")
+    import subprocess
+    import sys
+    import os
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    for i in range(2):
+        cv2.imwrite(str(root / ("im%d.png" % i)), _make_img(seed=i))
+    lst = tmp_path / "det.lst"
+    # index, A=2, B=5, objects..., path
+    rows = [
+        "0\t2\t5\t1\t0.1\t0.2\t0.5\t0.6\tim0.png",
+        "1\t2\t5\t0\t0.3\t0.3\t0.8\t0.9\t2\t0.0\t0.1\t0.4\t0.5\tim1.png",
+    ]
+    lst.write_text("\n".join(rows) + "\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run([sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+                    str(tmp_path / "det"), str(root)], check=True,
+                   capture_output=True)
+    it = mx.io.ImageDetRecordIter(path_imgrec=str(tmp_path / "det.rec"),
+                                  data_shape=(3, 8, 8), batch_size=2)
+    batch = next(iter(it))
+    lab = batch.label[0].asnumpy()
+    np.testing.assert_allclose(lab[0, 0], [1, 0.1, 0.2, 0.5, 0.6], atol=1e-6)
+    np.testing.assert_allclose(lab[1, 1], [2, 0.0, 0.1, 0.4, 0.5], atol=1e-6)

@@ -2,6 +2,11 @@
 """Pack images into RecordIO (parity: reference tools/im2rec.py).
 
 List-file format (reference-compatible): index\tlabel[\tlabel2...]\tpath
+Multi-column labels pass through verbatim, so DETECTION lists
+(index\tA\tB\t<extras>\t<id x1 y1 x2 y2>*\tpath — the im2rec detection
+convention) pack into records that mx.io.ImageDetRecordIter consumes
+directly.
+
 Usage:
     python tools/im2rec.py prefix image_root --list  # generate list
     python tools/im2rec.py prefix image_root         # pack prefix.lst → prefix.rec
