@@ -130,6 +130,12 @@ class NDArray:
     # DLPack interop (reference include/mxnet/ndarray.h:401 SetDLTensor;
     # zero-copy exchange with numpy/torch/jax ecosystems)
     # ------------------------------------------------------------------
+    def __array__(self, dtype=None, copy=None):
+        # numpy interop: np.asarray(nd) is one bulk transfer, not a
+        # per-element __getitem__ walk
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
     def __dlpack__(self, *args, **kwargs):
         return self.data.__dlpack__(*args, **kwargs)
 
@@ -230,7 +236,9 @@ class NDArray:
     # symbolic share one definition (SURVEY.md §7 phase 2)
     # ------------------------------------------------------------------
     def _binary(self, other, op_name, scalar_name, reverse=False):
-        if isinstance(other, NDArray) or isinstance(other, jax.Array):
+        if isinstance(other, _np.ndarray) and other.ndim == 0:
+            other = float(other)
+        if isinstance(other, (NDArray, jax.Array, _np.ndarray)):
             lhs, rhs = self.data, _as_jax(other)
             if reverse:
                 lhs, rhs = rhs, lhs

@@ -1,0 +1,95 @@
+"""FeedForward, SequentialModule, PythonLossModule, check_consistency —
+module-family surfaces that had no coverage (round-1 VERDICT weak list)."""
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.io as mio
+from mxnet_tpu import test_utils as tu
+
+
+def _toy(seed=0, n=256, d=10, k=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = np.argmax(X @ rng.randn(d, k), 1).astype(np.float32)
+    return X, y
+
+
+def _mlp(hidden=32, k=3):
+    x = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(x, num_hidden=hidden), act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=k),
+                                name="softmax")
+
+
+def test_feedforward_fit_predict_checkpoint(tmp_path):
+    mx.random.seed(11)
+    X, y = _toy()
+    train = mio.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    model = mx.model.FeedForward(
+        _mlp(), ctx=mx.cpu(), num_epoch=4, optimizer="sgd",
+        initializer=mx.init.Xavier(), learning_rate=0.1, momentum=0.9)
+    model.fit(train)
+    preds = model.predict(mio.NDArrayIter(X, y, batch_size=32)).asnumpy()
+    acc = (preds.argmax(1) == y).mean()
+    assert acc > 0.9, acc
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, epoch=4)
+    loaded = mx.model.FeedForward.load(prefix, 4, ctx=mx.cpu())
+    preds2 = loaded.predict(mio.NDArrayIter(X, y, batch_size=32)).asnumpy()
+    np.testing.assert_allclose(preds2, preds, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_module():
+    mx.random.seed(12)
+    X, y = _toy()
+    it = mio.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    # stage 1: feature net; stage 2: classifier consuming stage-1 output
+    feat = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=24, name="s1fc"),
+        act_type="tanh", name="s1act")
+    head = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("s1act_output"), num_hidden=3, name="s2fc"),
+        name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, data_names=("data",), label_names=None,
+                          context=mx.cpu()))
+    seq.add(mx.mod.Module(head, data_names=("s1act_output",),
+                          label_names=("softmax_label",), context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    seq.fit(it, num_epoch=5, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    score = seq.score(mio.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.85, score
+    args, _ = seq.get_params()
+    assert "s1fc_weight" in args and "s2fc_weight" in args
+
+
+def test_python_loss_module():
+    # PythonLossModule backpropagates a hand-written gradient into the
+    # preceding module (reference python_module.py PythonLossModule)
+    def nll_grad(scores, labels):
+        g = scores.asnumpy().copy()
+        g[np.arange(len(g)), labels.asnumpy().astype(int)] -= 1.0
+        return g
+
+    mod = mx.mod.PythonLossModule(data_names=("pred",), grad_func=nll_grad)
+    batch = mio.DataBatch(data=[mx.nd.array(np.array([[1.0, -2.0]], np.float32))],
+                          label=[mx.nd.array(np.array([0.0], np.float32))])
+    mod.bind(data_shapes=[("pred", (1, 2))], label_shapes=[("softmax_label", (1,))])
+    mod.init_params()
+    mod.forward(batch, is_train=True)
+    out = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, [[1.0, -2.0]])
+    mod.backward()
+    grads = mod.get_input_grads()
+    np.testing.assert_allclose(grads[0].asnumpy(), [[0.0, -2.0]])
+
+
+def test_check_consistency_across_contexts():
+    # reference test_operator_gpu.py pattern: same symbol on multiple
+    # contexts, outputs/grads cross-compared — cpu(0) vs cpu(1) here
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    ctx_list = [{"ctx": mx.cpu(0), "data": (3, 5)},
+                {"ctx": mx.cpu(1), "data": (3, 5)}]
+    tu.check_consistency(sym, ctx_list)
