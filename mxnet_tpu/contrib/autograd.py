@@ -32,7 +32,14 @@ _STATE = _TapeState()
 
 
 def set_is_training(is_train):
-    """Toggle training/recording (parity: contrib/autograd.py set_is_training)."""
+    """Toggle training/recording (parity: contrib/autograd.py set_is_training).
+
+    Only toggles — the tape persists across toggles and is consumed by
+    `backward` (so grads can be taken after leaving the scope); a thread
+    pausing recording via test_section resumes onto the same tape.
+    NOTE: the hook install is process-wide while `is_training` is
+    thread-local, matching the reference's global training mode switch.
+    """
     from .. import ndarray as _nd_mod
 
     prev = _STATE.is_training
@@ -40,8 +47,6 @@ def set_is_training(is_train):
     # the imperative recording hook is installed only while recording, so
     # the common not-recording path pays a single `is None` check per op
     _nd_mod._RECORD_HOOK = _record if is_train else None
-    if not is_train:
-        _STATE.tape = []
     return prev
 
 
@@ -57,7 +62,9 @@ class train_section:
         return self
 
     def __exit__(self, *args):
-        _STATE.is_training = self._prev
+        # restore via set_is_training so the recording hook installs/
+        # uninstalls consistently with the state flag
+        set_is_training(self._prev)
 
 
 class test_section:
@@ -66,7 +73,7 @@ class test_section:
         return self
 
     def __exit__(self, *args):
-        _STATE.is_training = self._prev
+        set_is_training(self._prev)
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

@@ -160,8 +160,16 @@ class ImageDetRecordIterImpl(DataIter):
                 break
             img, objs = self._load_one(self._offsets[int(self._order[self._cursor])])
             data[i] = img
-            k = min(len(objs), self.max_objects)
-            if k:
-                labels[i, :k] = objs[:k]
+            if objs.size and objs.shape[1] != self._obj_width:
+                raise MXNetError(
+                    "record object width %d != %d (inconsistent .rec labels)"
+                    % (objs.shape[1], self._obj_width))
+            if len(objs) > self.max_objects:
+                raise MXNetError(
+                    "record has %d objects > label_pad_width=%d — raise "
+                    "label_pad_width (labels must not be silently truncated)"
+                    % (len(objs), self.max_objects))
+            if len(objs):
+                labels[i, :len(objs)] = objs
             self._cursor += 1
         return DataBatch(data=[array(data)], label=[array(labels)], pad=pad)

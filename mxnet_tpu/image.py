@@ -288,10 +288,26 @@ class ImageIter(DataIter):
         if path_imgrec:
             # stream via the indexed native reader — an ImageNet-scale .rec
             # must not be buffered into RAM
-            from .native import NativeRecordReader, native_index
+            try:
+                from .native import NativeRecordReader, native_index
 
-            self.imgrec = NativeRecordReader(path_imgrec)
-            self._offsets = native_index(path_imgrec)
+                self.imgrec = NativeRecordReader(path_imgrec)
+                self._offsets = native_index(path_imgrec)
+            except (RuntimeError, OSError):
+                # no C toolchain: fall back to buffering via the pure-python
+                # reader (the pre-streaming behavior)
+                from .recordio import MXRecordIO
+
+                reader = MXRecordIO(path_imgrec, "r")
+                self._buffered = []
+                while True:
+                    raw = reader.read()
+                    if raw is None:
+                        break
+                    self._buffered.append(raw)
+                reader.close()
+                self.imgrec = _BufferedRecords(self._buffered)
+                self._offsets = list(range(len(self._buffered)))
         else:
             entries = []
             if imglist is not None:
@@ -355,3 +371,13 @@ class ImageIter(DataIter):
             self._cursor += 1
         label_out = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch(data=[array(data)], label=[array(label_out)], pad=pad)
+
+
+class _BufferedRecords:
+    """read_at shim over in-memory records (no-native-toolchain fallback)."""
+
+    def __init__(self, records):
+        self._records = records
+
+    def read_at(self, idx):
+        return self._records[idx]

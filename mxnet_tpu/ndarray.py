@@ -133,6 +133,10 @@ class NDArray:
     def __array__(self, dtype=None, copy=None):
         # numpy interop: np.asarray(nd) is one bulk transfer, not a
         # per-element __getitem__ walk
+        if copy is False:
+            raise ValueError(
+                "NDArray->numpy always copies (device-to-host transfer); "
+                "copy=False cannot be honored")
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 

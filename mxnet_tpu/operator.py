@@ -166,7 +166,6 @@ class PythonOp:
 
     def __init__(self, need_top_grad=True):
         self.need_top_grad_ = need_top_grad
-        self._counter = [0]
 
     def __call__(self, *args, **kwargs):
         return self.get_symbol(*args, **kwargs)
@@ -202,9 +201,17 @@ class NDArrayOp(PythonOp):
     the jitted graph like any other.
     """
 
+    _next_uid = [0]
+
     def get_symbol(self, *args, **kwargs):
         name = kwargs.pop("name", None)
         outer = self
+        if getattr(self, "_reg_name", None) is not None:
+            # one registration per instance; later calls reuse it
+            from .symbol import _create
+
+            return _create("Custom:" + self._reg_name, list(args),
+                           dict(kwargs), name=name)
 
         class _Prop(CustomOpProp):
             def __init__(self):
@@ -231,7 +238,12 @@ class NDArrayOp(PythonOp):
 
                 return _Adapter()
 
-        reg_name = "_ndarray_op_%s_%d" % (type(self).__name__, id(self))
+        # monotonic uid: id(self) can be reused after gc, which would let a
+        # new instance overwrite a live symbol's registration
+        NDArrayOp._next_uid[0] += 1
+        reg_name = "_ndarray_op_%s_%d" % (type(self).__name__,
+                                          NDArrayOp._next_uid[0])
+        self._reg_name = reg_name
         register(reg_name)(_Prop)
         from .symbol import _create
 

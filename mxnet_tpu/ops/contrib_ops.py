@@ -701,7 +701,6 @@ def _infer_deform_conv(in_shapes, attrs):
     pad = _lit(attrs.get("pad")) or (0, 0)
     dilate = _lit(attrs.get("dilate")) or (1, 1)
     dg = int(_lit(attrs.get("num_deformable_group", 1)))
-    conv_in = [data] + [s for s in in_shapes[2:]]
     shapes, outs = _infer_conv([data] + list(in_shapes[2:]), attrs)
     ho, wo = outs[0][2], outs[0][3]
     off = (data[0], 2 * dg * kernel[0] * kernel[1], ho, wo)

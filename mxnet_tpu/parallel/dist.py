@@ -220,10 +220,11 @@ class Scheduler:
                 # a closed connection counts as dead unless the job is done
                 self._left.add(node)
                 waiters = list(self._barrier_waiters)
+                dead = self._dead_nodes()
             # wake any barrier waiters so they can observe the dead node
             for c in waiters:
                 try:
-                    self._send(c, _DEADNODES_R, _meta(dead=self._dead_nodes()))
+                    self._send(c, _DEADNODES_R, _meta(dead=dead))
                 except Exception:
                     pass
 
