@@ -29,7 +29,10 @@ def _create_kvstore(kvstore, num_device, arg_params):
     update_on_kvstore = True
     if kvstore is None:
         kv = None
-    elif isinstance(kvstore, KVStore):
+    elif isinstance(kvstore, KVStore) or (
+            hasattr(kvstore, "push") and hasattr(kvstore, "pull")):
+        # KVStore façade OR the distributed client (DistKVStore) — the
+        # reference accepts any KVStore handle here (model.py:40-77)
         kv = kvstore
     elif isinstance(kvstore, str):
         if num_device == 1 and "dist" not in kvstore:

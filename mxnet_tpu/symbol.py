@@ -322,6 +322,16 @@ class Symbol:
     # serialization — MXNet-style nodes/arg_nodes/heads JSON
     # (parity: reference nnvm SaveJSON via src/c_api/c_api_symbolic.cc)
     # ------------------------------------------------------------------
+    def __getstate__(self):
+        # Symbols pickle via their JSON graph form (the node DAG uses
+        # __slots__); needed when a dist kvstore ships an optimizer whose
+        # attrs include the bound symbol (reference pickles optimizers to
+        # servers, kvstore.py set_optimizer)
+        return {"json": self.tojson()}
+
+    def __setstate__(self, state):
+        self._entries = load_json(state["json"])._entries
+
     def tojson(self):
         order = _topo_order(self._entries)
         node_ids = {id(n): i for i, n in enumerate(order)}

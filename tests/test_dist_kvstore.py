@@ -55,3 +55,17 @@ def test_dead_node_detection():
                    "MXNET_KVSTORE_DEAD_TIMEOUT": "3"})
     assert "DEAD_DETECTED" in proc.stdout, proc.stdout + proc.stderr
     assert "BARRIER_PASSED_UNEXPECTEDLY" not in proc.stdout, proc.stdout
+
+
+def test_dist_training_convergence():
+    """Distributed Module.fit end-to-end (reference dist_lenet.py gate)."""
+    proc = _run_launch(2, 2, os.path.join(REPO, "tests", "dist_lenet_script.py"),
+                       timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import re
+
+    sigs = re.findall(r"DIST_LENET_OK rank \d+ acc [\d.]+ sig ([-\d.]+)",
+                      proc.stdout)
+    assert len(sigs) == 2, proc.stdout + proc.stderr
+    # identical parameters on every worker after dist_sync training
+    assert abs(float(sigs[0]) - float(sigs[1])) < 1e-4, sigs

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (parity: reference tools/parse_log.py —
+the nightly accuracy gates grep their thresholds out of these logs,
+reference tests/nightly/test_all.sh:43-50).
+
+Reads fit() output lines:
+    Epoch[3] Train-accuracy=0.94
+    Epoch[3] Time cost=12.2
+    Epoch[3] Validation-accuracy=0.95
+and prints one row per epoch: epoch, train metric, valid metric, time.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric="accuracy"):
+    rows = {}
+    res = [
+        re.compile(r"Epoch\[(\d+)\] Train-%s=([\d.einf-]+)" % re.escape(metric)),
+        re.compile(r"Epoch\[(\d+)\] Validation-%s=([\d.einf-]+)" % re.escape(metric)),
+        re.compile(r"Epoch\[(\d+)\] Time cost=([\d.]+)"),
+    ]
+    for line in lines:
+        for col, rx in enumerate(res):
+            m = rx.search(line)
+            if m:
+                epoch = int(m.group(1))
+                rows.setdefault(epoch, [None, None, None])[col] = float(m.group(2))
+    return [(e,) + tuple(v) for e, v in sorted(rows.items())]
+
+
+def main():
+    parser = argparse.ArgumentParser(description="parse training logs")
+    parser.add_argument("logfile", nargs="?", help="log file (default stdin)")
+    parser.add_argument("--format", choices=["markdown", "none"],
+                        default="markdown")
+    parser.add_argument("--metric", type=str, default="accuracy")
+    args = parser.parse_args()
+    lines = open(args.logfile).readlines() if args.logfile else sys.stdin.readlines()
+    rows = parse(lines, metric=args.metric)
+    if args.format == "markdown":
+        print("| epoch | train-%s | valid-%s | time |" % (args.metric, args.metric))
+        print("| --- | --- | --- | --- |")
+    for e, tr, va, t in rows:
+        fmt = lambda v: ("%.6f" % v) if v is not None else "-"  # noqa: E731
+        if args.format == "markdown":
+            print("| %d | %s | %s | %s |" % (e, fmt(tr), fmt(va), fmt(t)))
+        else:
+            print(e, fmt(tr), fmt(va), fmt(t))
+
+
+if __name__ == "__main__":
+    main()
