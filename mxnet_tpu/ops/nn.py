@@ -346,6 +346,9 @@ def _infer_bn(in_shapes, attrs):
     need_is_train=True,
     num_aux_out=2,
     aliases=("BatchNorm_v1",),
+    params={"eps": P.Float(default=1e-3, low=0.0),
+            "momentum": P.Float(default=0.9, low=0.0, high=1.0),
+            "fix_gamma": P.Bool(), "use_global_stats": P.Bool()},
 )
 def batch_norm(
     data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9, fix_gamma=True,
@@ -454,7 +457,9 @@ def _infer_leaky(in_shapes, attrs):
     return [data], [data]
 
 
-@register("LeakyReLU", inputs=("data", "gamma"), infer_shape=_infer_leaky)
+@register("LeakyReLU", inputs=("data", "gamma"), infer_shape=_infer_leaky,
+          params={"act_type": P.Enum(("leaky", "elu", "prelu", "rrelu")),
+                  "slope": P.Float(default=0.25, low=0.0)})
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334, **kw):
     """Leaky family (reference src/operator/leaky_relu-inl.h)."""
     act = str(act_type)
@@ -522,7 +527,9 @@ def _infer_embed(in_shapes, attrs):
     return [data, (idim, odim)], [tuple(data) + (odim,)]
 
 
-@register("Embedding", inputs=("data", "weight"), infer_shape=_infer_embed)
+@register("Embedding", inputs=("data", "weight"), infer_shape=_infer_embed,
+          params={"input_dim": P.Int(required=True, low=1, desc="vocab size"),
+                  "output_dim": P.Int(required=True, low=1, desc="embed dim")})
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", **kw):
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 

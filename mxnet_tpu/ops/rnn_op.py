@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register
+from . import params as _P
 from .tensor import _bool, _lit
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
@@ -93,7 +94,12 @@ def _cell_step(mode, h_prev, c_prev, gi, gh):
 
 @register("RNN", inputs=("data", "parameters", "state", "state_cell"),
           num_outputs=_num_outputs, infer_shape=_infer_rnn,
-          need_is_train=True, need_rng=True)
+          need_is_train=True, need_rng=True,
+          params={"state_size": _P.Int(required=True, low=1),
+                  "num_layers": _P.Int(default=1, low=1),
+                  "mode": _P.Enum(("rnn_relu", "rnn_tanh", "lstm", "gru")),
+                  "bidirectional": _P.Bool(),
+                  "p": _P.Float(default=0.0, low=0.0, high=1.0)})
 def rnn(data, parameters, state, state_cell=None, state_size=None,
         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
         state_outputs=False, is_train=False, rng=None, **kw):
