@@ -26,7 +26,8 @@ from jax import lax
 from .collectives import shard_map
 from .mesh import NamedSharding, P
 
-__all__ = ["ring_attention", "ring_attention_sharded", "blockwise_attention"]
+__all__ = ["ring_attention", "ring_attention_sharded", "blockwise_attention",
+           "ulysses_attention", "ulysses_attention_sharded"]
 
 
 def _attn_block(q, k_blk, v_blk, bias, o, l, m, scale):
@@ -133,3 +134,57 @@ def blockwise_attention(q, k, v, block_size, causal=False, scale=None):
     m0 = jnp.full((b, h, t), neg)
     (o, l, m, _), _ = lax.scan(step, (o0, l0, m0, 0), (kb, vb))
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Ulysses-style sequence parallelism: two all-to-alls swap the sharded
+    dim between sequence and heads (SURVEY §5: "Ulysses-style all-to-all
+    head/sequence swaps").
+
+    Call inside shard_map with seq sharded over `axis_name` and heads
+    divisible by the axis size: the first all-to-all gives every device
+    the FULL sequence for heads/n heads, attention runs locally with exact
+    softmax (no ring accumulation), and the second all-to-all restores the
+    seq sharding.  Complements ring attention: better for moderate T with
+    many heads (two collectives total vs n ppermute hops).
+    """
+    n = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    assert h % n == 0, "heads (%d) must divide the seq axis size (%d)" % (h, n)
+    scale = (d ** -0.5) if scale is None else scale
+
+    def seq_to_heads(x):
+        # (B, T/n, H, D) -> gather seq, scatter heads -> (B, T, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf).astype(jnp.float32) * scale
+    if causal:
+        t = t_local * n
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1).astype(vf.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+    return heads_to_seq(out).astype(q.dtype)
+
+
+def ulysses_attention_sharded(mesh, q, k, v, seq_axis="seq", batch_axis=None,
+                              causal=False, scale=None):
+    """Host-level Ulysses attention over (B, T, H, D) arrays."""
+    batch = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(batch, seq_axis, None, None)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def f(qs, ks, vs):
+        return ulysses_attention(qs, ks, vs, seq_axis, causal=causal,
+                                 scale=scale)
+
+    sh = NamedSharding(mesh, spec)
+    return f(jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh))

@@ -8,6 +8,7 @@ asserts the sweep plus the dedicated test files touch >=80% of all
 registered ops.
 """
 import math
+import zlib
 
 import numpy as np
 import pytest
@@ -87,7 +88,7 @@ UNARY_OPS = {
 @pytest.mark.parametrize("name", sorted(UNARY_OPS))
 def test_unary_forward_and_grad(name):
     np_fn, (lo, hi), gradable = UNARY_OPS[name]
-    rng = RS(hash(name) % (2 ** 31))
+    rng = RS(zlib.crc32(name.encode()) % (2 ** 31))
     x = rng.uniform(lo, hi, (3, 4)).astype(np.float32)
     sym = getattr(S, name)(S.Variable("x"))
     _fwd(sym, {"x": x}, [np_fn(x)], rtol=1e-4, atol=1e-5)
@@ -120,7 +121,7 @@ BINARY_OPS = {
 @pytest.mark.parametrize("name", sorted(BINARY_OPS))
 def test_binary_forward_and_grad(name):
     np_fn, gradable = BINARY_OPS[name]
-    rng = RS(hash(name) % (2 ** 31))
+    rng = RS(zlib.crc32(name.encode()) % (2 ** 31))
     a = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
     b = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
     sym = getattr(S, name)(S.Variable("a"), S.Variable("b"))
@@ -156,7 +157,7 @@ SCALAR_OPS = {
 @pytest.mark.parametrize("name", sorted(SCALAR_OPS))
 def test_scalar_ops(name):
     np_fn = SCALAR_OPS[name]
-    rng = RS(hash(name) % (2 ** 31))
+    rng = RS(zlib.crc32(name.encode()) % (2 ** 31))
     x = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
     sym = getattr(S, name)(S.Variable("x"), scalar=1.5)
     _fwd(sym, {"x": x}, [np_fn(x, 1.5)], rtol=1e-4, atol=1e-5)

@@ -102,3 +102,24 @@ def test_contrib_blockwise_attention_op():
     ex.backward()
     for n, g in ex.grad_dict.items():
         assert np.isfinite(g.asnumpy()).all() and np.abs(g.asnumpy()).max() > 0, n
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    from mxnet_tpu.parallel.ring_attention import ulysses_attention_sharded
+    B, T, H, D = 2, 32, 4, 8  # H=4 divisible by seq axis 4
+    q, k, v = _qkv(6, B, T, H, D)
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    out = np.asarray(ulysses_attention_sharded(mesh, q, k, v, causal=causal))
+    ref = _full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    from mxnet_tpu.parallel.ring_attention import ulysses_attention_sharded
+    B, T, H, D = 1, 16, 4, 4
+    q, k, v = _qkv(7, B, T, H, D)
+    mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    ring = np.asarray(ring_attention_sharded(mesh, q, k, v, causal=True))
+    uly = np.asarray(ulysses_attention_sharded(mesh, q, k, v, causal=True))
+    np.testing.assert_allclose(uly, ring, rtol=2e-4, atol=2e-5)
