@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes (parity: reference
+tools/kill-mxnet.py, which pkill'ed the python jobs on each host).
+
+Local mode kills every process whose command line references the given
+script (default: any process with DMLC_ROLE in its environment, i.e.
+launcher-spawned workers/servers/schedulers).
+
+    python tools/kill-mxnet.py [script_name]
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+
+def _ancestors():
+    """This process and its parents — never kill the invoking shell."""
+    out = set()
+    pid = os.getpid()
+    while pid > 1:
+        out.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            break
+    return out
+
+
+def main():
+    needle = sys.argv[1] if len(sys.argv) > 1 else None
+    skip = _ancestors()
+    killed = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) in skip:
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                env = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if needle is not None:
+            match = needle in cmd
+        else:
+            match = "DMLC_ROLE=" in env
+        if match and "python" in cmd:
+            try:
+                os.kill(int(pid), signal.SIGTERM)
+                killed.append((int(pid), cmd.strip()))
+            except OSError:
+                pass
+    for pid, cmd in killed:
+        print("killed %d: %s" % (pid, cmd[:100]))
+    if not killed:
+        print("no matching processes")
+
+
+if __name__ == "__main__":
+    main()
