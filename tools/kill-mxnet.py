@@ -39,13 +39,18 @@ def main():
         try:
             with open("/proc/%s/cmdline" % pid, "rb") as f:
                 cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
-            with open("/proc/%s/environ" % pid, "rb") as f:
-                env = f.read().decode(errors="replace")
         except OSError:
             continue
         if needle is not None:
             match = needle in cmd
         else:
+            # environ is only readable for same-uid processes; needed only
+            # for the default DMLC_ROLE discovery mode
+            try:
+                with open("/proc/%s/environ" % pid, "rb") as f:
+                    env = f.read().decode(errors="replace")
+            except OSError:
+                continue
             match = "DMLC_ROLE=" in env
         if match and "python" in cmd:
             try:

@@ -18,9 +18,10 @@ import sys
 
 def parse(lines, metric="accuracy"):
     rows = {}
+    num = r"([-+]?(?:[\d.]+(?:e[-+]?\d+)?|nan|inf))"
     res = [
-        re.compile(r"Epoch\[(\d+)\] Train-%s=([\d.einf-]+)" % re.escape(metric)),
-        re.compile(r"Epoch\[(\d+)\] Validation-%s=([\d.einf-]+)" % re.escape(metric)),
+        re.compile(r"Epoch\[(\d+)\] Train-%s=%s" % (re.escape(metric), num), re.I),
+        re.compile(r"Epoch\[(\d+)\] Validation-%s=%s" % (re.escape(metric), num), re.I),
         re.compile(r"Epoch\[(\d+)\] Time cost=([\d.]+)"),
     ]
     for line in lines:
