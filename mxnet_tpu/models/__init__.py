@@ -8,6 +8,8 @@ from . import alexnet
 from . import vgg
 from . import inception_v3
 from . import ssd
+from . import googlenet
+from . import inception_bn
 from .lenet import get_lenet
 from .mlp import get_mlp
 from .resnet import get_resnet
@@ -15,3 +17,5 @@ from .alexnet import get_alexnet
 from .vgg import get_vgg
 from .inception_v3 import get_inception_v3
 from .ssd import get_ssd_vgg16, get_ssd_tiny
+from .googlenet import get_googlenet
+from .inception_bn import get_inception_bn

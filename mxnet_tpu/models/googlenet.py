@@ -1,0 +1,47 @@
+"""GoogLeNet / Inception v1 (reference example/image-classification/symbols/
+googlenet.py behavior — "Going Deeper with Convolutions")."""
+from .. import symbol as sym
+
+__all__ = ["get_googlenet"]
+
+
+def _conv(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    conv = sym.Convolution(data, kernel=kernel, stride=stride, pad=pad,
+                           num_filter=num_filter, name="conv_%s" % name)
+    return sym.Activation(conv, act_type="relu", name="relu_%s" % name)
+
+
+def _inception(data, n1, n3r, n3, n5r, n5, pool, proj, name):
+    c1 = _conv(data, n1, (1, 1), name="%s_1x1" % name)
+    c3 = _conv(_conv(data, n3r, (1, 1), name="%s_3x3r" % name),
+               n3, (3, 3), pad=(1, 1), name="%s_3x3" % name)
+    c5 = _conv(_conv(data, n5r, (1, 1), name="%s_5x5r" % name),
+               n5, (5, 5), pad=(2, 2), name="%s_5x5" % name)
+    p = sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                    pool_type=pool, name="%s_pool" % name)
+    cp = _conv(p, proj, (1, 1), name="%s_proj" % name)
+    return sym.Concat(c1, c3, c5, cp, name="ch_concat_%s" % name)
+
+
+def get_googlenet(num_classes=1000):
+    data = sym.Variable("data")
+    body = _conv(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="conv1")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    body = _conv(body, 64, (1, 1), name="conv2")
+    body = _conv(body, 192, (3, 3), pad=(1, 1), name="conv3")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    body = _inception(body, 64, 96, 128, 16, 32, "max", 32, "in3a")
+    body = _inception(body, 128, 128, 192, 32, 96, "max", 64, "in3b")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    body = _inception(body, 192, 96, 208, 16, 48, "max", 64, "in4a")
+    body = _inception(body, 160, 112, 224, 24, 64, "max", 64, "in4b")
+    body = _inception(body, 128, 128, 256, 24, 64, "max", 64, "in4c")
+    body = _inception(body, 112, 144, 288, 32, 64, "max", 64, "in4d")
+    body = _inception(body, 256, 160, 320, 32, 128, "max", 128, "in4e")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    body = _inception(body, 256, 160, 320, 32, 128, "max", 128, "in5a")
+    body = _inception(body, 384, 192, 384, 48, 128, "max", 128, "in5b")
+    body = sym.Pooling(body, kernel=(7, 7), stride=(1, 1), pool_type="avg")
+    flat = sym.Flatten(body)
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(fc, name="softmax")

@@ -1,0 +1,99 @@
+"""Inception-BN / Inception v2 (reference example/image-classification/
+symbols/inception-bn.py behavior — "Batch Normalization" paper network;
+a simpler stack for <=28px inputs, the full A/B-factory stack otherwise)."""
+from .. import symbol as sym
+
+__all__ = ["get_inception_bn"]
+
+_EPS = 2e-5
+_BN_MOM = 0.9
+
+
+def _conv(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None,
+          suffix=""):
+    conv = sym.Convolution(data, kernel=kernel, stride=stride, pad=pad,
+                           num_filter=num_filter,
+                           name="conv_%s%s" % (name, suffix))
+    bn = sym.BatchNorm(conv, fix_gamma=False, eps=_EPS, momentum=_BN_MOM,
+                       name="bn_%s%s" % (name, suffix))
+    return sym.Activation(bn, act_type="relu", name="relu_%s%s" % (name, suffix))
+
+
+def _factory_a(data, n1, n3r, n3, nd3r, nd3, pool, proj, name):
+    c1 = _conv(data, n1, (1, 1), name="%s_1x1" % name)
+    c3 = _conv(_conv(data, n3r, (1, 1), name="%s_3x3" % name, suffix="_reduce"),
+               n3, (3, 3), pad=(1, 1), name="%s_3x3" % name)
+    cd = _conv(data, nd3r, (1, 1), name="%s_d3x3" % name, suffix="_reduce")
+    cd = _conv(cd, nd3, (3, 3), pad=(1, 1), name="%s_d3x3_0" % name)
+    cd = _conv(cd, nd3, (3, 3), pad=(1, 1), name="%s_d3x3_1" % name)
+    p = sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                    pool_type=pool, name="%s_pool" % name)
+    cp = _conv(p, proj, (1, 1), name="%s_proj" % name)
+    return sym.Concat(c1, c3, cd, cp, name="ch_concat_%s" % name)
+
+
+def _factory_b(data, n3r, n3, nd3r, nd3, name):
+    c3 = _conv(_conv(data, n3r, (1, 1), name="%s_3x3" % name, suffix="_reduce"),
+               n3, (3, 3), pad=(1, 1), stride=(2, 2), name="%s_3x3" % name)
+    cd = _conv(data, nd3r, (1, 1), name="%s_d3x3" % name, suffix="_reduce")
+    cd = _conv(cd, nd3, (3, 3), pad=(1, 1), name="%s_d3x3_0" % name)
+    cd = _conv(cd, nd3, (3, 3), pad=(1, 1), stride=(2, 2), name="%s_d3x3_1" % name)
+    p = sym.Pooling(data, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                    pool_type="max", name="%s_pool" % name)
+    return sym.Concat(c3, cd, p, name="ch_concat_%s" % name)
+
+
+def _simple(data, c1, c3, name):
+    a = _conv(data, c1, (1, 1), name="%s_1x1" % name)
+    b = _conv(data, c3, (3, 3), pad=(1, 1), name="%s_3x3" % name)
+    return sym.Concat(a, b, name="%s_concat" % name)
+
+
+def _downsample(data, c3, name):
+    conv = _conv(data, c3, (3, 3), stride=(2, 2), pad=(1, 1),
+                 name="%s_conv" % name)
+    pool = sym.Pooling(data, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max", name="%s_pool" % name)
+    return sym.Concat(conv, pool, name="%s_concat" % name)
+
+
+def get_inception_bn(num_classes=1000, image_shape=(3, 224, 224)):
+    height = image_shape[1]
+    data = sym.Variable("data")
+    if height <= 28:
+        body = _conv(data, 96, (3, 3), pad=(1, 1), name="1")
+        body = _simple(body, 32, 32, "in3a")
+        body = _simple(body, 32, 48, "in3b")
+        body = _downsample(body, 80, "in3c")
+        body = _simple(body, 112, 48, "in4a")
+        body = _simple(body, 96, 64, "in4b")
+        body = _simple(body, 80, 80, "in4c")
+        body = _simple(body, 48, 96, "in4d")
+        body = _downsample(body, 96, "in4e")
+        body = _simple(body, 176, 160, "in5a")
+        body = _simple(body, 176, 160, "in5b")
+        body = sym.Pooling(body, kernel=(7, 7), pool_type="avg",
+                           name="global_pool")
+    else:
+        body = _conv(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="1")
+        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2),
+                           pool_type="max", name="pool_1")
+        body = _conv(body, 64, (1, 1), name="2_red")
+        body = _conv(body, 192, (3, 3), pad=(1, 1), name="2")
+        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2),
+                           pool_type="max", name="pool_2")
+        body = _factory_a(body, 64, 64, 64, 64, 96, "avg", 32, "3a")
+        body = _factory_a(body, 64, 64, 96, 64, 96, "avg", 64, "3b")
+        body = _factory_b(body, 128, 160, 64, 96, "3c")
+        body = _factory_a(body, 224, 64, 96, 96, 128, "avg", 128, "4a")
+        body = _factory_a(body, 192, 96, 128, 96, 128, "avg", 128, "4b")
+        body = _factory_a(body, 160, 128, 160, 128, 160, "avg", 128, "4c")
+        body = _factory_a(body, 96, 128, 192, 160, 192, "avg", 128, "4d")
+        body = _factory_b(body, 128, 192, 192, 256, "4e")
+        body = _factory_a(body, 352, 192, 320, 160, 224, "avg", 128, "5a")
+        body = _factory_a(body, 352, 192, 320, 192, 224, "max", 128, "5b")
+        body = sym.Pooling(body, kernel=(7, 7), stride=(1, 1),
+                           pool_type="avg", name="global_pool")
+    flat = sym.Flatten(body)
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(fc, name="softmax")

@@ -208,3 +208,27 @@ def test_pack_unpack():
     h3, content = mx.recordio.unpack(packed)
     assert list(h3.label) == [1.0, 2.0]
     assert content == b"p2"
+
+
+def test_model_zoo_symbols_build_and_forward():
+    """Every zoo model symbol binds and runs one forward (shape sanity)."""
+    from mxnet_tpu import models
+
+    cases = [
+        (models.get_googlenet(num_classes=10), (1, 3, 224, 224), (1, 10)),
+        (models.get_inception_bn(num_classes=10), (1, 3, 224, 224), (1, 10)),
+        (models.get_inception_bn(num_classes=10, image_shape=(3, 28, 28)),
+         (1, 3, 28, 28), (1, 10)),
+    ]
+    for net, in_shape, out_shape in cases:
+        _, out_shapes, _ = net.infer_shape(data=in_shape)
+        assert out_shapes[0] == out_shape, (out_shapes, out_shape)
+    # forward the small one end to end
+    net = models.get_inception_bn(num_classes=10, image_shape=(3, 28, 28))
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 28, 28), grad_req="null")
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.random.RandomState(0)
+                                .rand(2, 3, 28, 28).astype(np.float32)))
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
