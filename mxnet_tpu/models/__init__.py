@@ -10,6 +10,7 @@ from . import inception_v3
 from . import ssd
 from . import googlenet
 from . import inception_bn
+from . import resnext
 from .lenet import get_lenet
 from .mlp import get_mlp
 from .resnet import get_resnet
@@ -19,3 +20,4 @@ from .inception_v3 import get_inception_v3
 from .ssd import get_ssd_vgg16, get_ssd_tiny
 from .googlenet import get_googlenet
 from .inception_bn import get_inception_bn
+from .resnext import get_resnext, resnext

@@ -1,0 +1,68 @@
+"""ResNeXt (reference example/image-classification/symbols/resnext.py
+behavior — "Aggregated Residual Transformations"): the bottleneck's 3x3
+becomes a grouped convolution with `num_group` cardinality."""
+from .. import symbol as sym
+
+__all__ = ["get_resnext", "resnext"]
+
+_DEPTH_UNITS = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+
+
+def _unit(data, num_filter, stride, dim_match, name, num_group, bn_mom=0.9,
+          bottle_width_ratio=0.5):
+    mid = int(num_filter * bottle_width_ratio)
+    conv1 = sym.Convolution(data, num_filter=mid, kernel=(1, 1), no_bias=True,
+                            name=name + "_conv1")
+    bn1 = sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                        name=name + "_bn1")
+    act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+    conv2 = sym.Convolution(act1, num_filter=mid, kernel=(3, 3), stride=stride,
+                            pad=(1, 1), num_group=num_group, no_bias=True,
+                            name=name + "_conv2")
+    bn2 = sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                        name=name + "_bn2")
+    act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
+    conv3 = sym.Convolution(act2, num_filter=num_filter, kernel=(1, 1),
+                            no_bias=True, name=name + "_conv3")
+    bn3 = sym.BatchNorm(conv3, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                        name=name + "_bn3")
+    if dim_match:
+        shortcut = data
+    else:
+        sc = sym.Convolution(data, num_filter=num_filter, kernel=(1, 1),
+                             stride=stride, no_bias=True, name=name + "_sc")
+        shortcut = sym.BatchNorm(sc, fix_gamma=False, eps=2e-5,
+                                 momentum=bn_mom, name=name + "_sc_bn")
+    return sym.Activation(bn3 + shortcut, act_type="relu",
+                          name=name + "_relu")
+
+
+def get_resnext(units, num_classes=1000, num_group=32,
+                filter_list=(256, 512, 1024, 2048), bn_mom=0.9):
+    data = sym.Variable("data")
+    body = sym.Convolution(data, num_filter=64, kernel=(7, 7), stride=(2, 2),
+                           pad=(3, 3), no_bias=True, name="conv0")
+    body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                         name="bn0")
+    body = sym.Activation(body, act_type="relu", name="relu0")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max")
+    for i, n in enumerate(units):
+        stride = (1, 1) if i == 0 else (2, 2)
+        body = _unit(body, filter_list[i], stride, False,
+                     "stage%d_unit1" % (i + 1), num_group)
+        for j in range(n - 1):
+            body = _unit(body, filter_list[i], (1, 1), True,
+                         "stage%d_unit%d" % (i + 1, j + 2), num_group)
+    pool = sym.Pooling(body, global_pool=True, kernel=(7, 7), pool_type="avg",
+                       name="pool1")
+    flat = sym.Flatten(pool)
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def resnext(depth, num_classes=1000, num_group=32):
+    if depth not in _DEPTH_UNITS:
+        raise ValueError("depth must be one of %s" % sorted(_DEPTH_UNITS))
+    return get_resnext(_DEPTH_UNITS[depth], num_classes=num_classes,
+                       num_group=num_group)

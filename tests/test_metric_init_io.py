@@ -232,3 +232,11 @@ def test_model_zoo_symbols_build_and_forward():
     out = ex.outputs[0].asnumpy()
     assert out.shape == (2, 10)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_resnext_builds():
+    from mxnet_tpu.models.resnext import resnext
+
+    net = resnext(50, num_classes=7)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 7)
