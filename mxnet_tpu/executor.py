@@ -43,9 +43,11 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
     an edge crosses two ctx_groups a replicated sharding constraint is
     applied, the SPMD analog of the reference's _CrossDeviceCopy insertion
     at PlaceDevice boundaries (reference src/executor/graph_executor.cc:347-360).
-    `cast` is (compute_dtype, keep_fp32_names): float args/aux are cast to
-    the compute dtype ON ENTRY to the executable (labels and other names in
-    the keep set stay fp32) and outputs/aux-updates are cast back on exit.
+    `cast` is (compute_dtype, keep_fp32_names): float args are cast to the
+    compute dtype ON ENTRY to the executable (labels and other names in the
+    keep set stay fp32) and outputs are cast back on exit.  Aux states stay
+    in their STORAGE dtype end-to-end — ops cast them at point of use — so
+    fp32 running statistics never round-trip through bf16.
     Because the cast sits inside the traced function, `jax.vjp` returns
     fp32 gradients for the fp32 master parameters automatically — the
     multi-precision training recipe (reference python/mxnet/optimizer.py
@@ -63,7 +65,11 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
 
     out_dtypes = {n: v.dtype for n, v in zip(aux_names, aux_vals)}
     arg_env = {n: _to_compute(n, v) for n, v in zip(arg_names, arg_vals)}
-    aux_env = {n: _to_compute(n, v) for n, v in zip(aux_names, aux_vals)}
+    # aux states (BatchNorm running stats) are NEVER cast to the compute
+    # dtype: re-quantizing carried fp32 statistics through bf16 every step
+    # degrades them — the reference multi-precision recipe (cuDNN BN) keeps
+    # statistics fp32 under fp16 compute; ops cast at the point of use
+    aux_env = dict(zip(aux_names, aux_vals))
     env = {}
     aux_updates = dict(aux_env)
     for i, node in enumerate(order):
@@ -118,8 +124,13 @@ _INDEX_ARG_SLOTS = {
 
 
 def _index_like_args(symbol):
-    """Variable args fed into an index slot of any consumer op."""
+    """Variable args whose values reach an index slot of any consumer op,
+    traced TRANSITIVELY through intermediate ops (an index routed through
+    e.g. `slice` before `take` must not round through bf16 either).  The
+    closure over-approximates — a variable feeding both an index path and a
+    magnitude path is kept fp32, trading a little speed for correctness."""
     keep = set()
+    pending = []  # nodes whose producing subgraph feeds an index slot
     for node in _topo_order(symbol._entries):
         if node.op is None:
             continue
@@ -128,9 +139,18 @@ def _index_like_args(symbol):
             continue
         for i in slots:
             if i < len(node.inputs):
-                src, _ = node.inputs[i]
-                if src.op is None:
-                    keep.add(src.name)
+                pending.append(node.inputs[i][0])
+    seen = set()
+    while pending:
+        src = pending.pop()
+        if id(src) in seen:
+            continue
+        seen.add(id(src))
+        if src.op is None:
+            if not src.is_aux:
+                keep.add(src.name)
+        else:
+            pending.extend(s for s, _ in src.inputs)
     return keep
 
 
@@ -321,7 +341,8 @@ class Executor:
 
     @staticmethod
     def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             group2ctx=None, shared_exec=None, mesh=None, param_shardings=None):
+             group2ctx=None, shared_exec=None, mesh=None, param_shardings=None,
+             compute_dtype=None, fp32_names=()):
         """Bind with user-provided arrays (reference Executor::Bind).
 
         `group2ctx` maps ctx_group names to Contexts: groups are sharded
@@ -371,7 +392,8 @@ class Executor:
         else:
             aux_dict = dict(zip(aux_names, aux_states))
         return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh,
-                        param_shardings=param_shardings, node_groups=node_groups)
+                        param_shardings=param_shardings, node_groups=node_groups,
+                        compute_dtype=compute_dtype, fp32_names=fp32_names)
 
     # ------------------------------------------------------------------
     # data-path helpers
@@ -735,12 +757,18 @@ class Executor:
                 arg_dict[n] = cur
             else:
                 arg_dict[n] = NDArray(jnp.zeros(s, dtype=cur.dtype), self._first_ctx)
-        return Executor(
+        new_exec = Executor(
             self._symbol, self._ctx, arg_dict,
             {n: NDArray(jnp.zeros_like(arg_dict[n].data), self._first_ctx) for n in self.grad_dict},
             dict(self._grad_req), dict(self.aux_dict), mesh=self._mesh,
             param_shardings=self._param_shardings, node_groups=self._node_groups,
+            compute_dtype=self._compute_dtype, fp32_names=self._fp32_names,
         )
+        # a rebound executor keeps the training regime: the fused
+        # single-dispatch step survives reshape (bucketing hot path)
+        if getattr(self, "_fused_updater", None) is not None:
+            new_exec.install_fused_update(self._fused_updater, self._fused_index_of_name)
+        return new_exec
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback

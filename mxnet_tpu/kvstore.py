@@ -141,8 +141,14 @@ def create(name="local"):
             from .parallel.dist import DistKVStore
 
             return DistKVStore(name)
-        # no cluster configured: degrade to local semantics, rank 0 of 1
-        return KVStore(name)
+        # Reference ps-lite aborts when the cluster env is missing
+        # (src/kvstore/kvstore.cc:16-43); silently degrading to a healthy-
+        # looking single-worker run hides typo'd DMLC_ROLE deployments.
+        raise MXNetError(
+            "kvstore type %r requires a cluster environment: launch via "
+            "tools/launch.py or set DMLC_ROLE / DMLC_PS_ROOT_URI / "
+            "DMLC_PS_ROOT_PORT / DMLC_NUM_WORKER / DMLC_NUM_SERVER "
+            "(use 'local' or 'device' for single-process training)" % name)
     if name in ("local", "local_update_cpu", "local_allreduce_cpu", "local_allreduce_device", "device"):
         return KVStore(name)
     raise MXNetError("Unknown KVStore type %s" % name)

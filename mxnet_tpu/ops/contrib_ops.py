@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
 from .registry import register
 from .tensor import _bool, _lit
 
@@ -310,6 +311,11 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
                        background_id=0, nms_threshold=0.5, force_suppress=False,
                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):
     """Convert SSD predictions to detections [id, score, xmin, ymin, xmax, ymax]."""
+    if int(_lit(background_id)) != 0:
+        # _detect_one hardcodes class 0 as background; fail fast instead of
+        # silently producing wrong detections (unsupported-param convention)
+        raise MXNetError("_contrib_MultiBoxDetection: only background_id=0 "
+                         "is supported, got %s" % background_id)
     anchors = anchor.reshape(-1, 4)
     f = partial(_detect_one, anchors=anchors, clip=_bool(clip),
                 threshold=float(_lit(threshold)),

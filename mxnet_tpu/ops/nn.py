@@ -369,16 +369,26 @@ def batch_norm(
     bshape = tuple(bshape)
     if _bool(fix_gamma):
         gamma = jnp.ones_like(gamma)
+    # batch statistics accumulate in fp32 even under bf16 compute (the
+    # cuDNN-BN multi-precision recipe); moving stats stay in their storage
+    # dtype (fp32) — see executor._run_graph, which no longer casts aux
     if is_train and not _bool(use_global_stats):
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
-        new_mm = moving_mean * momentum + lax.stop_gradient(mean) * (1 - momentum)
-        new_mv = moving_var * momentum + lax.stop_gradient(var) * (1 - momentum)
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=reduce_axes)
+        var = jnp.var(x32, axis=reduce_axes)
+        new_mm = moving_mean * momentum + lax.stop_gradient(mean).astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + lax.stop_gradient(var).astype(moving_var.dtype) * (1 - momentum)
     else:
-        mean, var = moving_mean, moving_var
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
         new_mm, new_mv = moving_mean, moving_var
+    # fold normalization into ONE per-channel affine: out = data*w + b.
+    # Halves the elementwise HBM traffic vs sub/mul/mul/add and keeps the
+    # output in data.dtype (bf16 end-to-end under mixed precision)
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) * gamma.reshape(bshape) + beta.reshape(bshape)
+    g32 = gamma.astype(jnp.float32)
+    w = (g32 * inv).astype(data.dtype)
+    b = (beta.astype(jnp.float32) - mean * inv * g32).astype(data.dtype)
+    out = data * w.reshape(bshape) + b.reshape(bshape)
     return out, new_mm, new_mv
 
 

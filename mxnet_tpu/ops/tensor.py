@@ -526,7 +526,7 @@ def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
     return jnp.matmul(lhs, rhs)
 
 
-@register("_linalg_gemm2", inputs=("A", "B"))
+@register("_linalg_gemm2", inputs=("A", "B"), aliases=("linalg_gemm2",))
 def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
     if _bool(transpose_a):
         A = jnp.swapaxes(A, -1, -2)
@@ -535,16 +535,68 @@ def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
     return float(_lit(alpha)) * jnp.matmul(A, B)
 
 
-@register("_linalg_potrf", inputs=("A",))
+@register("_linalg_potrf", inputs=("A",), aliases=("linalg_potrf",))
 def linalg_potrf(A, **kw):
     return jnp.linalg.cholesky(A)
 
 
-@register("_linalg_syrk", inputs=("A",))
+@register("_linalg_syrk", inputs=("A",), aliases=("linalg_syrk",))
 def linalg_syrk(A, transpose=False, alpha=1.0, **kw):
     if _bool(transpose):
         A = jnp.swapaxes(A, -1, -2)
     return float(_lit(alpha)) * jnp.matmul(A, jnp.swapaxes(A, -1, -2))
+
+
+@register("_linalg_gemm", inputs=("A", "B", "C"), aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, **kw):
+    """BLAS3 gemm: alpha*op(A)@op(B) + beta*C (reference
+    src/operator/tensor/la_op.cc:16-63), batched over leading dims."""
+    if _bool(transpose_a):
+        A = jnp.swapaxes(A, -1, -2)
+    if _bool(transpose_b):
+        B = jnp.swapaxes(B, -1, -2)
+    return float(_lit(alpha)) * jnp.matmul(A, B) + float(_lit(beta)) * C
+
+
+@register("_linalg_trmm", inputs=("A", "B"), aliases=("linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0, **kw):
+    """Triangular matrix multiply: alpha*op(A)@B or alpha*B@op(A), A lower
+    triangular (reference src/operator/tensor/la_op.cc:232-282).  On TPU a
+    triangular matmul IS a dense MXU matmul — the zero pattern is data."""
+    if _bool(transpose):
+        A = jnp.swapaxes(A, -1, -2)
+    prod = jnp.matmul(B, A) if _bool(rightside) else jnp.matmul(A, B)
+    return float(_lit(alpha)) * prod
+
+
+@register("_linalg_trsm", inputs=("A", "B"), aliases=("linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0, **kw):
+    """Solve op(A)@X = alpha*B (or X@op(A) = alpha*B), A lower triangular
+    (reference src/operator/tensor/la_op.cc:293-345)."""
+    return lax.linalg.triangular_solve(
+        A, float(_lit(alpha)) * B, left_side=not _bool(rightside),
+        lower=True, transpose_a=_bool(transpose))
+
+
+@register("_linalg_potri", inputs=("A",), aliases=("linalg_potri",))
+def linalg_potri(A, **kw):
+    """Inverse from a Cholesky factor: out = (A@A^T)^-1 for lower-triangular
+    A (reference src/operator/tensor/la_op.cc:183-222).  Computed as
+    A^-T @ A^-1 via two triangular solves — no general inverse needed."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    ainv = lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(ainv, -1, -2), ainv)
+
+
+@register("_linalg_sumlogdiag", inputs=("A",), aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A, **kw):
+    """Sum of log of diagonal elements per matrix (reference
+    src/operator/tensor/la_op.cc:347-383); a (2,2) input reduces to
+    shape (1,) like the reference LaReduceShape<2>."""
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    out = jnp.sum(jnp.log(d), axis=-1)
+    return out.reshape((1,)) if out.ndim == 0 else out
 
 
 # ----------------------------------------------------------------------

@@ -59,6 +59,7 @@ _HEARTBEAT = 13
 _DEADNODES = 14
 _DEADNODES_R = 15
 _ERROR = 16
+_FINALIZE = 17
 
 BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
 # liveness knobs (reference analog: ps-lite heartbeats + CheckDeadNodes,
@@ -145,6 +146,7 @@ class Scheduler:
         self._barrier_waiters = []
         self._last_seen = {}  # node id "role:rank" -> monotonic timestamp
         self._left = set()  # nodes whose connection closed
+        self._finalized = set()  # nodes that deregistered cleanly (ps-lite Finalize)
         self._send_locks = {}  # id(conn) -> Lock serializing frame sends
         self._stopped = False
 
@@ -156,10 +158,15 @@ class Scheduler:
             _send_frame(conn, cmd, meta)
 
     def _dead_nodes(self):
+        """Nodes that vanished WITHOUT a _FINALIZE deregistration.  A clean
+        exit (FINALIZE then close) is never reported dead — matching ps-lite,
+        where Finalize() removes the node before the connection drops."""
         now = time.monotonic()
-        dead = sorted(self._left)
+        dead = sorted(self._left - self._finalized)
         for node, seen in self._last_seen.items():
-            if node not in self._left and now - seen > DEAD_NODE_TIMEOUT:
+            if node in self._left or node in self._finalized:
+                continue
+            if now - seen > DEAD_NODE_TIMEOUT:
                 dead.append(node)
         return dead
 
@@ -214,6 +221,10 @@ class Scheduler:
                     with self._lock:
                         dead = self._dead_nodes()
                     self._send(conn, _DEADNODES_R, _meta(dead=dead))
+                elif cmd == _FINALIZE:
+                    with self._lock:
+                        self._finalized.add(node)
+                    self._send(conn, _ACK)
                 # _HEARTBEAT: timestamp already refreshed above
         except (ConnectionError, OSError):
             with self._lock:
@@ -558,7 +569,9 @@ class DistKVStore:
         self.barrier()
 
     def close(self):
-        """Rank-0 stops servers (reference kStopServer on finalize)."""
+        """Graceful exit: barrier, rank-0 stops servers, then deregister
+        from the scheduler so peers never see this node as dead (reference
+        ps-lite Finalize(); kStopServer on finalize)."""
         self.barrier()
         if self._rank == 0:
             for i in range(len(self._servers)):
@@ -566,6 +579,19 @@ class DistKVStore:
                     self._rpc(i, _STOP)
                 except Exception:
                     pass
+        try:
+            with self._sched_recv_lock:
+                # bounded handshake: a dead-but-not-RST scheduler must not
+                # hang worker shutdown waiting for the ACK forever
+                self._sched.settimeout(10.0)
+                with self._sched_send_lock:
+                    _send_frame(self._sched, _FINALIZE)
+                while True:
+                    cmd, _, _ = _recv_frame(self._sched)
+                    if cmd == _ACK:
+                        break
+        except Exception:
+            pass
 
     def save_optimizer_states(self, fname):
         raise MXNetError("Cannot save states for distributed training")
@@ -594,6 +620,11 @@ def _start_heartbeat(sock, send_lock, stop_event=None):
             try:
                 with send_lock:
                     _send_frame(sock, _HEARTBEAT)
+            except socket.timeout:
+                # transient: barrier() puts a short timeout on this shared
+                # socket — a timed-out beat must not kill the loop (the node
+                # would then be declared dead after DEAD_NODE_TIMEOUT)
+                continue
             except (OSError, ConnectionError):
                 return
 

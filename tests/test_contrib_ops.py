@@ -276,3 +276,16 @@ def test_ctc_loss_symbolic_grad():
     ex.backward()
     g = ex.grad_dict["data"].asnumpy()
     assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_multibox_detection_rejects_nonzero_background_id():
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    cls_prob = mx.nd.array(np.ones((1, 3, 4)) / 3.0)
+    loc_pred = mx.nd.zeros((1, 16))
+    anchor = mx.nd.array(np.random.RandomState(0).rand(1, 4, 4))
+    with pytest.raises(MXNetError, match="background_id"):
+        contrib.ndarray.MultiBoxDetection(cls_prob, loc_pred, anchor,
+                                          background_id=1)

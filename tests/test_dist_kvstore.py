@@ -69,3 +69,23 @@ def test_dist_training_convergence():
     assert len(sigs) == 2, proc.stdout + proc.stderr
     # identical parameters on every worker after dist_sync training
     assert abs(float(sigs[0]) - float(sigs[1])) < 1e-4, sigs
+
+
+def test_dist_create_without_cluster_env_raises():
+    # round-2 review: a typo'd DMLC_ROLE must not silently yield a healthy-
+    # looking single-worker run (reference ps-lite aborts)
+    import os
+
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+
+    saved = {k: os.environ.pop(k, None) for k in ("DMLC_ROLE", "MXTPU_DIST_URI")}
+    try:
+        with pytest.raises(MXNetError, match="cluster environment"):
+            mx.kv.create("dist_sync")
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v

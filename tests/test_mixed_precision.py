@@ -106,3 +106,66 @@ def test_bf16_outputs_are_fp32_and_close_to_fp32_run():
     bf = run("bfloat16")
     assert bf.dtype == np.float32  # outputs cast back on exit
     np.testing.assert_allclose(bf, ref, atol=0.05)
+
+
+def test_bf16_survives_reshape():
+    # round-2 review: Executor.reshape rebuilt without compute_dtype —
+    # any reshape after Module(compute_dtype=...) silently reverted to fp32
+    net = _mlp()
+    ex = mx.executor.Executor.simple_bind(
+        net, mx.cpu(), grad_req="write", compute_dtype="bfloat16",
+        data=(4, 10), softmax_label=(4,))
+    ex2 = ex.reshape(data=(8, 10), softmax_label=(8,))
+    assert ex2._compute_dtype == ex._compute_dtype
+    assert ex2._fp32_names == ex._fp32_names
+
+
+def test_bind_accepts_compute_dtype():
+    net = _mlp()
+    args = {n: mx.nd.zeros(s) for n, s in zip(
+        net.list_arguments(),
+        net.infer_shape(data=(4, 10), softmax_label=(4,))[0])}
+    ex = mx.executor.Executor.bind(net, mx.cpu(), args, args_grad=None,
+                                   compute_dtype="bfloat16")
+    assert ex._compute_dtype is not None
+
+
+def test_bf16_index_protection_is_transitive():
+    # an index routed through an intermediate op (slice before take) must
+    # also keep its source variable fp32
+    idx = mx.sym.Variable("idx")
+    src = mx.sym.Variable("src")
+    sliced = mx.sym.slice(idx, begin=(0,), end=(2,))
+    net = mx.sym.take(src, sliced)
+    ex = mx.executor.Executor.simple_bind(net, mx.cpu(), grad_req="null",
+                                          compute_dtype="bfloat16",
+                                          src=(2000, 4), idx=(4,))
+    assert "idx" in ex._fp32_names
+    w = np.random.RandomState(0).randn(2000, 4).astype(np.float32)
+    ex.arg_dict["src"][:] = w
+    ex.arg_dict["idx"][:] = np.array([1001, 1999, 3, 5], np.float32)
+    ex.forward(is_train=False)
+    got = ex.outputs[0].asnumpy()
+    exp = w[[1001, 1999]]
+    np.testing.assert_allclose(got, exp, rtol=1e-2, atol=1e-2)
+
+
+def test_bf16_keeps_bn_aux_fp32():
+    # advisor finding: casting BN moving stats to bf16 on entry re-quantizes
+    # the carried fp32 statistics every step; they must stay fp32
+    x = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(x, name="bn", fix_gamma=False, momentum=0.9)
+    ex = mx.executor.Executor.simple_bind(net, mx.cpu(), grad_req="null",
+                                          compute_dtype="bfloat16",
+                                          data=(8, 4))
+    # a moving mean NOT representable in bf16 (needs >8 mantissa bits);
+    # zero data => batch mean 0, so new_mm = momentum * mm EXACTLY
+    mm = np.full((4,), 1.0 + 2 ** -12, np.float32)
+    ex.aux_dict["bn_moving_mean"][:] = mm
+    ex.arg_dict["data"][:] = np.zeros((8, 4), np.float32)
+    ex.forward(is_train=True)
+    _ = ex.outputs[0].asnumpy()
+    new_mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert str(new_mm.dtype) == "float32"
+    # old bf16 round-trip collapsed 1+2^-12 to 1.0 (error ~2.2e-4)
+    np.testing.assert_allclose(new_mm, 0.9 * mm, rtol=0, atol=1e-6)

@@ -353,6 +353,75 @@ def test_dot_and_linalg():
          rtol=1e-4, atol=1e-5)
 
 
+def test_la_op_family():
+    """la_op family vs numpy/scipy oracles (reference
+    src/operator/tensor/la_op.cc describe-block examples + random cases)."""
+    import scipy.linalg as sla
+
+    rng = RS(9)
+    # gemm: out = alpha*op(A)@op(B) + beta*C   (doc example, la_op.cc:16-47)
+    A = np.ones((2, 2), np.float32)
+    B = np.ones((3, 2), np.float32)
+    C = np.ones((2, 3), np.float32)
+    _fwd(S.linalg_gemm(S.Variable("A"), S.Variable("B"), S.Variable("C"),
+                       transpose_b=True, alpha=2.0, beta=10.0),
+         {"A": A, "B": B, "C": C}, [np.full((2, 3), 14.0, np.float32)])
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(2, 4, 5).astype(np.float32)
+    c = rng.randn(2, 3, 5).astype(np.float32)
+    _fwd(S.linalg_gemm(S.Variable("A"), S.Variable("B"), S.Variable("C"),
+                       alpha=0.5, beta=-1.5),
+         {"A": a, "B": b, "C": c}, [0.5 * (a @ b) - 1.5 * c],
+         rtol=1e-4, atol=1e-5)
+    _ngrad(S.linalg_gemm(S.Variable("A"), S.Variable("B"), S.Variable("C")),
+           {"A": a[0], "B": b[0], "C": c[0]})
+    # lower-triangular factor for trmm/trsm/potri
+    spd = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)
+    L = np.linalg.cholesky(spd)
+    Bm = rng.randn(2, 3).astype(np.float32)
+    # trmm doc example (la_op.cc:232-262)
+    _fwd(S.linalg_trmm(S.Variable("A"), S.Variable("B"), alpha=2.0),
+         {"A": np.array([[1.0, 0], [1.0, 1.0]], np.float32),
+          "B": np.ones((2, 3), np.float32)},
+         [np.array([[2.0, 2.0, 2.0], [4.0, 4.0, 4.0]], np.float32)])
+    _fwd(S.linalg_trmm(S.Variable("A"), S.Variable("B"), transpose=True),
+         {"A": L, "B": Bm}, [L.T @ Bm], rtol=1e-4, atol=1e-5)
+    Br = rng.randn(3, 2).astype(np.float32)
+    _fwd(S.linalg_trmm(S.Variable("A"), S.Variable("B"), rightside=True),
+         {"A": L, "B": Br}, [Br @ L], rtol=1e-4, atol=1e-5)
+    # trsm: solves op(A) X = alpha B  (doc example la_op.cc:293-330)
+    _fwd(S.linalg_trsm(S.Variable("A"), S.Variable("B"), alpha=0.5),
+         {"A": np.array([[1.0, 0], [1.0, 1.0]], np.float32),
+          "B": np.array([[2.0, 2.0, 2.0], [4.0, 4.0, 4.0]], np.float32)},
+         [np.ones((2, 3), np.float32)])
+    _fwd(S.linalg_trsm(S.Variable("A"), S.Variable("B")),
+         {"A": L, "B": Bm},
+         [sla.solve_triangular(L, Bm, lower=True)], rtol=1e-4, atol=1e-5)
+    _fwd(S.linalg_trsm(S.Variable("A"), S.Variable("B"), rightside=True,
+                       transpose=True),
+         {"A": L, "B": Br},
+         [sla.solve_triangular(L, Br.T, lower=True, trans='N').T],
+         rtol=1e-4, atol=1e-5)
+    _ngrad(S.linalg_trsm(S.Variable("A"), S.Variable("B")),
+           {"A": L + np.eye(2, dtype=np.float32), "B": Bm})
+    # potri: (L L^T)^-1 from the factor (doc example la_op.cc:183-213)
+    _fwd(S.linalg_potri(S.Variable("A")),
+         {"A": np.array([[2.0, 0], [0.5, 2.0]], np.float32)},
+         [np.array([[0.265625, -0.0625], [-0.0625, 0.25]], np.float32)],
+         rtol=1e-4, atol=1e-5)
+    _fwd(S.linalg_potri(S.Variable("A")), {"A": L}, [np.linalg.inv(spd)],
+         rtol=1e-4, atol=1e-4)
+    # sumlogdiag (doc example la_op.cc:347-372): (2,2) input -> shape (1,)
+    _fwd(S.linalg_sumlogdiag(S.Variable("A")),
+         {"A": np.array([[1.0, 1.0], [1.0, 7.0]], np.float32)},
+         [np.array([np.log(7.0)], np.float32)], rtol=1e-5, atol=1e-5)
+    batch = np.stack([spd, 2 * spd]).astype(np.float32)
+    _fwd(S.linalg_sumlogdiag(S.Variable("A")), {"A": batch},
+         [np.log(np.diagonal(batch, axis1=-2, axis2=-1)).sum(-1)],
+         rtol=1e-5, atol=1e-5)
+    _ngrad(S.linalg_sumlogdiag(S.Variable("A")), {"A": spd})
+
+
 # ======================================================================
 # NN layer ops vs torch oracles
 # ======================================================================
@@ -668,7 +737,8 @@ COVERED_ELSEWHERE = {
     # test_contrib_ops2.py
     "_contrib_fft", "_contrib_ifft", "_contrib_quantize",
     "_contrib_dequantize", "_contrib_count_sketch", "_contrib_Proposal",
-    "_contrib_PSROIPooling",
+    "_contrib_PSROIPooling", "_contrib_MultiProposal",
+    "_contrib_DeformableConvolution", "_contrib_DeformablePSROIPooling",
 }
 
 TABLE_COVERED = (
@@ -681,6 +751,8 @@ TABLE_COVERED = (
         "Pad", "Crop", "take", "batch_take", "one_hot", "gather_nd",
         "scatter_nd", "pick", "where", "Embedding", "sort", "argsort", "topk",
         "dot", "batch_dot", "_linalg_gemm2", "_linalg_potrf", "_linalg_syrk",
+        "_linalg_gemm", "_linalg_trmm", "_linalg_trsm", "_linalg_potri",
+        "_linalg_sumlogdiag",
         "FullyConnected", "Convolution", "Deconvolution", "Pooling",
         "BatchNorm", "InstanceNorm", "L2Normalization", "LRN", "Activation",
         "LeakyReLU", "softmax", "log_softmax", "SoftmaxActivation", "Dropout",
@@ -702,5 +774,7 @@ def test_zz_registry_coverage():
     covered = sum(1 for names in groups.values() if names & covered_names)
     frac = covered / total
     missing = sorted(min(n) for n in groups.values() if not (n & covered_names))
-    assert frac >= 0.8, (
-        "op test coverage %.1f%% < 80%%; uncovered: %s" % (100 * frac, missing))
+    # every registered op must have an oracle test (the reference's
+    # test_operator.py is the de-facto spec — finish it)
+    assert frac >= 1.0, (
+        "op test coverage %.1f%% < 100%%; uncovered: %s" % (100 * frac, missing))
