@@ -43,6 +43,12 @@ REGISTRY = [
     EnvVar("DMLC_PS_ROOT_PORT", int, 9091, "Scheduler port"),
     EnvVar("DMLC_NUM_WORKER", int, 1, "Worker count"),
     EnvVar("DMLC_NUM_SERVER", int, 1, "Server count"),
+    # ---- memory (executor.py) ----
+    EnvVar("MXNET_BACKWARD_DO_MIRROR", int, 0,
+           "Memory mirroring: recompute cheap activations (BN/ReLU/elemwise) "
+           "in the backward pass instead of storing them — jax.checkpoint "
+           "with a save-only-matmul/conv-outputs remat policy (reference "
+           "src/executor/graph_executor.cc:225-239)"),
     # ---- JAX/XLA passthrough the test/dev flows rely on ----
     EnvVar("JAX_PLATFORMS", str, "", "Force a JAX backend, e.g. 'cpu'"),
     EnvVar("XLA_FLAGS", str, "",
@@ -65,7 +71,6 @@ ABSORBED = {
     "MXNET_EXEC_BULK_EXEC_TRAIN": "whole-graph jit (always bulk)",
     "MXNET_KVSTORE_REDUCTION_NTHREADS": "XLA collectives",
     "MXNET_ENABLE_GPU_P2P": "ICI collectives",
-    "MXNET_BACKWARD_DO_MIRROR": "use jax.checkpoint/remat in custom ops",
 }
 
 _BY_NAME = {v.name: v for v in REGISTRY}

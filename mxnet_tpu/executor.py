@@ -115,6 +115,13 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
     return outputs, aux_out
 
 
+# remat policy for memory mirroring: MXU results (matmul/conv) are the
+# expensive-to-recompute outputs — save those, recompute everything else
+# (BN affines, activations, adds) in the backward pass
+def _MIRROR_POLICY(prim, *_, **__):
+    return prim.name in ("dot_general", "conv_general_dilated")
+
+
 # op → input slots whose values are indices, not magnitudes
 _INDEX_ARG_SLOTS = {
     "Embedding": (0,), "take": (1,), "batch_take": (1,), "one_hot": (0,),
@@ -246,8 +253,13 @@ class Executor:
 
     def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict, mesh=None,
                  param_shardings=None, node_groups=None, compute_dtype=None,
-                 fp32_names=()):
+                 fp32_names=(), mirror=None):
         self._symbol = symbol
+        if mirror is None:
+            from . import config
+
+            mirror = bool(config.get("MXNET_BACKWARD_DO_MIRROR"))
+        self._mirror = bool(mirror)
         self._compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
         fp32 = set(fp32_names)
         if self._compute_dtype is not None:
@@ -291,7 +303,7 @@ class Executor:
     @staticmethod
     def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None, mesh=None,
                     shared_exec=None, group2ctx=None, param_shardings=None,
-                    compute_dtype=None, fp32_names=(), **kwargs):
+                    compute_dtype=None, fp32_names=(), mirror=None, **kwargs):
         """Allocate all arrays from shapes and bind
         (reference GraphExecutor simple_bind overload, executor.h:76)."""
         ctx = ctx or current_context()
@@ -337,12 +349,13 @@ class Executor:
                 aux_dict[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx)
         return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh,
                         param_shardings=param_shardings, node_groups=node_groups,
-                        compute_dtype=compute_dtype, fp32_names=fp32_names)
+                        compute_dtype=compute_dtype, fp32_names=fp32_names,
+                        mirror=mirror)
 
     @staticmethod
     def bind(symbol, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None, mesh=None, param_shardings=None,
-             compute_dtype=None, fp32_names=()):
+             compute_dtype=None, fp32_names=(), mirror=None):
         """Bind with user-provided arrays (reference Executor::Bind).
 
         `group2ctx` maps ctx_group names to Contexts: groups are sharded
@@ -393,7 +406,8 @@ class Executor:
             aux_dict = dict(zip(aux_names, aux_states))
         return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict, mesh=mesh,
                         param_shardings=param_shardings, node_groups=node_groups,
-                        compute_dtype=compute_dtype, fp32_names=fp32_names)
+                        compute_dtype=compute_dtype, fp32_names=fp32_names,
+                        mirror=mirror)
 
     # ------------------------------------------------------------------
     # data-path helpers
@@ -542,25 +556,71 @@ class Executor:
     # XLA executable with donated param/state buffers — the reference's
     # bulk-exec + update_on_kvstore taken to its limit)
     # ------------------------------------------------------------------
-    def _grad_core(self, diff_idx, nondiff_idx):
-        """Build the shared fwd+vjp core used by both backward() and the
-        fused step — ONE place owns the vals scatter and aux cotangents."""
+    def _grad_fwd(self, diff_idx, nondiff_idx):
+        """Forward closure `fwd(dv, nondiff_vals, aux_vals, rng)` used by the
+        gradient core; when mirroring is armed it is wrapped in
+        `jax.checkpoint` so only matmul/conv outputs are kept as residuals."""
         entries, order = self._entries, self._order
         an, xn = self._arg_names, self._aux_names
         boundary = self._boundary()
         cast = self._cast()
 
+        def fwd(dv, nondiff_vals, aux_vals, rng):
+            vals = [None] * len(an)
+            for i, v in zip(diff_idx, dv):
+                vals[i] = v
+            for i, v in zip(nondiff_idx, nondiff_vals):
+                vals[i] = v
+            return _run_graph(entries, order, an, xn, tuple(vals), aux_vals,
+                              True, rng, boundary=boundary, cast=cast)
+
+        if self._mirror:
+            fwd = jax.checkpoint(fwd, policy=_MIRROR_POLICY)
+        return fwd
+
+    def backward_residual_bytes(self):
+        """Bytes of forward activations saved for the backward pass — the
+        quantity memory mirroring shrinks (reference graph_executor.cc
+        mirror pass reduces exactly this set).  Backend-independent: reads
+        JAX's AD residuals rather than XLA buffer assignment."""
+        from jax._src.ad_checkpoint import saved_residuals
+
+        an = self._arg_names
+        diff_idx = [i for i, n in enumerate(an)
+                    if self._grad_req.get(n, "null") != "null"]
+        nondiff_idx = [i for i in range(len(an)) if i not in set(diff_idx)]
+        fwd = self._grad_fwd(diff_idx, nondiff_idx)
+        all_vals = self._gather_args()
+        dv = tuple(all_vals[i] for i in diff_idx)
+        ndv = tuple(all_vals[i] for i in nondiff_idx)
+        res = saved_residuals(fwd, dv, ndv, self._gather_aux(),
+                              jax.random.key(0))
+        total = 0
+        for aval, _ in res:
+            if hasattr(aval, "shape") and hasattr(aval, "dtype"):
+                n = 1
+                for d in aval.shape:
+                    n *= int(d)
+                total += n * jnp.dtype(aval.dtype).itemsize
+        return total
+
+    def _grad_core(self, diff_idx, nondiff_idx):
+        """Build the shared fwd+vjp core used by both backward() and the
+        fused step — ONE place owns the vals scatter and aux cotangents.
+
+        Memory mirroring (reference graph_executor.cc:225-239
+        MXNET_BACKWARD_DO_MIRROR): when armed, the forward is wrapped in
+        `jax.checkpoint` with a policy that saves ONLY matmul/conv outputs
+        — BN, activations, and other cheap elementwise results are
+        recomputed during the backward pass instead of living in HBM
+        across it.  Same trade as the reference (a few % more FLOPs for a
+        large cut in peak activation memory), expressed as a remat policy
+        instead of graph surgery."""
+        fwd4 = self._grad_fwd(diff_idx, nondiff_idx)
+
         def core(diff_vals, nondiff_vals, aux_vals, rng, head_grads):
             def fwd(dv):
-                vals = [None] * len(an)
-                for i, v in zip(diff_idx, dv):
-                    vals[i] = v
-                for i, v in zip(nondiff_idx, nondiff_vals):
-                    vals[i] = v
-                outs, aux_upd = _run_graph(entries, order, an, xn, tuple(vals),
-                                           aux_vals, True, rng, boundary=boundary,
-                                           cast=cast)
-                return outs, aux_upd
+                return fwd4(dv, nondiff_vals, aux_vals, rng)
 
             (outs, aux_upd), vjp_fn = jax.vjp(fwd, diff_vals)
             if head_grads is None:
@@ -763,6 +823,7 @@ class Executor:
             dict(self._grad_req), dict(self.aux_dict), mesh=self._mesh,
             param_shardings=self._param_shardings, node_groups=self._node_groups,
             compute_dtype=self._compute_dtype, fp32_names=self._fp32_names,
+            mirror=self._mirror,
         )
         # a rebound executor keeps the training regime: the fused
         # single-dispatch step survives reshape (bucketing hot path)

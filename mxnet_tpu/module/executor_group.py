@@ -76,7 +76,7 @@ class DataParallelExecutorGroup:
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=logging, fixed_param_names=None, grad_req="write",
                  state_names=None, mesh=None, param_shardings=None, group2ctx=None,
-                 compute_dtype=None):
+                 compute_dtype=None, mirror=None):
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -92,6 +92,7 @@ class DataParallelExecutorGroup:
         self.param_shardings = param_shardings or {}
         self.group2ctx = group2ctx
         self.compute_dtype = compute_dtype
+        self.mirror = mirror
         self.batch_size = None
         self.slices = None
         self.execs = []
@@ -153,7 +154,7 @@ class DataParallelExecutorGroup:
             self.symbol, self.contexts[0], grad_req=grad_req, mesh=self.mesh,
             shared_exec=shared_exec, group2ctx=self.group2ctx,
             param_shardings=self.param_shardings,
-            compute_dtype=self.compute_dtype,
+            compute_dtype=self.compute_dtype, mirror=self.mirror,
             # labels keep fp32: class ids above 256 are not bf16-exact
             fp32_names=tuple(self.label_names or ()), **shape_kwargs
         )

@@ -34,7 +34,7 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None, mesh=None, sharding_map=None, group2ctx=None,
-                 compute_dtype=None):
+                 compute_dtype=None, mirror=None):
         """`mesh`/`sharding_map` expose user-facing tensor parallelism: pass
         a `jax.sharding.Mesh` (e.g. parallel.mesh.make_mesh({'data': -1,
         'model': 2})) plus {param_name: PartitionSpec} and the single SPMD
@@ -46,6 +46,9 @@ class Module(BaseModule):
         self._sharding_map = dict(sharding_map or {})
         self._group2ctx = group2ctx
         self._compute_dtype = compute_dtype
+        # memory mirroring (reference MXNET_BACKWARD_DO_MIRROR): recompute
+        # cheap activations in backward; None defers to the env var
+        self._mirror = mirror
         if context is None:
             context = [current_context()]
         if not isinstance(context, list):
@@ -207,7 +210,7 @@ class Module(BaseModule):
             shared_group, logger=self.logger, fixed_param_names=self._fixed_param_names,
             grad_req=grad_req, state_names=self._state_names, mesh=self._mesh,
             param_shardings=self._sharding_map, group2ctx=self._group2ctx,
-            compute_dtype=self._compute_dtype,
+            compute_dtype=self._compute_dtype, mirror=self._mirror,
         )
         self._total_exec_bytes = 0
         if shared_module is not None:
