@@ -8,18 +8,21 @@ buffers) — matching how the reference's 181.53 img/s baseline was measured
 181-190).
 
 Config: bf16 compute with fp32 master weights (Module compute_dtype —
-the multi-precision recipe) at batch 512, the throughput-optimal point on
-a v5e chip.  The model is BatchNorm-heavy and HBM-bandwidth bound: the
-compiled forward touches ~22 GB per 256-image step, so throughput rides
-the 819 GB/s HBM roofline (~27% MXU utilization), not the systolic array.
+the multi-precision recipe) at batch 512 in NHWC layout (the TPU-native
+channel-minor layout; measured equal to NCHW on v5e since XLA relayouts
+convs internally — see README "Roofline" for the full layout A/B and
+profile).  BatchNorm uses the one-pass fp32-accumulated E[x]/E[x^2] stats
+(ops/nn.py batch_norm), worth ~17% step time on this model.
 
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "img/s", "vs_baseline": N}
+plus an `mfu` field: XLA-counted step FLOPs / step time / 197 TFLOP/s
+(v5e bf16 peak, MAC=2 convention both sides).
 
 Methodology note: on the tunneled TPU platform `block_until_ready` can
-return early and a full-output device→host pull costs ~100 ms RTT, so the
-timed loop is fenced once by a ONE-element weight transfer, amortized over
-N steps.
+return early and each CHAINED dispatch carries ~11 ms tunnel overhead, so
+the timed loop runs 30 steps (amortizing the fixed costs) and is fenced
+once by a ONE-element weight transfer.
 """
 import json
 import time
@@ -27,8 +30,9 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 181.53  # 1x P100, reference docs/how_to/perf.md:181-190
+V5E_PEAK_FLOPS = 197e12  # bf16, MAC=2 convention
 BATCH = 512
-STEPS = 12
+STEPS = 30
 
 
 def main():
@@ -36,16 +40,16 @@ def main():
     from mxnet_tpu.models.resnet import resnet
 
     mx.random.seed(0)
-    net = resnet(50)
+    net = resnet(50, layout="NHWC")
     mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
-    mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
+    mod.bind(data_shapes=[("data", (BATCH, 224, 224, 3))],
              label_shapes=[("softmax_label", (BATCH,))])
     mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2))
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
     rng = np.random.RandomState(0)
     batch = mx.io.DataBatch(
-        data=[mx.nd.array(rng.randn(BATCH, 3, 224, 224).astype("float32"))],
+        data=[mx.nd.array(rng.randn(BATCH, 224, 224, 3).astype("float32"))],
         label=[mx.nd.array(rng.randint(0, 1000, BATCH).astype("float32"))],
     )
 
@@ -53,7 +57,7 @@ def main():
         x = mod._exec_group.execs[0].arg_dict["fc1_weight"].data
         np.asarray(x[(0,) * x.ndim])  # 1-element transfer = real sync
 
-    for _ in range(3):  # compile + settle
+    for _ in range(4):  # compile + settle
         mod.forward_backward(batch)
         mod.update()
     fence()
@@ -65,11 +69,35 @@ def main():
     fence()
     dt = (time.time() - t0) / STEPS
     img_s = BATCH / dt
+
+    # XLA-counted FLOPs of the fused step (fwd+bwd+update) for the MFU claim
+    mfu = None
+    try:
+        ex = mod._exec_group.execs[0]
+        args = ex._place(ex._gather_args())
+        diff_names, diff_idx, nondiff_idx = ex._fused_static
+        dv = tuple(args[i] for i in diff_idx)
+        ndv = tuple(args[i] for i in nondiff_idx)
+        from mxnet_tpu.optimizer import _state_leaves
+
+        st = tuple(tuple(l.data for l in _state_leaves(
+            ex._fused_updater.states[ex._fused_index_of_name[n]]))
+            for n in diff_names)
+        sc = np.zeros((len(diff_names), 3), np.float32)
+        comp = ex._jit_step[0].lower(dv, ndv, ex._gather_aux(), st,
+                                     np.uint32(0), sc).compile()
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        mfu = round(float(ca.get("flops", 0.0)) / dt / V5E_PEAK_FLOPS, 4)
+    except Exception:
+        pass
+
     print(json.dumps({
-        "metric": "ResNet-50 full train step img/s/chip (bf16+fp32 master, batch 512, fwd+bwd+SGD)",
+        "metric": "ResNet-50 full train step img/s/chip (bf16+fp32 master, batch 512, NHWC, fwd+bwd+SGD)",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "mfu": mfu,
     }))
 
 

@@ -189,9 +189,16 @@ class Xavier(Initializer):
         hw_scale = 1.0
         if len(shape) < 2:
             raise MXNetError("Xavier requires ndim >= 2: %s" % str(name))
-        if len(shape) > 2:
-            hw_scale = _np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        layout = getattr(name, "attrs", {}).get("__layout__", "")
+        if layout.endswith("IO"):
+            # channel-last conv kernel (spatial..., I, O) — the NHWC path's
+            # HWIO weights; fans computed over the right dims
+            hw_scale = _np.prod(shape[:-2]) if len(shape) > 2 else 1.0
+            fan_in, fan_out = shape[-2] * hw_scale, shape[-1] * hw_scale
+        else:
+            if len(shape) > 2:
+                hw_scale = _np.prod(shape[2:])
+            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
         factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[self.factor_type]
         scale = math.sqrt(self.magnitude / factor)
         from .ops.random_ops import HOST_RNG

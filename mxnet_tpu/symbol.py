@@ -546,6 +546,12 @@ def _create(op_name, input_syms, attrs, name=None, aux_syms=None):
                 inputs.append((var_node, 0))
                 continue
             var_node = _Node(None, in_name)
+            if declared[len(inputs)] == "weight" and op.name in (
+                "Convolution", "Deconvolution"
+            ):
+                lay = str(full_attrs.get("layout", ""))
+                if lay.endswith("C"):  # channel-last: kernel stored spatial+IO
+                    var_node.attrs["__layout__"] = lay[1:-1] + "IO"
             inputs.append((var_node, 0))
     aux_vars = []
     if aux_syms:

@@ -1,0 +1,133 @@
+"""Channel-last (NHWC/NWC) layout support — the TPU-native data path.
+
+Parity: reference ConvolutionParam.layout / PoolingParam layout options
+(src/operator/convolution-inl.h).  Under channel-last, conv kernels are
+stored spatial+IO (HWIO): keeping OIHW weights with NHWC activations makes
+XLA emit a hostile-layout weight-grad conv (see ops/nn.py _conv_dn).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_conv_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 9, 9, 4).astype(np.float32)
+    w = rng.randn(3, 3, 2, 6).astype(np.float32)  # HWIO, groups=2
+    b = rng.randn(6).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=(3, 3), num_filter=6, pad=(1, 1),
+                            stride=(2, 2), dilate=(2, 2), num_group=2,
+                            layout="NHWC")
+    xn = np.transpose(x, (0, 3, 1, 2))
+    wn = np.transpose(w, (3, 2, 0, 1))
+    outn = mx.nd.Convolution(mx.nd.array(xn), mx.nd.array(wn), mx.nd.array(b),
+                             kernel=(3, 3), num_filter=6, pad=(1, 1),
+                             stride=(2, 2), dilate=(2, 2), num_group=2)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.transpose(outn.asnumpy(), (0, 2, 3, 1)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv_nwc_1d():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 12, 4).astype(np.float32)
+    w = rng.randn(3, 4, 8).astype(np.float32)  # WIO
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3,),
+                            num_filter=8, pad=(1,), no_bias=True, layout="NWC")
+    xn = np.transpose(x, (0, 2, 1))
+    wn = np.transpose(w, (2, 1, 0))
+    outn = mx.nd.Convolution(mx.nd.array(xn), mx.nd.array(wn), kernel=(3,),
+                             num_filter=8, pad=(1,), no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.transpose(outn.asnumpy(), (0, 2, 1)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_nhwc_matches_nchw():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 8, 8, 4).astype(np.float32)
+    xn = np.transpose(x, (0, 3, 1, 2))
+    for kwargs in ({"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
+                   {"kernel": (3, 3), "stride": (2, 2), "pad": (1, 1),
+                    "pool_type": "avg"},
+                   {"global_pool": True, "kernel": (1, 1), "pool_type": "avg"}):
+        p = mx.nd.Pooling(mx.nd.array(x), layout="NHWC", **kwargs)
+        pn = mx.nd.Pooling(mx.nd.array(xn), **kwargs)
+        np.testing.assert_allclose(p.asnumpy(),
+                                   np.transpose(pn.asnumpy(), (0, 2, 3, 1)),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_channel_last_axis():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 6, 6, 8).astype(np.float32)
+    g = rng.rand(8).astype(np.float32) + 0.5
+    b = rng.randn(8).astype(np.float32)
+    out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          mx.nd.zeros((8,)), mx.nd.ones((8,)),
+                          fix_gamma=False, axis=-1)
+    xn = np.transpose(x, (0, 3, 1, 2))
+    outn = mx.nd.BatchNorm(mx.nd.array(xn), mx.nd.array(g), mx.nd.array(b),
+                           mx.nd.zeros((8,)), mx.nd.ones((8,)),
+                           fix_gamma=False)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.transpose(outn.asnumpy(), (0, 2, 3, 1)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_nhwc_binds_and_infers_hwio_weights():
+    from mxnet_tpu.models.resnet import resnet
+
+    net = resnet(18, num_classes=10, layout="NHWC")
+    ex = mx.executor.Executor.simple_bind(
+        net, mx.cpu(), grad_req="write", data=(2, 64, 64, 3),
+        softmax_label=(2,))
+    assert ex.arg_dict["conv0_weight"].shape == (7, 7, 3, 64)
+    # the weight variable carries the layout hint for initializers
+    assert net.attr_dict()["conv0_weight"]["__layout__"] == "HWIO"
+    rng = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rng.randn(2, 64, 64, 3).astype(np.float32)
+    ex.forward(is_train=True)
+    ex.backward()
+    assert ex.outputs[0].shape == (2, 10)
+    assert np.isfinite(ex.grad_dict["conv0_weight"].asnumpy()).all()
+
+
+def test_xavier_fans_hwio():
+    from mxnet_tpu.initializer import InitDesc, Xavier
+
+    mx.random.seed(0)
+    # OIHW (64, 16, 3, 3) and HWIO (3, 3, 16, 64) must get the SAME scale
+    ini = Xavier(rnd_type="uniform", factor_type="in", magnitude=3.0)
+    a = mx.nd.zeros((64, 16, 3, 3))
+    ini(InitDesc("w_weight"), a)
+    b = mx.nd.zeros((3, 3, 16, 64))
+    ini(InitDesc("w_weight", {"__layout__": "HWIO"}), b)
+    sa, sb = np.abs(a.asnumpy()).max(), np.abs(b.asnumpy()).max()
+    # scale = sqrt(3 / (16*9)) ~= 0.144 for both
+    assert abs(sa - sb) / sa < 0.1, (sa, sb)
+    assert abs(sa - (3.0 / (16 * 9)) ** 0.5) / sa < 0.1
+
+
+def test_nhwc_trains_mixed_precision():
+    from mxnet_tpu.models.resnet import get_resnet
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 16, 16, 3).astype(np.float32)
+    y = rng.randint(0, 4, (64,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    net = get_resnet([1], [8, 16], num_classes=4, bottle_neck=False,
+                     image_shape=(3, 16, 16), layout="NHWC")
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype="bfloat16")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.05})
+    for _ in range(2):
+        it.reset()
+        for b in it:
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+    params, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in params.values())
