@@ -117,7 +117,7 @@ class ImageDetRecordIterImpl(DataIter):
     def _load_one(self, off):
         header, payload = unpack(self._reader.read_at(off))
         objs, _ = _parse_det_label(header.label)
-        img = _np.asarray(_decode_img(payload))
+        img = _np.asarray(_decode_img(payload, rgb=True))
         if img.ndim == 2:
             img = img[:, :, None]
         img = img.astype(_np.float32)

@@ -29,7 +29,8 @@ __all__ = [
 
 def imdecode(buf, flag=1, to_rgb=True, **kwargs):
     """Decode an image byte buffer to HWC uint8 (parity: image.py imdecode)."""
-    img = _decode_img(buf if isinstance(buf, bytes) else bytes(buf), iscolor=flag)
+    img = _decode_img(buf if isinstance(buf, bytes) else bytes(buf), iscolor=flag,
+                      rgb=to_rgb)
     img = np.asarray(img)
     if img.ndim == 2:
         img = img[:, :, None]

@@ -32,7 +32,7 @@ class ImageRecordIterImpl(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, mean_img=None, scale=1.0,
                  preprocess_threads=4, prefetch_buffer=4, round_batch=True,
                  data_name="data", label_name="softmax_label", seed=0,
-                 part_index=0, num_parts=1, **kwargs):
+                 part_index=0, num_parts=1, resize=0, **kwargs):
         super().__init__(batch_size)
         if path_imgrec is None or data_shape is None:
             raise MXNetError("path_imgrec and data_shape are required")
@@ -46,10 +46,22 @@ class ImageRecordIterImpl(DataIter):
         self.rand_mirror = rand_mirror
         self.mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
         self.scale = scale
+        self.resize = int(resize)
         self.data_name = data_name
         self.label_name = label_name
         self._rng = _np.random.RandomState(seed)
         self._reader = NativeRecordReader(path_imgrec)
+        # native batched JPEG decode (src/imdecode.cc) — the default fast
+        # path; Python/PIL remains the per-image fallback for non-JPEG
+        # payloads and toolchain-less installs
+        self._decoder = None
+        if not kwargs.get("force_python_decode"):
+            try:
+                from .native import NativeImageDecoder
+
+                self._decoder = NativeImageDecoder(preprocess_threads)
+            except Exception:
+                self._decoder = None
         offsets = native_index(path_imgrec)
         # sharded reading for distributed training (reference
         # dmlc::InputSplit rank sharding, iter_image_recordio.cc)
@@ -70,11 +82,14 @@ class ImageRecordIterImpl(DataIter):
     # ------------------------------------------------------------------
     def _decode_one(self, raw):
         header, payload = unpack(raw)
-        img = _decode_img(payload)
+        img = _decode_img(payload, rgb=True)
         img = _np.asarray(img)
         if img.ndim == 2:
             img = img[:, :, None]
-        c, h, w = self.data_shape
+        if self._layout_code() == 0:
+            c, h, w = self.data_shape
+        else:
+            h, w, c = self.data_shape
         # crop/resize to target (random crop for training parity:
         # reference image_aug_default.cc rand_crop)
         ih, iw = img.shape[:2]
@@ -97,46 +112,88 @@ class ImageRecordIterImpl(DataIter):
             img = img[:, :, :c]
         if self.rand_mirror and self._rng.randint(2):
             img = img[:, ::-1]
-        out = img.transpose(2, 0, 1).astype(_np.float32)
-        if self.mean.any():
-            out -= self.mean[:c].reshape(c, 1, 1)
+        if self._layout_code() == 0:
+            out = img.transpose(2, 0, 1).astype(_np.float32)
+            if self.mean.any():
+                out -= self.mean[:c].reshape(c, 1, 1)
+        else:
+            out = img.astype(_np.float32)
+            if self.mean.any():
+                out -= self.mean[:c]
         if self.scale != 1.0:
             out *= self.scale
+        return out, self._label_of(header)
+
+    def _label_of(self, header):
         label = header.label
         if not _np.isscalar(label) and hasattr(label, "__len__"):
             label = _np.asarray(label, dtype=_np.float32)[: self.label_width]
-        return out, label
+        return label
+
+    def _layout_code(self):
+        """0 = CHW (reference data_shape (c,h,w)); 1 = HWC ((h,w,c) —
+        the TPU-native channel-last graphs, see ops/nn.py layout)."""
+        return 0 if self.data_shape[0] in (1, 3, 4) else 1
+
+    def _fill_batch_native(self, chunk, batch_data, batch_label):
+        """Batched C++ decode of one batch; returns False to use the
+        Python path (native decoder off or non-3-channel target)."""
+        if self._decoder is None:
+            return False
+        layout = self._layout_code()
+        c = self.data_shape[0] if layout == 0 else self.data_shape[-1]
+        if c != 3:
+            return False
+        n = len(chunk)
+        raws = [self._reader.read_at(off) for off in chunk]
+        payloads = []
+        for j, raw in enumerate(raws):
+            header, payload = unpack(raw)
+            batch_label[j] = self._label_of(header)
+            payloads.append(bytes(payload))
+        cu = self._rng.uniform(size=n).astype(_np.float32) if self.rand_crop \
+            else _np.full((n,), 0.5, _np.float32)
+        cv = self._rng.uniform(size=n).astype(_np.float32) if self.rand_crop \
+            else _np.full((n,), 0.5, _np.float32)
+        mir = self._rng.randint(0, 2, size=n).astype(_np.uint8) if self.rand_mirror \
+            else _np.zeros((n,), _np.uint8)
+        status = self._decoder.decode_batch(
+            payloads, batch_data[:n], cu, cv, mir, self.mean, self.scale,
+            resize_short=self.resize, layout=layout)
+        for j in _np.nonzero(status < 0)[0]:
+            # non-JPEG payload (PNG / raw array): per-image Python fallback
+            img, _ = self._decode_one(raws[j])
+            batch_data[j] = img
+        return True
 
     def _produce(self, order):
         try:
             batch_data = _np.empty((self.batch_size,) + self.data_shape, dtype=_np.float32)
             lshape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
-            i = 0
             batch_label = _np.zeros(lshape, dtype=_np.float32)
-            futures = []
-            for off in order:
+            for start in range(0, len(order), self.batch_size):
                 if self._stop.is_set():
                     return
-                raw = self._reader.read_at(off)
-                futures.append(self._pool.submit(self._decode_one, raw))
-                if len(futures) == self.batch_size:
+                chunk = order[start:start + self.batch_size]
+                if not self._fill_batch_native(chunk, batch_data, batch_label):
+                    futures = [
+                        self._pool.submit(self._decode_one, self._reader.read_at(off))
+                        for off in chunk
+                    ]
                     for j, fut in enumerate(futures):
                         img, label = fut.result()
                         batch_data[j] = img
                         batch_label[j] = label
+                n = len(chunk)
+                if n == self.batch_size:
                     self._queue.put((batch_data.copy(), batch_label.copy()))
-                    futures = []
-            # last partial batch: pad by wrapping (reference pad semantics)
-            if futures:
-                pad = self.batch_size - len(futures)
-                for j, fut in enumerate(futures):
-                    img, label = fut.result()
-                    batch_data[j] = img
-                    batch_label[j] = label
-                for j in range(len(futures), self.batch_size):
-                    batch_data[j] = batch_data[j - len(futures)]
-                    batch_label[j] = batch_label[j - len(futures)]
-                self._queue.put((batch_data.copy(), batch_label.copy(), pad))
+                else:
+                    # last partial batch: pad by wrapping (reference pad semantics)
+                    for j in range(n, self.batch_size):
+                        batch_data[j] = batch_data[j - n]
+                        batch_label[j] = batch_label[j - n]
+                    self._queue.put((batch_data.copy(), batch_label.copy(),
+                                     self.batch_size - n))
         finally:
             self._queue.put(None)
 

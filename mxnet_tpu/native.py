@@ -14,7 +14,7 @@ import os
 import subprocess
 import threading
 
-__all__ = ["get_recordio_lib"]
+__all__ = ["get_recordio_lib", "get_imdecode_lib", "NativeImageDecoder"]
 
 _LOCK = threading.Lock()
 _LIB = {}
@@ -23,7 +23,7 @@ _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
 
 
-def _build(name, sources):
+def _build(name, sources, extra=()):
     os.makedirs(_BUILD_DIR, exist_ok=True)
     out = os.path.join(_BUILD_DIR, "lib%s.so" % name)
     srcs = [os.path.join(_SRC_DIR, s) for s in sources]
@@ -31,17 +31,17 @@ def _build(name, sources):
         os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
     ):
         return out
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out] + srcs
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", out] + srcs + list(extra)
     subprocess.run(cmd, check=True, capture_output=True)
     return out
 
 
-def _load(name, sources):
+def _load(name, sources, extra=()):
     with _LOCK:
         if name in _LIB:
             return _LIB[name]
         try:
-            path = _build(name, sources)
+            path = _build(name, sources, extra)
             lib = ctypes.CDLL(path)
         except Exception:
             lib = None
@@ -155,3 +155,94 @@ def native_index(path):
         if count <= cap:
             return list(offsets[:count])
         cap = count
+
+
+def _jpeg_link_flags():
+    """Prefer a SIMD libjpeg-turbo (ABI 62, e.g. Pillow's bundled copy —
+    ~3-4x faster huffman+IDCT than classic libjpeg62) over the system lib."""
+    import glob
+    import sysconfig
+
+    site = os.path.dirname(os.path.dirname(sysconfig.get_paths()["purelib"]))
+    for pat in (
+        os.path.join(sysconfig.get_paths()["purelib"], "pillow.libs", "libjpeg-*.so.62*"),
+        os.path.join(site, "**", "pillow.libs", "libjpeg-*.so.62*"),
+    ):
+        hits = sorted(glob.glob(pat, recursive=True))
+        if hits:
+            return [hits[0], "-Wl,-rpath," + os.path.dirname(hits[0]), "-pthread"]
+    return ["-ljpeg", "-pthread"]
+
+
+def get_imdecode_lib():
+    """Load (building if needed) the native JPEG decode engine
+    (src/imdecode.cc over libjpeg-turbo/libjpeg); None if unavailable."""
+    lib = _load("imdecode", ["imdecode.cc"], extra=tuple(_jpeg_link_flags()))
+    if lib is None:
+        return None
+    if not getattr(lib, "_imdec_configured", False):
+        lib.imdec_batch.restype = ctypes.c_long
+        lib.imdec_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_float, ctypes.c_int,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        lib._imdec_configured = True
+    return lib
+
+
+class NativeImageDecoder:
+    """Batched JPEG decode+resize+crop+normalize (reference analog:
+    src/io/iter_image_recordio_2.cc OMP decode loop).  One ctypes call
+    decodes a whole batch on a C++ thread pool; per-image failures are
+    reported for a Python fallback (PNG/raw records)."""
+
+    LAYOUT_CHW_F32 = 0
+    LAYOUT_HWC_F32 = 1
+    LAYOUT_HWC_U8 = 2
+
+    def __init__(self, nthreads=8):
+        self._lib = get_imdecode_lib()
+        if self._lib is None:
+            raise RuntimeError("native imdecode unavailable")
+        # oversubscribing physical cores degrades decode throughput
+        self.nthreads = max(1, min(int(nthreads), os.cpu_count() or 1))
+
+    def decode_batch(self, payloads, out, crop_u, crop_v, mirror,
+                     mean, scale=1.0, resize_short=0, layout=0):
+        """Decode `payloads` (list of bytes) into preallocated numpy `out`.
+
+        out: (n, c, h, w) f32 / (n, h, w, c) f32 / (n, h, w, c) u8 per layout.
+        crop_u/crop_v: per-image crop position in [0, 1] (0.5 = center).
+        Returns a numpy int32 status array (0 ok, -1 needs fallback)."""
+        import numpy as np
+
+        n = len(payloads)
+        if layout == self.LAYOUT_CHW_F32:
+            c, h, w = out.shape[1:]
+        else:
+            h, w, c = out.shape[1:]
+        bufs = (ctypes.c_char_p * n)(*payloads)
+        lens = (ctypes.c_long * n)(*[len(p) for p in payloads])
+        cu = np.ascontiguousarray(crop_u, dtype=np.float32)
+        cv = np.ascontiguousarray(crop_v, dtype=np.float32)
+        mir = np.ascontiguousarray(mirror, dtype=np.uint8)
+        mn = np.ascontiguousarray(mean, dtype=np.float32)
+        if mn.size < c:
+            mn = np.resize(mn, (c,)).astype(np.float32)
+        status = np.zeros((n,), dtype=np.int32)
+        self._lib.imdec_batch(
+            bufs, lens, n, h, w, c, int(resize_short),
+            cu.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            cv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            mir.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            mn.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_float(scale), int(layout),
+            out.ctypes.data_as(ctypes.c_void_p),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            self.nthreads,
+        )
+        return status

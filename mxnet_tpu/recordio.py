@@ -274,7 +274,12 @@ def _encode_img(img, quality, img_fmt):
     return _encode_raw(img)  # no cv2/PIL in this environment
 
 
-def _decode_img(s, iscolor=-1):
+def _decode_img(s, iscolor=-1, rgb=False):
+    """Decode an image payload.  `rgb=False` keeps the legacy cv2 channel
+    order (BGR — parity: reference recordio.unpack_img, which hands back
+    cv2.imdecode output); `rgb=True` guarantees RGB regardless of decoder
+    (parity: ImageRecordIter, which swaps after cv::imdecode —
+    reference src/io/iter_image_recordio_2.cc)."""
     if len(s) >= 16 and struct.unpack("<I", s[:4])[0] == 0xFEEDBEEF:
         h, w, c = struct.unpack("<III", s[4:16])
         arr = _np.frombuffer(s[16:], dtype=_np.uint8)
@@ -282,11 +287,14 @@ def _decode_img(s, iscolor=-1):
     try:
         import cv2
 
-        return cv2.imdecode(_np.frombuffer(s, dtype=_np.uint8), iscolor)
+        img = cv2.imdecode(_np.frombuffer(s, dtype=_np.uint8), iscolor)
+        if rgb and img is not None and img.ndim == 3 and img.shape[2] == 3:
+            img = img[:, :, ::-1]
+        return img
     except ImportError:
         pass
     import io as _io
 
     from PIL import Image
 
-    return _np.asarray(Image.open(_io.BytesIO(s)))
+    return _np.asarray(Image.open(_io.BytesIO(s)))  # PIL is RGB already
