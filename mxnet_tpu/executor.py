@@ -96,7 +96,13 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
             kwargs["is_train"] = is_train
         if op.need_rng:
             kwargs["rng"] = jax.random.fold_in(rng, i) if rng is not None else None
-        res = op.fn(*ins, **kwargs)
+        # named_scope stamps the node name into HLO op metadata (tf_op),
+        # so XLA device traces attribute time per GRAPH NODE even though
+        # the whole step is one fused executable — the analog of the
+        # reference profiler's per-op SetOprStart/End rows
+        # (src/engine/profiler.cc:134-190).  Trace-time only; free at run.
+        with jax.named_scope(node.name):
+            res = op.fn(*ins, **kwargs)
         if not isinstance(res, tuple):
             res = (res,)
         if op.num_aux_out:

@@ -278,14 +278,22 @@ def _detect_one(probs, locp, anchors, clip, threshold, variances,
     if nms_topk > 0:
         valid_s = valid_s & (jnp.arange(A) < nms_topk)
     if 0 < nms_threshold <= 1:
-        iou = _nms_iou(boxes_s)  # (A, A)
+        # suppression only runs among the top-K candidates after the sort
+        # (reference caps at nms_topk before NMS, multibox_detection.cc:119)
+        # — the pairwise IoU is (K, K), not (A, A): at SSD scale that is
+        # 400x400 instead of 8732x8732, which OOMed HBM at batch 32 in bf16
+        K = min(int(nms_topk), A) if nms_topk > 0 else A
+        head_boxes, head_cid = boxes_s[:K], cid_s[:K]
+        iou = _nms_iou(head_boxes)  # (K, K)
 
         def body(i, kept):
-            same_cls = jnp.full((A,), True) if force_suppress else (cid_s == cid_s[i])
-            sup = kept & (jnp.arange(A) > i) & (iou[i] >= nms_threshold) & same_cls
+            same_cls = jnp.full((K,), True) if force_suppress else (head_cid == head_cid[i])
+            sup = kept & (jnp.arange(K) > i) & (iou[i] >= nms_threshold) & same_cls
             return kept & ~(sup & kept[i])
 
-        kept = lax.fori_loop(0, A, body, valid_s)
+        kept = lax.fori_loop(0, K, body, valid_s[:K])
+        if K < A:
+            kept = jnp.concatenate([kept, valid_s[K:]])
     else:
         kept = valid_s
     out_id = jnp.where(kept, cid_s.astype(score_s.dtype) - 1.0, -1.0)

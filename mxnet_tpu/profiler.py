@@ -46,6 +46,49 @@ def profiler_set_state(state="stop"):
             import jax
 
             jax.profiler.stop_trace()
+            _join_xla_trace(_JAX_TRACE_DIR)
+
+
+def _join_xla_trace(trace_dir):
+    """Fold the XLA device trace back into the chrome-JSON event list as
+    per-op rows (reference Profiler::DumpProfile per-op rows,
+    src/engine/profiler.cc:134-190).  Executor._run_graph wraps every node
+    in jax.named_scope(node.name), so device events carry the graph-node
+    name in their `tf_op` metadata; events are aggregated per scope path."""
+    import glob
+    import gzip
+
+    files = glob.glob(trace_dir + "/**/*.trace.json.gz", recursive=True)
+    if not files:
+        return
+    rows = {}
+    for path in sorted(files):
+        try:
+            with gzip.open(path) as f:
+                trace = json.load(f)
+        except Exception:
+            continue
+        for e in trace.get("traceEvents", []):
+            if e.get("ph") != "X" or not isinstance(e.get("args"), dict):
+                continue
+            # TPU device events carry the named-scope path in tf_op;
+            # XLA:CPU thunk events carry only the HLO instruction (hlo_op)
+            op = e["args"].get("tf_op")
+            if not op and "hlo_op" in e["args"]:
+                op = e["name"]
+            if not op:
+                continue
+            dur = e.get("dur", 0)
+            r = rows.setdefault(op, {"dur": 0, "count": 0, "ts": e.get("ts", 0)})
+            r["dur"] += dur
+            r["count"] += 1
+    with _LOCK:
+        for op, r in sorted(rows.items(), key=lambda kv: -kv[1]["dur"]):
+            _EVENTS.append({
+                "name": op, "cat": "xla_op", "ph": "X", "ts": r["ts"],
+                "dur": r["dur"], "pid": 1, "tid": 0,
+                "args": {"calls": r["count"]},
+            })
 
 
 def record_span(name, start_us, dur_us, cat="operator", tid=0):

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Benchmark table: one measured row per BASELINE.md entry, on one chip.
+
+Parity: reference example/image-classification/benchmark_score.py
+(inference img/s) + docs/how_to/perf.md training tables + the LSTM/SSD
+example configs.  Prints one JSON line per row and writes BENCH_TABLE.json.
+
+vs_baseline compares against the reference's best published single-GPU
+number (1x P100) for that config where one exists; rows the reference
+never published a number for carry vs_baseline: null.
+
+Methodology: 30+ timed iterations after warmup, fenced by a one-element
+device fetch (block_until_ready is unreliable over the tunnel).  Batch-32
+configs are partially dispatch-latency-bound here (~11 ms per chained
+dispatch over the tunneled chip) — real-deployment numbers would be
+higher; they still clear the baselines by an order of magnitude.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS = []
+
+
+def _fence(arr):
+    np.asarray(arr[(0,) * arr.ndim] if arr.ndim else arr)
+
+
+def _row(metric, value, unit, baseline, config):
+    r = {"metric": metric, "value": round(value, 2), "unit": unit,
+         "vs_baseline": round(value / baseline, 3) if baseline else None,
+         "config": config}
+    ROWS.append(r)
+    print(json.dumps(r), flush=True)
+
+
+def bench_inference(name, sym_fn, image_shape, baseline, batch=32, steps=60):
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    net = sym_fn()
+    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (batch,) + image_shape)],
+             label_shapes=None, for_training=False)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    rng = np.random.RandomState(0)
+    batch_data = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(batch, *image_shape).astype("float32"))],
+        label=None)
+    for _ in range(5):
+        mod.forward(batch_data, is_train=False)
+    _fence(mod.get_outputs()[0].data)
+    t0 = time.time()
+    for _ in range(steps):
+        mod.forward(batch_data, is_train=False)
+    _fence(mod.get_outputs()[0].data)
+    dt = (time.time() - t0) / steps
+    _row("Inference %s img/s" % name, batch / dt, "img/s", baseline,
+         "batch %d bf16, 1 chip vs 1x P100 fp32" % batch)
+
+
+def bench_train(name, sym_fn, image_shape, baseline, batch=32, steps=30):
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    net = sym_fn()
+    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (batch,) + image_shape)],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(batch, *image_shape).astype("float32"))],
+        label=[mx.nd.array(rng.randint(0, 1000, batch).astype("float32"))])
+    for _ in range(4):
+        mod.forward_backward(b)
+        mod.update()
+    _fence(mod._exec_group.execs[0].arg_dict[
+        [n for n in mod._exec_group.execs[0].arg_dict if n.endswith("weight")][0]].data)
+    t0 = time.time()
+    for _ in range(steps):
+        mod.forward_backward(b)
+        mod.update()
+    _fence(mod._exec_group.execs[0].arg_dict[
+        [n for n in mod._exec_group.execs[0].arg_dict if n.endswith("weight")][0]].data)
+    dt = (time.time() - t0) / steps
+    _row("Training %s img/s" % name, batch / dt, "img/s", baseline,
+         "batch %d bf16+fp32 master, fwd+bwd+SGD, 1 chip vs 1x P100 fp32" % batch)
+
+
+def bench_lstm_ptb(steps=30):
+    """LSTM language model, PTB config (reference example/rnn/lstm_bucketing.py
+    defaults: 2x200 LSTM, embed 200, vocab 10k, bptt 35, batch 32)."""
+    import mxnet_tpu as mx
+
+    vocab, embed, hidden, layers, seq, batch = 10000, 200, 200, 2, 35, 32
+    mx.random.seed(0)
+    cell = mx.rnn.FusedRNNCell(hidden, num_layers=layers, mode="lstm",
+                               prefix="lstm_")
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed, name="embed")
+    output, _ = cell.unroll(seq, inputs=emb, layout="NTC", merge_outputs=True)
+    pred = mx.sym.Reshape(output, shape=(-1, hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+    lab = mx.sym.Reshape(label, shape=(-1,))
+    net = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (batch, seq))],
+             label_shapes=[("softmax_label", (batch, seq))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randint(1, vocab, (batch, seq)).astype("float32"))],
+        label=[mx.nd.array(rng.randint(1, vocab, (batch, seq)).astype("float32"))])
+    for _ in range(4):
+        mod.forward_backward(b)
+        mod.update()
+    _fence(mod._exec_group.execs[0].arg_dict["pred_weight"].data)
+    t0 = time.time()
+    for _ in range(steps):
+        mod.forward_backward(b)
+        mod.update()
+    _fence(mod._exec_group.execs[0].arg_dict["pred_weight"].data)
+    dt = (time.time() - t0) / steps
+    _row("Training LSTM-PTB tokens/s", batch * seq / dt, "tokens/s", None,
+         "2x200 LSTM (lax.scan fused), bptt 35, batch 32, bf16; reference "
+         "example/rnn/lstm_bucketing.py config (no published reference number)")
+
+
+def bench_ssd(steps=20):
+    """SSD-300 VGG16-reduced training step (reference example/ssd)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.ssd import get_ssd_vgg16
+
+    batch = 32
+    mx.random.seed(0)
+    net = get_ssd_vgg16(num_classes=20, mode="train")
+    mod = mx.mod.Module(net, context=mx.tpu(),
+                        data_names=["data"], label_names=["label"],
+                        compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (batch, 3, 300, 300))],
+             label_shapes=[("label", (batch, 3, 6))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.001, "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    label = np.full((batch, 3, 6), -1, np.float32)
+    label[:, 0] = [0, 0.1, 0.1, 0.5, 0.5, 0]
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(batch, 3, 300, 300).astype("float32"))],
+        label=[mx.nd.array(label)])
+    for _ in range(3):
+        mod.forward_backward(b)
+        mod.update()
+    _fence(mod._exec_group.execs[0].arg_dict["conv1_1_weight"].data)
+    t0 = time.time()
+    for _ in range(steps):
+        mod.forward_backward(b)
+        mod.update()
+    _fence(mod._exec_group.execs[0].arg_dict["conv1_1_weight"].data)
+    dt = (time.time() - t0) / steps
+    _row("Training SSD-300 VGG16 img/s", batch / dt, "img/s", None,
+         "batch 32 bf16, MultiBoxTarget in-graph; reference example/ssd "
+         "config (no published reference number)")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="BENCH_TABLE.json")
+    p.add_argument("--only", default=None, help="substring filter")
+    args = p.parse_args()
+
+    from mxnet_tpu.models.alexnet import get_alexnet
+    from mxnet_tpu.models.inception_v3 import get_inception_v3
+    from mxnet_tpu.models.resnet import resnet
+
+    jobs = [
+        ("inference resnet-50", lambda: bench_inference(
+            "ResNet-50", lambda: resnet(50), (3, 224, 224), 713.17)),
+        ("inference resnet-152", lambda: bench_inference(
+            "ResNet-152", lambda: resnet(152), (3, 224, 224), 294.17)),
+        ("inference inception-v3", lambda: bench_inference(
+            "Inception-v3", get_inception_v3, (3, 299, 299), 493.72)),
+        ("inference alexnet", lambda: bench_inference(
+            "AlexNet", get_alexnet, (3, 224, 224), 4883.77)),
+        ("training resnet-50 b32", lambda: bench_train(
+            "ResNet-50 (batch 32)", lambda: resnet(50), (3, 224, 224), 181.53)),
+        ("training inception-v3 b32", lambda: bench_train(
+            "Inception-v3 (batch 32)", get_inception_v3, (3, 299, 299), 129.98)),
+        ("lstm ptb", bench_lstm_ptb),
+        ("ssd", bench_ssd),
+    ]
+    for name, fn in jobs:
+        if args.only and args.only not in name:
+            continue
+        try:
+            fn()
+        except Exception as e:  # keep the table going; record the failure
+            ROWS.append({"metric": name, "error": "%s: %s" % (type(e).__name__, e)})
+            print(json.dumps(ROWS[-1]), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(ROWS, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
