@@ -1,0 +1,121 @@
+/*
+ * c_predict_api.h — C ABI for deployment-only inference.
+ *
+ * ABI parity: reference include/mxnet/c_predict_api.h (same function
+ * names, argument lists and return conventions), so existing C/C++
+ * embedders of the reference predict API can relink against
+ * libmxnet_tpu_predict.so unchanged.  The implementation
+ * (src/c_predict_api.cc) embeds CPython and delegates to
+ * mxnet_tpu.predict.Predictor, whose compute path is JAX/XLA on TPU.
+ *
+ * Conventions:
+ *   - every function returns 0 on success, -1 on failure;
+ *   - after a failure, MXGetLastError() returns a message valid until
+ *     the next API call on the same thread;
+ *   - dev_type: 1 = cpu, 2 = accelerator (the TPU chip; the reference
+ *     used 2 for gpu — same slot, same meaning: "the fast device").
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXNET_DLL
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+/* Message of the most recent failure on this thread ("" if none). */
+MXNET_DLL const char* MXGetLastError();
+
+/* Create a predictor from a symbol JSON string and the raw bytes of a
+ * .params file (reference binary NDArray-list ABI or the native
+ * container).  input_keys/input_shape_indptr/input_shape_data describe
+ * the input nodes in CSR form: input i has rank
+ * indptr[i+1]-indptr[i] and its dims are shape_data[indptr[i]..]. */
+MXNET_DLL int MXPredCreate(const char* symbol_json_str,
+                           const void* param_bytes,
+                           int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char** input_keys,
+                           const mx_uint* input_shape_indptr,
+                           const mx_uint* input_shape_data,
+                           PredictorHandle* out);
+
+/* Same, but cut the graph at the named internal outputs (feature
+ * extraction).  output_keys entries may be given with or without the
+ * "_output" suffix. */
+MXNET_DLL int MXPredCreatePartialOut(const char* symbol_json_str,
+                                     const void* param_bytes,
+                                     int param_size,
+                                     int dev_type, int dev_id,
+                                     mx_uint num_input_nodes,
+                                     const char** input_keys,
+                                     const mx_uint* input_shape_indptr,
+                                     const mx_uint* input_shape_data,
+                                     mx_uint num_output_nodes,
+                                     const char** output_keys,
+                                     PredictorHandle* out);
+
+/* Shape of output `index`.  The returned pointers stay valid until the
+ * next call on this handle. */
+MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle,
+                                   mx_uint index,
+                                   mx_uint** shape_data,
+                                   mx_uint* shape_ndim);
+
+/* Copy `size` floats into the named input (row-major, must match the
+ * element count of the shape given at create time). */
+MXNET_DLL int MXPredSetInput(PredictorHandle handle,
+                             const char* key,
+                             const mx_float* data,
+                             mx_uint size);
+
+/* Run one forward pass. */
+MXNET_DLL int MXPredForward(PredictorHandle handle);
+
+/* Stepped forward for progress display.  The XLA design runs the whole
+ * graph as one fused executable, so the pass completes at step 0 and
+ * *step_left is set to 0; the reference's step loop still terminates
+ * correctly. */
+MXNET_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int* step_left);
+
+/* Copy output `index` into caller memory as float32; `size` must equal
+ * the element count reported by MXPredGetOutputShape. */
+MXNET_DLL int MXPredGetOutput(PredictorHandle handle,
+                              mx_uint index,
+                              mx_float* data,
+                              mx_uint size);
+
+/* Release the predictor. */
+MXNET_DLL int MXPredFree(PredictorHandle handle);
+
+/* Load an NDArray-list file (e.g. a mean image) from memory. */
+MXNET_DLL int MXNDListCreate(const char* nd_file_bytes,
+                             int nd_file_size,
+                             NDListHandle *out,
+                             mx_uint* out_length);
+
+/* Borrow item `index`: key, float32 data, shape.  Pointers stay valid
+ * until MXNDListFree. */
+MXNET_DLL int MXNDListGet(NDListHandle handle,
+                          mx_uint index,
+                          const char** out_key,
+                          const mx_float** out_data,
+                          const mx_uint** out_shape,
+                          mx_uint* out_ndim);
+
+/* Release the list. */
+MXNET_DLL int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

@@ -49,6 +49,60 @@ def _load(name, sources, extra=()):
         return lib
 
 
+def get_predict_lib_path():
+    """Build (if needed) and return the path of the C predict ABI library
+    (include/mxnet_tpu/c_predict_api.h ≙ reference c_predict_api.h).
+
+    Unlike the other natives this one EMBEDS CPython — it is meant to be
+    linked by non-Python processes — so it needs the interpreter's include
+    dir and libpython on the link line.  Returns None if no toolchain or
+    no shared libpython is available."""
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    if ".so" not in ldlib:
+        # static-only python build: INSTSONAME usually names the shared one
+        ldlib = sysconfig.get_config_var("INSTSONAME") or ldlib
+    if ".so" not in ldlib:
+        return None  # no shared libpython to embed
+    # link by the detected library name, not a guessed stem: covers debug
+    # suffixes (libpython3.Xd.so) and soname-only installs (.so.1.0)
+    if ldlib.endswith(".so"):
+        link = "-l%s" % ldlib[len("lib"):-len(".so")]
+    else:
+        link = "-l:%s" % ldlib
+    extra = [
+        "-I%s" % inc,
+        "-L%s" % libdir,
+        link,
+        "-Wl,-rpath,%s" % libdir,
+    ]
+    with _LOCK:
+        try:
+            # the .so embeds one specific interpreter; invalidate the cache
+            # when the link flags (interpreter/libdir) change, which the
+            # source-mtime check in _build cannot see
+            flags_path = os.path.join(_BUILD_DIR, "libmxnet_tpu_predict.flags")
+            flags = " ".join(extra)
+            old = None
+            if os.path.exists(flags_path):
+                with open(flags_path) as f:
+                    old = f.read()
+            if old != flags:
+                out = os.path.join(_BUILD_DIR, "libmxnet_tpu_predict.so")
+                if os.path.exists(out):
+                    os.remove(out)
+            path = _build("mxnet_tpu_predict", ["c_predict_api.cc"], extra)
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            with open(flags_path, "w") as f:
+                f.write(flags)
+            return path
+        except Exception:
+            return None
+
+
 def get_recordio_lib():
     """Load (building if needed) the native RecordIO engine; None if no
     toolchain."""

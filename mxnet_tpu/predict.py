@@ -101,11 +101,17 @@ class Predictor:
 
     # -- the C predict API surface --------------------------------------
     def set_input(self, name, data):
-        """MXPredSetInput (c_predict_api.cc:243)."""
+        """MXPredSetInput (c_predict_api.cc:243).  A flat buffer with the
+        right element count is accepted and reshaped (the C ABI passes
+        row-major float pointers without shape)."""
         if name not in self._input_names:
             raise MXNetError("unknown input %s (inputs: %s)"
                              % (name, self._input_names))
-        self._exec.arg_dict[name][:] = _np.asarray(data, dtype=_np.float32)
+        target = self._exec.arg_dict[name]
+        data = _np.asarray(data, dtype=_np.float32)
+        if data.ndim == 1 and data.size == target.size:
+            data = data.reshape(target.shape)
+        target[:] = data
 
     def forward(self, **inputs):
         """MXPredForward (c_predict_api.cc:258); inputs may be given inline."""
@@ -117,6 +123,16 @@ class Predictor:
     def get_output(self, index=0):
         """MXPredGetOutput → numpy."""
         return self._exec.outputs[index].asnumpy()
+
+    def get_output_shape(self, index=0):
+        """MXPredGetOutputShape: shape tuple of output `index`."""
+        return tuple(int(d) for d in self._exec.outputs[index].shape)
+
+    def get_output_bytes(self, index=0):
+        """Row-major float32 bytes of output `index` (the C ABI's
+        MXPredGetOutput copies these into caller memory)."""
+        out = _np.ascontiguousarray(self.get_output(index), dtype=_np.float32)
+        return out.tobytes()
 
     @property
     def num_outputs(self):

@@ -37,8 +37,13 @@ def profiler_set_state(state="stop"):
         _STATE["running"] = True
         if _STATE["mode"] == "xla":
             import jax
+            import shutil
 
             _JAX_TRACE_DIR = _STATE["filename"] + ".xla"
+            # fresh dir per session: start_trace writes a new timestamped
+            # subdir and never cleans old ones, so stale sessions would be
+            # re-aggregated into this profile's per-op rows
+            shutil.rmtree(_JAX_TRACE_DIR, ignore_errors=True)
             jax.profiler.start_trace(_JAX_TRACE_DIR)
     elif state == "stop" and _STATE["running"]:
         _STATE["running"] = False

@@ -1,0 +1,397 @@
+// C ABI for deployment-only inference (include/mxnet_tpu/c_predict_api.h).
+//
+// Parity: reference src/c_api/c_predict_api.cc (MXPredCreate /
+// MXPredCreatePartialOut / MXPredSetInput / MXPredForward /
+// MXPredPartialForward / MXPredGetOutput / MXNDList*).  The reference
+// links the whole C++ engine into the library; here the engine IS the
+// Python-hosted JAX/XLA runtime, so this library embeds a CPython
+// interpreter and drives mxnet_tpu.predict.Predictor through it.  That
+// keeps ONE executor implementation (no drift between the C and Python
+// paths) while still giving non-Python processes a predict entry point.
+//
+// Interpreter bootstrap: the first API call initialises CPython lazily.
+// Module search honours PYTHONPATH, so embedders point it at the
+// mxnet_tpu package (and, for virtualenvs, the env's site-packages) —
+// see tests/c_predict_smoke.c for the canonical embedding recipe.
+// All calls are GIL-safe and may come from any thread.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+// Format the pending Python exception into g_last_error and clear it.
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (type) {
+    PyObject *n = PyObject_GetAttrString(type, "__name__");
+    if (n) {
+      const char *c = PyUnicode_AsUTF8(n);
+      if (c) msg = std::string(c) + ": " + msg;
+      Py_DECREF(n);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+std::once_flag g_py_once;
+
+// Start CPython once, then drop the GIL so per-call PyGILState_Ensure
+// works from arbitrary threads.  If the host process already runs an
+// interpreter (e.g. a Python process dlopening this library), reuse it.
+void ensure_python() {
+  std::call_once(g_py_once, [] {
+    if (Py_IsInitialized()) return;
+    PyConfig config;
+    PyConfig_InitPythonConfig(&config);
+    config.parse_argv = 0;
+    config.install_signal_handlers = 0;  // never steal the host's handlers
+    Py_InitializeFromConfig(&config);
+    PyConfig_Clear(&config);
+    // Some site configs register accelerator plugins that override the
+    // platform choice at import; re-assert the caller's JAX_PLATFORMS so
+    // the documented env contract holds for embedders too.
+    PyRun_SimpleString(
+        "import os\n"
+        "_p = os.environ.get('JAX_PLATFORMS')\n"
+        "if _p and ',' not in _p:\n"
+        "    try:\n"
+        "        import jax\n"
+        "        jax.config.update('jax_platforms', _p)\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "del _p\n");
+    PyEval_SaveThread();
+  });
+}
+
+// RAII GIL hold for one API call.
+struct Gil {
+  PyGILState_STATE state;
+  Gil() {
+    ensure_python();
+    state = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+struct Pred {
+  PyObject *obj = nullptr;             // mxnet_tpu.predict.Predictor
+  std::vector<unsigned> shape_scratch; // backs MXPredGetOutputShape
+};
+
+struct NDItem {
+  std::string key;
+  std::vector<float> data;
+  std::vector<unsigned> shape;
+};
+
+struct NDList {
+  std::vector<NDItem> items;
+};
+
+PyObject *import_attr(const char *module, const char *attr) {
+  PyObject *mod = PyImport_ImportModule(module);
+  if (!mod) return nullptr;
+  PyObject *a = PyObject_GetAttrString(mod, attr);
+  Py_DECREF(mod);
+  return a;
+}
+
+// Build the ctx object for (dev_type, dev_id): 1 -> cpu, else the chip.
+PyObject *make_ctx(int dev_type, int dev_id) {
+  PyObject *fn = import_attr("mxnet_tpu", dev_type == 1 ? "cpu" : "tpu");
+  if (!fn) return nullptr;
+  PyObject *ctx = PyObject_CallFunction(fn, "i", dev_id);
+  Py_DECREF(fn);
+  return ctx;
+}
+
+// {key: (d0, d1, ...)} from the CSR-encoded input shapes.
+PyObject *make_shape_dict(unsigned n, const char **keys,
+                          const unsigned *indptr, const unsigned *dims) {
+  PyObject *d = PyDict_New();
+  if (!d) return nullptr;
+  for (unsigned i = 0; i < n; ++i) {
+    unsigned lo = indptr[i], hi = indptr[i + 1];
+    PyObject *t = PyTuple_New(hi - lo);
+    if (!t) { Py_DECREF(d); return nullptr; }
+    for (unsigned j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(t, j - lo, PyLong_FromUnsignedLong(dims[j]));
+    if (PyDict_SetItemString(d, keys[i], t) != 0) {
+      Py_DECREF(t);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+int create_impl(const char *symbol_json, const void *param_bytes,
+                int param_size, int dev_type, int dev_id,
+                unsigned num_inputs, const char **input_keys,
+                const unsigned *indptr, const unsigned *dims,
+                unsigned num_outputs, const char **output_keys,
+                void **out) {
+  Gil gil;
+  PyObject *cls = import_attr("mxnet_tpu.predict", "Predictor");
+  if (!cls) { set_error_from_python(); return -1; }
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *shapes = make_shape_dict(num_inputs, input_keys, indptr, dims);
+  PyObject *ctx = make_ctx(dev_type, dev_id);
+  PyObject *outputs = nullptr;
+  if (num_outputs > 0) {
+    outputs = PyList_New(num_outputs);
+    for (unsigned i = 0; outputs && i < num_outputs; ++i)
+      PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+  } else {
+    outputs = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *pred = nullptr;
+  if (params && shapes && ctx && outputs) {
+    PyObject *args = Py_BuildValue("(sOO)", symbol_json, params, shapes);
+    PyObject *kwargs = Py_BuildValue("{s:O,s:O}", "ctx", ctx,
+                                     "output_names", outputs);
+    if (args && kwargs) pred = PyObject_Call(cls, args, kwargs);
+    Py_XDECREF(args);
+    Py_XDECREF(kwargs);
+  }
+  Py_XDECREF(params);
+  Py_XDECREF(shapes);
+  Py_XDECREF(ctx);
+  Py_XDECREF(outputs);
+  Py_DECREF(cls);
+  if (!pred) { set_error_from_python(); return -1; }
+  Pred *h = new Pred();
+  h->obj = pred;
+  *out = h;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char **input_keys,
+                 const unsigned *input_shape_indptr,
+                 const unsigned *input_shape_data, void **out) {
+  return create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                     dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                     input_shape_data, 0, nullptr, out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           unsigned num_input_nodes, const char **input_keys,
+                           const unsigned *input_shape_indptr,
+                           const unsigned *input_shape_data,
+                           unsigned num_output_nodes,
+                           const char **output_keys, void **out) {
+  return create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                     dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                     input_shape_data, num_output_nodes, output_keys, out);
+}
+
+int MXPredGetOutputShape(void *handle, unsigned index, unsigned **shape_data,
+                         unsigned *shape_ndim) {
+  Gil gil;
+  Pred *h = static_cast<Pred *>(handle);
+  PyObject *shape =
+      PyObject_CallMethod(h->obj, "get_output_shape", "I", index);
+  if (!shape) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyTuple_Check(shape) ? PyTuple_GET_SIZE(shape) : -1;
+  if (n < 0) {
+    Py_DECREF(shape);
+    set_error("get_output_shape did not return a tuple");
+    return -1;
+  }
+  h->shape_scratch.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape_scratch[i] =
+        (unsigned)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i));
+  Py_DECREF(shape);
+  *shape_data = h->shape_scratch.data();
+  *shape_ndim = (unsigned)n;
+  return 0;
+}
+
+int MXPredSetInput(void *handle, const char *key, const float *data,
+                   unsigned size) {
+  Gil gil;
+  Pred *h = static_cast<Pred *>(handle);
+  // Zero-copy view of the caller's buffer; Predictor.set_input copies it
+  // into the bound executor before we return, so the view never escapes.
+  PyObject *mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      (Py_ssize_t)size * 4, PyBUF_READ);
+  if (!mem) { set_error_from_python(); return -1; }
+  PyObject *frombuffer = import_attr("numpy", "frombuffer");
+  PyObject *arr = nullptr;
+  if (frombuffer)
+    arr = PyObject_CallFunction(frombuffer, "Os", mem, "float32");
+  Py_XDECREF(frombuffer);
+  Py_DECREF(mem);
+  PyObject *r = nullptr;
+  if (arr) r = PyObject_CallMethod(h->obj, "set_input", "sO", key, arr);
+  Py_XDECREF(arr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(void *handle) {
+  Gil gil;
+  Pred *h = static_cast<Pred *>(handle);
+  PyObject *r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredPartialForward(void *handle, int step, int *step_left) {
+  // One fused XLA executable: the whole pass runs at step 0.
+  if (step_left) *step_left = 0;
+  if (step > 0) return 0;
+  return MXPredForward(handle);
+}
+
+int MXPredGetOutput(void *handle, unsigned index, float *data, unsigned size) {
+  Gil gil;
+  Pred *h = static_cast<Pred *>(handle);
+  PyObject *b =
+      PyObject_CallMethod(h->obj, "get_output_bytes", "I", index);
+  if (!b) { set_error_from_python(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(b, &buf, &len) != 0) {
+    Py_DECREF(b);
+    set_error_from_python();
+    return -1;
+  }
+  if ((Py_ssize_t)size * 4 != len) {
+    Py_DECREF(b);
+    set_error("MXPredGetOutput: size mismatch (got " + std::to_string(size) +
+              " floats, output has " + std::to_string(len / 4) + ")");
+    return -1;
+  }
+  std::memcpy(data, buf, (size_t)len);
+  Py_DECREF(b);
+  return 0;
+}
+
+int MXPredFree(void *handle) {
+  Gil gil;
+  Pred *h = static_cast<Pred *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size, void **out,
+                   unsigned *out_length) {
+  Gil gil;
+  PyObject *loads = import_attr("mxnet_tpu.ndarray", "loads");
+  if (!loads) { set_error_from_python(); return -1; }
+  PyObject *payload =
+      PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *d = nullptr;
+  if (payload) d = PyObject_CallFunction(loads, "O", payload);
+  Py_XDECREF(payload);
+  Py_DECREF(loads);
+  if (!d) { set_error_from_python(); return -1; }
+
+  NDList *list = new NDList();
+  PyObject *key = nullptr, *val = nullptr;
+  Py_ssize_t pos = 0;
+  bool ok = true;
+  while (ok && PyDict_Next(d, &pos, &key, &val)) {
+    NDItem item;
+    const char *k = PyUnicode_AsUTF8(key);
+    item.key = k ? k : "";
+    PyObject *np_arr = PyObject_CallMethod(val, "asnumpy", nullptr);
+    PyObject *f32 = nullptr, *bytes = nullptr, *shape = nullptr;
+    if (np_arr) f32 = PyObject_CallMethod(np_arr, "astype", "s", "float32");
+    if (f32) bytes = PyObject_CallMethod(f32, "tobytes", nullptr);
+    if (f32) shape = PyObject_GetAttrString(f32, "shape");
+    if (bytes && shape && PyTuple_Check(shape)) {
+      char *buf = nullptr;
+      Py_ssize_t len = 0;
+      PyBytes_AsStringAndSize(bytes, &buf, &len);
+      item.data.assign(reinterpret_cast<float *>(buf),
+                       reinterpret_cast<float *>(buf + len));
+      for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(shape); ++i)
+        item.shape.push_back(
+            (unsigned)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i)));
+      list->items.push_back(std::move(item));
+    } else {
+      ok = false;
+    }
+    Py_XDECREF(shape);
+    Py_XDECREF(bytes);
+    Py_XDECREF(f32);
+    Py_XDECREF(np_arr);
+  }
+  Py_DECREF(d);
+  if (!ok) {
+    delete list;
+    set_error_from_python();
+    return -1;
+  }
+  *out = list;
+  *out_length = (unsigned)list->items.size();
+  return 0;
+}
+
+int MXNDListGet(void *handle, unsigned index, const char **out_key,
+                const float **out_data, const unsigned **out_shape,
+                unsigned *out_ndim) {
+  NDList *list = static_cast<NDList *>(handle);
+  if (index >= list->items.size()) {
+    set_error("MXNDListGet: index out of range");
+    return -1;
+  }
+  const NDItem &item = list->items[index];
+  *out_key = item.key.c_str();
+  *out_data = item.data.data();
+  *out_shape = item.shape.data();
+  *out_ndim = (unsigned)item.shape.size();
+  return 0;
+}
+
+int MXNDListFree(void *handle) {
+  delete static_cast<NDList *>(handle);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+/* C smoke test for the predict ABI (include/mxnet_tpu/c_predict_api.h).
+ *
+ * A plain C program — no Python — that loads a checkpoint and scores a
+ * batch, the way a non-Python inference service would embed the
+ * reference's libmxnet_predict.  Driven by tests/test_c_predict.py:
+ *
+ *   c_predict_smoke <symbol.json> <model.params> <N> <C> [out.bin]
+ *
+ * Feeds a deterministic ramp input, prints the output shape and the
+ * argmax+sum of row 0, and (optionally) dumps the raw float32 output so
+ * the Python side can compare bit-for-bit against Predictor.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu/c_predict_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s symbol.json model.params N C [out.bin]\n",
+            argv[0]);
+    return 2;
+  }
+  long sym_size = 0, param_size = 0;
+  char *sym_json = read_file(argv[1], &sym_size);
+  char *params = read_file(argv[2], &param_size);
+  if (!sym_json || !params) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+  mx_uint n = (mx_uint)atoi(argv[3]), c = (mx_uint)atoi(argv[4]);
+
+  const char *input_keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint dims[] = {n, c};
+  PredictorHandle pred = NULL;
+  if (MXPredCreate(sym_json, params, (int)param_size, 1, 0, 1, input_keys,
+                   indptr, dims, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint in_size = n * c;
+  mx_float *input = (mx_float *)malloc(in_size * sizeof(mx_float));
+  for (mx_uint i = 0; i < in_size; ++i)
+    input[i] = (mx_float)(i % 17) * 0.25f - 2.0f;
+  if (MXPredSetInput(pred, "data", input, in_size) != 0) {
+    fprintf(stderr, "MXPredSetInput failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  int step_left = 1;
+  for (int step = 0; step_left != 0; ++step)
+    if (MXPredPartialForward(pred, step, &step_left) != 0) {
+      fprintf(stderr, "MXPredPartialForward failed: %s\n", MXGetLastError());
+      return 1;
+    }
+
+  mx_uint *shape = NULL, ndim = 0;
+  if (MXPredGetOutputShape(pred, 0, &shape, &ndim) != 0) {
+    fprintf(stderr, "MXPredGetOutputShape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint out_size = 1;
+  printf("output_shape:");
+  for (mx_uint i = 0; i < ndim; ++i) {
+    printf(" %u", shape[i]);
+    out_size *= shape[i];
+  }
+  printf("\n");
+
+  mx_float *output = (mx_float *)malloc(out_size * sizeof(mx_float));
+  if (MXPredGetOutput(pred, 0, output, out_size) != 0) {
+    fprintf(stderr, "MXPredGetOutput failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint row = ndim >= 2 ? out_size / shape[0] : out_size;
+  mx_uint argmax = 0;
+  float sum = 0.0f;
+  for (mx_uint i = 0; i < row; ++i) {
+    sum += output[i];
+    if (output[i] > output[argmax]) argmax = i;
+  }
+  printf("row0_argmax: %u\nrow0_sum: %.6f\n", argmax, sum);
+
+  if (argc > 5) {
+    FILE *f = fopen(argv[5], "wb");
+    fwrite(output, sizeof(mx_float), out_size, f);
+    fclose(f);
+  }
+
+  MXPredFree(pred);
+  free(input);
+  free(output);
+  free(sym_json);
+  free(params);
+  return 0;
+}

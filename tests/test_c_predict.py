@@ -1,0 +1,128 @@
+"""C ABI predict smoke: build libmxnet_tpu_predict.so, compile the plain-C
+driver (tests/c_predict_smoke.c), score a saved checkpoint from C, and
+check the raw output floats against the in-process Predictor.
+
+Parity: reference c_predict_api.h + amalgamation's predict-only build —
+the non-Python embedding path.
+"""
+import os
+import shutil
+import struct
+import subprocess
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_smoke(tmpdir, libpath):
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    exe = os.path.join(tmpdir, "c_predict_smoke")
+    libdir = os.path.dirname(libpath)
+    cmd = [
+        cc, os.path.join(ROOT, "tests", "c_predict_smoke.c"),
+        "-I", os.path.join(ROOT, "include"),
+        "-L", libdir, "-lmxnet_tpu_predict",
+        "-Wl,-rpath," + libdir, "-o", exe,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return exe
+
+
+def _save_checkpoint(tmpdir):
+    """A small MLP checkpoint saved through the normal Module path."""
+    mx.random.seed(7)
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=16, name="fc1"), act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=5, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    prefix = os.path.join(tmpdir, "cpred")
+    mod.save_checkpoint(prefix, 0)
+    return prefix
+
+
+def test_c_predict_smoke(tmp_path):
+    libpath = native.get_predict_lib_path()
+    if libpath is None:
+        pytest.skip("toolchain or shared libpython unavailable")
+    tmpdir = str(tmp_path)
+    exe = _build_smoke(tmpdir, libpath)
+    prefix = _save_checkpoint(tmpdir)
+
+    out_bin = os.path.join(tmpdir, "out.bin")
+    env = dict(os.environ)
+    # The embedded interpreter starts from libpython's default sys.path;
+    # point it at the package and this interpreter's site-packages.
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT, sysconfig.get_paths()["purelib"]]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    env["JAX_PLATFORMS"] = "cpu"
+    n, c = 4, 8
+    proc = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0000.params",
+         str(n), str(c), out_bin],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "output_shape: 4 5" in proc.stdout, proc.stdout
+
+    # bit-compare against the in-process Predictor on the same ramp input
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        params = f.read()
+    pred = mx.predict.Predictor(sym_json, params, {"data": (n, c)},
+                                ctx=mx.cpu())
+    x = (np.arange(n * c) % 17).astype(np.float32) * 0.25 - 2.0
+    expect = pred.forward(data=x.reshape(n, c)).get_output(0)
+    with open(out_bin, "rb") as f:
+        got = np.array(struct.unpack("<%df" % expect.size, f.read()),
+                       np.float32).reshape(expect.shape)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_c_predict_ndlist(tmp_path):
+    """MXNDList* round-trip through the C ABI (mean-image loading path)."""
+    libpath = native.get_predict_lib_path()
+    if libpath is None:
+        pytest.skip("toolchain or shared libpython unavailable")
+    import ctypes
+
+    lib = ctypes.CDLL(libpath)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    mean = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    path = os.path.join(str(tmp_path), "mean.nd")
+    mx.nd.save(path, {"mean_img": mean})
+    with open(path, "rb") as f:
+        payload = f.read()
+
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    rc = lib.MXNDListCreate(payload, len(payload), ctypes.byref(handle),
+                            ctypes.byref(length))
+    assert rc == 0, lib.MXGetLastError()
+    assert length.value == 1
+
+    key = ctypes.c_char_p()
+    data = ctypes.POINTER(ctypes.c_float)()
+    shape = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXNDListGet(handle, 0, ctypes.byref(key), ctypes.byref(data),
+                         ctypes.byref(shape), ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError()
+    assert key.value == b"mean_img"
+    assert [shape[i] for i in range(ndim.value)] == [2, 3]
+    got = np.array([data[i] for i in range(6)], np.float32)
+    np.testing.assert_array_equal(got, np.arange(6, dtype=np.float32))
+    assert lib.MXNDListFree(handle) == 0

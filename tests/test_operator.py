@@ -763,12 +763,17 @@ TABLE_COVERED = (
 )
 
 
-def test_zz_registry_coverage():
-    from mxnet_tpu.ops.registry import OP_REGISTRY
+# Snapshot at collection time: the gate covers the built-in registry, not
+# Custom/RTC ops other tests register at runtime (those are user surface).
+from mxnet_tpu.ops.registry import OP_REGISTRY as _REG  # noqa: E402
 
+_BUILTIN_OPS = dict(_REG)
+
+
+def test_zz_registry_coverage():
     covered_names = TABLE_COVERED | COVERED_ELSEWHERE
     groups = {}
-    for name, op in OP_REGISTRY.items():
+    for name, op in _BUILTIN_OPS.items():
         groups.setdefault(id(op), set()).add(name)
     total = len(groups)
     covered = sum(1 for names in groups.values() if names & covered_names)
