@@ -59,5 +59,10 @@ from . import visualization as viz
 from . import test_utils
 from . import operator
 from . import parallel
+from . import executor_manager
+from . import registry
+from . import notebook
+from . import torch
+from .torch import th
 
 __version__ = "0.1.0"

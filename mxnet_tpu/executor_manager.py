@@ -1,0 +1,169 @@
+"""Legacy data-parallel executor manager API.
+
+Parity: reference python/mxnet/executor_manager.py:278
+(`DataParallelExecutorManager`) plus its module-level helpers
+(`_split_input_slice:14`, `_check_arguments:51`, `_load_general:81`,
+`_load_data:93`, `_load_label:97`).  The reference `model.py FeedForward`
+drives training through this class, and some user scripts import it
+directly.
+
+TPU redesign: the reference manager binds one executor per device and
+hand-copies batch slices; here the "group" is the SPMD
+`module.executor_group.DataParallelExecutorGroup` — ONE jitted executor
+over the device mesh, with XLA inserting the gradient all-reduce — so
+this file is a thin façade that preserves the legacy call surface
+(`load_data_batch` / `forward` / `backward` / `copy_to` / bucketing via
+`sym_gen`) over that design.
+"""
+from __future__ import annotations
+
+import logging
+
+from .context import cpu
+from .io import DataDesc
+from .module.executor_group import (
+    DataParallelExecutorGroup as _SPMDGroup,
+    _split_input_slice,
+)
+
+__all__ = ["DataParallelExecutorManager", "_split_input_slice",
+           "_check_arguments", "_load_general", "_load_data", "_load_label"]
+
+
+def _check_arguments(symbol):
+    """Reject duplicate argument/aux names (reference
+    executor_manager.py:51)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        raise ValueError(
+            "Find duplicated argument name, please make the weight name "
+            "non-duplicated (using name arguments), arguments are %s"
+            % str(arg_names))
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise ValueError(
+            "Find duplicated auxiliary param name, please make the weight "
+            "name non-duplicated (using name arguments), auxiliary params "
+            "are %s" % str(aux_names))
+
+
+def _load_general(data, targets):
+    """Copy a list of source arrays into a list of targets; each target is
+    either an NDArray or a list of (slice, NDArray) pairs (reference
+    executor_manager.py:81)."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, list):
+            for slice_idx, d_dst in d_targets:
+                d_src[slice_idx].copyto(d_dst)
+        else:
+            d_src.copyto(d_targets)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+class DataParallelExecutorManager:
+    """Manage executors for data parallelism over `ctx` (reference
+    executor_manager.py:278).  With `sym_gen`, keeps one executor group
+    per bucket key, parameters shared (bucketing)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names, param_names,
+                 aux_names, work_load_list=None, logger=None, sym_gen=None):
+        if logger is None:
+            logger = logging
+        num_device = len(ctx)
+        logger.info("Start training with %s", str(ctx))
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        assert isinstance(work_load_list, list) and \
+            len(work_load_list) == num_device, "Invalid settings for work load."
+
+        self.slices = _split_input_slice(train_data.batch_size, work_load_list)
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.ctx = ctx
+        self.symbol = symbol
+        self.sym_gen = sym_gen
+        self._workload = work_load_list
+        self._data_shapes = [DataDesc(*x[:2]) if not isinstance(x, DataDesc)
+                             else x for x in train_data.provide_data]
+        self._label_shapes = [DataDesc(*x[:2]) if not isinstance(x, DataDesc)
+                              else x for x in (train_data.provide_label or [])]
+
+        self.execgrp = self._make_group(symbol, shared_group=None)
+        self.curr_execgrp = None  # set when data is loaded
+        if self.sym_gen is not None:
+            self.execgrp_bucket = {train_data.default_bucket_key: self.execgrp}
+
+    def _make_group(self, symbol, shared_group):
+        return _SPMDGroup(
+            symbol, self.ctx, self._workload, self._data_shapes,
+            self._label_shapes or None, self.param_names, for_training=True,
+            inputs_need_grad=False, shared_group=shared_group)
+
+    def install_monitor(self, monitor):
+        """Install monitor on all executors."""
+        if self.sym_gen is not None:
+            raise NotImplementedError(
+                "Monitoring is not implemented for bucketing")
+        self.execgrp.install_monitor(monitor)
+
+    def set_params(self, arg_params, aux_params):
+        """Push parameter/aux dicts into the bound executors."""
+        self.execgrp.set_params(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Pull current parameter values into the given dicts (in place)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.copyto(cpu()) for w in block) / len(block)
+            weight.astype(arg_params[name].dtype).copyto(arg_params[name])
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.copyto(cpu()) for w in block) / len(block)
+            weight.astype(aux_params[name].dtype).copyto(aux_params[name])
+
+    @property
+    def param_arrays(self):
+        return self.execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.execgrp.aux_arrays
+
+    def load_data_batch(self, data_batch):
+        """Select (building if bucketing) the executor group for this batch
+        and stage the batch on it."""
+        if self.sym_gen is not None:
+            key = data_batch.bucket_key
+            if key not in self.execgrp_bucket:
+                symbol = self.sym_gen(key)
+                self._data_shapes = [
+                    DataDesc(*x[:2]) if not isinstance(x, DataDesc) else x
+                    for x in data_batch.provide_data]
+                self._label_shapes = [
+                    DataDesc(*x[:2]) if not isinstance(x, DataDesc) else x
+                    for x in (data_batch.provide_label or [])]
+                self.execgrp_bucket[key] = self._make_group(
+                    symbol, shared_group=self.execgrp)
+            self.curr_execgrp = self.execgrp_bucket[key]
+        else:
+            self.curr_execgrp = self.execgrp
+        self._curr_batch = data_batch
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(self._curr_batch, is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

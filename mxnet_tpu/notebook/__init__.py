@@ -1,0 +1,4 @@
+"""Notebook helpers (parity: reference python/mxnet/notebook/)."""
+from . import callback
+
+__all__ = ["callback"]

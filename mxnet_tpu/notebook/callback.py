@@ -1,0 +1,162 @@
+"""Training-visualization callbacks for notebooks.
+
+Parity: reference python/mxnet/notebook/callback.py (PandasLogger +
+LiveBokehChart/LiveLearningCurve).  The reference renders through bokeh;
+that is a hosted-notebook dependency, so here the logger is the
+first-class citizen (pandas if available, plain dict-of-lists otherwise)
+and `LiveLearningCurve` renders through matplotlib when importable,
+degrading to silent accumulation — training never gains a hard viz
+dependency."""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+__all__ = ["PandasLogger", "LiveLearningCurve"]
+
+
+class PandasLogger:
+    """Record train/eval/epoch metric streams (reference
+    notebook/callback.py:54).  Frames are exposed as pandas DataFrames when
+    pandas is importable, else as {column: list} dicts."""
+
+    def __init__(self, batch_size, frequent=50):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._data = {"train": defaultdict(list),
+                      "eval": defaultdict(list),
+                      "epoch": defaultdict(list)}
+        self.last_time = time.time()
+        self.start_time = time.time()
+        self.last_epoch_time = time.time()
+
+    def _frame(self, name):
+        data = dict(self._data[name])
+        try:
+            import pandas as pd
+            return pd.DataFrame(data)
+        except ImportError:
+            return data
+
+    @property
+    def train_df(self):
+        return self._frame("train")
+
+    @property
+    def eval_df(self):
+        return self._frame("eval")
+
+    @property
+    def epoch_df(self):
+        return self._frame("epoch")
+
+    @property
+    def all_dataframes(self):
+        return {k: self._frame(k) for k in self._data}
+
+    def elapsed(self):
+        return time.time() - self.start_time
+
+    def append_metrics(self, metrics, df_name):
+        d = self._data[df_name]
+        for key, value in metrics.items():
+            d[key].append(value)
+
+    def _process_batch(self, param, df_name):
+        now = time.time()
+        if param.eval_metric is not None:
+            names, values = param.eval_metric.get()
+            if not isinstance(names, list):
+                names, values = [names], [values]
+            metrics = dict(zip(names, values))
+            param.eval_metric.reset()
+        else:
+            metrics = {}
+        speed = self.frequent / (now - self.last_time) if now != self.last_time \
+            else float("inf")
+        metrics["batches_per_sec"] = speed
+        metrics["records_per_sec"] = speed * self.batch_size
+        metrics["elapsed"] = self.elapsed()
+        metrics["minibatch_count"] = param.nbatch
+        metrics["epoch"] = param.epoch
+        self.append_metrics(metrics, df_name)
+        self.last_time = now
+
+    def train_cb(self, param):
+        if param.nbatch % self.frequent == 0:
+            self._process_batch(param, "train")
+
+    def eval_cb(self, param):
+        self._process_batch(param, "eval")
+
+    def epoch_cb(self):
+        metrics = {"elapsed": self.elapsed()}
+        now = time.time()
+        metrics["epoch_time"] = now - self.last_epoch_time
+        self.append_metrics(metrics, "epoch")
+        self.last_epoch_time = now
+
+    def callback_args(self):
+        """kwargs for Module.fit: batch/eval/epoch callbacks wired up."""
+        return {
+            "batch_end_callback": self.train_cb,
+            "eval_end_callback": self.eval_cb,
+            "epoch_end_callback": lambda *args: self.epoch_cb(),
+        }
+
+
+class LiveLearningCurve:
+    """Live train/eval curve for a metric (reference
+    notebook/callback.py:316).  Renders with matplotlib when available
+    (call `.plot()`, or let the callbacks refresh every `frequent`
+    batches); always accumulates, so `.data` is usable headless."""
+
+    def __init__(self, metric_name, frequent=10):
+        self.metric_name = metric_name
+        self.frequent = frequent
+        self.data = {"train": ([], []), "eval": ([], [])}
+        self._fig = None
+
+    def _append(self, which, param):
+        if param.eval_metric is None:
+            return
+        names, values = param.eval_metric.get()
+        pairs = dict(zip(names if isinstance(names, list) else [names],
+                         values if isinstance(values, list) else [values]))
+        if self.metric_name in pairs:
+            xs, ys = self.data[which]
+            xs.append(param.nbatch)
+            ys.append(pairs[self.metric_name])
+
+    def train_cb(self, param):
+        self._append("train", param)
+        if param.nbatch % self.frequent == 0:
+            self.plot(refresh=True)
+
+    def eval_cb(self, param):
+        self._append("eval", param)
+        self.plot(refresh=True)
+
+    def plot(self, refresh=False):
+        try:
+            import matplotlib.pyplot as plt
+        except ImportError:
+            return None
+        if self._fig is None:
+            self._fig, self._ax = plt.subplots()
+            self._ax.set_xlabel("batch")
+            self._ax.set_ylabel(self.metric_name)
+        self._ax.clear()
+        for which, (xs, ys) in self.data.items():
+            if xs:
+                self._ax.plot(xs, ys, label=which)
+        self._ax.legend()
+        if refresh:
+            self._fig.canvas.draw_idle()
+        return self._fig
+
+    def callback_args(self):
+        return {
+            "batch_end_callback": self.train_cb,
+            "eval_end_callback": self.eval_cb,
+        }

@@ -1,0 +1,92 @@
+"""Generic class-registry factories.
+
+Parity: reference python/mxnet/registry.py (get_register_func /
+get_alias_func / get_create_func) — the factory triple behind
+`Optimizer.register` / `Initializer.register` / `mx.optimizer.create`
+style plugin points.  Keyed per base class; names are case-insensitive;
+`create` accepts an instance (passed through), a name, a name+kwargs JSON
+list, or a kwargs JSON dict."""
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRY = {}
+
+
+def get_register_func(base_class, nickname):
+    """Return a `register(klass, name=None)` function for `base_class`."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "Can only register subclass of %s" % base_class.__name__
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        if name in registry:
+            warnings.warn(
+                "New %s %s.%s registered with name %s is overriding "
+                "existing %s %s.%s" % (
+                    nickname, klass.__module__, klass.__name__, name,
+                    nickname, registry[name].__module__,
+                    registry[name].__name__),
+                UserWarning, stacklevel=2)
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (nickname, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Return an `@alias("a", "b")` decorator registering under each name."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Return a `create(name_or_instance, **kwargs)` factory."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if len(args):
+            name = args[0]
+            args = args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            assert not args and not kwargs, (
+                "%s is already an instance. Additional arguments are "
+                "invalid" % nickname)
+            return name
+        if isinstance(name, dict):
+            return create(**name)
+        assert isinstance(name, str), "%s must be of string type" % nickname
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            assert not args and not kwargs
+            kwargs = json.loads(name)
+            return create(**kwargs)
+        name = name.lower()
+        assert name in registry, \
+            "%s is not registered. Please register with %s.register first" % (
+                name, nickname)
+        return registry[name](*args, **kwargs)
+
+    create.__doc__ = (
+        "Create a %s instance from config (name, instance, or JSON)."
+        % nickname)
+    return create

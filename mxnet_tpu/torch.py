@@ -1,0 +1,144 @@
+"""PyTorch interop bridge.
+
+Parity: reference python/mxnet/torch.py + plugin/torch (the Torch7
+foreign-function bridge: `mxnet.th.<fn>` applies a Torch math function to
+NDArrays, and the plugin exposes Torch modules as graph operators).
+
+TPU redesign: the foreign framework is PyTorch (CPU build, baked into the
+image) instead of LuaJIT/Torch7, and the bridge crosses via host memory —
+`jax.pure_callback` on the traced path, numpy on the eager path — so a
+torch-implemented op can sit inside an XLA graph: the callback runs on
+host around the compiled program, exactly where the reference ran Torch
+kernels outside the MXNet engine.
+
+    mx.th.mul(a, b)                       # imperative, any torch.* fn
+    mx.torch.register_torch_op("tsin", torch.sin)
+    y = mx.sym.Custom(x, op_type="tsin")  # symbolic node, torch backward
+
+Gradients for registered ops come from torch.autograd on the host.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .operator import CustomOp, CustomOpProp, register
+
+__all__ = ["to_torch", "from_torch", "th", "register_torch_op"]
+
+
+def _torch():
+    try:
+        import torch as _t
+        return _t
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("PyTorch is not available: %s" % e)
+
+
+def to_torch(arr):
+    """NDArray → torch.Tensor (host copy; a TPU-resident array is fetched)."""
+    host = _np.asarray(arr.asnumpy())
+    if not host.flags.writeable:  # torch rejects read-only buffers
+        host = host.copy()
+    return _torch().from_numpy(host)
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor → NDArray on `ctx` (default: current context)."""
+    return nd.array(tensor.detach().cpu().numpy(), ctx=ctx)
+
+
+class _TorchNamespace:
+    """`mx.th`: resolve any torch function and apply it to NDArrays
+    (reference `mxnet.th.<name>` surface, torch.py:76-147)."""
+
+    def __getattr__(self, name):
+        torch = _torch()
+        fn = getattr(torch, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError("torch has no function %r" % name)
+
+        def call(*args, **kwargs):
+            t_args = [to_torch(a) if isinstance(a, nd.NDArray) else a
+                      for a in args]
+            out = fn(*t_args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return [from_torch(o) if hasattr(o, "detach") else o
+                        for o in out]
+            return from_torch(out) if hasattr(out, "detach") else out
+
+        call.__name__ = name
+        call.__doc__ = "mxnet_tpu bridge for torch.%s" % name
+        return call
+
+
+th = _TorchNamespace()
+
+
+def register_torch_op(reg_name, fn, num_inputs=1, infer_shape=None):
+    """Register a (differentiable) torch callable as a graph operator.
+
+    After registration, `mx.sym.Custom(..., op_type=reg_name)` /
+    `mx.nd.Custom(...)` create the node.  Forward runs `fn` on host torch
+    tensors via `jax.pure_callback`; backward runs `torch.autograd.grad`
+    the same way, so the op trains inside an otherwise-XLA graph.
+
+    infer_shape: optional `in_shapes -> out_shape`; default: shape of
+    input 0 (elementwise convention, like the reference TorchModule
+    wrapper's default)."""
+    import jax
+    import jax.numpy as jnp
+
+    torch = _torch()
+
+    def _host_fwd(*arrs):
+        ts = [torch.from_numpy(_np.asarray(a)) for a in arrs]
+        out = fn(*ts)
+        return _np.asarray(out.detach().cpu().numpy())
+
+    def _host_bwd(g, *arrs):
+        ts = [torch.from_numpy(_np.asarray(a)).requires_grad_(True)
+              for a in arrs]
+        out = fn(*ts)
+        grads = torch.autograd.grad(out, ts, grad_outputs=torch.from_numpy(
+            _np.ascontiguousarray(_np.asarray(g), dtype=_np.asarray(g).dtype)))
+        return tuple(_np.asarray(gr.cpu().numpy()) for gr in grads)
+
+    class _TorchBridgeOp(CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            xs = [x.data for x in in_data]
+            spec = jax.ShapeDtypeStruct(tuple(out_data[0].shape),
+                                        jnp.asarray(xs[0]).dtype)
+            y = jax.pure_callback(_host_fwd, spec, *xs, vmap_method="sequential")
+            self.assign(out_data[0], req[0], nd.NDArray(y))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            xs = [x.data for x in in_data]
+            g = out_grad[0].data
+            specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape),
+                                               jnp.asarray(x).dtype)
+                          for x in xs)
+            gs = jax.pure_callback(_host_bwd, specs, g, *xs,
+                                   vmap_method="sequential")
+            for dst, r, src in zip(in_grad, req, gs):
+                self.assign(dst, r, nd.NDArray(src))
+
+    class _TorchBridgeProp(CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data%d" % i for i in range(num_inputs)]
+
+        def infer_shape(self, in_shape):
+            out = (list(infer_shape(in_shape)) if infer_shape is not None
+                   else [in_shape[0]])
+            return in_shape, out, []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _TorchBridgeOp()
+
+    _TorchBridgeProp.__name__ = "TorchOp_%s" % reg_name
+    register(reg_name)(_TorchBridgeProp)
+    return _TorchBridgeProp

@@ -1,0 +1,150 @@
+"""Legacy/auxiliary API parity: executor_manager.DataParallelExecutorManager
+(reference executor_manager.py:278), the generic registry factories
+(reference registry.py), the PyTorch bridge (reference torch.py + the
+torch plugin), and notebook callbacks (reference notebook/callback.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.model import BatchEndParam
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=3, name="fc2"), name="softmax")
+
+
+def test_executor_manager_train_step():
+    mx.random.seed(3)
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 6).astype(np.float32)
+    y = rng.randint(0, 3, 16).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    net = _mlp()
+    arg_names = net.list_arguments()
+    param_names = [n for n in arg_names if n not in ("data", "softmax_label")]
+    mgr = mx.executor_manager.DataParallelExecutorManager(
+        net, [mx.cpu(0), mx.cpu(1)], it, arg_names, param_names,
+        net.list_auxiliary_states())
+
+    init = mx.init.Xavier()
+    arg_params = {n: mx.nd.empty(b[0].shape) for n, b in
+                  zip(param_names, mgr.param_arrays)}
+    for n, a in arg_params.items():
+        init(n, a)
+    mgr.set_params(arg_params, {})
+
+    batch = next(iter(it))
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    grads = mgr.grad_arrays
+    assert len(grads) == len(param_names)
+    assert any(float(np.abs(g[0].asnumpy()).sum()) > 0 for g in grads)
+
+    out_params = {n: mx.nd.empty(b[0].shape) for n, b in
+                  zip(param_names, mgr.param_arrays)}
+    mgr.copy_to(out_params, {})
+    for n in param_names:
+        np.testing.assert_allclose(out_params[n].asnumpy(),
+                                   arg_params[n].asnumpy(), rtol=1e-5)
+
+    metric = mx.metric.Accuracy()
+    mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0
+
+
+def test_executor_manager_helpers():
+    with pytest.raises(ValueError):
+        dup = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                    name="same")
+        dup = dup + mx.sym.FullyConnected(mx.sym.Variable("same_weight"),
+                                          num_hidden=2, name="other")
+        mx.executor_manager._check_arguments(dup)
+    src = [mx.nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))]
+    dst = mx.nd.zeros((2, 2))
+    mx.executor_manager._load_general(src, [[(slice(1, 3), dst)]])
+    np.testing.assert_array_equal(dst.asnumpy(),
+                                  np.arange(8).reshape(4, 2)[1:3])
+
+
+def test_generic_registry():
+    from mxnet_tpu.registry import (get_alias_func, get_create_func,
+                                    get_register_func)
+
+    class Thing:
+        def __init__(self, power=1):
+            self.power = power
+
+    reg = get_register_func(Thing, "thing")
+    alias = get_alias_func(Thing, "thing")
+    create = get_create_func(Thing, "thing")
+
+    @alias("widget", "gadget")
+    class Widget(Thing):
+        pass
+
+    assert isinstance(create("widget"), Widget)
+    assert isinstance(create("gadget", power=3), Widget)
+    assert create("widget", power=2).power == 2
+    inst = Widget()
+    assert create(inst) is inst
+    assert isinstance(create('["widget", {"power": 5}]'), Widget)
+    assert create('["widget", {"power": 5}]').power == 5
+    with pytest.raises(AssertionError):
+        create("nonexistent")
+    with pytest.warns(UserWarning):
+        reg(Widget, "widget")  # re-register warns
+
+
+def test_torch_imperative_bridge():
+    a = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    b = mx.nd.array(np.array([[10.0, 20.0], [30.0, 40.0]], np.float32))
+    out = mx.th.add(a, b)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() + b.asnumpy())
+    out = mx.th.sigmoid(a)
+    np.testing.assert_allclose(out.asnumpy(),
+                               1 / (1 + np.exp(-a.asnumpy())), rtol=1e-6)
+
+
+def test_torch_registered_op_fwd_bwd():
+    import torch as pytorch
+
+    mx.torch.register_torch_op("torchsin_t", pytorch.sin)
+    x_np = np.linspace(-2, 2, 12).astype(np.float32).reshape(3, 4)
+    x = mx.sym.Variable("x")
+    y = mx.sym.Custom(x, op_type="torchsin_t")
+    exe = y.simple_bind(mx.cpu(), x=(3, 4), grad_req="write")
+    exe.arg_dict["x"][:] = x_np
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    np.testing.assert_allclose(out, np.sin(x_np), rtol=1e-5, atol=1e-6)
+    exe.backward([mx.nd.ones((3, 4))])
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), np.cos(x_np),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_notebook_pandas_logger():
+    from mxnet_tpu.notebook.callback import LiveLearningCurve, PandasLogger
+
+    logger = PandasLogger(batch_size=4, frequent=1)
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0.0, 1.0])],
+                  [mx.nd.array([[0.8, 0.2], [0.1, 0.9]])])
+    cbs = logger.callback_args()
+    param = BatchEndParam(epoch=0, nbatch=1, eval_metric=metric, locals=None)
+    cbs["batch_end_callback"](param)
+    cbs["epoch_end_callback"]()
+    train = logger.train_df
+    col = train["accuracy"] if not isinstance(train, dict) else train["accuracy"]
+    assert len(col) == 1 and abs(float(col[0]) - 1.0) < 1e-6
+    assert len(logger.epoch_df["epoch_time"]) == 1
+
+    curve = LiveLearningCurve("accuracy", frequent=100)
+    metric.update([mx.nd.array([0.0])], [mx.nd.array([[0.9, 0.1]])])
+    curve._append("train", BatchEndParam(epoch=0, nbatch=2, eval_metric=metric,
+                                         locals=None))
+    assert curve.data["train"][0] == [2]
