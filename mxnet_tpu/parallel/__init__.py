@@ -8,8 +8,12 @@ parallelism is expressed as shardings over a `jax.sharding.Mesh`:
   * collectives.py— psum/all_gather/ppermute wrappers ≙ comm layer
   * ring_attention.py — context-parallel ring attention (new capability
     the reference lacks; SURVEY.md §5 long-context)
+  * pipeline.py   — GPipe-style scheduled pipeline parallelism over a
+    'pipe' axis (new capability the reference lacks)
   * dist.py       — multi-process control plane (Postoffice/tracker analog)
 """
 from . import mesh
 from . import collectives
+from . import pipeline
 from .mesh import make_mesh, data_parallel_mesh
+from .pipeline import pipeline_apply, pipeline_sharded

@@ -1,0 +1,118 @@
+"""Scheduled pipeline parallelism over a 'pipe' mesh axis.
+
+A capability the 2017 reference lacks (SURVEY.md §2.5 lists its
+parallelism modes as DP/model-placement only); on TPU it is the natural
+third axis next to data/tensor sharding, so it is provided as a
+first-class transform.  Design is GPipe microbatch scheduling expressed
+the XLA way: one `lax.scan` over pipeline ticks inside `shard_map`, with
+`lax.ppermute` shifting activations one hop along the 'pipe' axis each
+tick (neighbor traffic — rides ICI on a TPU torus, never DCN).  The
+backward schedule falls out of JAX AD through the scan: activations are
+stashed per tick exactly as GPipe stashes per microbatch, and
+`remat=True` swaps that for recomputation (the GPipe memory trade).
+
+Requirements (the classic pipeline contract):
+  * stages share one parameter structure and one boundary activation
+    shape (N identical blocks — e.g. transformer layers).  Embed/head
+    layers run outside the pipeline, as usual.
+  * params are stacked along a leading stage axis, sharded over 'pipe'.
+
+Entry points:
+  * pipeline_apply(stage_fn, params, microbatches, axis_name)
+      — per-shard body, for use INSIDE an existing shard_map
+  * pipeline_sharded(mesh, stage_fn, stacked_params, x, num_microbatches)
+      — host-level wrapper: builds the shard_map, splits microbatches,
+        composes with a 'data' axis when the mesh has one
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .collectives import shard_map
+from .mesh import NamedSharding, P
+
+__all__ = ["pipeline_apply", "pipeline_sharded"]
+
+
+def pipeline_apply(stage_fn, params, microbatches, axis_name="pipe",
+                   remat=False):
+    """Run the GPipe schedule; call inside `shard_map`.
+
+    stage_fn : (stage_params, x) -> y with y.shape == x.shape
+    params   : this device's stage parameters — a pytree whose leaves
+               carry a leading stage axis of length 1 (the 'pipe' shard
+               of the stacked params); squeezed here.
+    microbatches : [M, mb, ...] — the full microbatched input
+               (replicated along 'pipe'; only stage 0 reads it).
+    Returns [M, mb, ...] outputs, replicated along 'pipe'.
+    """
+    n_stages = lax.axis_size(axis_name)
+    my_stage = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, axis=0), params)
+    num_mb = microbatches.shape[0]
+    ticks = num_mb + n_stages - 1
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    # forward shift WITHOUT wraparound: stage 0 gets zeros from the
+    # permute and overwrites them with the injected microbatch, so no
+    # last->first traffic exists at all
+    shift_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        x_recv = carry
+        inject = microbatches[jnp.minimum(t, num_mb - 1)]
+        x_in = jnp.where(my_stage == 0, inject, x_recv)
+        y = fn(params, x_in)
+        x_next = lax.ppermute(y, axis_name, shift_perm)
+        return x_next, y
+
+    x0 = jnp.zeros_like(microbatches[0])
+    _, ys = lax.scan(tick, x0, jnp.arange(ticks))
+
+    # device s produced microbatch m at tick m+s; the last stage's are
+    # the pipeline outputs.  Mask + psum replicates them along 'pipe'
+    # (exact; the bubble ticks of other stages are zeroed out).
+    out = ys[n_stages - 1:]
+    is_last = (my_stage == n_stages - 1).astype(out.dtype)
+    return lax.psum(out * is_last, axis_name)
+
+
+def pipeline_sharded(mesh, stage_fn, stacked_params, x, num_microbatches,
+                     pipe_axis="pipe", data_axis=None, remat=False):
+    """Host-level pipelined apply: shard stacked params over `pipe_axis`,
+    split `x` (leading dim = batch) into `num_microbatches`, run the
+    schedule, return outputs with the original batch layout.
+
+    With `data_axis` set (a mesh axis name), the batch dim additionally
+    shards over it — DPxPP composition in one shard_map."""
+    n_stages = mesh.shape[pipe_axis]
+    batch = x.shape[0]
+    assert batch % num_microbatches == 0, \
+        "batch %d not divisible into %d microbatches" % (batch, num_microbatches)
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    assert all(l.shape[0] == n_stages for l in leaves), \
+        "stacked params must carry a leading stage axis of length %d" % n_stages
+
+    mb = x.reshape((num_microbatches, batch // num_microbatches) + x.shape[1:])
+
+    param_spec = jax.tree_util.tree_map(
+        lambda _: P(pipe_axis), stacked_params)
+    # microbatch batch dim is axis 1 of [M, mb, ...]
+    mb_spec = P(None, data_axis) if data_axis else P()
+    out_spec = P(None, data_axis) if data_axis else P()
+
+    body = functools.partial(pipeline_apply, stage_fn, axis_name=pipe_axis,
+                             remat=remat)
+    out = shard_map(
+        lambda p, m: body(p, m),
+        mesh=mesh,
+        in_specs=(param_spec, mb_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )(stacked_params, mb)
+    return out.reshape((batch,) + out.shape[2:])
