@@ -62,13 +62,20 @@ def main():
         mod.update()
     fence()
 
-    t0 = time.time()
-    for _ in range(STEPS):
-        mod.forward_backward(batch)
-        mod.update()
-    fence()
-    dt = (time.time() - t0) / STEPS
-    img_s = BATCH / dt
+    # 3 fenced chunks -> mean + spread, so the headline number carries a
+    # variance estimate (perf.md-style methodology, not a single sample)
+    chunk = STEPS // 3
+    rates = []
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(chunk):
+            mod.forward_backward(batch)
+            mod.update()
+        fence()
+        rates.append(BATCH * chunk / (time.time() - t0))
+    img_s = float(np.mean(rates))
+    spread = float(np.std(rates))
+    dt = BATCH / img_s
 
     # XLA-counted FLOPs of the fused step (fwd+bwd+update) for the MFU claim
     mfu = None
@@ -98,6 +105,7 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "mfu": mfu,
+        "stdev": round(spread, 2),
     }))
 
 

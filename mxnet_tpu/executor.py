@@ -842,6 +842,11 @@ class Executor:
         if callback is not None and getattr(self, "_fused_updater", None) is not None:
             # monitors need materialized outputs/grads — the single-dispatch
             # step keeps gradients inside the executable, so disarm it
+            import logging
+            logging.info(
+                "Monitor installed: leaving the fused fwd+bwd+update "
+                "dispatch (gradients must be materialized); expect lower "
+                "step throughput while monitoring")
             self._fused_updater = None
 
     def debug_str(self):

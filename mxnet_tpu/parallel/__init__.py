@@ -10,10 +10,14 @@ parallelism is expressed as shardings over a `jax.sharding.Mesh`:
     the reference lacks; SURVEY.md §5 long-context)
   * pipeline.py   — GPipe-style scheduled pipeline parallelism over a
     'pipe' axis (new capability the reference lacks)
+  * moe.py        — expert parallelism: capacity-bounded top-k routing +
+    all_to_all dispatch over an 'expert' axis (new capability)
   * dist.py       — multi-process control plane (Postoffice/tracker analog)
 """
 from . import mesh
 from . import collectives
 from . import pipeline
+from . import moe
 from .mesh import make_mesh, data_parallel_mesh
 from .pipeline import pipeline_apply, pipeline_sharded
+from .moe import moe_sharded, top_k_gating

@@ -1,0 +1,140 @@
+"""Expert parallelism — Mixture-of-Experts over an 'expert' mesh axis.
+
+Completes the named-strategy set (DP/TP/SP/PP/EP; SURVEY.md §2.5 marks EP
+absent from the 2017 reference).  The TPU-idiomatic design: experts are
+sharded one-per-device-group along an 'expert' mesh axis, tokens are
+routed with a capacity-bounded top-k gate, and the dispatch/combine is
+`lax.all_to_all` — the collective that rides ICI all-to-all links on a
+TPU torus (the same primitive Ulysses SP uses, parallel/ring_attention.py).
+
+Pieces:
+  * top_k_gating(logits, k, capacity) — deterministic capacity-bounded
+    router (Switch/GShard-style): per-expert position via a cumulative
+    count, tokens over capacity dropped (combine weight 0).
+  * moe_apply(...)    — per-shard body, call inside shard_map: dispatch
+    tokens to local experts via all_to_all, apply, combine back.
+  * moe_sharded(...)  — host-level wrapper building the shard_map over
+    ('expert',) or ('data','expert').
+
+Everything is static-shaped (capacity fixes the buffer sizes) so the
+whole layer jits into one XLA program — no data-dependent shapes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .collectives import shard_map
+from .mesh import P
+
+__all__ = ["top_k_gating", "moe_apply", "moe_sharded"]
+
+
+def top_k_gating(logits, k, capacity):
+    """Capacity-bounded top-k routing.
+
+    logits: [T, E] router scores.  Returns (dispatch, combine):
+      dispatch [T, E, C] one-hot: token t occupies slot c of expert e
+      combine  [T, E, C] float:   dispatch * softmax gate weight
+    Tokens beyond `capacity` of an expert are dropped (zero combine),
+    matching Switch-Transformer semantics; position assignment is by
+    token order (deterministic, shape-static).
+    """
+    t_len, n_exp = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    _, top_idx = lax.top_k(probs, k)                      # [T, k]
+    # mask[t, e] = 1 if e in token t's top-k
+    mask = jax.nn.one_hot(top_idx, n_exp, dtype=jnp.float32).sum(1)
+    # position of each token within each expert's queue, by token order
+    pos = jnp.cumsum(mask, axis=0) * mask - 1.0           # [T, E], -1 if unrouted
+    keep = mask * (pos < capacity)
+    pos = jnp.where(keep > 0, pos, 0).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, E, C]
+    dispatch = slot * keep[..., None]
+    gates = probs * keep
+    denom = gates.sum(-1, keepdims=True)
+    gates = gates / jnp.maximum(denom, 1e-9)              # renormalize kept
+    combine = dispatch * gates[..., None]
+    return dispatch, combine
+
+
+def moe_apply(expert_fn, params, x, gate_w, k=1, capacity_factor=1.0,
+              axis_name="expert"):
+    """Expert-parallel MoE layer body; call inside `shard_map`.
+
+    params : this shard's expert parameters (leading axis = local expert
+             count, usually 1).
+    x      : [T_local, D] this shard's tokens.
+    gate_w : [D, E] router weight (replicated).
+    Dispatch path: gate locally -> all_to_all tokens to expert owners ->
+    each shard applies its experts -> all_to_all back -> combine.
+    Returns [T_local, D].
+    """
+    n_shards = lax.axis_size(axis_name)
+    t_local, d = x.shape
+    local_experts = jax.tree_util.tree_leaves(params)[0].shape[0]
+    n_exp = n_shards * local_experts
+    capacity = max(1, int(capacity_factor * k * t_local // n_exp))
+
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    dispatch, combine = top_k_gating(logits, k, capacity)  # [T,E,C]
+
+    # gather expert inputs: [E, C, D] on every shard, then all_to_all so
+    # shard s ends up with ITS experts' slots from ALL shards:
+    # [E, C, D] -> split E -> [n_shards * local_E, C, D] laid out so the
+    # receiving shard concatenates senders along a new leading axis
+    exp_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    exp_in = exp_in.reshape(n_shards, local_experts, capacity, d)
+    # [S, localE, C, D] --all_to_all--> [S_from, localE, C, D]
+    recv = lax.all_to_all(exp_in, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+
+    # apply local experts over the concatenated sender axis
+    def one_expert(p, xe):  # xe: [S_from * C, D]
+        return expert_fn(p, xe)
+
+    xe = recv.transpose(1, 0, 2, 3).reshape(local_experts,
+                                            n_shards * capacity, d)
+    ye = jax.vmap(one_expert)(params, xe.astype(x.dtype))
+    ye = ye.reshape(local_experts, n_shards, capacity, d).transpose(1, 0, 2, 3)
+
+    # route results back to the token owners
+    back = lax.all_to_all(ye.astype(jnp.float32), axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)
+    back = back.reshape(n_exp, capacity, d)
+    return jnp.einsum("tec,ecd->td", combine, back).astype(x.dtype)
+
+
+def moe_sharded(mesh, expert_fn, stacked_params, x, gate_w, k=1,
+                capacity_factor=1.0, expert_axis="expert", data_axis=None):
+    """Host-level expert-parallel apply.
+
+    stacked_params: pytree with leading axis = total experts E (must be a
+    multiple of the 'expert' mesh axis size; each shard owns E/n).
+    x: [T, D] tokens (sharded over `data_axis` if given, tokens split
+    over the expert axis otherwise so all devices participate).
+    """
+    n_shards = mesh.shape[expert_axis]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    n_exp = leaves[0].shape[0]
+    assert n_exp % n_shards == 0, \
+        "experts %d not divisible over %d shards" % (n_exp, n_shards)
+
+    param_spec = jax.tree_util.tree_map(lambda _: P(expert_axis),
+                                        stacked_params)
+    tok_axes = (data_axis, expert_axis) if data_axis else (expert_axis,)
+    tok_spec = P(tok_axes)
+
+    body = functools.partial(moe_apply, expert_fn, k=k,
+                             capacity_factor=capacity_factor,
+                             axis_name=expert_axis)
+    return shard_map(
+        lambda p, xx, gw: body(p, xx, gw),
+        mesh=mesh,
+        in_specs=(param_spec, tok_spec, P()),
+        out_specs=tok_spec,
+        check_vma=False,
+    )(stacked_params, x, gate_w)
