@@ -49,6 +49,13 @@ REGISTRY = [
            "in the backward pass instead of storing them — jax.checkpoint "
            "with a save-only-matmul/conv-outputs remat policy (reference "
            "src/executor/graph_executor.cc:225-239)"),
+    EnvVar("MXNET_TPU_PALLAS_BN", int, 0,
+           "Use the hand-tiled Pallas kernel for BatchNorm train-mode "
+           "statistics on channel-minor TPU graphs (ops/pallas_kernels.py). "
+           "Default OFF: measured 27% SLOWER end-to-end on ResNet-50 batch "
+           "512 (1826 vs 2487 img/s) — the kernel wins nothing over XLA's "
+           "fused reduce and its custom_vjp pins an extra residual. Kept "
+           "for experimentation; see README Roofline item 5"),
     # ---- JAX/XLA passthrough the test/dev flows rely on ----
     EnvVar("JAX_PLATFORMS", str, "", "Force a JAX backend, e.g. 'cpu'"),
     EnvVar("XLA_FLAGS", str, "",

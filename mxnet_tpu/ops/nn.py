@@ -167,6 +167,7 @@ def _infer_conv(in_shapes, attrs):
 
 
 @register("Convolution", inputs=("data", "weight", "bias"), infer_shape=_infer_conv,
+          aliases=("Convolution_v1",),
           params={"kernel": P.Shape(required=True, low=1, desc="conv kernel (h, w)"),
                   "num_filter": P.Int(required=True, low=1, desc="number of output filters"),
                   "stride": P.Shape(low=1), "pad": P.Shape(low=0),
@@ -397,7 +398,7 @@ def _infer_bn(in_shapes, attrs):
     infer_shape=_infer_bn,
     need_is_train=True,
     num_aux_out=2,
-    aliases=("BatchNorm_v1",),
+    aliases=("BatchNorm_v1", "CuDNNBatchNorm"),
     params={"eps": P.Float(default=1e-3, low=0.0),
             "momentum": P.Float(default=0.9, low=0.0, high=1.0),
             "fix_gamma": P.Bool(), "use_global_stats": P.Bool()},
@@ -434,8 +435,17 @@ def batch_norm(
         # var costs a second full HBM sweep — measured ~25 ms/step on
         # ResNet-50 batch 512).  Cancellation is benign post-conv (mean~0)
         # and both accumulators are fp32.
-        mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
-        mean_sq = jnp.mean(jnp.square(data), axis=reduce_axes, dtype=jnp.float32)
+        mean = mean_sq = None
+        if ax == data.ndim - 1:
+            from ..config import get as _cfg_get
+            from .pallas_kernels import bn_stats, bn_stats_supported
+            if _cfg_get("MXNET_TPU_PALLAS_BN") and \
+                    bn_stats_supported(data.shape, ax):
+                mean, mean_sq = bn_stats(data, ax)
+        if mean is None:
+            mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
+            mean_sq = jnp.mean(jnp.square(data), axis=reduce_axes,
+                               dtype=jnp.float32)
         var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
         new_mm = moving_mean * momentum + lax.stop_gradient(mean).astype(moving_mean.dtype) * (1 - momentum)
         new_mv = moving_var * momentum + lax.stop_gradient(var).astype(moving_var.dtype) * (1 - momentum)

@@ -1,0 +1,76 @@
+"""Pallas BN-stats kernel (ops/pallas_kernels.py): numerics + custom-vjp
+gradient vs the jnp reference, run in interpret mode on CPU; shape gating;
+and the batch_norm fallback contract off-TPU."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+@pytest.fixture()
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(pk, "_INTERPRET", True)
+
+
+@pytest.mark.parametrize("shape", [(16, 14, 14, 256), (32, 8, 8, 128),
+                                   (64, 4, 4, 64)])
+def test_bn_stats_matches_jnp(interpret_mode, shape):
+    assert pk.bn_stats_supported(shape, 3)
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    mean, msq = pk.bn_stats(x, 3)
+    np.testing.assert_allclose(np.asarray(mean),
+                               np.asarray(x.mean((0, 1, 2))),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(msq),
+                               np.asarray((x * x).mean((0, 1, 2))),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bn_stats_grad_matches_jnp(interpret_mode):
+    shape = (32, 8, 8, 128)
+    x = jax.random.normal(jax.random.PRNGKey(1), shape, jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (shape[-1],))
+
+    def loss_p(x):
+        m, s = pk.bn_stats(x, 3)
+        return jnp.sum(m * w) + jnp.sum(s * w * w)
+
+    def loss_j(x):
+        return (jnp.sum(x.mean((0, 1, 2)) * w)
+                + jnp.sum((x * x).mean((0, 1, 2)) * w * w))
+
+    np.testing.assert_allclose(np.asarray(jax.grad(loss_p)(x)),
+                               np.asarray(jax.grad(loss_j)(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bn_stats_gating():
+    # channel-major layouts, non-foldable channels, and ragged M refused
+    assert not pk.bn_stats_supported((8, 64, 14, 14), 1)   # NCHW
+    assert not pk.bn_stats_supported((4, 3, 3, 384), 3)    # M=36 ragged
+    assert not pk.bn_stats_supported((16, 14, 14, 96), 3)  # 128 % 96 != 0
+    # off-TPU without interpret mode: always unsupported
+    assert not pk.bn_stats_supported((16, 14, 14, 256), 3)
+
+
+def test_batch_norm_fallback_off_tpu():
+    """On the CPU mesh batch_norm must silently use the jnp path and stay
+    correct (the production gating contract)."""
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 5, 5, 32).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data, axis=3, fix_gamma=False, name="bn")
+    exe = net.simple_bind(mx.cpu(), data=x.shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["bn_gamma"][:] = np.ones(32, np.float32)
+    exe.arg_dict["bn_beta"][:] = np.zeros(32, np.float32)
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    mean = x.mean((0, 1, 2))
+    var = x.var((0, 1, 2))
+    ref = (x - mean) / np.sqrt(var + 1e-3)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
