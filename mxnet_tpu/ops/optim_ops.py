@@ -1,0 +1,225 @@
+"""Fused optimizer-update ops + graph-compat utility ops.
+
+Parity: reference src/operator/optimizer_op.cc (sgd_update,
+sgd_mom_update, mp_sgd_update, mp_sgd_mom_update, adam_update,
+rmsprop_update, rmspropalex_update — the kernels the reference Optimizer
+classes dispatch to) and assorted registry stragglers
+(src/operator/loss_binary_op.cc softmax_cross_entropy,
+src/operator/tensor/matrix_op.cc _slice_assign/_crop_assign_scalar,
+src/operator/tensor/elemwise_unary_op.cc _identity_with_attr_like_rhs,
+src/operator/cross_device_copy.cc, identity_attach_KL_sparse_reg-inl.h).
+
+Functional deviation (XLA has no in-place mutation): the reference
+update ops MUTATE their state inputs (mom/mean/var/n/g/delta) and return
+only the weight; here every updated array is returned, weight first —
+`w, mom = nd.sgd_mom_update(w, g, mom, lr=...)`.  `optimizer.py`'s fused
+step uses the same math through its own jitted path; these ops are the
+public/per-call surface.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .tensor import _lit
+
+
+def _prep_grad(weight, grad, wd, rescale_grad, clip_gradient):
+    """grad = rescale*grad + wd*weight, then clip — the shared preamble of
+    every reference update kernel (optimizer_op-inl.h)."""
+    g = jnp.asarray(rescale_grad, grad.dtype) * grad + \
+        jnp.asarray(wd, grad.dtype) * weight
+    if clip_gradient >= 0.0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+def _f(v, default=None):
+    return float(_lit(v)) if v is not None else default
+
+
+@register("sgd_update", inputs=("weight", "grad"))
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, **kw):
+    """weight - lr * (rescale*grad + wd*weight) (optimizer_op.cc sgd_update)."""
+    g = _prep_grad(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
+    return weight - jnp.asarray(_f(lr), weight.dtype) * g
+
+
+@register("sgd_mom_update", inputs=("weight", "grad", "mom"), num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    """mom = momentum*mom - lr*grad'; weight += mom.  Returns (weight, mom)."""
+    g = _prep_grad(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
+    mom = jnp.asarray(_f(momentum), mom.dtype) * mom - \
+        jnp.asarray(_f(lr), mom.dtype) * g
+    return weight + mom, mom
+
+
+@register("mp_sgd_update", inputs=("weight", "grad", "weight32"),
+          num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, **kw):
+    """Multi-precision SGD: fp32 master `weight32` updates in fp32, the
+    low-precision weight is its cast.  Returns (weight, weight32)."""
+    g = _prep_grad(weight32, grad.astype(jnp.float32), _f(wd),
+                   _f(rescale_grad), _f(clip_gradient))
+    w32 = weight32 - jnp.float32(_f(lr)) * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", inputs=("weight", "grad", "mom", "weight32"),
+          num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    """Multi-precision momentum SGD. Returns (weight, mom, weight32)."""
+    g = _prep_grad(weight32, grad.astype(jnp.float32), _f(wd),
+                   _f(rescale_grad), _f(clip_gradient))
+    mom = jnp.float32(_f(momentum)) * mom - jnp.float32(_f(lr)) * g
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register("adam_update", inputs=("weight", "grad", "mean", "var"),
+          num_outputs=3)
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                **kw):
+    """Adam step exactly as optimizer_op-inl.h AdamUpdate (no bias
+    correction inside the kernel — the python Optimizer folds it into lr).
+    Returns (weight, mean, var)."""
+    g = _prep_grad(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
+    b1, b2 = _f(beta1), _f(beta2)
+    mean = b1 * mean + (1.0 - b1) * g
+    var = b2 * var + (1.0 - b2) * jnp.square(g)
+    out = weight - jnp.asarray(_f(lr), weight.dtype) * mean / \
+        (jnp.sqrt(var) + _f(epsilon))
+    return out, mean, var
+
+
+@register("rmsprop_update", inputs=("weight", "grad", "n"), num_outputs=2)
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
+                   **kw):
+    """Tieleman & Hinton RMSProp (optimizer_op-inl.h RMSPropUpdate).
+    Returns (weight, n)."""
+    g = _prep_grad(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
+    g1 = _f(gamma1)
+    n = (1.0 - g1) * jnp.square(g) + g1 * n
+    out = weight - jnp.asarray(_f(lr), weight.dtype) * \
+        (g / jnp.sqrt(n + _f(epsilon)))
+    cw = _f(clip_weights)
+    if cw >= 0.0:
+        out = jnp.clip(out, -cw, cw)
+    return out, n
+
+
+@register("rmspropalex_update", inputs=("weight", "grad", "n", "g", "delta"),
+          num_outputs=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0, **kw):
+    """Graves 2013 RMSProp (optimizer_op-inl.h RMSPropAlexUpdate).
+    Returns (weight, n, g, delta)."""
+    gr = _prep_grad(weight, grad, _f(wd), _f(rescale_grad),
+                    _f(clip_gradient))
+    g1, g2 = _f(gamma1), _f(gamma2)
+    n = (1.0 - g1) * jnp.square(gr) + g1 * n
+    g = (1.0 - g1) * gr + g1 * g
+    delta = g2 * delta - jnp.asarray(_f(lr), weight.dtype) * \
+        (gr / jnp.sqrt(n - jnp.square(g) + _f(epsilon)))
+    out = weight + delta
+    cw = _f(clip_weights)
+    if cw >= 0.0:
+        out = jnp.clip(out, -cw, cw)
+    return out, n, g, delta
+
+
+# ----------------------------------------------------------------------
+# graph-compat stragglers
+# ----------------------------------------------------------------------
+
+def _infer_scalar_out(in_shapes, attrs):
+    return list(in_shapes), [(1,)]
+
+
+@register("softmax_cross_entropy", inputs=("data", "label"),
+          infer_shape=_infer_scalar_out)
+def softmax_cross_entropy(data, label, **kw):
+    """Summed cross entropy of softmax(data) vs integer labels
+    (loss_binary_op.cc): out = -sum_i log softmax(data)[i, label_i]."""
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked).reshape(1).astype(data.dtype)
+
+
+def _norm_bounds(shape, begin, end):
+    begin = [0 if b is None else int(b) for b in begin]
+    end = [shape[i] if e is None else int(e) for i, e in enumerate(end)]
+    begin = [b + shape[i] if b < 0 else b for i, b in enumerate(begin)]
+    end = [e + shape[i] if e < 0 else e for i, e in enumerate(end)]
+    return begin, end
+
+
+@register("_slice_assign", inputs=("lhs", "rhs"),
+          aliases=("_crop_assign",))
+def slice_assign(lhs, rhs, begin, end, step=None, **kw):
+    """lhs with lhs[begin:end] replaced by rhs (matrix_op.cc
+    _slice_assign; the engine op behind sliced NDArray writes)."""
+    begin, _ = _norm_bounds(lhs.shape, _lit(begin), _lit(end))
+    return lax.dynamic_update_slice(lhs, rhs.astype(lhs.dtype), begin)
+
+
+@register("_crop_assign_scalar", inputs=("data",))
+def crop_assign_scalar(data, begin, end, scalar=0.0, **kw):
+    """data with data[begin:end] filled with `scalar`
+    (matrix_op.cc _crop_assign_scalar)."""
+    begin, end = _norm_bounds(data.shape, _lit(begin), _lit(end))
+    patch = jnp.full([e - b for b, e in zip(begin, end)],
+                     float(_lit(scalar)), data.dtype)
+    return lax.dynamic_update_slice(data, patch, begin)
+
+
+@register("_identity_with_attr_like_rhs", inputs=("lhs", "rhs"))
+def identity_with_attr_like_rhs(lhs, rhs, **kw):
+    """Identity on lhs, shape/type attributes taken from rhs
+    (elemwise_unary_op.cc) — used by reference graph rewrites."""
+    return lhs
+
+
+@register("_CrossDeviceCopy", inputs=("data",))
+def cross_device_copy(data, **kw):
+    """Reference inter-device boundary op (cross_device_copy.cc), inserted
+    by PlaceDevice at group2ctx boundaries.  Under the SPMD design data
+    movement is XLA's job, so this is identity — registered so reference
+    graph JSON containing these nodes loads and runs."""
+    return data
+
+
+@register("IdentityAttachKLSparseReg", inputs=("data",))
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9, **kw):
+    """Identity forward; backward adds the KL-sparsity penalty gradient on
+    the mean activation rho vs target (identity_attach_KL_sparse_reg-inl.h):
+      d/dx += penalty * (-target/rho + (1-target)/(1-rho)) / batch
+    """
+    target = float(_lit(sparseness_target))
+    pen = float(_lit(penalty))
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        rho = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+        kl_grad = pen * (-target / rho + (1.0 - target) / (1.0 - rho))
+        return (g + (kl_grad / x.shape[0]).astype(x.dtype)[None, :],)
+
+    f.defvjp(fwd, bwd)
+    return f(data)

@@ -24,18 +24,27 @@ from .tensor import _dtype, _lit, _shape
 
 
 class _RngState:
-    """Process-global key chain for imperative sampling."""
+    """Process-global key chain for imperative sampling.
+
+    The key is materialized LAZILY: creating it at import would
+    initialize the XLA backend, which must not happen before a
+    multi-host job calls jax.distributed.initialize
+    (parallel/multihost.py)."""
 
     def __init__(self, seed=0):
         self._lock = threading.Lock()
-        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._key = None
 
     def seed(self, seed):
         with self._lock:
-            self._key = jax.random.key(seed)
+            self._seed = seed
+            self._key = None
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
         return sub
 

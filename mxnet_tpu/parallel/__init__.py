@@ -12,12 +12,15 @@ parallelism is expressed as shardings over a `jax.sharding.Mesh`:
     'pipe' axis (new capability the reference lacks)
   * moe.py        — expert parallelism: capacity-bounded top-k routing +
     all_to_all dispatch over an 'expert' axis (new capability)
+  * multihost.py  — multi-host SPMD bootstrap (jax.distributed over DCN;
+    global mesh + per-host input slices), launcher-env compatible
   * dist.py       — multi-process control plane (Postoffice/tracker analog)
 """
 from . import mesh
 from . import collectives
 from . import pipeline
 from . import moe
+from . import multihost
 from .mesh import make_mesh, data_parallel_mesh
 from .pipeline import pipeline_apply, pipeline_sharded
 from .moe import moe_sharded, top_k_gating

@@ -1,0 +1,132 @@
+"""Multi-host SPMD runtime — the DCN-scale story.
+
+Parity target: the reference scales past one host with ps-lite over
+TCP/RDMA (`parallel/dist.py` reimplements that control plane).  The
+TPU-native data plane is different: every host runs the SAME SPMD
+program, JAX's distributed runtime stitches the per-host PJRT clients
+into one global device list, and XLA lowers collectives so intra-slice
+traffic rides ICI while cross-host hops ride DCN — no parameter server
+in the gradient path at all (the "How to Scale Your Model" recipe).
+
+This module packages that: `initialize()` bootstraps from the same
+DMLC_* / MXTPU_* environment `tools/launch.py` already exports (so the
+reference launcher workflow starts multi-host SPMD jobs unchanged),
+`global_mesh()` builds a mesh over ALL hosts' devices, and
+`host_local_batch()` carves out this host's slice of the global batch
+(per-host input pipelines, the standard multi-host data-loading
+pattern).
+
+Verified by real multi-process tests: `tests/test_multihost.py` spawns
+N OS processes that each initialize the distributed runtime over a CPU
+"DCN" and jit one global-psum training step.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as _np
+
+from .mesh import Mesh
+
+__all__ = ["initialize", "is_initialized", "global_mesh",
+           "host_local_batch", "make_global_array", "sync_global_devices"]
+
+_STATE = {"initialized": False}
+
+
+def initialize(coordinator=None, num_processes=None, process_id=None,
+               local_device_count=None):
+    """Join (or create) a multi-host SPMD job.
+
+    Defaults come from the launcher environment: MXTPU_COORDINATOR or
+    DMLC_PS_ROOT_URI:PORT+1 for the coordinator address, DMLC_NUM_WORKER
+    for world size, MXTPU_PROCESS_ID / DMLC_WORKER_ID for the rank.  On
+    real TPU pods jax.distributed discovers these from the TPU metadata
+    instead — then all arguments may be None.
+
+    local_device_count forces per-process CPU device count (testing)."""
+    if _STATE["initialized"]:
+        return
+    if local_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(f for f in flags.split() if not f.startswith(
+            "--xla_force_host_platform_device_count"))
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % local_device_count).strip()
+    if coordinator is None:
+        coordinator = os.environ.get("MXTPU_COORDINATOR")
+    if coordinator is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        # launcher env: scheduler host, one port above the PS port
+        coordinator = "%s:%d" % (os.environ["DMLC_PS_ROOT_URI"],
+                                 int(os.environ.get("DMLC_PS_ROOT_PORT",
+                                                    "9091")) + 1)
+    if num_processes is None:
+        num_processes = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get(
+            "MXTPU_PROCESS_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+    if num_processes > 1 or coordinator is not None:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _STATE["initialized"] = True
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+def global_mesh(axes):
+    """Mesh over ALL processes' devices from {'axis': size} (-1 inferred).
+
+    Device order is jax.devices() — process-major, so a leading 'data'
+    axis puts whole hosts in distinct data shards and cross-host traffic
+    is the gradient all-reduce on DCN, the efficient layout."""
+    devices = jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != len(devices):
+        raise ValueError("mesh %s does not cover %d global devices"
+                         % (dict(zip(names, sizes)), len(devices)))
+    return Mesh(_np.array(devices).reshape(sizes), tuple(names))
+
+
+def host_local_batch(global_batch_size):
+    """(start, stop) row range of the global batch this host must load —
+    per-host input pipelines feed disjoint slices (the multi-host data
+    pattern; replaces the reference's per-worker `part_index`/`num_parts`
+    RecordIO splitting at DCN scale)."""
+    n = jax.process_count()
+    i = jax.process_index()
+    per = global_batch_size // n
+    assert global_batch_size % n == 0, \
+        "global batch %d not divisible by %d hosts" % (global_batch_size, n)
+    return i * per, (i + 1) * per
+
+
+def make_global_array(mesh, spec, host_data, batch_axis=0):
+    """Assemble a globally-sharded array from this host's local rows
+    (jax.make_array_from_process_local_data) — the device_put analog that
+    works when no single host holds the full batch."""
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), host_data)
+
+
+def sync_global_devices(tag="barrier"):
+    """Cross-host barrier (useful around checkpoint writes)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)

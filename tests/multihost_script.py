@@ -1,0 +1,60 @@
+"""Worker for tests/test_multihost.py: one process of a multi-host SPMD
+job over a CPU 'DCN'.  Each process owns 2 local devices; together they
+form a 'data'-mesh, run 5 jitted SGD steps on a shared linear-regression
+problem with per-host input slices, and print the final weights — the
+test asserts all hosts agree and match the single-process answer."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from mxnet_tpu.parallel import multihost
+
+    multihost.initialize(local_device_count=2)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.device_count() == 2 * jax.process_count(), \
+        (jax.device_count(), jax.process_count())
+    mesh = multihost.global_mesh({"data": -1})
+
+    # deterministic shared problem
+    rng = np.random.RandomState(0)
+    batch, dim = 16, 4
+    X = rng.randn(batch, dim).astype(np.float32)
+    w_true = rng.randn(dim, 1).astype(np.float32)
+    y = X @ w_true
+
+    lo, hi = multihost.host_local_batch(batch)
+    x_g = multihost.make_global_array(mesh, P("data"), X[lo:hi])
+    y_g = multihost.make_global_array(mesh, P("data"), y[lo:hi])
+
+    w = jnp.zeros((dim, 1), np.float32)
+    rep = NamedSharding(mesh, P())
+
+    @jax.jit
+    def step(w, x, yy):
+        def loss(w):
+            return jnp.mean((x @ w - yy) ** 2)
+        l, g = jax.value_and_grad(loss)(w)
+        return w - 0.1 * g, l
+
+    w = jax.device_put(w, rep)
+    for _ in range(5):
+        w, l = step(w, x_g, y_g)
+    multihost.sync_global_devices("done")
+    w_host = np.asarray(jax.device_get(w)).ravel()
+    print("MHOK rank=%d loss=%.6f w=%s"
+          % (jax.process_index(), float(l),
+             ",".join("%.6f" % v for v in w_host)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""Fused optimizer-update ops + compat stragglers (ops/optim_ops.py) vs
+numpy oracles transcribing the reference kernels
+(src/operator/optimizer_op-inl.h, loss_binary_op.cc, matrix_op.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RS = np.random.RandomState
+
+
+def _arrs(*shapes, seed=0):
+    rng = RS(seed)
+    return [rng.randn(*s).astype(np.float32) for s in shapes]
+
+
+def _prep(w, g, wd, rescale, clip):
+    g = rescale * g + wd * w
+    if clip >= 0:
+        g = np.clip(g, -clip, clip)
+    return g
+
+
+def test_sgd_update():
+    w, g = _arrs((3, 4), (3, 4))
+    out = mx.nd.sgd_update(mx.nd.array(w), mx.nd.array(g), lr=0.1, wd=0.01,
+                           rescale_grad=0.5, clip_gradient=0.4)
+    exp = w - 0.1 * _prep(w, g, 0.01, 0.5, 0.4)
+    np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-6)
+
+
+def test_sgd_mom_update():
+    w, g, m = _arrs((3, 4), (3, 4), (3, 4), seed=1)
+    ow, om = mx.nd.sgd_mom_update(mx.nd.array(w), mx.nd.array(g),
+                                  mx.nd.array(m), lr=0.1, momentum=0.9,
+                                  wd=0.01, rescale_grad=1.0)
+    gp = _prep(w, g, 0.01, 1.0, -1)
+    em = 0.9 * m - 0.1 * gp
+    np.testing.assert_allclose(om.asnumpy(), em, rtol=1e-6)
+    np.testing.assert_allclose(ow.asnumpy(), w + em, rtol=1e-6)
+
+
+def test_mp_sgd_update_keeps_fp32_master():
+    rng = RS(2)
+    w32 = rng.randn(4, 4).astype(np.float32)
+    g = rng.randn(4, 4).astype(np.float32)
+    w16 = w32.astype(np.float16)
+    ow, ow32 = mx.nd.mp_sgd_update(
+        mx.nd.array(w16, dtype="float16"), mx.nd.array(g),
+        mx.nd.array(w32), lr=0.1, wd=0.0)
+    exp32 = w32 - 0.1 * g
+    np.testing.assert_allclose(ow32.asnumpy(), exp32, rtol=1e-6)
+    assert ow.dtype == np.float16
+    np.testing.assert_allclose(ow.asnumpy(), exp32.astype(np.float16),
+                               rtol=1e-3)
+
+
+def test_mp_sgd_mom_update():
+    rng = RS(11)
+    w32 = rng.randn(3, 3).astype(np.float32)
+    g = rng.randn(3, 3).astype(np.float32)
+    m = rng.randn(3, 3).astype(np.float32)
+    w16 = w32.astype(np.float16)
+    ow, om, ow32 = mx.nd.mp_sgd_mom_update(
+        mx.nd.array(w16, dtype="float16"), mx.nd.array(g), mx.nd.array(m),
+        mx.nd.array(w32), lr=0.1, momentum=0.9, wd=0.01)
+    gp = _prep(w32, g, 0.01, 1.0, -1)
+    em = 0.9 * m - 0.1 * gp
+    np.testing.assert_allclose(om.asnumpy(), em, rtol=1e-5)
+    np.testing.assert_allclose(ow32.asnumpy(), w32 + em, rtol=1e-5)
+    assert ow.dtype == np.float16
+
+
+def test_adam_update():
+    w, g, m, v = _arrs((5,), (5,), (5,), (5,), seed=3)
+    v = np.abs(v)
+    ow, om, ov = mx.nd.adam_update(
+        mx.nd.array(w), mx.nd.array(g), mx.nd.array(m), mx.nd.array(v),
+        lr=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.01)
+    gp = _prep(w, g, 0.01, 1.0, -1)
+    em = 0.9 * m + 0.1 * gp
+    ev = 0.999 * v + 0.001 * gp * gp
+    np.testing.assert_allclose(om.asnumpy(), em, rtol=1e-5)
+    np.testing.assert_allclose(ov.asnumpy(), ev, rtol=1e-5)
+    np.testing.assert_allclose(ow.asnumpy(),
+                               w - 0.01 * em / (np.sqrt(ev) + 1e-8),
+                               rtol=1e-5)
+
+
+def test_rmsprop_updates():
+    w, g, n = _arrs((6,), (6,), (6,), seed=4)
+    n = np.abs(n)
+    ow, on = mx.nd.rmsprop_update(mx.nd.array(w), mx.nd.array(g),
+                                  mx.nd.array(n), lr=0.01, gamma1=0.95,
+                                  epsilon=1e-8)
+    en = 0.05 * g * g + 0.95 * n
+    np.testing.assert_allclose(on.asnumpy(), en, rtol=1e-5)
+    np.testing.assert_allclose(
+        ow.asnumpy(), w - 0.01 * g / np.sqrt(en + 1e-8), rtol=1e-5)
+
+    gacc, d = _arrs((6,), (6,), seed=5)
+    ow2, on2, og2, od2 = mx.nd.rmspropalex_update(
+        mx.nd.array(w), mx.nd.array(g), mx.nd.array(n), mx.nd.array(gacc),
+        mx.nd.array(d), lr=0.01, gamma1=0.95, gamma2=0.9, epsilon=1e-4)
+    en2 = 0.05 * g * g + 0.95 * n
+    eg2 = 0.05 * g + 0.95 * gacc
+    ed2 = 0.9 * d - 0.01 * g / np.sqrt(en2 - eg2 * eg2 + 1e-4)
+    np.testing.assert_allclose(on2.asnumpy(), en2, rtol=1e-5)
+    np.testing.assert_allclose(og2.asnumpy(), eg2, rtol=1e-5)
+    np.testing.assert_allclose(od2.asnumpy(), ed2, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ow2.asnumpy(), w + ed2, rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_cross_entropy():
+    rng = RS(6)
+    data = rng.randn(4, 5).astype(np.float32)
+    label = rng.randint(0, 5, 4).astype(np.float32)
+    out = mx.nd.softmax_cross_entropy(mx.nd.array(data), mx.nd.array(label))
+    e = np.exp(data - data.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    exp = -np.log(p[np.arange(4), label.astype(int)]).sum()
+    assert out.shape == (1,)
+    np.testing.assert_allclose(float(out.asnumpy()[0]), exp, rtol=1e-5)
+
+
+def test_slice_assign_ops():
+    rng = RS(7)
+    x = rng.randn(4, 6).astype(np.float32)
+    r = rng.randn(2, 3).astype(np.float32)
+    out = mx.nd._slice_assign(mx.nd.array(x), mx.nd.array(r),
+                              begin=(1, 2), end=(3, 5))
+    exp = x.copy()
+    exp[1:3, 2:5] = r
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+
+    out = mx.nd._crop_assign_scalar(mx.nd.array(x), begin=(0, 0),
+                                    end=(2, 2), scalar=7.5)
+    exp = x.copy()
+    exp[0:2, 0:2] = 7.5
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+
+
+def test_identity_compat_ops():
+    rng = RS(8)
+    a = rng.randn(3, 3).astype(np.float32)
+    b = rng.randn(3, 3).astype(np.float32)
+    np.testing.assert_array_equal(
+        mx.nd._identity_with_attr_like_rhs(mx.nd.array(a),
+                                           mx.nd.array(b)).asnumpy(), a)
+    np.testing.assert_array_equal(
+        mx.nd._CrossDeviceCopy(mx.nd.array(a)).asnumpy(), a)
+    # aliases exist
+    assert "Convolution_v1" in mx.ops.OP_REGISTRY
+    assert "CuDNNBatchNorm" in mx.ops.OP_REGISTRY
+    assert "_crop_assign" in mx.ops.OP_REGISTRY
+
+
+def test_kl_sparse_reg_grad():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.optim_ops import identity_attach_kl_sparse_reg
+
+    rng = RS(9)
+    x = jnp.asarray(rng.uniform(0.1, 0.9, (8, 3)).astype(np.float32))
+
+    def loss(x):
+        return jnp.sum(identity_attach_kl_sparse_reg(
+            x, sparseness_target=0.2, penalty=0.01) * 2.0)
+
+    g = jax.grad(loss)(x)
+    rho = np.clip(np.asarray(x).mean(0), 1e-6, 1 - 1e-6)
+    kl = 0.01 * (-0.2 / rho + 0.8 / (1 - rho)) / x.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(g), np.broadcast_to(2.0 + kl[None, :], g.shape),
+        rtol=1e-5)
