@@ -60,6 +60,7 @@ from . import test_utils
 from . import operator
 from . import parallel
 from . import executor_manager
+from . import log
 from . import registry
 from . import notebook
 from . import torch

@@ -1,0 +1,58 @@
+"""Logging helper (parity: reference python/mxnet/log.py).
+
+`get_logger` configures a logger with the framework's single-letter
+level labels, colored when the stream is a TTY, and optional file
+output.  Kept API-compatible (`getLogger` alias included) so reference
+scripts' logging setup runs unmodified."""
+from __future__ import annotations
+
+import logging
+import sys
+
+from logging import DEBUG, ERROR, INFO, WARNING  # noqa: F401 (re-export)
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR"]
+
+_COLORS = {logging.WARNING: "\x1b[0;33m", logging.ERROR: "\x1b[0;31m",
+           logging.CRITICAL: "\x1b[0;35m", logging.DEBUG: "\x1b[0;32m"}
+_LABELS = {logging.DEBUG: "D", logging.INFO: "I", logging.WARNING: "W",
+           logging.ERROR: "E", logging.CRITICAL: "C"}
+
+
+class _Formatter(logging.Formatter):
+    """Single-letter level labels, colorized on TTY streams."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno, "U")
+        if self._colored and record.levelno in _COLORS:
+            label = _COLORS[record.levelno] + label + "\x1b[0m"
+        self._style._fmt = label + "%(asctime)s %(process)d %(pathname)s:%(lineno)d] %(message)s"
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Return a logger with the framework formatter attached once.
+
+    filename: also log to this file (filemode default 'a').  Level applies
+    to the logger, reference log.py:62 semantics."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxnet_tpu_configured", False):
+        logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        handler.setFormatter(_Formatter(colored=False))
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_Formatter(colored=sys.stderr.isatty()))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxnet_tpu_configured = True
+    return logger
+
+
+getLogger = get_logger

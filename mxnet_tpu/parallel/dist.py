@@ -148,6 +148,8 @@ class Scheduler:
         self._left = set()  # nodes whose connection closed
         self._finalized = set()  # nodes that deregistered cleanly (ps-lite Finalize)
         self._send_locks = {}  # id(conn) -> Lock serializing frame sends
+        self._current_conn = {}  # node -> id(conn) of its LIVE connection
+        self._worker_threads = []
         self._stopped = False
 
     def _send(self, conn, cmd, meta=b""):
@@ -172,7 +174,10 @@ class Scheduler:
 
     def serve_forever(self):
         """Register num_workers+num_servers nodes, then service barriers,
-        heartbeats, and dead-node queries until all workers disconnect."""
+        heartbeats, dead-node queries — and late RECOVERY registrations
+        (ps-lite is_recovery(): a restarted role rejoins under its old
+        rank, servers retain state; reference kvstore_dist.h:39-44) —
+        until all workers disconnect."""
         conns = []
         while len(conns) < self.num_workers + self.num_servers:
             conn, _ = self.sock.accept()
@@ -185,22 +190,72 @@ class Scheduler:
                 self._ranks[role] += 1
                 if role == "server":
                     self._server_addrs[rank] = (info["host"], info["port"])
-                self._last_seen["%s:%d" % (role, rank)] = time.monotonic()
+                node = "%s:%d" % (role, rank)
+                self._last_seen[node] = time.monotonic()
+                self._current_conn[node] = id(conn)
             conns.append((conn, role, rank))
         # everyone registered: broadcast address book + ranks
         addrs = [self._server_addrs[r] for r in sorted(self._server_addrs)]
         for conn, role, rank in conns:
             self._send(conn, _ADDRS, _meta(rank=rank, servers=addrs))
         # serve every node's connection (workers barrier, all heartbeat)
-        threads = []
-        for conn, role, rank in conns:
+        with self._lock:
+            for conn, role, rank in conns:
+                t = threading.Thread(target=self._serve_conn,
+                                     args=(conn, role, rank), daemon=True)
+                t.start()
+                if role == "worker":
+                    self._worker_threads.append(t)
+        # recovery registrations arrive on the listening socket after start
+        accept_t = threading.Thread(target=self._accept_recovery, daemon=True)
+        accept_t.start()
+        while True:
+            with self._lock:
+                threads = list(self._worker_threads)
+            if not any(t.is_alive() for t in threads):
+                # re-check under the lock: a recovery may have just landed
+                with self._lock:
+                    if not any(t.is_alive() for t in self._worker_threads):
+                        return
+            for t in threads:
+                t.join(timeout=0.5)
+
+    def _accept_recovery(self):
+        """Accept post-startup _REGISTER frames carrying recover=rank: the
+        node resumes its old identity; liveness bookkeeping is reset so
+        peers stop seeing it dead."""
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+                cmd, meta, _ = _recv_frame(conn)
+            except OSError:
+                return
+            if cmd != _REGISTER:
+                conn.close()
+                continue
+            info = _parse_meta(meta)
+            role, rank = info.get("role"), int(info.get("recover", -1))
+            if rank < 0:
+                conn.close()  # late non-recovery register: not a member
+                continue
+            node = "%s:%d" % (role, rank)
+            with self._lock:
+                self._left.discard(node)
+                self._finalized.discard(node)
+                self._last_seen[node] = time.monotonic()
+                self._current_conn[node] = id(conn)
+                if role == "server":
+                    self._server_addrs[rank] = (info["host"], info["port"])
+                addrs = [self._server_addrs[r]
+                         for r in sorted(self._server_addrs)]
+            self._send(conn, _ADDRS,
+                       _meta(rank=rank, servers=addrs, recovery=1))
             t = threading.Thread(target=self._serve_conn,
                                  args=(conn, role, rank), daemon=True)
             t.start()
             if role == "worker":
-                threads.append(t)
-        for t in threads:
-            t.join()
+                with self._lock:
+                    self._worker_threads.append(t)
 
     def _serve_conn(self, conn, role, rank):
         node = "%s:%d" % (role, rank)
@@ -228,6 +283,8 @@ class Scheduler:
                 # _HEARTBEAT: timestamp already refreshed above
         except (ConnectionError, OSError):
             with self._lock:
+                if self._current_conn.get(node) != id(conn):
+                    return  # stale socket of an already-recovered node
                 # a closed connection counts as dead unless the job is done
                 self._left.add(node)
                 waiters = list(self._barrier_waiters)
@@ -411,7 +468,19 @@ class DistKVStore:
         self._sched = _connect_retry((root, port))
         self._sched_send_lock = threading.Lock()
         self._sched_recv_lock = threading.Lock()
-        _send_frame(self._sched, _REGISTER, _meta(role="worker", host="", port=0))
+        # MXTPU_RECOVER_RANK: rejoin a running job under the old rank after
+        # a crash (ps-lite is_recovery; reference kvstore_dist.h:39-44,77-80).
+        # Servers retained state, so re-Init is ignored and the worker
+        # resumes by pulling; the startup barrier and sync-mode flip are
+        # skipped — the cluster is already past them.
+        recover = int(os.environ.get("MXTPU_RECOVER_RANK", "-1"))
+        self.is_recovery = recover >= 0
+        if self.is_recovery:
+            _send_frame(self._sched, _REGISTER,
+                        _meta(role="worker", host="", port=0, recover=recover))
+        else:
+            _send_frame(self._sched, _REGISTER,
+                        _meta(role="worker", host="", port=0))
         cmd, meta, _ = _recv_frame(self._sched)
         assert cmd == _ADDRS
         info = _parse_meta(meta)
@@ -422,7 +491,11 @@ class DistKVStore:
         self._server_locks = [threading.Lock() for _ in self._servers]
         self._push_round = {}
         self._updater = None
-        if "sync" in self.type and self._rank == 0:
+        if self.is_recovery:
+            return
+        # NOTE: substring matching would be wrong here — "sync" is a
+        # substring of "async", so test the async marker
+        if "async" not in self.type and self._rank == 0:
             # rank-0 flips servers to sync mode (reference kvstore.cc:30-34)
             for i in range(len(self._servers)):
                 self._rpc(i, _SETSYNC, _meta(sync=True))
@@ -497,7 +570,12 @@ class DistKVStore:
                     if cmd == _BARRIER_DONE:
                         return
                     if cmd == _DEADNODES_R:
-                        dead = _parse_meta(meta).get("dead", [])
+                        # the barrier is a WORKER-group rendezvous (ps-lite
+                        # Barrier(kWorkerGroup)): only a dead worker can
+                        # leave it stuck — a flapping server heartbeat
+                        # must not abort it
+                        dead = [n for n in _parse_meta(meta).get("dead", [])
+                                if n.startswith("worker:")]
                         if dead:
                             raise MXNetError(
                                 "barrier aborted: dead nodes %s" % (dead,))
@@ -514,7 +592,12 @@ class DistKVStore:
                               _meta(key=skey, shape=list(shard.shape), dtype=str(shard.dtype)),
                               np.ascontiguousarray(shard).tobytes())
             self._push_round[k] = 0
-        self.barrier()
+        # a RECOVERED worker re-declares keys without the rendezvous: the
+        # cluster is mid-job and its barrier counts must stay aligned with
+        # the survivors (ps-lite is_recovery skips the init barrier,
+        # reference kvstore_dist.h:77-80)
+        if not self.is_recovery:
+            self.barrier()
 
     def push(self, key, value, priority=0):
         keys, vals = ([key], [value]) if not isinstance(key, (list, tuple)) else (list(key), list(value))
@@ -539,7 +622,8 @@ class DistKVStore:
             shape = first.shape
             total = int(np.prod(shape))
             flat = np.empty((total,), dtype=np.float32)
-            min_version = self._push_round.get(k, 0) if "sync" in self.type else 0
+            min_version = self._push_round.get(k, 0) \
+                if "async" not in self.type else 0
             pieces = self._shards(k, flat)
             for si, skey, shard in pieces:
                 meta, payload = self._rpc(

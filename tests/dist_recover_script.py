@@ -1,0 +1,77 @@
+"""Worker-crash-and-rejoin script for tests/test_dist_kvstore.py.
+
+Phase comes from argv[1] (ranks are assigned in arrival order, so both
+phase-1 processes run the same code and branch on kv.rank):
+  phase1 — rank 1: init, push, then die WITHOUT finalize (os._exit);
+           rank 0: observe the death (check_dead_nodes), then the
+           recovery, then barrier with the recovered peer and verify
+  phase2 — the restarted rank 1 (MXTPU_RECOVER_RANK=1): re-pull the
+           retained server state, barrier, verify
+The parent test runs the scheduler + server as separate processes.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu.parallel.dist import DistKVStore
+
+
+def main():
+    phase = sys.argv[1]
+    kv = DistKVStore("dist_async")  # async: no per-push sync gating
+
+    if phase == "phase1" and kv.rank == 1:
+        kv.init("w", np.zeros(4, np.float32))
+        kv.barrier()
+        kv.push("w", np.full(4, 3.0, np.float32))
+        # make sure the push landed before dying
+        out = np.zeros(4, np.float32)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out, np.full(4, 3.0, np.float32))
+        print("B_PUSHED", flush=True)
+        os._exit(1)                       # crash: no FINALIZE, no close
+    elif phase == "phase1":
+        kv.init("w", np.zeros(4, np.float32))
+        kv.barrier()                      # everyone up
+        # wait until rank 1 is seen dead, then until it has recovered
+        deadline = time.monotonic() + 90
+        while "worker:1" not in kv.check_dead_nodes():
+            assert time.monotonic() < deadline, "peer never died"
+            time.sleep(0.2)
+        print("A_SAW_DEAD", flush=True)
+        flag = os.environ.get("MXTPU_TEST_FLAG_FILE")
+        if flag:
+            with open(flag, "w") as f:
+                f.write("dead-observed")
+        while "worker:1" in kv.check_dead_nodes():
+            assert time.monotonic() < deadline, "peer never recovered"
+            time.sleep(0.2)
+        print("A_SAW_RECOVERY", flush=True)
+        kv.barrier()                      # with the RECOVERED rank 1
+        out = np.zeros(4, np.float32)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out, np.full(4, 3.0, np.float32))
+        print("A_OK", flush=True)
+        kv.close()
+    elif phase == "phase2":
+        assert kv.is_recovery and kv.rank == 1, (kv.is_recovery, kv.rank)
+        # servers retained state across the crash: re-init is ignored,
+        # pull returns the pre-crash value
+        kv.init("w", np.zeros(4, np.float32))
+        out = np.zeros(4, np.float32)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out, np.full(4, 3.0, np.float32))
+        kv.barrier()
+        print("B2_OK", flush=True)
+        kv.close()
+    else:
+        raise SystemExit("unknown phase %s" % phase)
+
+
+if __name__ == "__main__":
+    main()

@@ -89,3 +89,86 @@ def test_dist_create_without_cluster_env_raises():
         for k, v in saved.items():
             if v is not None:
                 os.environ[k] = v
+
+
+def test_worker_crash_and_recovery():
+    """A worker dies without finalize; a replacement rejoins under the old
+    rank (MXTPU_RECOVER_RANK ≙ ps-lite is_recovery), servers retain state,
+    the healthy worker observes dead -> recovered and both barrier."""
+    import socket
+    import time
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env.setdefault("JAX_PLATFORMS", "cpu")
+    flag = os.path.join(REPO, ".recover_flag_%d" % port)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "1",
+        # fast detection so the test doesn't wait the 60 s default
+        "MXNET_KVSTORE_DEAD_TIMEOUT": "5",
+        "MXTPU_TEST_FLAG_FILE": flag,
+    })
+    if os.path.exists(flag):
+        os.remove(flag)
+    script = os.path.join(REPO, "tests", "dist_recover_script.py")
+    procs = []
+
+    def spawn(role_env, args, extra=None):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = role_env
+        env.update(extra or {})
+        p = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        return p
+
+    try:
+        sched = spawn("scheduler", [
+            sys.executable, "-c",
+            "from mxnet_tpu.parallel.dist import run_scheduler as r; r()"])
+        spawn("server", [
+            sys.executable, "-c",
+            "from mxnet_tpu.parallel.dist import run_server as r; r()"])
+        w1 = spawn("worker", [sys.executable, script, "phase1"])
+        w2 = spawn("worker", [sys.executable, script, "phase1"])
+        # whichever got rank 1 crashes with rc 1; the other survives
+        deadline = time.monotonic() + 120
+        crasher = survivor = None
+        while crasher is None:
+            assert time.monotonic() < deadline, "no worker crashed"
+            for p, q in ((w1, w2), (w2, w1)):
+                if p.poll() == 1:
+                    crasher, survivor = p, q
+            time.sleep(0.2)
+        # restart rank 1 only after the survivor OBSERVED the death (else
+        # recovery clears the dead flag before it is ever seen)
+        while not os.path.exists(flag):
+            assert time.monotonic() < deadline, \
+                "survivor never observed the death: %s" \
+                % (survivor.communicate()[0] if survivor.poll() is not None
+                   else "(still running)")
+            time.sleep(0.2)
+        os.remove(flag)
+        b2 = spawn("worker", [sys.executable, script, "phase2"],
+                   {"MXTPU_RECOVER_RANK": "1"})
+        out_s, _ = survivor.communicate(timeout=150)
+        out_b2, _ = b2.communicate(timeout=150)
+        assert survivor.returncode == 0, out_s
+        assert b2.returncode == 0, out_b2
+        assert "A_SAW_DEAD" in out_s and "A_SAW_RECOVERY" in out_s \
+            and "A_OK" in out_s, out_s
+        assert "B2_OK" in out_b2, out_b2
+        assert "B_PUSHED" in crasher.communicate()[0]
+        assert sched.wait(timeout=60) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if os.path.exists(flag):
+            os.remove(flag)

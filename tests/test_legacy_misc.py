@@ -148,3 +148,22 @@ def test_notebook_pandas_logger():
     curve._append("train", BatchEndParam(epoch=0, nbatch=2, eval_metric=metric,
                                          locals=None))
     assert curve.data["train"][0] == [2]
+
+
+def test_log_module(tmp_path):
+    """mx.log.get_logger (reference python/mxnet/log.py): single-letter
+    level labels, file output, idempotent configuration."""
+    import logging
+
+    path = str(tmp_path / "run.log")
+    lg = mx.log.get_logger("mxtpu_log_test", filename=path,
+                           level=mx.log.DEBUG)
+    lg.debug("file-line")
+    lg2 = mx.log.get_logger("mxtpu_log_test")
+    assert lg2 is lg and len(lg.handlers) == 1  # no duplicate handlers
+    for h in lg.handlers:
+        h.flush()
+    with open(path) as f:
+        content = f.read()
+    assert "file-line" in content and content.startswith("D")
+    assert mx.log.getLogger is mx.log.get_logger
