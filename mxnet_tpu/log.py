@@ -51,6 +51,10 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
         handler.setFormatter(_Formatter(colored=sys.stderr.isatty()))
     logger.addHandler(handler)
     logger.setLevel(level)
+    if name:
+        # named loggers own their output: without this, a root handler
+        # (logging.basicConfig) would emit every record a second time
+        logger.propagate = False
     logger._mxnet_tpu_configured = True
     return logger
 

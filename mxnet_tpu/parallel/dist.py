@@ -192,7 +192,7 @@ class Scheduler:
                     self._server_addrs[rank] = (info["host"], info["port"])
                 node = "%s:%d" % (role, rank)
                 self._last_seen[node] = time.monotonic()
-                self._current_conn[node] = id(conn)
+                self._current_conn[node] = conn
             conns.append((conn, role, rank))
         # everyone registered: broadcast address book + ranks
         addrs = [self._server_addrs[r] for r in sorted(self._server_addrs)]
@@ -222,20 +222,26 @@ class Scheduler:
 
     def _accept_recovery(self):
         """Accept post-startup _REGISTER frames carrying recover=rank: the
-        node resumes its old identity; liveness bookkeeping is reset so
-        peers stop seeing it dead."""
+        WORKER resumes its old identity; liveness bookkeeping is reset so
+        peers stop seeing it dead.  (Server recovery is not a capability:
+        a restarted Server has an empty store and workers hold connections
+        to the old address — sync-mode jobs resume from checkpoint.)"""
         while True:
             try:
                 conn, _ = self.sock.accept()
-                cmd, meta, _ = _recv_frame(conn)
             except OSError:
-                return
+                return  # listening socket closed: scheduler shutting down
+            try:
+                cmd, meta, _ = _recv_frame(conn)
+            except (ConnectionError, OSError):
+                conn.close()  # stray probe died mid-register: keep serving
+                continue
             if cmd != _REGISTER:
                 conn.close()
                 continue
             info = _parse_meta(meta)
             role, rank = info.get("role"), int(info.get("recover", -1))
-            if rank < 0:
+            if rank < 0 or role != "worker":
                 conn.close()  # late non-recovery register: not a member
                 continue
             node = "%s:%d" % (role, rank)
@@ -243,19 +249,25 @@ class Scheduler:
                 self._left.discard(node)
                 self._finalized.discard(node)
                 self._last_seen[node] = time.monotonic()
-                self._current_conn[node] = id(conn)
-                if role == "server":
-                    self._server_addrs[rank] = (info["host"], info["port"])
+                old = self._current_conn.get(node)
+                self._current_conn[node] = conn
                 addrs = [self._server_addrs[r]
                          for r in sorted(self._server_addrs)]
+            if old is not None:
+                # close the superseded socket: unblocks the stale
+                # _serve_conn thread (else a half-open connection from a
+                # power-failed host pins it, and serve_forever never exits)
+                try:
+                    old.close()
+                except OSError:
+                    pass
             self._send(conn, _ADDRS,
                        _meta(rank=rank, servers=addrs, recovery=1))
             t = threading.Thread(target=self._serve_conn,
                                  args=(conn, role, rank), daemon=True)
             t.start()
-            if role == "worker":
-                with self._lock:
-                    self._worker_threads.append(t)
+            with self._lock:
+                self._worker_threads.append(t)
 
     def _serve_conn(self, conn, role, rank):
         node = "%s:%d" % (role, rank)
@@ -283,10 +295,16 @@ class Scheduler:
                 # _HEARTBEAT: timestamp already refreshed above
         except (ConnectionError, OSError):
             with self._lock:
-                if self._current_conn.get(node) != id(conn):
+                if self._current_conn.get(node) is not conn:
                     return  # stale socket of an already-recovered node
                 # a closed connection counts as dead unless the job is done
                 self._left.add(node)
+                # a worker that died INSIDE a barrier must not keep
+                # occupying a waiter slot: the next rendezvous would
+                # "complete" against its dead socket and skip the live
+                # replacement
+                self._barrier_waiters = [c for c in self._barrier_waiters
+                                         if c is not conn]
                 waiters = list(self._barrier_waiters)
                 dead = self._dead_nodes()
             # wake any barrier waiters so they can observe the dead node
@@ -475,6 +493,15 @@ class DistKVStore:
         # skipped — the cluster is already past them.
         recover = int(os.environ.get("MXTPU_RECOVER_RANK", "-1"))
         self.is_recovery = recover >= 0
+        if self.is_recovery and "async" not in self.type:
+            # sync aggregation cannot absorb a mid-round rejoin: the dead
+            # worker's partial merge contribution is still counted on the
+            # servers, so the round would apply with a double rank-r /
+            # missing-peer gradient.  Sync jobs resume from checkpoint
+            # (reference practice: example/image-classification --load-epoch)
+            raise MXNetError(
+                "MXTPU_RECOVER_RANK is only supported for dist_async; "
+                "restart %s jobs from a checkpoint instead" % self.type)
         if self.is_recovery:
             _send_frame(self._sched, _REGISTER,
                         _meta(role="worker", host="", port=0, recover=recover))
