@@ -38,8 +38,8 @@ from .context import Context, cpu, current_context
 from .ops.registry import OP_REGISTRY, get_op
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
-           "concatenate", "load", "loads", "save", "waitall", "imresize",
-           "onehot_encode", "from_dlpack"]
+           "concatenate", "moveaxis", "load", "loads", "save", "waitall",
+           "imresize", "onehot_encode", "from_dlpack"]
 
 _DTYPE_ALIASES = {None: jnp.float32}
 
@@ -451,6 +451,13 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
                                dtype=dtype or "float32")
     ctx = ctx or current_context()
     return NDArray(arr, ctx)
+
+
+def moveaxis(tensor, source, destination):
+    """Move `tensor`'s axis `source` to position `destination`
+    (reference ndarray.py:1166)."""
+    return NDArray(jnp.moveaxis(tensor.data, int(source), int(destination)),
+                   tensor.ctx)
 
 
 def concatenate(arrays, axis=0, always_copy=True):

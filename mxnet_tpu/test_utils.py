@@ -339,3 +339,28 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
                     if raise_on_err:
                         raise
     return gt
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Fetch `url` to a local file and return its path (reference
+    test_utils.py:922).  A file already present (e.g. pre-staged data on
+    an air-gapped host) is reused unless overwrite=True; only then is the
+    network touched."""
+    import os
+
+    if fname is None:
+        fname = url.split("/")[-1]
+    if dirname is not None:
+        os.makedirs(dirname, exist_ok=True)
+        fname = os.path.join(dirname, fname)
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    import urllib.request
+
+    try:
+        urllib.request.urlretrieve(url, fname)
+    except Exception as e:
+        raise IOError(
+            "download of %s failed (%s). On hosts without egress, stage "
+            "the file at %r and it will be used as-is." % (url, e, fname))
+    return fname
