@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Model-parallel LSTM language model (reference
+example/model-parallel-lstm/lstm_ptb.py: LSTM layers split across devices
+with `ctx_group` annotations).
+
+TPU redesign: the same `mx.AttrScope(ctx_group=...)` annotations place
+layer groups, but `group2ctx` resolves to shardings over a 'model' mesh
+axis — XLA inserts the boundary transfers that the reference engine did
+with _CrossDeviceCopy (executor._resolve_group2ctx).  Runs on real chips
+or a virtual mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/model-parallel-lstm/lstm_ptb.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def build(seq_len, vocab, embed, hidden):
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    # layer group 1 (first device group): embedding + first LSTM layer
+    with mx.AttrScope(ctx_group="layer0"):
+        emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                               name="embed")
+        l0 = mx.rnn.LSTMCell(hidden, prefix="lstm0_")
+        out0, _ = l0.unroll(seq_len, inputs=emb, layout="NTC",
+                            merge_outputs=True)
+    # layer group 2 (second device group): second LSTM layer + head
+    with mx.AttrScope(ctx_group="layer1"):
+        l1 = mx.rnn.LSTMCell(hidden, prefix="lstm1_")
+        out1, _ = l1.unroll(seq_len, inputs=out0, layout="NTC",
+                            merge_outputs=True)
+        pred = mx.sym.Reshape(out1, shape=(-1, hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        net = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+    return net
+
+
+def main():
+    import mxnet_tpu as mx
+
+    seq_len, vocab, embed, hidden, batch = 16, 200, 32, 64, 8
+    net = build(seq_len, vocab, embed, hidden)
+    # two "devices": first two contexts stand in for the reference's GPUs
+    group2ctx = {"layer0": mx.cpu(0) if mx.num_tpus() < 2 else mx.tpu(0),
+                 "layer1": mx.cpu(1) if mx.num_tpus() < 2 else mx.tpu(1)}
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    X = rng.randint(1, vocab, (64, seq_len)).astype(np.float32)
+    Y = np.roll(X, -1, axis=1)
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=list(group2ctx.values()),
+                        group2ctx=group2ctx)
+    mod.fit(it, num_epoch=3, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            batch_end_callback=mx.callback.Speedometer(batch, 4))
+    it.reset()
+    metric = mx.metric.Perplexity(ignore_label=None)
+    score = mod.score(it, metric)
+    print("final:", score)
+
+
+if __name__ == "__main__":
+    main()

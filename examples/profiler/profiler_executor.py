@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Profiler demo (reference example/profiler/profiler_executor.py): trace
+a training executor and dump chrome-tracing JSON with per-op rows.
+
+Two modes mirror the reference's MXNET_PROFILER_MODE:
+  * default  — python-level spans (bind/forward/backward, fused step)
+  * xla      — jax.profiler device trace folded back into the dump as
+               per-op rows (the reference's per-operator table)
+
+Open the output in chrome://tracing or https://ui.perfetto.dev.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["default", "xla"], default="xla")
+    p.add_argument("--file", default="profile_executor.json")
+    p.add_argument("--steps", type=int, default=8)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+
+    mx.profiler.profiler_set_config(mode=args.mode, filename=args.file)
+
+    data = mx.sym.Variable("data")
+    net = data
+    for i in range(3):
+        net = mx.sym.Activation(
+            mx.sym.FullyConnected(net, num_hidden=256, name="fc%d" % i),
+            act_type="relu", name="act%d" % i)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=10, name="head"),
+        name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.current_context())
+    mod.bind(data_shapes=[("data", (64, 128))],
+             label_shapes=[("softmax_label", (64,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(64, 128).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 10, 64).astype(np.float32))])
+
+    mod.forward_backward(batch)  # compile outside the trace
+    mod.update()
+
+    mx.profiler.profiler_set_state("run")
+    for _ in range(args.steps):
+        mod.forward_backward(batch)
+        mod.update()
+    mx.nd.waitall()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    print("wrote %s (%d bytes); open in chrome://tracing"
+          % (args.file, os.path.getsize(args.file)))
+
+
+if __name__ == "__main__":
+    main()
