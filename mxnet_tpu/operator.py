@@ -11,6 +11,8 @@ work on the imperative path, where values are concrete.)
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -84,41 +86,82 @@ def register(reg_name):
         _CUSTOM_REGISTRY[reg_name] = prop_cls
 
         def op_fn(*inputs, **attrs):
-            kwargs = {k: v for k, v in attrs.items() if k not in ("is_train", "rng")}
+            # graph-plumbing attrs are not op parameters (the reference
+            # strips name/ctx the same way, operator.py:629)
+            kwargs = {k: v for k, v in attrs.items()
+                      if k not in ("is_train", "rng", "name", "ctx")}
             is_train = attrs.get("is_train", False)
             prop = prop_cls(**{k: str(v) for k, v in kwargs.items()})
-            n_out = len(prop.list_outputs())
             in_shapes = [tuple(x.shape) for x in inputs]
             _, out_shapes, _ = prop.infer_shape(list(in_shapes))
             cop = prop.create_operator(None, in_shapes, ["float32"] * len(inputs))
+            dtype = inputs[0].dtype if inputs else jnp.float32
+            out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), dtype)
+                              for s in out_shapes)
+            in_specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                             for x in inputs)
+
+            # CustomOp bodies are HOST code (the reference runs them on
+            # the engine's CPU workers outside any compiled region —
+            # example/numpy-ops is literally numpy).  They therefore run
+            # through jax.pure_callback: concrete arrays in, concrete
+            # arrays out, so .asnumpy() inside forward/backward works
+            # even when the surrounding graph is one jitted executable.
+            def _host_ctx():
+                # keep host-side array math off the accelerator the
+                # callback is suspending
+                return jax.default_device(jax.local_devices(backend="cpu")[0])
+
+            def _host_fwd(*arrs):
+                with _host_ctx():
+                    in_data = [NDArray(jnp.asarray(a)) for a in arrs]
+                    out_data = [NDArray(jnp.zeros(tuple(s), dtype))
+                                for s in out_shapes]
+                    cop.forward(is_train, ["write"] * len(out_data),
+                                in_data, out_data, [])
+                    import numpy as _onp
+                    return tuple(_onp.asarray(o.data, dtype=dtype)
+                                 for o in out_data)
+
+            def _host_bwd(n_out, *arrs):
+                # arrs = out_grads (n_out) + inputs (n_in) + outputs (n_out)
+                n_in = len(arrs) - 2 * n_out
+                gs = arrs[:n_out]
+                xs = arrs[n_out:n_out + n_in]
+                outs = arrs[n_out + n_in:]
+                with _host_ctx():
+                    in_data = [NDArray(jnp.asarray(a)) for a in xs]
+                    out_data = [NDArray(jnp.asarray(a)) for a in outs]
+                    out_grad = [NDArray(jnp.asarray(a)) for a in gs]
+                    in_grad = [NDArray(jnp.zeros_like(jnp.asarray(a)))
+                               for a in xs]
+                    cop.backward(["write"] * len(in_grad), out_grad,
+                                 in_data, out_data, in_grad, [])
+                    import numpy as _onp
+                    return tuple(_onp.asarray(g.data) for g in in_grad)
 
             @jax.custom_vjp
             def f(*xs):
-                return _run_fwd(cop, xs, out_shapes, is_train)
+                outs = jax.pure_callback(_host_fwd, out_specs, *xs,
+                                         vmap_method="sequential")
+                return tuple(outs) if len(outs) > 1 else outs[0]
 
             def f_fwd(*xs):
-                outs = _run_fwd(cop, xs, out_shapes, is_train)
+                outs = f(*xs)
                 return outs, (xs, outs)
 
             def f_bwd(res, gs):
                 xs, outs = res
-                in_data = [NDArray(x) for x in xs]
-                out_data = [NDArray(o) for o in (outs if isinstance(outs, tuple) else (outs,))]
-                out_grad = [NDArray(g) for g in (gs if isinstance(gs, tuple) else (gs,))]
-                in_grad = [NDArray(jnp.zeros_like(x)) for x in xs]
-                cop.backward(["write"] * len(in_grad), out_grad, in_data, out_data, in_grad, [])
-                return tuple(g.data for g in in_grad)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                gs = gs if isinstance(gs, tuple) else (gs,)
+                grads = jax.pure_callback(
+                    functools.partial(_host_bwd, len(outs)), in_specs,
+                    *(tuple(gs) + tuple(xs) + tuple(outs)),
+                    vmap_method="sequential")
+                return tuple(grads)
 
             f.defvjp(f_fwd, f_bwd)
             return f(*inputs)
-
-        def _run_fwd(cop, xs, out_shapes, is_train):
-            in_data = [NDArray(x) for x in xs]
-            out_data = [NDArray(jnp.zeros(s, dtype=xs[0].dtype if xs else jnp.float32))
-                        for s in out_shapes]
-            cop.forward(is_train, ["write"] * len(out_data), in_data, out_data, [])
-            outs = tuple(o.data for o in out_data)
-            return outs if len(outs) > 1 else outs[0]
 
         dummy = prop_cls()
         OP_REGISTRY["Custom:" + reg_name] = Op(

@@ -160,3 +160,57 @@ def test_ndarray_op_legacy_bridge():
     np.testing.assert_allclose(ex.outputs[0].asnumpy(), x ** 2)
     ex.backward(mx.nd.array(np.full(x.shape, 3.0, np.float32)))
     np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 6.0 * x)
+
+
+def test_numpy_custom_op_inside_jitted_module():
+    """A CustomOp implemented with .asnumpy()/numpy (the reference
+    example/numpy-ops pattern) must train inside the fused jitted step:
+    forward/backward run as host callbacks around the XLA program."""
+    import numpy as np
+
+    class NpScale(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()          # host numpy on purpose
+            self.assign(out_data[0], req[0], mx.nd.array(np.tanh(x)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0].asnumpy()
+            g = out_grad[0].asnumpy()
+            self.assign(in_grad[0], req[0], mx.nd.array(g * (1.0 - y * y)))
+
+    @mx.operator.register("np_tanh_t")
+    class NpScaleProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return NpScale()
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 6).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Custom(mx.sym.FullyConnected(data, num_hidden=8, name="f1"),
+                      op_type="np_tanh_t")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=2, name="f2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+    mod.fit(it, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16), "acc")
+    assert score[0][1] > 0.9, score
+
+    # numerics: custom tanh == jnp tanh path, fwd and grad
+    v = mx.sym.Variable("v")
+    cust = mx.sym.Custom(v, op_type="np_tanh_t")
+    exe = cust.simple_bind(mx.cpu(), v=(3, 4), grad_req="write")
+    xv = rng.randn(3, 4).astype(np.float32)
+    exe.arg_dict["v"][:] = xv
+    exe.forward(is_train=True)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), np.tanh(xv),
+                               rtol=1e-6)
+    exe.backward([mx.nd.ones((3, 4))])
+    np.testing.assert_allclose(exe.grad_dict["v"].asnumpy(),
+                               1 - np.tanh(xv) ** 2, rtol=1e-5)
