@@ -59,7 +59,10 @@ def main():
     net = mx.sym.Custom(fc, label, op_type="custom_softmax_demo",
                         name="softmax")
 
-    mod = mx.mod.Module(net, context=mx.current_context())
+    # numpy op bodies are HOST code; they need a backend with host-callback
+    # support (standard CPU/TPU runtimes). Tunneled dev TPUs lack it, so
+    # this demo pins CPU — on a real TPU host, mx.tpu() works too.
+    mod = mx.mod.Module(net, context=mx.cpu())
     it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
     mod.fit(it, num_epoch=8, optimizer="sgd",
             optimizer_params={"learning_rate": 0.5})

@@ -2,12 +2,20 @@
 src/operator/custom/custom-inl.h).
 
 TPU-native design: the reference calls python back on a dedicated worker
-thread per op execution (custom-inl.h:48-70).  Here a CustomOp's python
-`forward`/`backward` run ONCE at trace time — their NDArray math is traced
-into the same XLA executable as the rest of the graph, so custom ops cost
-nothing at step time as long as they are expressed in `mx.nd` ops.
-`backward` is wired in via `jax.custom_vjp`.  (NumPy-computing custom ops
-work on the imperative path, where values are concrete.)
+thread per op execution (custom-inl.h:48-70).  Here a CustomOp takes one
+of two paths, decided automatically per registration:
+
+  * `mx.nd`/jnp-expressed bodies TRACE: forward/backward run once at
+    trace time and their math compiles into the same XLA executable as
+    the rest of the graph — zero step-time cost.
+  * numpy-expressed bodies (`.asnumpy()` inside forward — the reference
+    example/numpy-ops pattern) cannot trace; on the first
+    TracerArrayConversionError the op permanently switches to
+    `jax.pure_callback`, running on host around the compiled program —
+    which is where the reference ran them too.  Requires a backend with
+    host-callback support (standard CPU/TPU runtimes have it).
+
+`backward` is wired in via `jax.custom_vjp` on both paths.
 """
 from __future__ import annotations
 
@@ -77,6 +85,10 @@ class CustomOpProp:
 
 _CUSTOM_REGISTRY = {}
 
+# registrations whose bodies proved untraceable (numpy inside): these run
+# through pure_callback permanently — see module docstring
+_HOST_OPS = set()
+
 
 def register(reg_name):
     """Register a CustomOpProp class under `reg_name`
@@ -101,12 +113,32 @@ def register(reg_name):
             in_specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
                              for x in inputs)
 
-            # CustomOp bodies are HOST code (the reference runs them on
-            # the engine's CPU workers outside any compiled region —
-            # example/numpy-ops is literally numpy).  They therefore run
-            # through jax.pure_callback: concrete arrays in, concrete
-            # arrays out, so .asnumpy() inside forward/backward works
-            # even when the surrounding graph is one jitted executable.
+            # Trace-compatible bodies compile into the graph (zero
+            # step-time cost); numpy bodies fall back to pure_callback —
+            # see the module docstring.  `_HOST_OPS` is the sticky
+            # per-registration switch: once a body proves untraceable it
+            # stays on the host path.
+            def _direct_fwd(*xs):
+                in_data = [NDArray(x) for x in xs]
+                out_data = [NDArray(jnp.zeros(tuple(s), dtype))
+                            for s in out_shapes]
+                cop.forward(is_train, ["write"] * len(out_data),
+                            in_data, out_data, [])
+                outs = tuple(o.data for o in out_data)
+                return outs if len(outs) > 1 else outs[0]
+
+            def _direct_bwd(res, gs):
+                xs, outs = res
+                in_data = [NDArray(x) for x in xs]
+                out_data = [NDArray(o) for o in
+                            (outs if isinstance(outs, tuple) else (outs,))]
+                out_grad = [NDArray(g) for g in
+                            (gs if isinstance(gs, tuple) else (gs,))]
+                in_grad = [NDArray(jnp.zeros_like(x)) for x in xs]
+                cop.backward(["write"] * len(in_grad), out_grad, in_data,
+                             out_data, in_grad, [])
+                return tuple(g.data for g in in_grad)
+
             def _host_ctx():
                 # keep host-side array math off the accelerator the
                 # callback is suspending
@@ -140,8 +172,16 @@ def register(reg_name):
                     import numpy as _onp
                     return tuple(_onp.asarray(g.data) for g in in_grad)
 
+            _untraceable = (jax.errors.TracerArrayConversionError,
+                            jax.errors.ConcretizationTypeError)
+
             @jax.custom_vjp
             def f(*xs):
+                if reg_name not in _HOST_OPS:
+                    try:
+                        return _direct_fwd(*xs)
+                    except _untraceable:
+                        _HOST_OPS.add(reg_name)
                 outs = jax.pure_callback(_host_fwd, out_specs, *xs,
                                          vmap_method="sequential")
                 return tuple(outs) if len(outs) > 1 else outs[0]
@@ -151,6 +191,11 @@ def register(reg_name):
                 return outs, (xs, outs)
 
             def f_bwd(res, gs):
+                if reg_name not in _HOST_OPS:
+                    try:
+                        return _direct_bwd(res, gs)
+                    except _untraceable:
+                        _HOST_OPS.add(reg_name)
                 xs, outs = res
                 outs = outs if isinstance(outs, tuple) else (outs,)
                 gs = gs if isinstance(gs, tuple) else (gs,)
