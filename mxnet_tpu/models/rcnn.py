@@ -1,0 +1,356 @@
+"""Faster R-CNN (VGG16 backbone).
+
+Parity: reference example/rcnn/rcnn/symbol/symbol_vgg.py
+(get_vgg_train:330-410 / get_vgg_test) + the python target-assignment ops
+the reference runs as CustomOps (example/rcnn/rcnn/symbol/proposal_target.py)
+and as data-prep (rcnn/io/rpn.py assign_anchor).
+
+Design notes for TPU:
+  * the backbone/RPN/ROI-head math traces into the jitted graph;
+  * `proposal_target` stays a python CustomOp exactly like the reference —
+    it is data-dependent box sampling, host work by nature.  Sampling is
+    deterministic (score-ordered, not RNG-permuted) so steps are
+    reproducible; shapes are static (batch_rois fixed, padded with
+    weight-0 rois) so recompilation never triggers.
+  * `assign_anchor` is a host data-prep helper the iterator calls
+    (reference puts it in the data pipeline, not the graph).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import operator
+from .. import symbol as S
+from ..contrib import symbol as CS
+from ..ndarray import array as _nd_array
+
+__all__ = ["get_faster_rcnn_train", "get_faster_rcnn_test",
+           "assign_anchor", "generate_anchors"]
+
+
+# ----------------------------------------------------------------------
+# anchors (reference rcnn/processing/generate_anchor.py)
+# ----------------------------------------------------------------------
+
+def generate_anchors(base_size=16, ratios=(0.5, 1, 2), scales=(8, 16, 32)):
+    """(A, 4) anchor windows around one base cell, [x1, y1, x2, y2]."""
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w, h = base[2] - base[0] + 1, base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    out = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(out, np.float32)
+
+
+def _bbox_overlaps(boxes, gt):
+    """IoU matrix (N, K)."""
+    n, k = boxes.shape[0], gt.shape[0]
+    if n == 0 or k == 0:
+        return np.zeros((n, k), np.float32)
+    ax1, ay1, ax2, ay2 = [boxes[:, i][:, None] for i in range(4)]
+    bx1, by1, bx2, by2 = [gt[:, i][None, :] for i in range(4)]
+    iw = np.maximum(0, np.minimum(ax2, bx2) - np.maximum(ax1, bx1) + 1)
+    ih = np.maximum(0, np.minimum(ay2, by2) - np.maximum(ay1, by1) + 1)
+    inter = iw * ih
+    area_a = (ax2 - ax1 + 1) * (ay2 - ay1 + 1)
+    area_b = (bx2 - bx1 + 1) * (by2 - by1 + 1)
+    return (inter / (area_a + area_b - inter)).astype(np.float32)
+
+
+def _bbox_transform(ex, gt):
+    """Regression targets from ex-boxes to gt-boxes (reference
+    rcnn/processing/bbox_regression.py)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * ew
+    ecy = ex[:, 1] + 0.5 * eh
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return np.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                     np.log(gw / ew), np.log(gh / eh)], axis=1).astype(np.float32)
+
+
+def assign_anchor(feat_shape, gt_boxes, im_info, feat_stride=16,
+                  scales=(8, 16, 32), ratios=(0.5, 1, 2),
+                  allowed_border=0, fg_overlap=0.7, bg_overlap=0.3,
+                  rpn_batch=256, fg_fraction=0.5):
+    """RPN training targets for one image (reference rcnn/io/rpn.py
+    assign_anchor): label in {-1 ignore, 0 bg, 1 fg}, bbox targets and
+    weights, laid out [A*4, H, W]-compatible flat order.
+
+    Returns dict(label [A*H*W], bbox_target [A*4, H, W],
+    bbox_weight [A*4, H, W])."""
+    h, w = feat_shape
+    base = generate_anchors(feat_stride, ratios, scales)
+    a = base.shape[0]
+    sx = (np.arange(w) * feat_stride)[None, :, None]
+    sy = (np.arange(h) * feat_stride)[:, None, None]
+    shifts = np.stack(np.broadcast_arrays(sx, sy, sx, sy), axis=-1)  # H,W,1,4
+    anchors = (base[None, None] + shifts).reshape(-1, 4)  # H*W*A
+    total = anchors.shape[0]
+    im_h, im_w = float(im_info[0]), float(im_info[1])
+    inside = np.where((anchors[:, 0] >= -allowed_border) &
+                      (anchors[:, 1] >= -allowed_border) &
+                      (anchors[:, 2] < im_w + allowed_border) &
+                      (anchors[:, 3] < im_h + allowed_border))[0]
+    label = np.full((total,), -1, np.float32)
+    bbox_target = np.zeros((total, 4), np.float32)
+    bbox_weight = np.zeros((total, 4), np.float32)
+    gt = np.asarray(gt_boxes, np.float32).reshape(-1, 5)
+    gt = gt[gt[:, 4] >= 0][:, :4] if gt.size else gt[:, :4]
+    if inside.size and gt.shape[0]:
+        ov = _bbox_overlaps(anchors[inside], gt)
+        argmax = ov.argmax(axis=1)
+        maxov = ov[np.arange(inside.size), argmax]
+        label[inside[maxov < bg_overlap]] = 0
+        # anchors with max IoU per gt are fg, plus anything above fg_overlap
+        gt_argmax = ov.argmax(axis=0)
+        label[inside[gt_argmax]] = 1
+        label[inside[maxov >= fg_overlap]] = 1
+        # cap fg/bg counts (deterministic: keep highest-overlap)
+        fg = np.where(label == 1)[0]
+        max_fg = int(rpn_batch * fg_fraction)
+        if fg.size > max_fg:
+            label[fg[max_fg:]] = -1
+            fg = fg[:max_fg]
+        bg = np.where(label == 0)[0]
+        max_bg = rpn_batch - min(fg.size, max_fg)
+        if bg.size > max_bg:
+            label[bg[max_bg:]] = -1
+        pos = np.where(label == 1)[0]
+        pos_inside = np.searchsorted(inside, pos)
+        bbox_target[pos] = _bbox_transform(anchors[pos], gt[ov[pos_inside].argmax(1)])
+        bbox_weight[pos] = 1.0
+    elif inside.size:
+        label[inside] = 0
+    # [H*W*A, x] -> [A*4, H, W] layout the RPN conv heads emit
+    bt = bbox_target.reshape(h, w, a * 4).transpose(2, 0, 1)
+    bw = bbox_weight.reshape(h, w, a * 4).transpose(2, 0, 1)
+    lab = label.reshape(h, w, a).transpose(2, 0, 1).reshape(-1)
+    return {"label": lab, "bbox_target": bt, "bbox_weight": bw}
+
+
+# ----------------------------------------------------------------------
+# proposal_target CustomOp (reference symbol/proposal_target.py)
+# ----------------------------------------------------------------------
+
+class _ProposalTargetOp(operator.CustomOp):
+    def __init__(self, num_classes, batch_rois, fg_fraction, fg_overlap=0.5):
+        self._nc = num_classes
+        self._br = batch_rois
+        self._fg = int(batch_rois * fg_fraction)
+        self._fg_ov = fg_overlap
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy().reshape(-1, 5)
+        gt = in_data[1].asnumpy().reshape(-1, 5)
+        gt = gt[gt[:, 4] >= 0]
+        all_rois = np.vstack([rois, np.hstack([np.zeros((gt.shape[0], 1),
+                                                        np.float32),
+                                               gt[:, :4]])])
+        ov = _bbox_overlaps(all_rois[:, 1:], gt[:, :4]) if gt.size else \
+            np.zeros((all_rois.shape[0], 0), np.float32)
+        if ov.shape[1]:
+            gt_assign = ov.argmax(1)
+            maxov = ov.max(1)
+        else:
+            gt_assign = np.zeros((all_rois.shape[0],), np.int64)
+            maxov = np.zeros((all_rois.shape[0],), np.float32)
+        order = np.argsort(-maxov)  # deterministic score-ordered sampling
+        fg = order[maxov[order] >= self._fg_ov][:self._fg]
+        bg = order[maxov[order] < self._fg_ov][:self._br - fg.size]
+        keep = np.concatenate([fg, bg])
+        # static output shape: pad with weight-0 background rois
+        pad = self._br - keep.size
+        if pad > 0:
+            keep = np.concatenate([keep, np.zeros((pad,), np.int64)])
+        rois_out = all_rois[keep].astype(np.float32)
+        label = np.zeros((self._br,), np.float32)
+        if ov.shape[1]:
+            label[:fg.size] = gt[gt_assign[fg], 4] + 1  # class ids 1..nc-1
+        target = np.zeros((self._br, 4 * self._nc), np.float32)
+        weight = np.zeros((self._br, 4 * self._nc), np.float32)
+        if ov.shape[1] and fg.size:
+            t = _bbox_transform(rois_out[:fg.size, 1:],
+                                gt[gt_assign[fg], :4])
+            for i in range(fg.size):
+                c = int(label[i])
+                target[i, 4 * c:4 * c + 4] = t[i]
+                weight[i, 4 * c:4 * c + 4] = 1.0
+        self.assign(out_data[0], req[0], _nd_array(rois_out))
+        self.assign(out_data[1], req[1], _nd_array(label))
+        self.assign(out_data[2], req[2], _nd_array(target))
+        self.assign(out_data[3], req[3], _nd_array(weight))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g, r in zip(in_grad, req):
+            self.assign(g, r, _nd_array(np.zeros(g.shape, np.float32)))
+
+
+@operator.register("proposal_target")
+class _ProposalTargetProp(operator.CustomOpProp):
+    def __init__(self, num_classes="21", batch_images="1", batch_rois="128",
+                 fg_fraction="0.25"):
+        super().__init__(need_top_grad=False)
+        self._nc = int(float(num_classes))
+        self._br = int(float(batch_rois))
+        self._ff = float(fg_fraction)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_output", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [(self._br, 5), (self._br,),
+                          (self._br, 4 * self._nc),
+                          (self._br, 4 * self._nc)], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _ProposalTargetOp(self._nc, self._br, self._ff)
+
+
+# ----------------------------------------------------------------------
+# symbols (reference symbol_vgg.py get_vgg_train:330 / get_vgg_test)
+# ----------------------------------------------------------------------
+
+def _vgg_conv(data, small=False):
+    """Conv body to relu5_3 (stride-16 feature map).  small=True shrinks
+    channel counts ~8x for tests."""
+    def block(x, n, filt, layers):
+        for i in range(layers):
+            x = S.Activation(S.Convolution(
+                x, kernel=(3, 3), pad=(1, 1), num_filter=filt,
+                name="conv%s_%d" % (n, i + 1)), act_type="relu")
+        return x
+
+    d = 8 if small else 64
+    x = block(data, "1", d, 2)
+    x = S.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = block(x, "2", d * 2, 2)
+    x = S.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = block(x, "3", d * 4, 3)
+    x = S.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = block(x, "4", d * 8, 3)
+    x = S.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = block(x, "5", d * 8, 3)
+    return x
+
+
+def _rpn(feat, num_anchors, small=False):
+    rpn_conv = S.Activation(S.Convolution(
+        feat, kernel=(3, 3), pad=(1, 1), num_filter=64 if small else 512,
+        name="rpn_conv_3x3"), act_type="relu")
+    cls = S.Convolution(rpn_conv, kernel=(1, 1), num_filter=2 * num_anchors,
+                        name="rpn_cls_score")
+    bbox = S.Convolution(rpn_conv, kernel=(1, 1), num_filter=4 * num_anchors,
+                         name="rpn_bbox_pred")
+    return cls, bbox
+
+
+def _roi_head(feat, rois, num_classes, spatial_scale, small=False):
+    pool = S.ROIPooling(feat, rois, pooled_size=(7, 7),
+                        spatial_scale=spatial_scale, name="roi_pool5")
+    hidden = 256 if small else 4096
+    x = S.Flatten(pool)
+    x = S.Activation(S.FullyConnected(x, num_hidden=hidden, name="fc6"),
+                     act_type="relu")
+    x = S.Activation(S.FullyConnected(x, num_hidden=hidden, name="fc7"),
+                     act_type="relu")
+    cls_score = S.FullyConnected(x, num_hidden=num_classes, name="cls_score")
+    bbox_pred = S.FullyConnected(x, num_hidden=num_classes * 4,
+                                 name="bbox_pred")
+    return cls_score, bbox_pred
+
+
+def get_faster_rcnn_train(num_classes=21, scales=(8, 16, 32),
+                          ratios=(0.5, 1, 2), feat_stride=16,
+                          batch_rois=128, fg_fraction=0.25,
+                          rpn_pre_nms=600, rpn_post_nms=64, small=False):
+    """Training symbol: RPN losses + proposal -> proposal_target -> ROI
+    head losses (reference symbol_vgg.py get_vgg_train:330-410).
+
+    Inputs: data (1,3,H,W), im_info (1,3), gt_boxes (1,G,5),
+    rpn_label (1, A*h*w), rpn_bbox_target (1, A*4, h, w),
+    rpn_bbox_weight (1, A*4, h, w) — from `assign_anchor`."""
+    na = len(scales) * len(ratios)
+    data = S.Variable("data")
+    im_info = S.Variable("im_info")
+    gt_boxes = S.Variable("gt_boxes")
+    rpn_label = S.Variable("rpn_label")
+    rpn_bbox_target = S.Variable("rpn_bbox_target")
+    rpn_bbox_weight = S.Variable("rpn_bbox_weight")
+
+    feat = _vgg_conv(data, small=small)
+    rpn_cls, rpn_bbox = _rpn(feat, na, small=small)
+
+    rpn_cls_reshape = S.Reshape(rpn_cls, shape=(0, 2, -1, 0),
+                                name="rpn_cls_score_reshape")
+    rpn_cls_prob = S.SoftmaxOutput(rpn_cls_reshape, rpn_label,
+                                   multi_output=True, normalization="valid",
+                                   use_ignore=True, ignore_label=-1,
+                                   name="rpn_cls_prob")
+    rpn_bbox_loss = S.MakeLoss(
+        rpn_bbox_weight * S.smooth_l1(rpn_bbox - rpn_bbox_target, scalar=3.0),
+        grad_scale=1.0 / 256, name="rpn_bbox_loss")
+
+    rpn_cls_act = S.SoftmaxActivation(rpn_cls_reshape, mode="channel",
+                                      name="rpn_cls_act")
+    rpn_cls_act = S.Reshape(rpn_cls_act, shape=(0, 2 * na, -1, 0),
+                            name="rpn_cls_act_reshape")
+    rois = CS.Proposal(
+        rpn_cls_act, rpn_bbox, im_info, feature_stride=feat_stride,
+        scales=scales, ratios=ratios, rpn_pre_nms_top_n=rpn_pre_nms,
+        rpn_post_nms_top_n=rpn_post_nms, threshold=0.7, rpn_min_size=16,
+        name="rois")
+
+    group = S.Custom(rois, S.Reshape(gt_boxes, shape=(-1, 5)),
+                     op_type="proposal_target", num_classes=num_classes,
+                     batch_rois=batch_rois, fg_fraction=fg_fraction,
+                     name="ptarget")
+    rois_s, label, bbox_target, bbox_weight = (group[0], group[1],
+                                               group[2], group[3])
+
+    cls_score, bbox_pred = _roi_head(feat, rois_s, num_classes,
+                                     1.0 / feat_stride, small=small)
+    cls_prob = S.SoftmaxOutput(cls_score, label, normalization="batch",
+                               name="cls_prob")
+    bbox_loss = S.MakeLoss(
+        bbox_weight * S.smooth_l1(bbox_pred - bbox_target, scalar=1.0),
+        grad_scale=1.0 / batch_rois, name="bbox_loss")
+    return S.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss,
+                    S.BlockGrad(label)])
+
+
+def get_faster_rcnn_test(num_classes=21, scales=(8, 16, 32),
+                         ratios=(0.5, 1, 2), feat_stride=16,
+                         rpn_pre_nms=600, rpn_post_nms=64, small=False):
+    """Inference symbol: proposal -> ROI head scores + box deltas
+    (reference get_vgg_test)."""
+    na = len(scales) * len(ratios)
+    data = S.Variable("data")
+    im_info = S.Variable("im_info")
+    feat = _vgg_conv(data, small=small)
+    rpn_cls, rpn_bbox = _rpn(feat, na, small=small)
+    rpn_cls_reshape = S.Reshape(rpn_cls, shape=(0, 2, -1, 0))
+    rpn_cls_act = S.SoftmaxActivation(rpn_cls_reshape, mode="channel")
+    rpn_cls_act = S.Reshape(rpn_cls_act, shape=(0, 2 * na, -1, 0))
+    rois = CS.Proposal(
+        rpn_cls_act, rpn_bbox, im_info, feature_stride=feat_stride,
+        scales=scales, ratios=ratios, rpn_pre_nms_top_n=rpn_pre_nms,
+        rpn_post_nms_top_n=rpn_post_nms, threshold=0.7, rpn_min_size=16,
+        name="rois")
+    cls_score, bbox_pred = _roi_head(feat, rois, num_classes,
+                                     1.0 / feat_stride, small=small)
+    cls_prob = S.softmax(cls_score, axis=-1, name="cls_prob")
+    return S.Group([rois, cls_prob, bbox_pred])

@@ -636,6 +636,14 @@ def _softmax_bwd(data, label, out, attrs):
     multi_output = _bool(attrs.get("multi_output", False))
     cls_axis = 1 if multi_output else -1
     num_cls = data.shape[cls_axis]
+    if multi_output and label.ndim != out.ndim:
+        # the reference accepts a FLAT label (batch, spatial...) for the
+        # channel-softmax form (e.g. Faster R-CNN rpn_label (1, A*H*W)
+        # against scores (1, 2, A*H, W)); align it to the spatial dims
+        expect = data.shape[:1] + data.shape[2:]
+        if tuple(label.shape) != tuple(expect) and label.size == int(
+                jnp.prod(jnp.array(expect))):
+            label = label.reshape(expect)
     if label.ndim == out.ndim:
         onehot = label
         valid = jnp.ones(label.shape[:1], dtype=data.dtype)

@@ -1,0 +1,126 @@
+"""Faster R-CNN model family (models/rcnn.py): anchor machinery vs
+closed forms, proposal_target invariants, and train/test symbols running
+forward+backward end-to-end (reference example/rcnn)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import rcnn
+
+
+def test_generate_anchors_shapes():
+    a = rcnn.generate_anchors(16, ratios=(0.5, 1, 2), scales=(8, 16, 32))
+    assert a.shape == (9, 4)
+    # ratio-1 scale-8 anchor is the centered 128x128 window
+    r1 = a[3]
+    assert (r1[2] - r1[0] + 1) == 128 and (r1[3] - r1[1] + 1) == 128
+
+
+def test_bbox_transform_roundtrip():
+    ex = np.array([[10, 10, 50, 60]], np.float32)
+    t = rcnn._bbox_transform(ex, ex)
+    np.testing.assert_allclose(t, np.zeros((1, 4)), atol=1e-6)
+    gt = np.array([[12, 8, 54, 66]], np.float32)
+    t = rcnn._bbox_transform(ex, gt)
+    assert np.all(np.isfinite(t)) and abs(float(t[0, 0])) > 0
+
+
+def test_assign_anchor_invariants():
+    gt = np.array([[40, 40, 120, 120, 0]], np.float32)
+    out = rcnn.assign_anchor((14, 14), gt, im_info=(224, 224, 1.0),
+                             feat_stride=16)
+    lab = out["label"]
+    assert lab.shape == (9 * 14 * 14,)
+    assert set(np.unique(lab)).issubset({-1.0, 0.0, 1.0})
+    assert (lab == 1).sum() >= 1          # the gt got at least one anchor
+    assert (lab == 0).sum() > 0
+    assert out["bbox_target"].shape == (36, 14, 14)
+    # weights nonzero exactly where the (anchor-major) label is fg
+    w = out["bbox_weight"].reshape(9, 4, 14, 14).max(axis=1).reshape(-1)
+    np.testing.assert_array_equal(w > 0, lab.reshape(-1) == 1)
+
+
+def test_proposal_target_invariants():
+    rng = np.random.RandomState(0)
+    rois = np.hstack([np.zeros((40, 1), np.float32),
+                      rng.uniform(0, 180, (40, 4)).astype(np.float32)])
+    rois[:, 3] = rois[:, 1] + np.abs(rois[:, 3] - rois[:, 1]) + 8
+    rois[:, 4] = rois[:, 2] + np.abs(rois[:, 4] - rois[:, 2]) + 8
+    gt = np.array([[30, 30, 90, 90, 2], [100, 110, 170, 200, 0]], np.float32)
+    out = mx.nd.Custom(mx.nd.array(rois), mx.nd.array(gt),
+                       op_type="proposal_target", num_classes=4,
+                       batch_rois=16, fg_fraction=0.5)
+    rois_out, label, target, weight = [o.asnumpy() for o in out]
+    assert rois_out.shape == (16, 5) and label.shape == (16,)
+    assert target.shape == (16, 16) and weight.shape == (16, 16)
+    # gt boxes were appended to the roi pool, so fg rois exist with the
+    # right class ids (gt class + 1)
+    assert set(np.unique(label)).issubset({0.0, 1.0, 3.0})
+    assert (label > 0).sum() >= 2
+    # weights only on the fg rows, in the labelled class' 4-slot
+    for i in range(16):
+        c = int(label[i])
+        row = weight[i].reshape(4, 4)
+        if c == 0:
+            assert row.sum() == 0
+        else:
+            assert row[c].sum() == 4 and row.sum() == 4
+
+
+def test_faster_rcnn_train_fwd_bwd():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = rcnn.get_faster_rcnn_train(num_classes=4, small=True,
+                                     rpn_pre_nms=200, rpn_post_nms=16,
+                                     batch_rois=16)
+    h = w = 112
+    fh = fw = h // 16
+    gt = np.array([[[20, 20, 80, 80, 1], [40, 50, 100, 90, 2]]], np.float32)
+    tgt = rcnn.assign_anchor((fh, fw), gt[0], im_info=(h, w, 1.0))
+    exe = net.simple_bind(
+        mx.cpu(), data=(1, 3, h, w), im_info=(1, 3), gt_boxes=(1, 2, 5),
+        rpn_label=(1, 9 * fh * fw), rpn_bbox_target=(1, 36, fh, fw),
+        rpn_bbox_weight=(1, 36, fh, fw), grad_req="write")
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name.endswith(("weight", "bias", "gamma", "beta")):
+            init(name, arr)
+    exe.arg_dict["data"][:] = np.random.randn(1, 3, h, w).astype(np.float32)
+    exe.arg_dict["im_info"][:] = np.array([[h, w, 1.0]], np.float32)
+    exe.arg_dict["gt_boxes"][:] = gt
+    exe.arg_dict["rpn_label"][:] = tgt["label"].reshape(
+        exe.arg_dict["rpn_label"].shape)
+    exe.arg_dict["rpn_bbox_target"][:] = tgt["bbox_target"][None]
+    exe.arg_dict["rpn_bbox_weight"][:] = tgt["bbox_weight"][None]
+    exe.forward(is_train=True)
+    outs = [o.asnumpy() for o in exe.outputs]
+    assert all(np.all(np.isfinite(o)) for o in outs)
+    assert outs[2].shape == (16, 4)  # roi-head class probs
+    exe.backward()
+    g = exe.grad_dict["conv1_1_weight"].asnumpy()
+    assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
+
+
+def test_faster_rcnn_test_symbol():
+    np.random.seed(1)
+    mx.random.seed(1)
+    net = rcnn.get_faster_rcnn_test(num_classes=4, small=True,
+                                    rpn_pre_nms=200, rpn_post_nms=8)
+    h = w = 112
+    exe = net.simple_bind(mx.cpu(), data=(1, 3, h, w), im_info=(1, 3),
+                          grad_req="null")
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name.endswith(("weight", "bias")):
+            init(name, arr)
+    exe.arg_dict["data"][:] = np.random.randn(1, 3, h, w).astype(np.float32)
+    exe.arg_dict["im_info"][:] = np.array([[h, w, 1.0]], np.float32)
+    exe.forward(is_train=False)
+    rois, cls_prob, bbox_pred = [o.asnumpy() for o in exe.outputs]
+    assert rois.shape == (8, 5)
+    assert cls_prob.shape == (8, 4)
+    np.testing.assert_allclose(cls_prob.sum(1), np.ones(8), rtol=1e-5)
+    assert bbox_pred.shape == (8, 16)
+    # rois are inside the image
+    assert np.all(rois[:, 1:] >= 0) and np.all(rois[:, [1, 3]] <= w) \
+        and np.all(rois[:, [2, 4]] <= h)
