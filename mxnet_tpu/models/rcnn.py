@@ -33,20 +33,15 @@ __all__ = ["get_faster_rcnn_train", "get_faster_rcnn_test",
 # ----------------------------------------------------------------------
 
 def generate_anchors(base_size=16, ratios=(0.5, 1, 2), scales=(8, 16, 32)):
-    """(A, 4) anchor windows around one base cell, [x1, y1, x2, y2]."""
-    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
-    w, h = base[2] - base[0] + 1, base[3] - base[1] + 1
-    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
-    out = []
-    for r in ratios:
-        size = w * h
-        ws = np.round(np.sqrt(size / r))
-        hs = np.round(ws * r)
-        for s in scales:
-            wss, hss = ws * s, hs * s
-            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
-                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
-    return np.array(out, np.float32)
+    """(A, 4) anchor windows around one base cell, [x1, y1, x2, y2].
+
+    Delegates to the SAME enumeration `_contrib_Proposal` decodes with
+    (ops/contrib_ops.py _generate_anchors, proposal-inl.h rounding) — a
+    second rounding rule here would silently offset the regression
+    targets against the proposal decode."""
+    from ..ops.contrib_ops import _generate_anchors
+
+    return _generate_anchors(base_size, ratios, scales)
 
 
 def _bbox_overlaps(boxes, gt):
@@ -116,13 +111,22 @@ def assign_anchor(feat_shape, gt_boxes, im_info, feat_stride=16,
         gt_argmax = ov.argmax(axis=0)
         label[inside[gt_argmax]] = 1
         label[inside[maxov >= fg_overlap]] = 1
-        # cap fg/bg counts (deterministic: keep highest-overlap)
+        # cap fg/bg counts.  Deterministic (no RNG) but overlap-ordered,
+        # NOT index-ordered: truncating np.where order would always drop
+        # bottom-of-image anchors (spatial bias).  Per-gt best anchors
+        # sort first so a small object never loses its only positive.
+        maxov_full = np.zeros((total,), np.float32)
+        maxov_full[inside] = maxov
+        is_gt_best = np.zeros((total,), np.float32)
+        is_gt_best[inside[gt_argmax]] = 1.0
         fg = np.where(label == 1)[0]
+        fg = fg[np.argsort(-(maxov_full[fg] + is_gt_best[fg]))]
         max_fg = int(rpn_batch * fg_fraction)
         if fg.size > max_fg:
             label[fg[max_fg:]] = -1
             fg = fg[:max_fg]
         bg = np.where(label == 0)[0]
+        bg = bg[np.argsort(-maxov_full[bg])]  # hard negatives first
         max_bg = rpn_batch - min(fg.size, max_fg)
         if bg.size > max_bg:
             label[bg[max_bg:]] = -1
@@ -131,7 +135,11 @@ def assign_anchor(feat_shape, gt_boxes, im_info, feat_stride=16,
         bbox_target[pos] = _bbox_transform(anchors[pos], gt[ov[pos_inside].argmax(1)])
         bbox_weight[pos] = 1.0
     elif inside.size:
-        label[inside] = 0
+        # background-only image: honor the same rpn_batch budget (spread
+        # evenly over the image rather than biasing one corner)
+        sel = inside[np.unique(np.linspace(
+            0, inside.size - 1, min(rpn_batch, inside.size)).astype(int))]
+        label[sel] = 0
     # [H*W*A, x] -> [A*4, H, W] layout the RPN conv heads emit
     bt = bbox_target.reshape(h, w, a * 4).transpose(2, 0, 1)
     bw = bbox_weight.reshape(h, w, a * 4).transpose(2, 0, 1)
@@ -170,13 +178,18 @@ class _ProposalTargetOp(operator.CustomOp):
         bg = order[maxov[order] < self._fg_ov][:self._br - fg.size]
         keep = np.concatenate([fg, bg])
         # static output shape: pad with weight-0 background rois
-        pad = self._br - keep.size
+        n_real = keep.size
+        pad = self._br - n_real
         if pad > 0:
             keep = np.concatenate([keep, np.zeros((pad,), np.int64)])
         rois_out = all_rois[keep].astype(np.float32)
         label = np.zeros((self._br,), np.float32)
         if ov.shape[1]:
             label[:fg.size] = gt[gt_assign[fg], 4] + 1  # class ids 1..nc-1
+        # pad rows repeat roi 0 only to keep the shape static — they are
+        # NOT background examples (roi 0 is the top proposal and often a
+        # real object); label -1 so the cls loss ignores them
+        label[n_real:] = -1
         target = np.zeros((self._br, 4 * self._nc), np.float32)
         weight = np.zeros((self._br, 4 * self._nc), np.float32)
         if ov.shape[1] and fg.size:
@@ -323,7 +336,10 @@ def get_faster_rcnn_train(num_classes=21, scales=(8, 16, 32),
 
     cls_score, bbox_pred = _roi_head(feat, rois_s, num_classes,
                                      1.0 / feat_stride, small=small)
-    cls_prob = S.SoftmaxOutput(cls_score, label, normalization="batch",
+    # 'valid' + ignore: padding rois (label -1) contribute no gradient and
+    # the loss normalizes over the real roi count
+    cls_prob = S.SoftmaxOutput(cls_score, label, normalization="valid",
+                               use_ignore=True, ignore_label=-1,
                                name="cls_prob")
     bbox_loss = S.MakeLoss(
         bbox_weight * S.smooth_l1(bbox_pred - bbox_target, scalar=1.0),

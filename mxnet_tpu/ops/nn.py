@@ -641,8 +641,9 @@ def _softmax_bwd(data, label, out, attrs):
         # channel-softmax form (e.g. Faster R-CNN rpn_label (1, A*H*W)
         # against scores (1, 2, A*H, W)); align it to the spatial dims
         expect = data.shape[:1] + data.shape[2:]
-        if tuple(label.shape) != tuple(expect) and label.size == int(
-                jnp.prod(jnp.array(expect))):
+        import math
+        if tuple(label.shape) != tuple(expect) and \
+                label.size == math.prod(expect):
             label = label.reshape(expect)
     if label.ndim == out.ndim:
         onehot = label

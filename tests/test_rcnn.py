@@ -54,14 +54,14 @@ def test_proposal_target_invariants():
     assert rois_out.shape == (16, 5) and label.shape == (16,)
     assert target.shape == (16, 16) and weight.shape == (16, 16)
     # gt boxes were appended to the roi pool, so fg rois exist with the
-    # right class ids (gt class + 1)
-    assert set(np.unique(label)).issubset({0.0, 1.0, 3.0})
+    # right class ids (gt class + 1); padding rows carry ignore-label -1
+    assert set(np.unique(label)).issubset({-1.0, 0.0, 1.0, 3.0})
     assert (label > 0).sum() >= 2
     # weights only on the fg rows, in the labelled class' 4-slot
     for i in range(16):
         c = int(label[i])
         row = weight[i].reshape(4, 4)
-        if c == 0:
+        if c <= 0:  # background or ignore-padding
             assert row.sum() == 0
         else:
             assert row[c].sum() == 4 and row.sum() == 4
