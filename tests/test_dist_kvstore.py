@@ -111,7 +111,7 @@ def test_worker_crash_and_recovery():
         "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "1",
         # fast detection so the test doesn't wait the 60 s default
-        "MXNET_KVSTORE_DEAD_TIMEOUT": "5",
+        "MXNET_KVSTORE_DEAD_TIMEOUT": "8",
         "MXTPU_TEST_FLAG_FILE": flag,
     })
     if os.path.exists(flag):
