@@ -23,6 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as _onp
 
 from .base import MXNetError
 from .ndarray import NDArray
@@ -151,7 +152,6 @@ def register(reg_name):
                                 for s in out_shapes]
                     cop.forward(is_train, ["write"] * len(out_data),
                                 in_data, out_data, [])
-                    import numpy as _onp
                     return tuple(_onp.asarray(o.data, dtype=dtype)
                                  for o in out_data)
 
@@ -169,7 +169,6 @@ def register(reg_name):
                                for a in xs]
                     cop.backward(["write"] * len(in_grad), out_grad,
                                  in_data, out_data, in_grad, [])
-                    import numpy as _onp
                     return tuple(_onp.asarray(g.data) for g in in_grad)
 
             _untraceable = (jax.errors.TracerArrayConversionError,

@@ -93,12 +93,10 @@ def moe_apply(expert_fn, params, x, gate_w, k=1, capacity_factor=1.0,
                           tiled=False)
 
     # apply local experts over the concatenated sender axis
-    def one_expert(p, xe):  # xe: [S_from * C, D]
-        return expert_fn(p, xe)
-
+    # (per expert: [S_from * C, D] tokens)
     xe = recv.transpose(1, 0, 2, 3).reshape(local_experts,
                                             n_shards * capacity, d)
-    ye = jax.vmap(one_expert)(params, xe.astype(x.dtype))
+    ye = jax.vmap(expert_fn)(params, xe.astype(x.dtype))
     ye = ye.reshape(local_experts, n_shards, capacity, d).transpose(1, 0, 2, 3)
 
     # route results back to the token owners
@@ -132,7 +130,7 @@ def moe_sharded(mesh, expert_fn, stacked_params, x, gate_w, k=1,
                              capacity_factor=capacity_factor,
                              axis_name=expert_axis)
     return shard_map(
-        lambda p, xx, gw: body(p, xx, gw),
+        body,
         mesh=mesh,
         in_specs=(param_spec, tok_spec, P()),
         out_specs=tok_spec,

@@ -25,9 +25,6 @@ from __future__ import annotations
 import os
 
 import jax
-import numpy as _np
-
-from .mesh import Mesh
 
 __all__ = ["initialize", "is_initialized", "global_mesh",
            "host_local_batch", "make_global_array", "sync_global_devices"]
@@ -84,22 +81,9 @@ def global_mesh(axes):
     Device order is jax.devices() — process-major, so a leading 'data'
     axis puts whole hosts in distinct data shards and cross-host traffic
     is the gradient all-reduce on DCN, the efficient layout."""
-    devices = jax.devices()
-    names = list(axes.keys())
-    sizes = list(axes.values())
-    if -1 in sizes:
-        known = 1
-        for s in sizes:
-            if s != -1:
-                known *= s
-        sizes[sizes.index(-1)] = len(devices) // known
-    total = 1
-    for s in sizes:
-        total *= s
-    if total != len(devices):
-        raise ValueError("mesh %s does not cover %d global devices"
-                         % (dict(zip(names, sizes)), len(devices)))
-    return Mesh(_np.array(devices).reshape(sizes), tuple(names))
+    from .mesh import make_mesh
+
+    return make_mesh(axes, devices=jax.devices())
 
 
 def host_local_batch(global_batch_size):

@@ -109,7 +109,7 @@ def pipeline_sharded(mesh, stage_fn, stacked_params, x, num_microbatches,
     body = functools.partial(pipeline_apply, stage_fn, axis_name=pipe_axis,
                              remat=remat)
     out = shard_map(
-        lambda p, m: body(p, m),
+        body,
         mesh=mesh,
         in_specs=(param_spec, mb_spec),
         out_specs=out_spec,
