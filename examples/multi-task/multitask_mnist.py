@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Multi-task training (reference example/multi-task): one trunk, two
+SoftmaxOutput heads grouped into a single symbol, a metric per task.
+
+Synthetic task pair on digit-like data: head A classifies the pattern
+class, head B classifies a parity-style attribute.  Both heads must
+converge through the shared trunk.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def build():
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    trunk = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=64, name="fc1"),
+        act_type="relu")
+    head_a = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(trunk, num_hidden=4, name="cls_a"),
+        name="softmax_a")
+    head_b = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(trunk, num_hidden=2, name="cls_b"),
+        name="softmax_b")
+    return mx.sym.Group([head_a, head_b])
+
+
+def make_multi_accuracy(mx, num):
+    """Per-task accuracies (reference multi-task Multi_Accuracy metric,
+    an EvalMetric subclass so Module.fit accepts it)."""
+
+    class MultiAccuracy(mx.metric.EvalMetric):
+        def __init__(self):
+            # NB: the EvalMetric base uses `self.num` itself; keep ours
+            # under a different name
+            self.ntasks = num
+            super().__init__("multi_accuracy")
+
+        def reset(self):
+            n = getattr(self, "ntasks", 0)
+            self.hits = [0] * n
+            self.counts = [0] * n
+
+        def update(self, labels, preds):
+            for i in range(self.ntasks):
+                pred = preds[i].asnumpy().argmax(1)
+                lab = labels[i].asnumpy().ravel()
+                self.hits[i] += int((pred == lab).sum())
+                self.counts[i] += lab.shape[0]
+
+        def get(self):
+            return (["task%d_acc" % i for i in range(self.ntasks)],
+                    [h / max(c, 1) for h, c in zip(self.hits, self.counts)])
+
+    return MultiAccuracy()
+
+
+def main():
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    n, dim = 512, 16
+    X = rng.randn(n, dim).astype(np.float32)
+    w = rng.randn(dim, 4)
+    y_a = np.argmax(X @ w, 1).astype(np.float32)
+    y_b = (X[:, 0] > 0).astype(np.float32)
+
+    net = build()
+    mod = mx.mod.Module(net, context=mx.current_context(),
+                        label_names=["softmax_a_label", "softmax_b_label"])
+    it = mx.io.NDArrayIter({"data": X},
+                           {"softmax_a_label": y_a, "softmax_b_label": y_b},
+                           batch_size=32, shuffle=True)
+    metric = make_multi_accuracy(mx, 2)
+    mod.fit(it, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01}, eval_metric=metric)
+    it.reset()
+    metric.reset()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False, force_rebind=True)
+    score = mod.score(it, metric)
+    print("final:", score)
+    assert all(v > 0.9 for v in dict(score).values()), score
+    print("multi-task OK")
+
+
+if __name__ == "__main__":
+    main()
