@@ -37,6 +37,8 @@ def make_digits(n, rng):
 def main():
     import mxnet_tpu as mx
 
+    mx.random.seed(0)
+    np.random.seed(0)
     rng = np.random.RandomState(0)
     X, y = make_digits(512, rng)
 

@@ -64,6 +64,8 @@ def make_multi_accuracy(mx, num):
 def main():
     import mxnet_tpu as mx
 
+    mx.random.seed(0)
+    np.random.seed(0)
     rng = np.random.RandomState(0)
     n, dim = 512, 16
     X = rng.randn(n, dim).astype(np.float32)

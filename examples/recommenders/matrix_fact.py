@@ -31,6 +31,8 @@ def build(num_users, num_items, k):
 def main():
     import mxnet_tpu as mx
 
+    mx.random.seed(0)
+    np.random.seed(0)
     rng = np.random.RandomState(0)
     num_users, num_items, k, n = 60, 40, 6, 4096
     true_u = rng.randn(num_users, k) * 0.8
