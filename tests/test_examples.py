@@ -16,6 +16,7 @@ FAST_EXAMPLES = [
     "examples/recommenders/matrix_fact.py",
     "examples/autoencoder/mlp_autoencoder.py",
     "examples/adversary/fgsm_mnist.py",
+    "examples/nce-loss/nce_lm.py",
 ]
 
 
