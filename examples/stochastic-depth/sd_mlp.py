@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Stochastic depth (reference example/stochastic-depth/sd_module.py:
+residual blocks randomly dropped per step during training).
+
+TPU redesign: the reference samples the active-block pattern in python
+and swaps module sub-graphs; under the one-XLA-executable design the
+natural carrier is the BUCKETING machinery — the active pattern is the
+bucket key, `sym_gen(pattern)` builds that depth's graph, and
+BucketingModule caches one executable per pattern with parameters shared
+by name.  Ten patterns on a 4-block net => at most 16 cached
+executables, params common to every depth.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+N_BLOCKS = 4
+DEATH_RATE = 0.35
+
+
+def sym_gen_factory(mx, dim, classes):
+    def sym_gen(pattern):
+        data = mx.sym.Variable("data")
+        x = mx.sym.Activation(
+            mx.sym.FullyConnected(data, num_hidden=dim, name="stem"),
+            act_type="relu")
+        for i, alive in enumerate(pattern):
+            if alive:
+                branch = mx.sym.Activation(
+                    mx.sym.FullyConnected(x, num_hidden=dim,
+                                          name="block%d" % i),
+                    act_type="relu")
+                x = x + branch
+        out = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(x, num_hidden=classes, name="head"),
+            name="softmax")
+        return out, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+class StochasticDepthIter:
+    """NDArrayIter wrapper stamping a sampled survival pattern as the
+    bucket key of every batch (the python-side coin flips of the
+    reference's sd_module, relocated to the data stream)."""
+
+    def __init__(self, it, rng, train=True):
+        self._it = it
+        self._rng = rng
+        self._train = train
+        self.batch_size = it.batch_size
+        self.default_bucket_key = (True,) * N_BLOCKS
+        self.provide_data = it.provide_data
+        self.provide_label = it.provide_label
+
+    def __iter__(self):
+        for batch in self._it:
+            if self._train:
+                pattern = tuple(bool(b) for b in
+                                self._rng.rand(N_BLOCKS) > DEATH_RATE)
+            else:
+                pattern = self.default_bucket_key
+            batch.bucket_key = pattern
+            batch.provide_data = self.provide_data
+            batch.provide_label = self.provide_label
+            yield batch
+
+    def reset(self):
+        self._it.reset()
+
+
+def main():
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    rng = np.random.RandomState(7)
+    n, dim, classes = 1024, 32, 4
+    X = rng.randn(n, dim).astype(np.float32)
+    y = np.argmax(X @ rng.randn(dim, classes), 1).astype(np.float32)
+
+    sym_gen = sym_gen_factory(mx, dim, classes)
+    base = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    train_it = StochasticDepthIter(base, rng, train=True)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train_it.default_bucket_key,
+                                 context=mx.current_context())
+    patterns = set()
+    orig_switch = mod.switch_bucket
+
+    def counting_switch(key, *a, **kw):
+        patterns.add(key)
+        return orig_switch(key, *a, **kw)
+
+    mod.switch_bucket = counting_switch
+    mod.fit(train_it, num_epoch=12, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    print("distinct depth patterns trained:", len(patterns))
+    assert len(patterns) >= 4, patterns
+
+    # evaluation runs the full-depth graph with the shared weights
+    eval_it = StochasticDepthIter(
+        mx.io.NDArrayIter(X, y, batch_size=64), rng, train=False)
+    acc = mod.score(eval_it, "acc")[0][1]
+    print("full-depth eval accuracy: %.3f" % acc)
+    assert acc > 0.9
+    print("stochastic depth OK")
+
+
+if __name__ == "__main__":
+    main()

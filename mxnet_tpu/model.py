@@ -80,13 +80,23 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
     TPU fast path: when no kvstore round-trip is involved, every parameter's
     update is fused into ONE jitted call via Updater.update_batch — the
     per-key loop would pay a device RTT per parameter."""
+    # Updater state is keyed by NAME when names are known: positional keys
+    # silently cross-wire optimizer state whenever two executables order
+    # (or subset) their params differently — e.g. BucketingModule buckets
+    # whose graphs contain different layers (stochastic depth).  Name keys
+    # also hit the name-keyed lr/wd multiplier tables directly.
+    def _key(index, k):
+        if param_names is not None and num_device == 1:
+            return param_names[index]
+        return index * num_device + k
+
     if kvstore is None and hasattr(updater, "update_batch"):
         triples = []
         for index, (arg_list, grad_list) in enumerate(zip(param_arrays, grad_arrays)):
             if grad_list[0] is None:
                 continue
             for k, (w, g) in enumerate(zip(arg_list, grad_list)):
-                triples.append((index * num_device + k, g, w))
+                triples.append((_key(index, k), g, w))
         updater.update_batch(triples)
         return
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
@@ -99,7 +109,7 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
             kvstore.pull(name, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
-            updater(index * num_device + k, g, w)
+            updater(_key(index, k), g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

@@ -17,6 +17,7 @@ FAST_EXAMPLES = [
     "examples/autoencoder/mlp_autoencoder.py",
     "examples/adversary/fgsm_mnist.py",
     "examples/nce-loss/nce_lm.py",
+    "examples/stochastic-depth/sd_mlp.py",
 ]
 
 
