@@ -324,10 +324,12 @@ class Module(BaseModule):
             and all(exe._grad_req.get(n) == "write" for n in self._param_names)
             and exe._monitor_callback is None
         ):
-            index_of_name = {
-                name: i * len(self._context)
-                for i, name in enumerate(self._exec_group.param_names)
-            }
+            # updater state is keyed by NAME (same contract as
+            # model._update_params): positional keys cross-wire shared
+            # optimizer state between executables with different param
+            # orders, e.g. bucketing over different-depth graphs
+            index_of_name = {name: name
+                             for name in self._exec_group.param_names}
             exe.install_fused_update(self._updater, index_of_name)
 
     def update(self):
