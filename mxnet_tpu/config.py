@@ -49,6 +49,12 @@ REGISTRY = [
            "in the backward pass instead of storing them — jax.checkpoint "
            "with a save-only-matmul/conv-outputs remat policy (reference "
            "src/executor/graph_executor.cc:225-239)"),
+    EnvVar("MXNET_PROFILER_MODE", str, "symbolic",
+           "Profiler mode at import: symbolic/all/xla (profiler.py)"),
+    EnvVar("MXNET_PROFILER_AUTOSTART", int, 0,
+           "Start profiling at import; dump via mx.profiler.dump_profile()"),
+    EnvVar("MXNET_PROFILER_FILENAME", str, "profile.json",
+           "Profiler output path (profiler.py)"),
     EnvVar("MXNET_TPU_PALLAS_BN", int, 0,
            "Use the hand-tiled Pallas kernel for BatchNorm train-mode "
            "statistics on channel-minor TPU graphs (ops/pallas_kernels.py). "

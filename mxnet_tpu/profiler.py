@@ -14,7 +14,14 @@ import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile", "record_span"]
 
-_STATE = {"mode": "symbolic", "filename": "profile.json", "running": False}
+import os as _os
+
+_STATE = {
+    # MXNET_PROFILER_MODE honored at import (reference env_var.md:101-108)
+    "mode": _os.environ.get("MXNET_PROFILER_MODE", "symbolic"),
+    "filename": _os.environ.get("MXNET_PROFILER_FILENAME", "profile.json"),
+    "running": False,
+}
 _EVENTS = []
 _LOCK = threading.Lock()
 _JAX_TRACE_DIR = None
@@ -130,3 +137,10 @@ def dump_profile():
         with open(_STATE["filename"], "w") as f:
             json.dump(payload, f)
         _EVENTS.clear()
+
+
+# env-driven bootstrap (reference docs/how_to/env_var.md:97-108)
+if _STATE["mode"] not in ("symbolic", "all", "xla"):
+    _STATE["mode"] = "symbolic"
+if int(_os.environ.get("MXNET_PROFILER_AUTOSTART", "0") or "0"):
+    profiler_set_state("run")
