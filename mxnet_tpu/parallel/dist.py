@@ -106,11 +106,21 @@ def _recv_frame(sock):
 
 
 def _connect_retry(addr, timeout=60.0):
-    """Connect with retry — roles race at startup (slow jax imports)."""
+    """Connect with retry — roles race at startup (slow jax imports).
+
+    The returned socket BLOCKS: create_connection's timeout would
+    otherwise persist as a 60 s recv deadline on every RPC, and on an
+    oversubscribed host a healthy server can be starved past that
+    (observed during multi-process test compile storms).  Liveness is the
+    scheduler's job (heartbeats + dead-node detection), matching ps-lite's
+    blocking vans; callers that need a bounded wait set their own
+    deadline (barrier, dead-node polls)."""
     deadline = time.time() + timeout
     while True:
         try:
-            return socket.create_connection(addr, timeout=60)
+            sock = socket.create_connection(addr, timeout=60)
+            sock.settimeout(None)
+            return sock
         except (ConnectionRefusedError, OSError):
             if time.time() > deadline:
                 raise
