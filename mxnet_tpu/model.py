@@ -85,8 +85,18 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
     # (or subset) their params differently — e.g. BucketingModule buckets
     # whose graphs contain different layers (stochastic depth).  Name keys
     # also hit the name-keyed lr/wd multiplier tables directly.
+    # The SPMD group holds ONE executor (one copy per param) regardless of
+    # context count, so name keys apply whenever names are known AND there
+    # is a single copy — keeping the key domain identical to the
+    # fused-update path, which also keys by name
+    # (module._maybe_install_fused_update).  True per-device replica lists
+    # keep positional keys throughout: synthetic per-replica names would
+    # miss the name-keyed lr_mult/wd_mult tables and desync the replicas.
+    single_copy = param_names is not None and all(
+        len(arg_list) == 1 for arg_list in param_arrays)
+
     def _key(index, k):
-        if param_names is not None and num_device == 1:
+        if single_copy:
             return param_names[index]
         return index * num_device + k
 

@@ -107,10 +107,18 @@ def register(reg_name):
             prop = prop_cls(**{k: str(v) for k, v in kwargs.items()})
             in_shapes = [tuple(x.shape) for x in inputs]
             _, out_shapes, _ = prop.infer_shape(list(in_shapes))
-            cop = prop.create_operator(None, in_shapes, ["float32"] * len(inputs))
+            in_dtypes = [jnp.dtype(x.dtype) for x in inputs]
+            cop = prop.create_operator(None, in_shapes,
+                                       [str(d) for d in in_dtypes])
             dtype = inputs[0].dtype if inputs else jnp.float32
-            out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), dtype)
-                              for s in out_shapes)
+            # per-output dtypes come from the prop's infer_type (the part
+            # of the CustomOpProp contract the reference uses to type the
+            # graph, operator.py InferType); mixed in/out dtypes otherwise
+            # violate the pure_callback result contract
+            _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
+            out_dtypes = [jnp.dtype(d) for d in out_dtypes]
+            out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                              for s, d in zip(out_shapes, out_dtypes))
             in_specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
                              for x in inputs)
 
@@ -121,8 +129,8 @@ def register(reg_name):
             # stays on the host path.
             def _direct_fwd(*xs):
                 in_data = [NDArray(x) for x in xs]
-                out_data = [NDArray(jnp.zeros(tuple(s), dtype))
-                            for s in out_shapes]
+                out_data = [NDArray(jnp.zeros(tuple(s), d))
+                            for s, d in zip(out_shapes, out_dtypes)]
                 cop.forward(is_train, ["write"] * len(out_data),
                             in_data, out_data, [])
                 outs = tuple(o.data for o in out_data)
@@ -148,12 +156,12 @@ def register(reg_name):
             def _host_fwd(*arrs):
                 with _host_ctx():
                     in_data = [NDArray(jnp.asarray(a)) for a in arrs]
-                    out_data = [NDArray(jnp.zeros(tuple(s), dtype))
-                                for s in out_shapes]
+                    out_data = [NDArray(jnp.zeros(tuple(s), d))
+                                for s, d in zip(out_shapes, out_dtypes)]
                     cop.forward(is_train, ["write"] * len(out_data),
                                 in_data, out_data, [])
-                    return tuple(_onp.asarray(o.data, dtype=dtype)
-                                 for o in out_data)
+                    return tuple(_onp.asarray(o.data, dtype=d)
+                                 for o, d in zip(out_data, out_dtypes))
 
             def _host_bwd(n_out, *arrs):
                 # arrs = out_grads (n_out) + inputs (n_in) + outputs (n_out)
@@ -169,7 +177,11 @@ def register(reg_name):
                                for a in xs]
                     cop.backward(["write"] * len(in_grad), out_grad,
                                  in_data, out_data, in_grad, [])
-                    return tuple(_onp.asarray(g.data) for g in in_grad)
+                    # grads must come back in the declared input dtypes —
+                    # host math (numpy promotes to fp64, fp32 math on bf16
+                    # inputs) otherwise breaks the callback result contract
+                    return tuple(_onp.asarray(g.data, dtype=d)
+                                 for g, d in zip(in_grad, in_dtypes))
 
             _untraceable = (jax.errors.TracerArrayConversionError,
                             jax.errors.ConcretizationTypeError)

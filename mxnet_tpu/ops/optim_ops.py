@@ -27,13 +27,24 @@ from .tensor import _lit
 
 
 def _prep_grad(weight, grad, wd, rescale_grad, clip_gradient):
-    """grad = rescale*grad + wd*weight, then clip — the shared preamble of
-    every reference update kernel (optimizer_op-inl.h)."""
+    """grad = rescale*grad + wd*weight, then clip — the preamble of the
+    Adam/RMSProp reference kernels (optimizer_op-inl.h AdamUpdate,
+    RMSPropUpdate, RMSPropAlexUpdate fold wd before the clip)."""
     g = jnp.asarray(rescale_grad, grad.dtype) * grad + \
         jnp.asarray(wd, grad.dtype) * weight
     if clip_gradient >= 0.0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     return g
+
+
+def _prep_grad_sgd(weight, grad, wd, rescale_grad, clip_gradient):
+    """SGD-family preamble: clip rescale*grad alone, THEN add wd*weight —
+    the reference SGDKernel/SGDMomKernel/MP_SGD* kernels apply wd outside
+    the clipped quantity, unlike the Adam/RMSProp kernels."""
+    g = jnp.asarray(rescale_grad, grad.dtype) * grad
+    if clip_gradient >= 0.0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + jnp.asarray(wd, grad.dtype) * weight
 
 
 def _f(v, default=None):
@@ -44,7 +55,7 @@ def _f(v, default=None):
 def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, **kw):
     """weight - lr * (rescale*grad + wd*weight) (optimizer_op.cc sgd_update)."""
-    g = _prep_grad(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
+    g = _prep_grad_sgd(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
     return weight - jnp.asarray(_f(lr), weight.dtype) * g
 
 
@@ -52,7 +63,7 @@ def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
 def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, **kw):
     """mom = momentum*mom - lr*grad'; weight += mom.  Returns (weight, mom)."""
-    g = _prep_grad(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
+    g = _prep_grad_sgd(weight, grad, _f(wd), _f(rescale_grad), _f(clip_gradient))
     mom = jnp.asarray(_f(momentum), mom.dtype) * mom - \
         jnp.asarray(_f(lr), mom.dtype) * g
     return weight + mom, mom
@@ -64,7 +75,7 @@ def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, **kw):
     """Multi-precision SGD: fp32 master `weight32` updates in fp32, the
     low-precision weight is its cast.  Returns (weight, weight32)."""
-    g = _prep_grad(weight32, grad.astype(jnp.float32), _f(wd),
+    g = _prep_grad_sgd(weight32, grad.astype(jnp.float32), _f(wd),
                    _f(rescale_grad), _f(clip_gradient))
     w32 = weight32 - jnp.float32(_f(lr)) * g
     return w32.astype(weight.dtype), w32
@@ -75,7 +86,7 @@ def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
                       rescale_grad=1.0, clip_gradient=-1.0, **kw):
     """Multi-precision momentum SGD. Returns (weight, mom, weight32)."""
-    g = _prep_grad(weight32, grad.astype(jnp.float32), _f(wd),
+    g = _prep_grad_sgd(weight32, grad.astype(jnp.float32), _f(wd),
                    _f(rescale_grad), _f(clip_gradient))
     mom = jnp.float32(_f(momentum)) * mom - jnp.float32(_f(lr)) * g
     w32 = weight32 + mom

@@ -88,12 +88,17 @@ class Optimizer:
     def fused_supported(self):
         return self._fused is not None
 
-    def _prep(self, g, dtype=None):
-        """Rescale + clip (shared grad preprocessing, parity: reference
-        kernels' rescale_grad/clip_gradient handling)."""
+    def _prep(self, g, dtype=None, wd_weight=None):
+        """Rescale [+ wd fold] + clip (parity: the reference kernels'
+        rescale_grad/clip_gradient handling).  The SGD-family kernels clip
+        rescale*grad alone; the Adam/RMSProp kernels fold wd*weight BEFORE
+        the clip — pass wd_weight=(wd, w) to get the latter ordering."""
         if dtype is not None:
             g = g.astype(dtype)
         g = g * self.rescale_grad
+        if wd_weight is not None:
+            wd, w = wd_weight
+            g = g + wd * w
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
@@ -253,7 +258,9 @@ class Adam(Optimizer):
         coef1 = 1.0 - self.beta1 ** jnp.float32(t)
         coef2 = 1.0 - self.beta2 ** jnp.float32(t)
         lr_t = lr * jnp.sqrt(coef2) / coef1
-        g = self._prep(g) + wd * w
+        # wd folds BEFORE the clip — the kernel ordering the reference's
+        # python Adam inherits by dispatching to adam_update (optimizer.py:564)
+        g = self._prep(g, wd_weight=(wd, w))
         mean, var = states
         m = self.beta1 * mean + (1.0 - self.beta1) * g
         v = self.beta2 * var + (1.0 - self.beta2) * g * g
@@ -297,7 +304,8 @@ class RMSProp(Optimizer):
         return (zeros(weight.shape, weight.context),)
 
     def _fused(self, w, g, states, lr, wd, t):
-        g = self._prep(g) + wd * w
+        # wd before the clip, matching rmsprop_update/rmspropalex_update
+        g = self._prep(g, wd_weight=(wd, w))
         if self.centered:
             n, gm, delta = states
             n_new = (1 - self.gamma1) * g * g + self.gamma1 * n
@@ -374,7 +382,7 @@ class Adamax(Optimizer):
 
     def _fused(self, w, g, states, lr, wd, t):
         lr = lr / (1.0 - self.beta1 ** jnp.float32(t))
-        g = self._prep(g) + wd * w
+        g = self._prep(g, wd_weight=(wd, w))
         m_t, u_t = states
         m = self.beta1 * m_t + (1.0 - self.beta1) * g
         u = jnp.maximum(self.beta2 * u_t, jnp.abs(g))
@@ -404,7 +412,7 @@ class Nadam(Optimizer):
         wd = self._get_wd(index)
         self._update_count(index)
         t = self._index_update_count[index]
-        g = self._prep(grad.data) + wd * weight.data
+        g = self._prep(grad.data, wd_weight=(wd, weight.data))
         mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
         mom_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
         self.m_schedule = self.m_schedule * mom_t

@@ -189,11 +189,19 @@ class Scheduler:
         rank, servers retain state; reference kvstore_dist.h:39-44) —
         until all workers disconnect."""
         conns = []
+        pending_recovery = []
         while len(conns) < self.num_workers + self.num_servers:
             conn, _ = self.sock.accept()
             cmd, meta, _ = _recv_frame(conn)
             assert cmd == _REGISTER
             info = _parse_meta(meta)
+            if int(info.get("recover", -1)) >= 0:
+                # a rejoining worker racing the startup window must NOT be
+                # assigned a fresh rank (it would inflate the member count
+                # and desync barrier accounting): park it until the
+                # original membership is fully registered
+                pending_recovery.append((conn, info))
+                continue
             role = info["role"]
             with self._lock:
                 rank = self._ranks[role]
@@ -216,6 +224,9 @@ class Scheduler:
                 t.start()
                 if role == "worker":
                     self._worker_threads.append(t)
+        # recoveries parked during the startup window rejoin first
+        for conn, info in pending_recovery:
+            self._handle_recovery(conn, info)
         # recovery registrations arrive on the listening socket after start
         accept_t = threading.Thread(target=self._accept_recovery, daemon=True)
         accept_t.start()
@@ -250,34 +261,50 @@ class Scheduler:
                 conn.close()
                 continue
             info = _parse_meta(meta)
-            role, rank = info.get("role"), int(info.get("recover", -1))
-            if rank < 0 or role != "worker":
+            if int(info.get("recover", -1)) < 0 or info.get("role") != "worker":
                 conn.close()  # late non-recovery register: not a member
                 continue
-            node = "%s:%d" % (role, rank)
-            with self._lock:
-                self._left.discard(node)
-                self._finalized.discard(node)
-                self._last_seen[node] = time.monotonic()
-                old = self._current_conn.get(node)
-                self._current_conn[node] = conn
-                addrs = [self._server_addrs[r]
-                         for r in sorted(self._server_addrs)]
-            if old is not None:
-                # close the superseded socket: unblocks the stale
-                # _serve_conn thread (else a half-open connection from a
-                # power-failed host pins it, and serve_forever never exits)
-                try:
-                    old.close()
-                except OSError:
-                    pass
+            self._handle_recovery(conn, info)
+
+    def _handle_recovery(self, conn, info):
+        """Rejoin a recovering WORKER under its old rank: reset liveness
+        bookkeeping, supersede its stale socket, replay the address book."""
+        role, rank = info["role"], int(info["recover"])
+        node = "%s:%d" % (role, rank)
+        with self._lock:
+            self._left.discard(node)
+            self._finalized.discard(node)
+            self._last_seen[node] = time.monotonic()
+            old = self._current_conn.get(node)
+            self._current_conn[node] = conn
+            addrs = [self._server_addrs[r]
+                     for r in sorted(self._server_addrs)]
+        if old is not None:
+            # close the superseded socket: unblocks the stale
+            # _serve_conn thread (else a half-open connection from a
+            # power-failed host pins it, and serve_forever never exits)
+            try:
+                old.close()
+            except OSError:
+                pass
+        try:
             self._send(conn, _ADDRS,
                        _meta(rank=rank, servers=addrs, recovery=1))
-            t = threading.Thread(target=self._serve_conn,
-                                 args=(conn, role, rank), daemon=True)
-            t.start()
-            with self._lock:
-                self._worker_threads.append(t)
+        except (ConnectionError, OSError):
+            # the rejoiner died mid-handshake: drop it — with no serve
+            # thread its last_seen simply ages back into dead via the
+            # timeout, and this must never crash serve_forever (which
+            # calls here inline for startup-window recoveries)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        t = threading.Thread(target=self._serve_conn,
+                             args=(conn, role, rank), daemon=True)
+        t.start()
+        with self._lock:
+            self._worker_threads.append(t)
 
     def _serve_conn(self, conn, role, rank):
         node = "%s:%d" % (role, rank)
@@ -287,13 +314,23 @@ class Scheduler:
                 with self._lock:
                     self._last_seen[node] = time.monotonic()
                 if cmd == _BARRIER:
+                    done = None
                     with self._lock:
                         self._barrier_waiters.append(conn)
                         if len(self._barrier_waiters) == self.num_workers:
-                            for c in self._barrier_waiters:
-                                self._send(c, _BARRIER_DONE)
+                            done = self._barrier_waiters
                             self._barrier_waiters = []
                             self._lock.notify_all()
+                    if done is not None:
+                        # send AFTER releasing the lock: sockets are
+                        # blocking, so one stalled peer with a full recv
+                        # buffer would otherwise pin the global lock and
+                        # freeze heartbeats/dead-node queries cluster-wide
+                        for c in done:
+                            try:
+                                self._send(c, _BARRIER_DONE)
+                            except Exception:
+                                pass  # dead waiter: its serve thread reports it
                 elif cmd == _DEADNODES:
                     with self._lock:
                         dead = self._dead_nodes()

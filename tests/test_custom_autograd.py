@@ -214,3 +214,61 @@ def test_numpy_custom_op_inside_jitted_module():
     exe.backward([mx.nd.ones((3, 4))])
     np.testing.assert_allclose(exe.grad_dict["v"].asnumpy(),
                                1 - np.tanh(xv) ** 2, rtol=1e-5)
+
+
+def test_numpy_custom_op_mixed_dtypes():
+    """A host-callback custom op whose output dtype differs from its input
+    (infer_type contract) and whose host backward computes in fp64 must
+    still satisfy the pure_callback shape/dtype contract: out_specs come
+    from CustomOpProp.infer_type and grads are cast back to input dtypes."""
+    import numpy as np
+
+    class ArgTop(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()
+            # fp64 host math on purpose; outputs: scaled data + int32 argmax
+            self.assign(out_data[0], req[0],
+                        mx.nd.array((x.astype(np.float64) * 2.0)
+                                    .astype(np.float32)))
+            self.assign(out_data[1], req[1],
+                        mx.nd.array(x.argmax(axis=1).astype(np.int32),
+                                    dtype="int32"))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            g = out_grad[0].asnumpy().astype(np.float64) * 2.0  # fp64 grads
+            self.assign(in_grad[0], req[0], mx.nd.array(g))
+
+    @mx.operator.register("argtop_t")
+    class ArgTopProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_outputs(self):
+            return ["scaled", "idx"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0], (in_shape[0][0],)], []
+
+        def infer_type(self, in_type):
+            return in_type, [in_type[0], np.int32], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return ArgTop()
+
+    rng = np.random.RandomState(3)
+    xv = rng.randn(4, 5).astype(np.float32)
+    scaled, idx = mx.nd.Custom(mx.nd.array(xv), op_type="argtop_t")
+    assert idx.dtype == np.int32
+    np.testing.assert_allclose(scaled.asnumpy(), xv * 2.0, rtol=1e-6)
+    np.testing.assert_array_equal(idx.asnumpy(), xv.argmax(1))
+
+    # gradient path: fp64 host grads must land back as fp32
+    v = mx.sym.Variable("v")
+    out = mx.sym.Custom(v, op_type="argtop_t")
+    exe = out[0].simple_bind(mx.cpu(), v=(4, 5), grad_req="write")
+    exe.arg_dict["v"][:] = xv
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((4, 5))])
+    assert exe.grad_dict["v"].dtype == np.float32
+    np.testing.assert_allclose(exe.grad_dict["v"].asnumpy(),
+                               np.full((4, 5), 2.0), rtol=1e-6)

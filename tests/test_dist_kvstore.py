@@ -172,3 +172,49 @@ def test_worker_crash_and_recovery():
                 p.kill()
         if os.path.exists(flag):
             os.remove(flag)
+
+
+def test_recovery_register_during_startup_window():
+    """A recovery _REGISTER racing the initial registration window must NOT
+    consume a fresh rank (which would inflate the member count and desync
+    barriers): the scheduler parks it until startup membership completes,
+    then replays the address book with recovery=1."""
+    import socket
+    import threading
+
+    from mxnet_tpu.parallel.dist import (
+        Scheduler, _ADDRS, _REGISTER, _meta, _parse_meta, _recv_frame,
+        _send_frame)
+
+    sched = Scheduler(0, num_workers=1, num_servers=1)
+    port = sched.sock.getsockname()[1]
+    t = threading.Thread(target=sched.serve_forever, daemon=True)
+    t.start()
+
+    def reg(meta):
+        c = socket.create_connection(("127.0.0.1", port), timeout=30)
+        _send_frame(c, _REGISTER, meta)
+        return c
+
+    # recovery register arrives FIRST, before any startup registration
+    rec = reg(_meta(role="worker", host="", port=0, recover=0))
+    srv = reg(_meta(role="server", host="127.0.0.1", port=12345))
+    wrk = reg(_meta(role="worker", host="", port=0))
+
+    # the fresh worker must still get rank 0 (the recovery didn't steal it)
+    cmd, meta, _ = _recv_frame(wrk)
+    assert cmd == _ADDRS
+    info = _parse_meta(meta)
+    assert info["rank"] == 0 and "recovery" not in info, info
+    cmd, meta, _ = _recv_frame(srv)
+    assert _parse_meta(meta)["rank"] == 0
+
+    # the parked recovery is then served its address book, recovery-tagged
+    cmd, meta, _ = _recv_frame(rec)
+    assert cmd == _ADDRS
+    info = _parse_meta(meta)
+    assert info["rank"] == 0 and info.get("recovery") == 1, info
+
+    for c in (rec, srv, wrk):
+        c.close()
+    sched.sock.close()

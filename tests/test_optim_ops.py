@@ -15,26 +15,46 @@ def _arrs(*shapes, seed=0):
 
 
 def _prep(w, g, wd, rescale, clip):
+    """Adam/RMSProp kernel preamble: wd folded BEFORE the clip."""
     g = rescale * g + wd * w
     if clip >= 0:
         g = np.clip(g, -clip, clip)
     return g
 
 
+def _prep_sgd(w, g, wd, rescale, clip):
+    """SGD-family kernel preamble (SGDKernel/SGDMomKernel/MP_SGD*):
+    only rescale*grad is clipped; wd*weight is added OUTSIDE the clip."""
+    g = rescale * g
+    if clip >= 0:
+        g = np.clip(g, -clip, clip)
+    return g + wd * w
+
+
 def test_sgd_update():
     w, g = _arrs((3, 4), (3, 4))
     out = mx.nd.sgd_update(mx.nd.array(w), mx.nd.array(g), lr=0.1, wd=0.01,
                            rescale_grad=0.5, clip_gradient=0.4)
-    exp = w - 0.1 * _prep(w, g, 0.01, 0.5, 0.4)
+    exp = w - 0.1 * _prep_sgd(w, g, 0.01, 0.5, 0.4)
     np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-6)
+    # the wd term must escape the clip: with saturating weights the two
+    # orderings disagree (the divergence the reference kernels define away)
+    wbig = (w * 100.0).astype(np.float32)
+    out2 = mx.nd.sgd_update(mx.nd.array(wbig), mx.nd.array(g), lr=0.1,
+                            wd=0.5, rescale_grad=0.5, clip_gradient=0.4)
+    exp2 = wbig - 0.1 * _prep_sgd(wbig, g, 0.5, 0.5, 0.4)
+    np.testing.assert_allclose(out2.asnumpy(), exp2, rtol=1e-6)
+    wrong = wbig - 0.1 * _prep(wbig, g, 0.5, 0.5, 0.4)
+    assert np.abs(out2.asnumpy() - wrong).max() > 1e-3
 
 
 def test_sgd_mom_update():
     w, g, m = _arrs((3, 4), (3, 4), (3, 4), seed=1)
     ow, om = mx.nd.sgd_mom_update(mx.nd.array(w), mx.nd.array(g),
                                   mx.nd.array(m), lr=0.1, momentum=0.9,
-                                  wd=0.01, rescale_grad=1.0)
-    gp = _prep(w, g, 0.01, 1.0, -1)
+                                  wd=0.01, rescale_grad=1.0,
+                                  clip_gradient=0.4)
+    gp = _prep_sgd(w, g, 0.01, 1.0, 0.4)
     em = 0.9 * m - 0.1 * gp
     np.testing.assert_allclose(om.asnumpy(), em, rtol=1e-6)
     np.testing.assert_allclose(ow.asnumpy(), w + em, rtol=1e-6)
@@ -63,8 +83,9 @@ def test_mp_sgd_mom_update():
     w16 = w32.astype(np.float16)
     ow, om, ow32 = mx.nd.mp_sgd_mom_update(
         mx.nd.array(w16, dtype="float16"), mx.nd.array(g), mx.nd.array(m),
-        mx.nd.array(w32), lr=0.1, momentum=0.9, wd=0.01)
-    gp = _prep(w32, g, 0.01, 1.0, -1)
+        mx.nd.array(w32), lr=0.1, momentum=0.9, wd=0.01,
+        clip_gradient=0.5)
+    gp = _prep_sgd(w32, g, 0.01, 1.0, 0.5)
     em = 0.9 * m - 0.1 * gp
     np.testing.assert_allclose(om.asnumpy(), em, rtol=1e-5)
     np.testing.assert_allclose(ow32.asnumpy(), w32 + em, rtol=1e-5)
