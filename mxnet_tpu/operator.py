@@ -110,13 +110,16 @@ def register(reg_name):
             in_dtypes = [jnp.dtype(x.dtype) for x in inputs]
             cop = prop.create_operator(None, in_shapes,
                                        [str(d) for d in in_dtypes])
-            dtype = inputs[0].dtype if inputs else jnp.float32
             # per-output dtypes come from the prop's infer_type (the part
             # of the CustomOpProp contract the reference uses to type the
             # graph, operator.py InferType); mixed in/out dtypes otherwise
-            # violate the pure_callback result contract
-            _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
-            out_dtypes = [jnp.dtype(d) for d in out_dtypes]
+            # violate the pure_callback result contract.  Zero-input ops
+            # have nothing to infer from: default float32, as before.
+            if inputs:
+                _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
+                out_dtypes = [jnp.dtype(d) for d in out_dtypes]
+            else:
+                out_dtypes = [jnp.dtype(jnp.float32)] * len(out_shapes)
             out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
                               for s, d in zip(out_shapes, out_dtypes))
             in_specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)

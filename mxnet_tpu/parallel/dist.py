@@ -196,11 +196,15 @@ class Scheduler:
             assert cmd == _REGISTER
             info = _parse_meta(meta)
             if int(info.get("recover", -1)) >= 0:
-                # a rejoining worker racing the startup window must NOT be
+                # a rejoining WORKER racing the startup window must NOT be
                 # assigned a fresh rank (it would inflate the member count
                 # and desync barrier accounting): park it until the
-                # original membership is fully registered
-                pending_recovery.append((conn, info))
+                # original membership is fully registered.  Same guard as
+                # _accept_recovery: only workers recover.
+                if info.get("role") == "worker":
+                    pending_recovery.append((conn, info))
+                else:
+                    conn.close()
                 continue
             role = info["role"]
             with self._lock:

@@ -378,7 +378,12 @@ class Symbol:
         return self.bind(ctx, kwargs).forward()
 
     def grad(self, wrt):
-        raise NotImplementedError("Use Executor.backward (reference symbol.grad is deprecated)")
+        raise NotImplementedError(
+            "Symbol.grad is deprecated (matching the reference). Bind with "
+            "gradients enabled instead: exe = sym.bind(ctx, args, "
+            "args_grad={...}, grad_req='write') or sym.simple_bind(ctx, "
+            "grad_req='write'), then exe.backward(); gradients land in "
+            "exe.grad_dict / exe.grad_arrays.")
 
 
 # ----------------------------------------------------------------------

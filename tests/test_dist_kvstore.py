@@ -218,3 +218,42 @@ def test_recovery_register_during_startup_window():
     for c in (rec, srv, wrk):
         c.close()
     sched.sock.close()
+
+
+def test_launcher_mpi_mode(tmp_path):
+    """--launcher mpi maps role sets onto mpirun (reference tools/launch.py
+    --launcher mpi -> dmlc_tracker/mpi.py).  A shim mpirun (no MPI install
+    here) validates the exact contract: -n counts, --hostfile passthrough,
+    OpenMPI -x K=V env forwarding — then runs the ranks locally, and the
+    full dist_sync job must converge through it."""
+    shim = tmp_path / "mpirun"
+    shim.write_text("""#!/usr/bin/env python3
+import os, subprocess, sys
+args = sys.argv[1:]
+n = None; env = dict(os.environ); cmd = []; i = 0
+while i < len(args):
+    if args[i] == "-n":
+        n = int(args[i + 1]); i += 2
+    elif args[i] == "--hostfile":
+        i += 2
+    elif args[i] == "-x":
+        k, _, v = args[i + 1].partition("="); env[k] = v; i += 2
+    else:
+        cmd = args[i:]; break
+assert n and cmd, (n, cmd)
+procs = [subprocess.Popen(cmd, env=env) for _ in range(n)]
+sys.exit(max(p.wait() for p in procs))
+""")
+    shim.chmod(0o755)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MXTPU_MPIRUN"] = str(shim)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "--launcher", "mpi",
+         sys.executable, os.path.join(REPO, "tests", "dist_check_script.py")],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("DIST_OK") == 2, proc.stdout + proc.stderr

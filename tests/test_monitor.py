@@ -1,0 +1,59 @@
+"""Monitor parity (reference python/mxnet/monitor.py:16-126): per-op
+output stats via the executor callback, plus arg AND aux arrays in toc()
+— BN running stats are exactly what one monitors while debugging."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _bn_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=3, name="fc2"), name="softmax")
+
+
+def test_monitor_reports_args_and_aux():
+    mon = mx.monitor.Monitor(interval=1, pattern=".*")
+    mod = mx.mod.Module(_bn_net(), context=mx.cpu())
+    it = mx.io.NDArrayIter(np.random.randn(32, 10).astype(np.float32),
+                           np.zeros(32, np.float32), batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.install_monitor(mon)
+
+    mon.tic()
+    mod.forward(next(it), is_train=True)
+    mod.backward()
+    rows = mon.toc()
+    names = [k for (_, k, _) in rows]
+    # weights are reported...
+    assert any("fc1_weight" in n for n in names), names
+    # ...and so are the BN auxiliary running stats (reference
+    # monitor.py:95-102 iterates aux_arrays too)
+    assert any("bn1_moving_mean" in n for n in names), names
+    assert any("bn1_moving_var" in n for n in names), names
+
+
+def test_monitor_interval_and_pattern():
+    mon = mx.monitor.Monitor(interval=2, pattern=".*moving.*", sort=True)
+    mod = mx.mod.Module(_bn_net(), context=mx.cpu())
+    it = mx.io.NDArrayIter(np.random.randn(32, 10).astype(np.float32),
+                           np.zeros(32, np.float32), batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.install_monitor(mon)
+
+    it.reset()
+    batch = next(it)
+    mon.tic()                       # step 0: active
+    mod.forward(batch, is_train=True)
+    rows0 = mon.toc()
+    assert rows0 and all("moving" in k for (_, k, _) in rows0), rows0
+    assert [k for (_, k, _) in rows0] == sorted(k for (_, k, _) in rows0)
+
+    mon.tic()                       # step 1: inactive (interval=2)
+    mod.forward(batch, is_train=True)
+    assert mon.toc() == []

@@ -10,6 +10,13 @@ n workers + s servers + scheduler all on localhost):
 SSH mode launches the same role set across hosts from a hostfile:
 
     python tools/launch.py -n 4 -s 4 -H hosts --launcher ssh python train.py
+
+MPI mode delegates process placement to mpirun (parity: reference
+tools/launch.py --launcher mpi -> dmlc_tracker/mpi.py): the scheduler
+runs locally, then one mpirun per role set carries the cluster env via
+OpenMPI -x (or MPICH -genv with --mpi-flavor mpich):
+
+    python tools/launch.py -n 4 -s 2 -H hosts --launcher mpi python train.py
 """
 from __future__ import annotations
 
@@ -33,9 +40,13 @@ def main():
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("-s", "--num-servers", type=int, default=None)
     parser.add_argument("-H", "--hostfile", type=str, default=None)
-    parser.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    parser.add_argument("--launcher", choices=["local", "ssh", "mpi"],
+                        default="local")
     parser.add_argument("--sync-dst-dir", type=str, default=None,
                         help="(ssh) rsync working dir to this path on each host")
+    parser.add_argument("--mpi-flavor", choices=["openmpi", "mpich"],
+                        default="openmpi",
+                        help="(mpi) env-forwarding syntax: -x vs -genv")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if args.num_servers is None:
@@ -77,6 +88,49 @@ def main():
         for p in workers:
             rc |= p.wait()
         for p in procs:
+            p.terminate()
+        sys.exit(rc)
+
+    if args.launcher == "mpi":
+        # scheduler local; one mpirun per role set (reference
+        # dmlc_tracker/mpi.py submit(): separate worker/server launches,
+        # env forwarded per MPI flavor).  MXTPU_MPIRUN overrides the
+        # binary so tests can shim it without an MPI install.
+        mpirun = os.environ.get("MXTPU_MPIRUN", "mpirun")
+        base_env["DMLC_PS_ROOT_URI"] = socket.gethostbyname(
+            socket.gethostname())
+        sched_env = dict(os.environ)
+        sched_env.update(base_env)
+        sched_env["DMLC_ROLE"] = "scheduler"
+        sched = subprocess.Popen(
+            [sys.executable, "-c",
+             "import mxnet_tpu.kvstore_server as s; s.init_server_module()"],
+            env=sched_env)
+
+        def mpi_cmd(role, n, cmd):
+            argv = [mpirun, "-n", str(n)]
+            if args.hostfile:
+                # OpenMPI's mpirun takes --hostfile; MPICH's Hydra takes -f
+                flag = "--hostfile" if args.mpi_flavor == "openmpi" else "-f"
+                argv += [flag, args.hostfile]
+            env = dict(base_env)
+            env["DMLC_ROLE"] = role
+            if args.mpi_flavor == "openmpi":
+                for k, v in env.items():
+                    argv += ["-x", "%s=%s" % (k, v)]
+            else:
+                for k, v in env.items():
+                    argv += ["-genv", k, v]
+            return argv + cmd
+
+        server_cmd = [sys.executable, "-c",
+                      "import mxnet_tpu.kvstore_server as s; s.init_server_module()"]
+        servers = subprocess.Popen(
+            mpi_cmd("server", args.num_servers, server_cmd))
+        workers = subprocess.Popen(
+            mpi_cmd("worker", args.num_workers, args.command))
+        rc = workers.wait()
+        for p in (servers, sched):
             p.terminate()
         sys.exit(rc)
 
