@@ -4,4 +4,5 @@ from .module import Module
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule, PythonLossModule
+from .pipeline_module import PipelineModule
 from .executor_group import DataParallelExecutorGroup

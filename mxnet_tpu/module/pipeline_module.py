@@ -1,0 +1,557 @@
+"""PipelineModule — pipeline parallelism as a first-class Module.
+
+The reference drives model parallelism from the user API: an ordinary
+model file annotates layers and `bind(group2ctx=...)` places them
+(example/model-parallel-lstm/lstm.py:48-112,186-205).  PipelineModule
+meets that bar for microbatch pipelining: the user writes each stage as
+an ordinary `mx.sym` graph and trains with `Module.fit` — no raw JAX.
+
+    def stage(i):
+        x = mx.sym.Variable('data')           # stage input boundary
+        x = mx.sym.FullyConnected(x, num_hidden=128, name='fc%d' % i)
+        x = mx.sym.Activation(x, act_type='relu')
+        if i == num_stages - 1:
+            x = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+                x, num_hidden=10, name='head'), name='softmax')
+        return x
+
+    mod = mx.mod.PipelineModule(stage, num_stages=4, num_microbatches=8,
+                                mesh=make_mesh({'data': 2, 'pipe': 4}),
+                                schedule='1f1b')
+    mod.fit(train_iter, num_epoch=5, optimizer='sgd')
+
+Stages are HETEROGENEOUS (each owns its parameter tree; embedding/head
+layers live inside the pipe), scheduled by parallel/pipeline_schedule
+(GPipe or 1F1B tables executed as one lax.scan under shard_map, with
+ppermute neighbor traffic over the 'pipe' axis and lax.switch stage
+dispatch).  Composes with data parallelism when the mesh carries a
+'data' axis.  BucketingModule is the precedent for a Module owning a
+symbol factory (reference bucketing_module.py:18-120).
+
+Contract:
+  * every stage reads its input from the Variable named `data_names[0]`;
+    stage 0's is the batch, later ones the previous stage's output[0]
+  * label variables (`label_names`) may appear in any stage (typically
+    the last, for SoftmaxOutput-style heads)
+  * stages must not carry auxiliary states (BatchNorm running stats are
+    microbatch-order-dependent inside a pipeline; use LayerNorm or
+    InstanceNorm in pipelined blocks — the standard pipeline recipe)
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..executor import _run_graph
+from ..initializer import InitDesc, Uniform
+from ..ndarray import NDArray
+from ..symbol import Group, _topo_order
+from ..parallel.collectives import shard_map
+from ..parallel.mesh import NamedSharding, P
+from ..parallel.pipeline_schedule import make_schedule, run_forward, run_schedule
+from .base_module import BaseModule
+
+__all__ = ["PipelineModule"]
+
+
+class _Stage:
+    """Parsed per-stage graph + flat-buffer layout."""
+
+    def __init__(self, index, symbol):
+        self.index = index
+        self.symbol = symbol
+        self.entries = symbol._entries
+        self.order = _topo_order(symbol._entries)
+        self.arg_names = symbol.list_arguments()
+        self.output_names = symbol.list_outputs()
+        if symbol.list_auxiliary_states():
+            raise MXNetError(
+                "pipeline stage %d carries auxiliary states %s: BatchNorm "
+                "running statistics are microbatch-order-dependent inside a "
+                "pipeline schedule; use LayerNorm/InstanceNorm in pipelined "
+                "blocks" % (index, symbol.list_auxiliary_states()))
+        self.param_names = None   # set at bind
+        self.layout = None        # name -> (offset, size, shape, dtype)
+        self.size = 0
+        self.in_shape = None
+        self.in_size = 0
+        self.out_shapes = None
+        self.out_layout = None    # [(offset, size, shape)] per output
+        self.out_size = 0
+
+
+class PipelineModule(BaseModule):
+    """Pipeline-parallel module over a 'pipe' mesh axis (see module doc)."""
+
+    def __init__(self, sym_gen, num_stages, num_microbatches, mesh,
+                 data_names=("data",), label_names=("softmax_label",),
+                 pipe_axis="pipe", schedule="1f1b", compute_dtype=None,
+                 logger=logging):
+        super().__init__(logger=logger)
+        if callable(sym_gen):
+            stages = [sym_gen(i) for i in range(num_stages)]
+        else:
+            stages = list(sym_gen)
+            assert len(stages) == num_stages
+        self._stages = [_Stage(i, s) for i, s in enumerate(stages)]
+        self._num_stages = int(num_stages)
+        self._num_microbatches = int(num_microbatches)
+        self._mesh = mesh
+        if pipe_axis not in mesh.axis_names:
+            raise MXNetError("mesh has no %r axis (axes: %s)"
+                             % (pipe_axis, mesh.axis_names))
+        if mesh.shape[pipe_axis] != num_stages:
+            raise MXNetError("mesh %r axis has %d devices but num_stages=%d"
+                             % (pipe_axis, mesh.shape[pipe_axis], num_stages))
+        self._pipe_axis = pipe_axis
+        self._data_axis = "data" if "data" in mesh.axis_names else None
+        self._dp = mesh.shape[self._data_axis] if self._data_axis else 1
+        if len(data_names) != 1:
+            raise MXNetError("PipelineModule supports exactly one data input")
+        if len(label_names) > 1:
+            raise MXNetError("PipelineModule supports at most one label")
+        self._data_names = list(data_names)
+        self._label_names = list(label_names)
+        self._schedule_kind = schedule
+        self._sched = make_schedule(num_stages, num_microbatches, schedule)
+        self._compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+        self._optimizer = None
+        self._buffer = None
+        self._opt_state = ()
+        self._train_jit = None
+        self._eval_jit = None
+        self._outputs_cache = None
+        self._pending_batch = None
+        self._prefix_names = False
+        self._base_seed = int(_np.random.randint(0, 2 ** 31))
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def symbol(self):
+        return Group([s.symbol for s in self._stages])
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._stages[-1].output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        last = self._stages[-1]
+        gshapes = [(self._batch,) + tuple(o[1:]) for o in last.out_shapes]
+        return list(zip(last.output_names, gshapes))
+
+    @property
+    def schedule_stats(self):
+        """Simulator stats for the active schedule (bubble fraction,
+        stash slots) — the measurable GPipe-vs-1F1B trade."""
+        return dict(self._sched.stats)
+
+    def _pname(self, stage, name):
+        return ("stage%d.%s" % (stage, name)) if self._prefix_names else name
+
+    # ------------------------------------------------------------------
+    # bind: chain per-stage shape inference, build the flat layouts
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if force_rebind:
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        assert shared_module is None and not inputs_need_grad
+        self.for_training = for_training
+        self.inputs_need_grad = False
+        self._data_shapes = [s if isinstance(s, tuple) else tuple(s)
+                             for s in data_shapes]
+        self._label_shapes = list(label_shapes) if label_shapes else None
+        name, dshape = self._data_shapes[0][0], tuple(self._data_shapes[0][1])
+        assert name == self._data_names[0]
+        B = dshape[0]
+        M, D = self._num_microbatches, self._dp
+        if B % (M * D) != 0:
+            raise MXNetError(
+                "batch %d not divisible by num_microbatches*data_parallel "
+                "= %d*%d" % (B, M, D))
+        self._batch = B
+        self._rows = B // (M * D)              # per-device microbatch rows
+        self._mb_rows_global = B // M
+        lab_shape = None
+        if self._label_shapes:
+            ls = tuple(self._label_shapes[0][1])
+            lab_shape = (self._rows,) + tuple(ls[1:])
+        self._label_mb_shape = lab_shape
+
+        in_shape = (self._rows,) + dshape[1:]
+        inputs = set(self._data_names) | set(self._label_names)
+        seen = {}
+        collide = False
+        for st in self._stages:
+            st.in_shape = in_shape
+            st.in_size = int(_np.prod(in_shape))
+            kwargs = {self._data_names[0]: in_shape}
+            for ln in self._label_names:
+                if ln in st.arg_names and lab_shape is not None:
+                    kwargs[ln] = lab_shape
+            arg_shapes, out_shapes, _ = st.symbol.infer_shape(**kwargs)
+            st.param_names = [n for n in st.arg_names if n not in inputs]
+            shapes = dict(zip(st.arg_names, arg_shapes))
+            off = 0
+            st.layout = {}
+            for n in st.param_names:
+                shp = tuple(shapes[n])
+                sz = int(_np.prod(shp)) if shp else 1
+                st.layout[n] = (off, sz, shp, jnp.float32)
+                off += sz
+                if n in seen:
+                    collide = True
+                seen[n] = st.index
+            st.size = off
+            st.out_shapes = [tuple(s) for s in out_shapes]
+            off = 0
+            st.out_layout = []
+            for shp in st.out_shapes:
+                sz = int(_np.prod(shp))
+                st.out_layout.append((off, sz, shp))
+                off += sz
+            st.out_size = off
+            in_shape = st.out_shapes[0]
+        self._prefix_names = collide
+        self._psize = max(st.size for st in self._stages)
+        self._bmax = max([st.in_size for st in self._stages] +
+                         [st.out_size for st in self._stages])
+        sharding = NamedSharding(self._mesh, P(self._pipe_axis))
+        self._buffer = jax.device_put(
+            jnp.zeros((self._num_stages, self._psize), jnp.float32), sharding)
+        self._buf_sharding = sharding
+        self.binded = True
+        self._train_jit = None
+        self._eval_jit = None
+
+    # ------------------------------------------------------------------
+    # params
+    # ------------------------------------------------------------------
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        if self.params_initialized:
+            # a partial update (allow_missing set_params) must KEEP the
+            # current values of absent keys, matching Module semantics
+            buf = _np.asarray(jax.device_get(self._buffer)).copy()
+        else:
+            buf = _np.zeros((self._num_stages, self._psize), _np.float32)
+        for st in self._stages:
+            attrs = st.symbol.attr_dict()
+            for n in st.param_names:
+                off, sz, shp, _ = st.layout[n]
+                key = self._pname(st.index, n)
+                if arg_params and key in arg_params:
+                    val = arg_params[key].asnumpy()
+                elif arg_params is not None and not allow_missing:
+                    raise RuntimeError("%s is not presented" % key)
+                elif initializer is not None:
+                    arr = NDArray(jnp.zeros(shp, jnp.float32))
+                    initializer(InitDesc(n, attrs.get(n, None) or {}), arr)
+                    val = arr.asnumpy()
+                else:
+                    continue  # missing + no initializer: keep current value
+                buf[st.index, off:off + sz] = val.reshape(-1)
+        self._buffer = jax.device_put(jnp.asarray(buf), self._buf_sharding)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        buf = _np.asarray(jax.device_get(self._buffer))
+        args = {}
+        for st in self._stages:
+            for n in st.param_names:
+                off, sz, shp, _ = st.layout[n]
+                args[self._pname(st.index, n)] = NDArray(
+                    jnp.asarray(buf[st.index, off:off + sz].reshape(shp)))
+        return args, {}
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    # ------------------------------------------------------------------
+    # optimizer: one fused elementwise update on the stacked buffer, with
+    # name-derived lr/wd multiplier masks so per-param lr_mult/wd_mult
+    # semantics (bias/gamma wd exemption) survive the flat packing
+    # ------------------------------------------------------------------
+    def init_optimizer(self, kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if kvstore not in (None, "local"):
+            raise MXNetError(
+                "PipelineModule handles gradient reduction inside the SPMD "
+                "step (psum over the 'data' mesh axis); kvstore=%r is not "
+                "supported — use multihost.initialize for DCN scale-out"
+                % (kvstore,))
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params)
+            params.setdefault("rescale_grad", 1.0 / self._batch)
+            idx2name = {}
+            for st in self._stages:
+                for n in st.param_names:
+                    key = self._pname(st.index, n)
+                    idx2name[key] = key
+            # sym=Group(stages) so __lr_mult__/__wd_mult__ layer attrs are
+            # honored exactly as Module honors them (module.py init_optimizer)
+            optimizer = opt_mod.create(optimizer, sym=self.symbol,
+                                       param_idx2name=idx2name, **params)
+        if optimizer._fused is None:
+            raise MXNetError(
+                "optimizer %s has no fused kernel; PipelineModule requires "
+                "one (state updates run on the stacked sharded buffer)"
+                % type(optimizer).__name__)
+        self._optimizer = optimizer
+        lr_mask = _np.ones((self._num_stages, self._psize), _np.float32)
+        wd_mask = _np.ones((self._num_stages, self._psize), _np.float32)
+        for st in self._stages:
+            for n in st.param_names:
+                off, sz, _, _ = st.layout[n]
+                key = self._pname(st.index, n)
+                lr_mask[st.index, off:off + sz] = optimizer.lr_mult.get(
+                    key, optimizer.lr_mult.get(n, 1.0))
+                wd_mask[st.index, off:off + sz] = optimizer.wd_mult.get(
+                    key, optimizer.wd_mult.get(n, 1.0))
+            # padding tail: no decay, no lr — stays exactly zero
+            lr_mask[st.index, st.size:] = 0.0
+            wd_mask[st.index, st.size:] = 0.0
+        self._lr_mask = jax.device_put(jnp.asarray(lr_mask),
+                                       self._buf_sharding)
+        self._wd_mask = jax.device_put(jnp.asarray(wd_mask),
+                                       self._buf_sharding)
+        state = optimizer.create_state(
+            "__pipeline__", NDArray(jnp.zeros_like(self._buffer)))
+        leaves = opt_mod._state_leaves(state)
+        self._opt_state = tuple(
+            jax.device_put(l.data, self._buf_sharding) for l in leaves)
+        self.optimizer_initialized = True
+        self._train_jit = None
+
+    # ------------------------------------------------------------------
+    # branch builders: Symbol graph -> flat-buffer stage function
+    # ------------------------------------------------------------------
+    def _cast_spec(self):
+        if self._compute_dtype is None:
+            return None
+        return (self._compute_dtype, frozenset(self._label_names))
+
+    def _make_branch(self, i, is_train):
+        st = self._stages[i]
+        in_name = self._data_names[0]
+        label_set = set(self._label_names)
+        last = i == self._num_stages - 1
+        cast = self._cast_spec()
+        bmax = self._bmax
+
+        def branch(params_row, x_flat, label_mb, rng):
+            vals = []
+            for n in st.arg_names:
+                if n == in_name:
+                    vals.append(x_flat[:st.in_size].reshape(st.in_shape))
+                elif n in label_set:
+                    vals.append(label_mb)
+                else:
+                    off, sz, shp, dt = st.layout[n]
+                    vals.append(params_row[off:off + sz].reshape(shp))
+            with jax.named_scope("pipe_stage_%d" % i):
+                outs, _ = _run_graph(st.entries, st.order, st.arg_names, (),
+                                     tuple(vals), (), is_train, rng, cast=cast)
+            if last:
+                flat = jnp.concatenate(
+                    [o.reshape(-1).astype(jnp.float32) for o in outs])
+            else:
+                flat = outs[0].reshape(-1).astype(jnp.float32)
+            return jnp.zeros((bmax,), jnp.float32).at[:flat.shape[0]].set(flat)
+
+        return branch
+
+    def _mb_specs(self):
+        dax = self._data_axis
+        mb_spec = P(None, dax) if dax else P()
+        return mb_spec
+
+    def _split_host(self, data, label):
+        """[B, ...] -> [M, rows_global, ...] microbatch-major."""
+        M = self._num_microbatches
+        d = data.data if isinstance(data, NDArray) else jnp.asarray(data)
+        d = d.reshape((M, self._mb_rows_global) + d.shape[1:])
+        if label is not None:
+            l = label.data if isinstance(label, NDArray) else jnp.asarray(label)
+            l = l.reshape((M, self._mb_rows_global) + l.shape[1:])
+        else:
+            l = jnp.zeros((M, self._mb_rows_global), jnp.float32)
+        return d, l
+
+    def _assemble(self, outbuf):
+        """[M, D*bmax] global flat pipeline output -> per-output arrays."""
+        M, D, rows = self._num_microbatches, self._dp, self._rows
+        last = self._stages[-1]
+        out3 = outbuf.reshape(M, D, self._bmax)
+        res = []
+        for off, sz, shp in last.out_layout:
+            o = out3[:, :, off:off + sz].reshape((M, D, rows) + tuple(shp[1:]))
+            res.append(o.reshape((self._batch,) + tuple(shp[1:])))
+        return res
+
+    def _build_engine(self, is_train):
+        branches = [self._make_branch(i, is_train) for i in
+                    range(self._num_stages)]
+        sched = self._sched
+        S, M = self._num_stages, self._num_microbatches
+        bmax, dax, pipe = self._bmax, self._data_axis, self._pipe_axis
+        mesh = self._mesh
+        mb_spec = self._mb_specs()
+
+        def engine(buf, mbs, labels, seed):
+            params_row = buf[0]
+            rng = jax.random.key(seed[0])
+            mb_flat = mbs.reshape(M, -1).astype(jnp.float32)
+            pad = bmax - mb_flat.shape[1]
+            if pad:
+                mb_flat = jnp.pad(mb_flat, ((0, 0), (0, pad)))
+            if is_train:
+                out, pgrad = run_schedule(sched, branches, params_row,
+                                          mb_flat, labels, rng, pipe)
+                if dax:
+                    pgrad = lax.psum(pgrad, dax)
+                return out, pgrad[None]
+            out = run_forward(S, M, branches, params_row, mb_flat, labels,
+                              rng, pipe)
+            return out, buf * 0.0    # grads unused on the eval path
+
+        return shard_map(
+            engine, mesh=mesh,
+            in_specs=(P(pipe), mb_spec, mb_spec, P()),
+            out_specs=(mb_spec, P(pipe)),
+            check_vma=False)
+
+    def _get_train_jit(self):
+        if self._train_jit is None:
+            smapped = self._build_engine(True)
+            opt = self._optimizer
+            lr_mask, wd_mask = self._lr_mask, self._wd_mask
+
+            def step(buf, states, mbs, labels, seed, lr0, wd0, t):
+                out, pgrad = smapped(buf, mbs, labels, seed)
+                nw, nst = opt._fused(buf, pgrad, states, lr0 * lr_mask,
+                                     wd0 * wd_mask, t)
+                return tuple(self._assemble(out)), nw, tuple(nst)
+
+            self._train_jit = jax.jit(step, donate_argnums=(0, 1))
+        return self._train_jit
+
+    def _get_eval_jit(self):
+        if self._eval_jit is None:
+            smapped = self._build_engine(False)
+
+            def step(buf, mbs, labels, seed):
+                out, _ = smapped(buf, mbs, labels, seed)
+                return tuple(self._assemble(out))
+
+            self._eval_jit = jax.jit(step)
+        return self._eval_jit
+
+    # ------------------------------------------------------------------
+    # computation (BaseModule protocol)
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        if is_train:
+            # full step runs in update() — one dispatch for the whole
+            # schedule + optimizer, same shape as Module's fused path
+            self._pending_batch = data_batch
+            self._outputs_cache = None
+            return
+        data = data_batch.data[0]
+        label = data_batch.label[0] if data_batch.label else None
+        mbs, labs = self._split_host(data, label)
+        seed = jnp.asarray([self._next_seed()], jnp.uint32)
+        outs = self._get_eval_jit()(self._buffer, mbs, labs, seed)
+        self._outputs_cache = [NDArray(o) for o in outs]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, \
+            "PipelineModule computes gradients inside its schedule"
+
+    def _next_seed(self):
+        self._step_count += 1
+        return (self._base_seed + self._step_count) % (2 ** 31)
+
+    def update(self):
+        assert self.optimizer_initialized and self._pending_batch is not None
+        batch = self._pending_batch
+        self._pending_batch = None
+        data = batch.data[0]
+        label = batch.label[0] if batch.label else None
+        mbs, labs = self._split_host(data, label)
+        opt = self._optimizer
+        opt._update_count("__pipeline__")
+        t = opt._index_update_count["__pipeline__"]
+        lr0 = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler else opt.lr
+        seed = jnp.asarray([self._next_seed()], jnp.uint32)
+        outs, nbuf, nstates = self._get_train_jit()(
+            self._buffer, self._opt_state, mbs, labs, seed,
+            jnp.float32(lr0), jnp.float32(opt.wd), jnp.uint32(t))
+        self._buffer = nbuf
+        self._opt_state = nstates
+        self._outputs_cache = [NDArray(o) for o in outs]
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self._outputs_cache is not None, \
+            "no outputs: run forward (eval) or update (train) first"
+        return self._outputs_cache
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def get_input_grads(self, merge_multi_context=True):
+        raise NotImplementedError(
+            "input gradients do not cross the pipeline boundary")
+
+    def install_monitor(self, mon):
+        self.logger.warning(
+            "Monitor is not supported inside the pipeline schedule; use "
+            "mx.profiler for per-stage timing")
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint as _save
+        args, auxs = self.get_params()
+        _save(prefix, epoch, self.symbol, args, auxs)

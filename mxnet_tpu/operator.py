@@ -113,12 +113,15 @@ def register(reg_name):
             # per-output dtypes come from the prop's infer_type (the part
             # of the CustomOpProp contract the reference uses to type the
             # graph, operator.py InferType); mixed in/out dtypes otherwise
-            # violate the pure_callback result contract.  Zero-input ops
-            # have nothing to infer from: default float32, as before.
-            if inputs:
+            # violate the pure_callback result contract.  A zero-input op
+            # whose DEFAULT infer_type raises (it indexes in_type[0]) falls
+            # back to float32; an overridden infer_type still decides.
+            try:
                 _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
                 out_dtypes = [jnp.dtype(d) for d in out_dtypes]
-            else:
+            except IndexError:
+                if inputs:
+                    raise
                 out_dtypes = [jnp.dtype(jnp.float32)] * len(out_shapes)
             out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
                               for s, d in zip(out_shapes, out_dtypes))

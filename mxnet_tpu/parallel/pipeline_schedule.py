@@ -1,0 +1,320 @@
+"""Static pipeline schedules (GPipe / 1F1B) + the lockstep SPMD engine.
+
+The reference's pipeline story is manual layer placement over devices
+(example/model-parallel-lstm/lstm.py:48-205 assigns cells to contexts);
+this module is the TPU-first generalization: microbatch pipeline
+schedules executed in SPMD lockstep over a 'pipe' mesh axis.
+
+Design: a schedule is COMPILED ON THE HOST by a tiny discrete-event
+simulator into static integer tables (one action per stage per step),
+and a single `lax.scan` executes the tables on device — `lax.switch`
+dispatches the per-stage computation (so stages may be HETEROGENEOUS),
+`lax.ppermute` moves boundary activations right and gradients left one
+hop per step (neighbor traffic: rides ICI on a TPU torus).  Backward is
+hand-scheduled, not left to AD: the B action recomputes its stage from a
+stashed input and applies the stage VJP, so the activation stash is the
+schedule's working set — bounded by the 1F1B in-flight cap instead of
+growing with the microbatch count.
+
+Two schedules ship:
+  * 'gpipe' — all forwards, then all backwards (stash grows ~ M).
+  * '1f1b'  — backward-first with per-stage in-flight cap S-s
+    (PipeDream-flush); stash bounded by the pipeline depth.
+In lockstep SPMD both have the same bubble fraction ((S-1)/(M+S-1) per
+phase — a device idles only while the wavefront passes); 1F1B's win
+here is MEMORY, and `Schedule.stats` reports both so the trade is
+measurable (see tests/test_pipeline_module.py).
+
+Boundary values travel as flat fixed-size buffers (padded to the max
+boundary size across stages) so heterogeneous stage boundaries fit one
+ppermute channel; padding regions are zeros and their cotangents vanish
+through the `.at[].set` in each stage wrapper.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["Schedule", "make_schedule", "run_schedule", "run_forward"]
+
+
+class _Pool:
+    """Slot allocator: lowest free slot, tracking the high-water mark."""
+
+    def __init__(self):
+        self.free = []
+        self.next = 0
+        self.high = 0
+
+    def alloc(self):
+        if self.free:
+            return self.free.pop(0)
+        slot = self.next
+        self.next += 1
+        self.high = max(self.high, self.next)
+        return slot
+
+    def release(self, slot):
+        self.free.append(slot)
+        self.free.sort()
+
+
+class Schedule:
+    """Static tables [T, S] driving the lockstep engine.
+
+    act:      0 noop, 1 forward, 2 backward
+    mb:       microbatch index of the action (0 when noop)
+    stash_w/r: activation-stash slot written by F / read by B
+    xin_r:    x-ring slot holding this F's input (-1: stage 0, inject)
+    gin_r:    g-ring slot holding this B's cotangent (-1: last stage, ones)
+    xrecv_w:  x-ring slot where this step's incoming boundary lands (-1: none)
+    grecv_w:  g-ring slot where this step's incoming gradient lands (-1: none)
+    """
+
+    def __init__(self, kind, num_stages, num_microbatches, tables, sizes, stats):
+        self.kind = kind
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        for k, v in tables.items():
+            setattr(self, k, v)
+        self.n_stash, self.n_xring, self.n_gring = sizes
+        self.stats = stats
+        self.num_steps = self.act.shape[0]
+
+
+def make_schedule(num_stages, num_microbatches, kind="1f1b"):
+    """Simulate the schedule and emit its static tables.
+
+    One work slot per stage per step (a stage is one compute unit: it
+    runs either a forward or a backward, mirroring how both occupy the
+    stage's chip time); messages produced at step t are consumable from
+    step t+1 (the engine's ppermute delivers at end-of-step)."""
+    S, M = int(num_stages), int(num_microbatches)
+    assert S >= 1 and M >= 1
+    if kind not in ("gpipe", "1f1b"):
+        raise ValueError("unknown pipeline schedule %r" % kind)
+
+    nf = [0] * S                      # next microbatch each stage forwards
+    inflight = [0] * S
+    x_avail = [dict() for _ in range(S)]   # stage -> {m: first consumable t}
+    g_avail = [dict() for _ in range(S)]
+    f_done = [set() for _ in range(S)]
+    b_done = [set() for _ in range(S)]
+    for m in range(M):
+        x_avail[0][m] = 0
+    cap = [(S - s) if kind == "1f1b" else M for s in range(S)]
+    prefer_b = kind == "1f1b"
+
+    stash = [_Pool() for _ in range(S)]
+    xring = [_Pool() for _ in range(S)]
+    gring = [_Pool() for _ in range(S)]
+    stash_slot = [dict() for _ in range(S)]   # m -> slot
+    xring_slot = [dict() for _ in range(S)]
+    gring_slot = [dict() for _ in range(S)]
+
+    cols = ("act", "mb", "stash_w", "stash_r", "xin_r", "gin_r",
+            "xrecv_w", "grecv_w")
+    rows = []
+    t = 0
+    limit = 6 * (M + S) + 16
+    while not all(len(b_done[s]) == M for s in range(S)):
+        assert t < limit, "pipeline schedule simulation did not terminate"
+        row = {c: [0 if c in ("act", "mb") else -1] * S for c in cols}
+        acts = []
+        for s in range(S):
+            bm = None
+            ready = [m for m, ta in g_avail[s].items()
+                     if ta <= t and m in f_done[s] and m not in b_done[s]]
+            if ready:
+                bm = min(ready)
+            fm = None
+            if nf[s] < M and inflight[s] < cap[s]:
+                m = nf[s]
+                if x_avail[s].get(m, limit + 1) <= t:
+                    fm = m
+            if prefer_b and bm is not None:
+                acts.append(("B", bm))
+            elif fm is not None:
+                acts.append(("F", fm))
+            elif bm is not None:
+                acts.append(("B", bm))
+            else:
+                acts.append((None, 0))
+        for s, (a, m) in enumerate(acts):
+            if a == "F":
+                nf[s] += 1
+                inflight[s] += 1
+                f_done[s].add(m)
+                row["act"][s] = 1
+                row["mb"][s] = m
+                slot = stash[s].alloc()
+                stash_slot[s][m] = slot
+                row["stash_w"][s] = slot
+                if s == 0:
+                    row["xin_r"][s] = -1
+                else:
+                    slot = xring_slot[s].pop(m)
+                    row["xin_r"][s] = slot
+                    xring[s].release(slot)
+                if s < S - 1:
+                    x_avail[s + 1][m] = t + 1
+                    slot = xring[s + 1].alloc()
+                    xring_slot[s + 1][m] = slot
+                    row["xrecv_w"][s + 1] = slot
+                else:
+                    g_avail[s][m] = t + 1    # head grads: self-ready
+            elif a == "B":
+                b_done[s].add(m)
+                inflight[s] -= 1
+                del g_avail[s][m]
+                row["act"][s] = 2
+                row["mb"][s] = m
+                slot = stash_slot[s].pop(m)
+                row["stash_r"][s] = slot
+                stash[s].release(slot)
+                if s == S - 1:
+                    row["gin_r"][s] = -1
+                else:
+                    slot = gring_slot[s].pop(m)
+                    row["gin_r"][s] = slot
+                    gring[s].release(slot)
+                if s > 0:
+                    g_avail[s - 1][m] = t + 1
+                    slot = gring[s - 1].alloc()
+                    gring_slot[s - 1][m] = slot
+                    row["grecv_w"][s - 1] = slot
+        rows.append(row)
+        t += 1
+
+    tables = {c: _np.asarray([r[c] for r in rows], dtype=_np.int32)
+              for c in cols}
+    n_stash = max(p.high for p in stash)
+    n_xring = max([p.high for p in xring] + [1])
+    n_gring = max([p.high for p in gring] + [1])
+    total = tables["act"].size
+    busy = int((tables["act"] != 0).sum())
+    stats = {
+        "num_steps": len(rows),
+        "bubble_fraction": 1.0 - busy / float(total),
+        "max_stash_slots": n_stash,
+        "per_stage_peak_stash": [p.high for p in stash],
+    }
+    return Schedule(kind, S, M, tables,
+                    (n_stash, max(n_xring, 1), max(n_gring, 1)), stats)
+
+
+def _perms(n):
+    fwd = [(i, i + 1) for i in range(n - 1)]
+    bwd = [(i + 1, i) for i in range(n - 1)]
+    return fwd, bwd
+
+
+def run_schedule(sched, branches, params_row, mb_flat, labels_mb, base_rng,
+                 axis_name="pipe"):
+    """Execute a Schedule inside `shard_map` over `axis_name`.
+
+    branches  : S fns (params_row, x_flat, label_mb, rng) -> y_flat, all
+                operating on [Bmax] flat boundary buffers (see module doc).
+    params_row: [P] — this device's stage parameters, flat.
+    mb_flat   : [M, Bmax] — flattened input microbatches (stage 0 injects).
+    labels_mb : [M, ...] — per-microbatch labels (consumed by stages whose
+                graphs have label arguments, typically the last).
+    Returns (outputs [M, Bmax] replicated along the axis, param_grad [P]).
+    """
+    S = sched.num_stages
+    M = sched.num_microbatches
+    s_idx = lax.axis_index(axis_name)
+    fwd_perm, bwd_perm = _perms(S)
+    tb = {c: jnp.asarray(getattr(sched, c)) for c in
+          ("act", "mb", "stash_w", "stash_r", "xin_r", "gin_r",
+           "xrecv_w", "grecv_w")}
+    bmax = mb_flat.shape[1]
+    zero_buf = jnp.zeros((bmax,), mb_flat.dtype)
+
+    def fwd_at(p, x, lab, rng):
+        return lax.switch(s_idx, branches, p, x, lab, rng)
+
+    def step(carry, t):
+        x_ring, g_ring, stash, pgrad, outbuf = carry
+        act = tb["act"][t, s_idx]
+        m = tb["mb"][t, s_idx]
+        lab = labels_mb[m]
+        # F and its B recompute MUST draw identical randomness (dropout
+        # masks must match across the recompute) — key off (microbatch,
+        # stage), never off the step index
+        rng = jax.random.fold_in(jax.random.fold_in(base_rng, m), s_idx)
+
+        def do_noop(x_ring, g_ring, stash, pgrad, outbuf):
+            return zero_buf, zero_buf, stash, pgrad, outbuf
+
+        def do_f(x_ring, g_ring, stash, pgrad, outbuf):
+            xr = tb["xin_r"][t, s_idx]
+            x_in = jnp.where(xr < 0, mb_flat[m], x_ring[jnp.maximum(xr, 0)])
+            y = fwd_at(params_row, x_in, lab, rng)
+            stash = stash.at[tb["stash_w"][t, s_idx]].set(x_in)
+            outbuf = jnp.where(s_idx == S - 1, outbuf.at[m].set(y), outbuf)
+            return y, zero_buf, stash, pgrad, outbuf
+
+        def do_b(x_ring, g_ring, stash, pgrad, outbuf):
+            x_in = stash[tb["stash_r"][t, s_idx]]
+            _, vjpf = jax.vjp(
+                lambda p, x: fwd_at(p, x, lab, rng), params_row, x_in)
+            gr = tb["gin_r"][t, s_idx]
+            g_in = jnp.where(gr < 0, jnp.ones_like(zero_buf),
+                             g_ring[jnp.maximum(gr, 0)])
+            dp, dx = vjpf(g_in)
+            return zero_buf, dx, stash, pgrad + dp, outbuf
+
+        send_x, send_g, stash, pgrad, outbuf = lax.switch(
+            act, (do_noop, do_f, do_b), x_ring, g_ring, stash, pgrad, outbuf)
+        x_in_flight = lax.ppermute(send_x, axis_name, fwd_perm)
+        g_in_flight = lax.ppermute(send_g, axis_name, bwd_perm)
+        xw = tb["xrecv_w"][t, s_idx]
+        x_ring = jnp.where(xw < 0, x_ring,
+                           x_ring.at[jnp.maximum(xw, 0)].set(x_in_flight))
+        gw = tb["grecv_w"][t, s_idx]
+        g_ring = jnp.where(gw < 0, g_ring,
+                           g_ring.at[jnp.maximum(gw, 0)].set(g_in_flight))
+        return (x_ring, g_ring, stash, pgrad, outbuf), None
+
+    carry0 = (
+        jnp.zeros((sched.n_xring, bmax), mb_flat.dtype),
+        jnp.zeros((sched.n_gring, bmax), mb_flat.dtype),
+        jnp.zeros((sched.n_stash, bmax), mb_flat.dtype),
+        jnp.zeros_like(params_row),
+        jnp.zeros((M, bmax), mb_flat.dtype),
+    )
+    (_, _, _, pgrad, outbuf), _ = lax.scan(
+        step, carry0, jnp.arange(sched.num_steps))
+    # only the last stage wrote outputs; psum replicates them along 'pipe'
+    outbuf = lax.psum(outbuf, axis_name)
+    return outbuf, pgrad
+
+
+def run_forward(num_stages, num_microbatches, branches, params_row, mb_flat,
+                labels_mb, base_rng, axis_name="pipe"):
+    """Forward-only pipeline (inference/eval): plain fill-and-drain shifts."""
+    S, M = num_stages, num_microbatches
+    s_idx = lax.axis_index(axis_name)
+    fwd_perm, _ = _perms(S)
+    ticks = M + S - 1
+
+    def tick(carry, t):
+        x_recv, outbuf = carry
+        m = jnp.clip(t - s_idx, 0, M - 1)
+        lab = labels_mb[m]
+        rng = jax.random.fold_in(jax.random.fold_in(base_rng, m), s_idx)
+        x_in = jnp.where(s_idx == 0, mb_flat[jnp.clip(t, 0, M - 1)], x_recv)
+        y = lax.switch(s_idx, branches, params_row, x_in, lab, rng)
+        write = (s_idx == S - 1) & (t >= S - 1)
+        outbuf = jnp.where(write, outbuf.at[jnp.clip(t - S + 1, 0, M - 1)].set(y),
+                           outbuf)
+        return (lax.ppermute(y, axis_name, fwd_perm), outbuf), None
+
+    carry0 = (jnp.zeros_like(mb_flat[0]),
+              jnp.zeros((M,) + mb_flat.shape[1:], mb_flat.dtype))
+    (_, outbuf), _ = lax.scan(tick, carry0, jnp.arange(ticks))
+    return lax.psum(outbuf, axis_name)

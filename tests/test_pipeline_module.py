@@ -1,0 +1,207 @@
+"""PipelineModule — pipeline parallelism from the Symbol/Module user API
+(reference bar: example/model-parallel-lstm drives model parallelism from
+an ordinary model file; here mx.sym stages + Module.fit drive PP).
+
+Runs on the virtual 8-device CPU mesh (conftest)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.parallel.pipeline_schedule import make_schedule
+
+S = 4
+HID = (24, 16, 20, 12)
+
+
+def _stage(i):
+    """Heterogeneous stages: different widths, loss head inside the pipe."""
+    x = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(x, num_hidden=HID[i], name="fc%d" % i)
+    x = mx.sym.Activation(x, act_type="tanh", name="act%d" % i)
+    if i == S - 1:
+        x = mx.sym.FullyConnected(x, num_hidden=5, name="head")
+        x = mx.sym.SoftmaxOutput(x, name="softmax")
+    return x
+
+
+def _full_net():
+    """The same model, unpipelined (for numerics comparison)."""
+    x = mx.sym.Variable("data")
+    for i in range(S):
+        x = mx.sym.FullyConnected(x, num_hidden=HID[i], name="fc%d" % i)
+        x = mx.sym.Activation(x, act_type="tanh", name="act%d" % i)
+    x = mx.sym.FullyConnected(x, num_hidden=5, name="head")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _data(batch, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(batch, 10).astype(np.float32)
+    y = rng.randint(0, 5, batch).astype(np.float32)
+    return X, y
+
+
+def _batch(X, y):
+    return mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+
+
+def _det_params(shapes):
+    """Deterministic per-name init (init draw ORDER differs between module
+    types, so explicit params are the only fair comparison)."""
+    out = {}
+    for n, shp in shapes.items():
+        rng = np.random.RandomState(abs(hash(n)) % (2 ** 31))
+        out[n] = mx.nd.array((rng.randn(*shp) * 0.1).astype(np.float32))
+    return out
+
+
+def _full_shapes(batch):
+    arg_shapes, _, _ = _full_net().infer_shape(data=(batch, 10),
+                                               softmax_label=(batch,))
+    names = _full_net().list_arguments()
+    return {n: tuple(s) for n, s in zip(names, arg_shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def _mesh(axes):
+    import jax
+    n = 1
+    for v in axes.values():
+        n *= v
+    return make_mesh(axes, devices=jax.devices()[:n])
+
+
+def _run_pipeline_step(schedule, mesh_axes, batch=32, microbatches=4,
+                       lr=0.1, steps=1, momentum=0.0):
+    mesh = _mesh(mesh_axes)
+    mod = mx.mod.PipelineModule(_stage, num_stages=S,
+                                num_microbatches=microbatches, mesh=mesh,
+                                schedule=schedule)
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(arg_params=_det_params(_full_shapes(batch)))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": lr,
+                                         "momentum": momentum})
+    X, y = _data(batch)
+    for _ in range(steps):
+        mod.forward(_batch(X, y))
+        mod.backward()
+        mod.update()
+    args, _ = mod.get_params()
+    outs = mod.get_outputs()
+    return mod, args, outs[0].asnumpy()
+
+
+def _run_reference_step(batch=32, lr=0.1, steps=1, momentum=0.0):
+    mod = mx.mod.Module(_full_net(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(arg_params=_det_params(_full_shapes(batch)))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": lr,
+                                         "momentum": momentum})
+    X, y = _data(batch)
+    for _ in range(steps):
+        mod.forward(_batch(X, y))
+        mod.backward()
+        mod.update()
+    args, _ = mod.get_params()
+    mod.forward(_batch(X, y), is_train=False)
+    return args, mod.get_outputs()[0].asnumpy()
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_matches_unpipelined(schedule):
+    """3 SGD+momentum steps through the pipeline == plain Module on the
+    sequentially-composed net (same init, same data)."""
+    _, args_p, _ = _run_pipeline_step(schedule, {"pipe": S, "data": 2},
+                                      steps=3, momentum=0.9)
+    args_r, _ = _run_reference_step(steps=3, momentum=0.9)
+    assert set(args_p) == set(args_r)
+    for n in sorted(args_r):
+        np.testing.assert_allclose(args_p[n].asnumpy(), args_r[n].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_gpipe_1f1b_same_numerics():
+    """The two schedules are different orderings of the same math."""
+    _, a1, o1 = _run_pipeline_step("gpipe", {"pipe": S}, steps=2)
+    _, a2, o2 = _run_pipeline_step("1f1b", {"pipe": S}, steps=2)
+    for n in sorted(a1):
+        np.testing.assert_allclose(a1[n].asnumpy(), a2[n].asnumpy(),
+                                   rtol=1e-5, err_msg=n)
+    np.testing.assert_allclose(o1, o2, rtol=1e-5)
+
+
+def test_pipeline_eval_path():
+    """Forward-only (score) path matches the training-step outputs."""
+    mod, _, train_out = _run_pipeline_step("1f1b", {"pipe": S, "data": 2})
+    X, y = _data(32)
+    mod.forward(_batch(X, y), is_train=False)
+    ev = mod.get_outputs()[0].asnumpy()
+    assert ev.shape == (32, 5)
+    np.testing.assert_allclose(ev.sum(1), np.ones(32), rtol=1e-5)
+
+
+def test_pipeline_fit_converges():
+    """End-to-end Module.fit through the pipeline (the reference-shaped
+    user path: sym stages + fit, no raw JAX anywhere)."""
+    mesh = _mesh({"pipe": S, "data": 2})
+    rng = np.random.RandomState(3)
+    X = rng.randn(256, 10).astype(np.float32)
+    W = rng.randn(10, 5).astype(np.float32)
+    y = np.argmax(X @ W, 1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.PipelineModule(_stage, num_stages=S, num_microbatches=4,
+                                mesh=mesh, schedule="1f1b")
+    mod.fit(it, num_epoch=25, optimizer="sgd",
+            arg_params=_det_params(_full_shapes(64)),
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=64), "acc")
+    assert score[0][1] > 0.8, score
+
+
+def test_schedule_memory_trade():
+    """1F1B's point: the activation stash is bounded by pipeline depth,
+    GPipe's grows with the microbatch count; lockstep bubble is equal."""
+    g = make_schedule(4, 16, "gpipe")
+    f = make_schedule(4, 16, "1f1b")
+    assert g.stats["max_stash_slots"] == 16
+    assert f.stats["max_stash_slots"] == 4
+    assert g.stats["bubble_fraction"] == f.stats["bubble_fraction"]
+    assert g.num_steps == f.num_steps
+
+
+def test_pipeline_checkpoint_roundtrip(tmp_path):
+    mod, args, _ = _run_pipeline_step("1f1b", {"pipe": S})
+    prefix = str(tmp_path / "pipe")
+    mod.save_checkpoint(prefix, 1)
+    mesh = _mesh({"pipe": S})
+    mod2 = mx.mod.PipelineModule(_stage, num_stages=S, num_microbatches=4,
+                                 mesh=mesh)
+    mod2.bind(data_shapes=[("data", (32, 10))],
+              label_shapes=[("softmax_label", (32,))])
+    import mxnet_tpu.model as model
+    _, loaded, _ = model.load_checkpoint(prefix, 1)
+    mod2.set_params(loaded)
+    a2, _ = mod2.get_params()
+    for n in sorted(args):
+        np.testing.assert_allclose(a2[n].asnumpy(), args[n].asnumpy(),
+                                   err_msg=n)
+
+
+def test_pipeline_rejects_batchnorm_stage():
+    def bn_stage(i):
+        x = mx.sym.Variable("data")
+        x = mx.sym.FullyConnected(x, num_hidden=8, name="fc%d" % i)
+        x = mx.sym.BatchNorm(x, name="bn%d" % i)
+        if i == S - 1:
+            x = mx.sym.SoftmaxOutput(x, name="softmax")
+        return x
+
+    mesh = _mesh({"pipe": S})
+    with pytest.raises(mx.base.MXNetError, match="auxiliary states"):
+        mx.mod.PipelineModule(bn_stage, num_stages=S, num_microbatches=4,
+                              mesh=mesh)

@@ -27,6 +27,25 @@ import subprocess
 import sys
 
 
+# servers/scheduler block inside this import-and-serve bootstrap
+_SERVER_BOOTSTRAP = "import mxnet_tpu.kvstore_server as s; s.init_server_module()"
+
+
+def _routable_ip():
+    """The launch host's outbound IP (UDP-connect trick) — NOT
+    gethostbyname(gethostname()), which maps to loopback on hosts whose
+    /etc/hosts pins the hostname to 127.0.1.1; remote ranks must be able
+    to reach the scheduler at this address."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("", 0))
@@ -73,9 +92,7 @@ def main():
             env.update(base_env)
             env["DMLC_ROLE"] = role
             if role != "worker":
-                # servers/scheduler block inside import (kvstore_server)
-                cmd = [sys.executable, "-c",
-                       "import mxnet_tpu.kvstore_server as s; s.init_server_module()"]
+                cmd = [sys.executable, "-c", _SERVER_BOOTSTRAP]
             else:
                 cmd = args.command
             return subprocess.Popen(cmd, env=env)
@@ -97,15 +114,12 @@ def main():
         # env forwarded per MPI flavor).  MXTPU_MPIRUN overrides the
         # binary so tests can shim it without an MPI install.
         mpirun = os.environ.get("MXTPU_MPIRUN", "mpirun")
-        base_env["DMLC_PS_ROOT_URI"] = socket.gethostbyname(
-            socket.gethostname())
+        base_env["DMLC_PS_ROOT_URI"] = _routable_ip()
         sched_env = dict(os.environ)
         sched_env.update(base_env)
         sched_env["DMLC_ROLE"] = "scheduler"
         sched = subprocess.Popen(
-            [sys.executable, "-c",
-             "import mxnet_tpu.kvstore_server as s; s.init_server_module()"],
-            env=sched_env)
+            [sys.executable, "-c", _SERVER_BOOTSTRAP], env=sched_env)
 
         def mpi_cmd(role, n, cmd):
             argv = [mpirun, "-n", str(n)]
@@ -123,8 +137,7 @@ def main():
                     argv += ["-genv", k, v]
             return argv + cmd
 
-        server_cmd = [sys.executable, "-c",
-                      "import mxnet_tpu.kvstore_server as s; s.init_server_module()"]
+        server_cmd = [sys.executable, "-c", _SERVER_BOOTSTRAP]
         servers = subprocess.Popen(
             mpi_cmd("server", args.num_servers, server_cmd))
         workers = subprocess.Popen(
@@ -143,8 +156,7 @@ def main():
         env_str = " ".join("%s=%s" % (k, v) for k, v in base_env.items())
         env_str += " DMLC_ROLE=%s" % role
         if role != "worker":
-            remote = ("python -c 'import mxnet_tpu.kvstore_server as s; "
-                      "s.init_server_module()'")
+            remote = "python -c %r" % _SERVER_BOOTSTRAP
         else:
             remote = " ".join(args.command)
         cwd = args.sync_dst_dir or os.getcwd()
