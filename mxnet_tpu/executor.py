@@ -34,7 +34,7 @@ __all__ = ["Executor"]
 
 
 def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_train, rng,
-               boundary=None, cast=None):
+               boundary=None, cast=None, mesh=None):
     """Interpret the graph as pure JAX ops (traced once under jit).
 
     `rng` is a jax PRNG key (or None); callers inside jit build it from a
@@ -96,6 +96,8 @@ def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_trai
             kwargs["is_train"] = is_train
         if op.need_rng:
             kwargs["rng"] = jax.random.fold_in(rng, i) if rng is not None else None
+        if getattr(op, "need_mesh", False):
+            kwargs["mesh"] = mesh
         # named_scope stamps the node name into HLO op metadata (tf_op),
         # so XLA device traces attribute time per GRAPH NODE even though
         # the whole step is one fused executable — the analog of the
@@ -302,6 +304,19 @@ class Executor:
             batch_spec = P("data") if "data" in mesh.axis_names else P()
             self._data_sharding = NamedSharding(mesh, batch_spec)
             self._repl_sharding = NamedSharding(mesh, P())
+            # ops may declare per-input mesh axes (Op.input_axes, e.g. MoE
+            # experts over 'expert'): shard those params dim-0 AT REST so
+            # expert memory scales 1/E across the axis — the EP analog of
+            # the reference's per-device expert placement
+            for node in self._order:
+                if node.op is None or not getattr(node.op, "input_axes", None):
+                    continue
+                for (src, _), in_name in zip(node.inputs, node.op.inputs):
+                    ax = node.op.input_axes.get(in_name)
+                    if (ax and ax in mesh.axis_names and src.op is None
+                            and not src.is_aux
+                            and src.name not in self._param_shardings):
+                        self._param_shardings[src.name] = P(ax)
 
     # ------------------------------------------------------------------
     # construction (parity: Executor::SimpleBind / Bind)
@@ -506,10 +521,12 @@ class Executor:
             boundary = self._boundary()
             cast = self._cast()
 
+            mesh = self._mesh
+
             def f(arg_vals, aux_vals, seed):
                 rng = jax.random.key(seed)
                 return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train,
-                                  rng, boundary=boundary, cast=cast)
+                                  rng, boundary=boundary, cast=cast, mesh=mesh)
 
             self._jit_fwd[is_train] = jax.jit(f)
         return self._jit_fwd[is_train]
@@ -570,6 +587,7 @@ class Executor:
         an, xn = self._arg_names, self._aux_names
         boundary = self._boundary()
         cast = self._cast()
+        mesh = self._mesh
 
         def fwd(dv, nondiff_vals, aux_vals, rng):
             vals = [None] * len(an)
@@ -578,7 +596,7 @@ class Executor:
             for i, v in zip(nondiff_idx, nondiff_vals):
                 vals[i] = v
             return _run_graph(entries, order, an, xn, tuple(vals), aux_vals,
-                              True, rng, boundary=boundary, cast=cast)
+                              True, rng, boundary=boundary, cast=cast, mesh=mesh)
 
         if self._mirror:
             fwd = jax.checkpoint(fwd, policy=_MIRROR_POLICY)

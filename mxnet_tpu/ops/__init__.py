@@ -7,4 +7,5 @@ from . import contrib_ops  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import spatial  # noqa: F401
 from . import optim_ops  # noqa: F401
+from . import sharded_ops  # noqa: F401
 from .registry import OP_REGISTRY, Op, get_op, list_ops, register  # noqa: F401

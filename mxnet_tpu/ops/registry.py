@@ -46,6 +46,8 @@ class Op:
         "need_is_train",
         "num_aux_out",
         "need_rng",
+        "need_mesh",
+        "input_axes",
         "variadic",
         "doc",
         "params",
@@ -63,6 +65,8 @@ class Op:
         need_is_train=False,
         num_aux_out=0,
         need_rng=False,
+        need_mesh=False,
+        input_axes=None,
         variadic=False,
         doc="",
         params=None,
@@ -77,6 +81,13 @@ class Op:
         self.need_is_train = need_is_train
         self.num_aux_out = num_aux_out
         self.need_rng = need_rng
+        # need_mesh: fn takes mesh= (the executor's device mesh) so the op
+        # can place GSPMD sharding constraints (e.g. MoE's 'expert' axis)
+        self.need_mesh = need_mesh
+        # input_axes: {input_name: mesh_axis} — parameters feeding these
+        # slots are sharded dim-0 over that axis AT REST when the bound
+        # mesh carries it (executor picks this up; the EP memory scaling)
+        self.input_axes = dict(input_axes or {})
         self.variadic = variadic
         self.doc = doc
         # declarative parameter specs (dmlc::Parameter analog, ops/params.py)

@@ -1,0 +1,170 @@
+"""Mesh-aware parallel layers as first-class symbol operators.
+
+`MoE` (expert parallelism) and `RingAttention` (sequence/context
+parallelism): ordinary `mx.sym` ops that detect the bound Module's mesh
+axes ('expert' / 'seq') and lower to the parallel path automatically —
+the user-API surface over parallel/moe.py and parallel/ring_attention.py.
+
+MoE — Mixture-of-Experts FFN as a first-class symbol operator.
+
+Expert parallelism from the USER API: `mx.sym.MoE(data, num_experts=8,
+hidden_size=1024, k=2)` inside an ordinary model file, trained with
+`Module(mesh=make_mesh({'data': d, 'expert': e}))`.  No reference
+counterpart exists (SURVEY.md §2.5 marks EP absent from the 2017
+reference); the design is the GShard/GSPMD dense-einsum formulation:
+
+  * capacity-bounded top-k routing (parallel/moe.py top_k_gating — the
+    SAME router as the shard_map library path, so both lower identically)
+  * dispatch/combine einsums over a static [T, E, C] routing tensor —
+    shape-static, fully differentiable (gate gradients flow through the
+    combine weights), one XLA program
+  * `with_sharding_constraint` pins expert-major tensors to the 'expert'
+    mesh axis; GSPMD inserts the all_to_all that moves token slots to
+    expert owners and back — the collective the library path writes by
+    hand (parallel/moe.py lax.all_to_all), here compiler-derived
+  * expert parameters are sharded dim-0 over 'expert' AT REST via
+    Op.input_axes (executor.py picks it up), so expert memory scales 1/E
+
+Without a mesh (or without an 'expert' axis) the same math runs dense —
+single-device numerics are identical by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .tensor import _lit
+
+
+def _f(v, default):
+    return float(_lit(v)) if v is not None else default
+
+
+def _infer_moe(in_shapes, attrs):
+    data = in_shapes[0]
+    E = int(_lit(attrs["num_experts"]))
+    H = int(_lit(attrs["hidden_size"]))
+    D = data[-1]
+    shapes = [data, (D, E), (E, D, H), (E, H), (E, H, D), (E, D)]
+    return shapes, [tuple(data)]
+
+
+def _constrain(x, mesh, spec):
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+@register(
+    "MoE",
+    inputs=("data", "gate_weight", "expert1_weight", "expert1_bias",
+            "expert2_weight", "expert2_bias"),
+    aliases=("_contrib_MoE",),
+    infer_shape=_infer_moe,
+    need_mesh=True,
+    input_axes={"expert1_weight": "expert", "expert1_bias": "expert",
+                "expert2_weight": "expert", "expert2_bias": "expert"},
+)
+def moe(data, gate_weight, w1, b1, w2, b2, num_experts, hidden_size,
+        k=2, capacity_factor=1.0, mesh=None, **kw):
+    """Top-k routed expert FFN: out[t] = sum_e gate[t,e] *
+    (relu(x[t] @ w1[e] + b1[e]) @ w2[e] + b2[e]) over t's top-k experts,
+    capacity-bounded (overflow tokens pass through with zero expert term,
+    Switch-Transformer semantics)."""
+    from ..parallel.moe import top_k_gating
+    from ..parallel.mesh import P
+
+    E = int(_lit(num_experts))
+    kk = int(_lit(k))
+    cf = _f(capacity_factor, 1.0)
+    lead = data.shape[:-1]
+    d_model = data.shape[-1]
+    x = data.reshape(-1, d_model)
+    T = x.shape[0]
+    capacity = max(1, int(cf * kk * T // E))
+
+    ep = mesh is not None and "expert" in mesh.axis_names
+
+    logits = x.astype(jnp.float32) @ gate_weight.astype(jnp.float32)
+    dispatch, combine = top_k_gating(logits, kk, capacity)     # [T, E, C]
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    if ep:
+        # expert-major tensors live on the 'expert' axis; GSPMD derives
+        # the dispatch/return all_to_all from this constraint pair
+        xe = _constrain(xe, mesh, P("expert"))
+    he = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, w1.astype(jnp.float32))
+                     + b1.astype(jnp.float32)[:, None, :])
+    ye = jnp.einsum("ech,ehd->ecd", he, w2.astype(jnp.float32)) \
+        + b2.astype(jnp.float32)[:, None, :]
+    if ep:
+        ye = _constrain(ye, mesh, P("expert"))
+    out = jnp.einsum("tec,ecd->td", combine, ye)
+    return out.reshape(lead + (d_model,)).astype(data.dtype)
+
+
+# ----------------------------------------------------------------------
+# RingAttention — sequence parallelism from the symbol API
+# ----------------------------------------------------------------------
+
+def _infer_ring_attn(in_shapes, attrs):
+    q = in_shapes[0]
+    return [q, q, q], [tuple(q)]
+
+
+@register(
+    "RingAttention",
+    inputs=("query", "key", "value"),
+    aliases=("_contrib_RingAttention",),
+    infer_shape=_infer_ring_attn,
+    need_mesh=True,
+)
+def ring_attention_op(query, key, value, causal=False, scale=None,
+                      impl="auto", mesh=None, **kw):
+    """Attention over (B, T, H, D) that SHARDS THE SEQUENCE automatically:
+    bound on a mesh with a 'seq' axis it runs ring attention (K/V shards
+    rotating over ICI, flash-style online softmax — parallel/
+    ring_attention.py), composing with 'data' batch sharding; `impl=
+    'ulysses'` picks the all-to-all head/seq swap variant instead (better
+    for many heads at moderate T).  Without a 'seq' axis it falls back to
+    single-device blockwise attention — same numerics, O(T·block) memory.
+    The long-context capability (SURVEY.md §5) as one symbol op."""
+    from jax import lax as _lax
+
+    from ..parallel import ring_attention as _ra
+    from ..parallel.collectives import shard_map
+    from ..parallel.mesh import P
+
+    causal = _bool_attr(causal)
+    impl = str(_lit(impl))
+    sc = float(_lit(scale)) if scale is not None else None
+    b, t, h, d = query.shape
+
+    sp = (mesh is not None and "seq" in mesh.axis_names
+          and t % mesh.shape["seq"] == 0)
+    if sp and impl == "ulysses" and h % mesh.shape["seq"] != 0:
+        sp = False
+    if not sp:
+        blk = min(128, t)
+        while t % blk:
+            blk -= 1
+        return _ra.blockwise_attention(query, key, value, blk,
+                                       causal=causal, scale=sc)
+
+    batch = "data" if "data" in mesh.axis_names else None
+    spec = P(batch, "seq", None, None)
+    fn = _ra.ulysses_attention if impl == "ulysses" else _ra.ring_attention
+
+    def body(qs, ks, vs):
+        return fn(qs, ks, vs, "seq", causal=causal, scale=sc)
+
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(query, key, value)
+
+
+def _bool_attr(v):
+    v = _lit(v)
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return bool(v)

@@ -1,0 +1,222 @@
+"""mx.sym.MoE — expert parallelism from the Symbol/Module user API
+(ops/moe_op.py).  Numerics vs the dense mixture formula and vs the
+shard_map library path; trains through Module on a data x expert mesh."""
+import zlib as _zlib
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.mesh import make_mesh
+
+
+def _dense_ref(x, gw, w1, b1, w2, b2, k, capacity):
+    """Dense oracle with the same capacity-bounded top-k router."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.moe import top_k_gating
+
+    logits = x @ gw
+    dispatch, combine = top_k_gating(jnp.asarray(logits), k, capacity)
+    dispatch, combine = np.asarray(dispatch), np.asarray(combine)
+    E = gw.shape[1]
+    xe = np.einsum("tec,td->ecd", dispatch, x)
+    ye = np.stack([np.maximum(xe[e] @ w1[e] + b1[e], 0) @ w2[e] + b2[e]
+                   for e in range(E)])
+    return np.einsum("tec,ecd->td", combine, ye)
+
+
+def test_moe_nd_matches_dense():
+    rng = np.random.RandomState(0)
+    T, D, H, E, k = 24, 8, 16, 4, 2
+    x = rng.randn(T, D).astype(np.float32)
+    gw = rng.randn(D, E).astype(np.float32) * 0.3
+    w1 = rng.randn(E, D, H).astype(np.float32) * 0.3
+    b1 = rng.randn(E, H).astype(np.float32) * 0.1
+    w2 = rng.randn(E, H, D).astype(np.float32) * 0.3
+    b2 = rng.randn(E, D).astype(np.float32) * 0.1
+    out = mx.nd.MoE(mx.nd.array(x), mx.nd.array(gw), mx.nd.array(w1),
+                    mx.nd.array(b1), mx.nd.array(w2), mx.nd.array(b2),
+                    num_experts=E, hidden_size=H, k=k, capacity_factor=2.0)
+    cap = max(1, int(2.0 * k * T // E))
+    ref = _dense_ref(x, gw, w1, b1, w2, b2, k, cap)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def _moe_net(E=4, H=16):
+    x = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(x, num_hidden=8, name="embed")
+    x = mx.sym.MoE(x, num_experts=E, hidden_size=H, k=2,
+                   capacity_factor=2.0, name="moe")
+    x = mx.sym.FullyConnected(x, num_hidden=3, name="out_fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _det_params(net, batch):
+    arg_shapes, _, _ = net.infer_shape(data=(batch, 10),
+                                       softmax_label=(batch,))
+    out = {}
+    for n, shp in zip(net.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        rng = np.random.RandomState(_zlib.crc32(n.encode()) % (2 ** 31))
+        out[n] = mx.nd.array((rng.randn(*shp) * 0.2).astype(np.float32))
+    return out
+
+
+def test_moe_symbol_infers_param_shapes():
+    net = _moe_net(E=4, H=16)
+    shapes = dict(zip(net.list_arguments(),
+                      net.infer_shape(data=(32, 10), softmax_label=(32,))[0]))
+    assert shapes["moe_expert1_weight"] == (4, 8, 16)
+    assert shapes["moe_expert1_bias"] == (4, 16)
+    assert shapes["moe_expert2_weight"] == (4, 16, 8)
+    assert shapes["moe_expert2_bias"] == (4, 8)
+    assert shapes["moe_gate_weight"] == (8, 4)
+
+
+def _train(mod, batch=32, steps=3):
+    net_params = _det_params(_moe_net(), batch)
+    mod.bind(data_shapes=[("data", (batch, 10))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(arg_params=net_params)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.2,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(1)
+    X = rng.randn(batch, 10).astype(np.float32)
+    y = rng.randint(0, 3, batch).astype(np.float32)
+    b = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    for _ in range(steps):
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+    return mod.get_params()[0]
+
+
+def test_moe_module_expert_mesh_matches_single_device():
+    """Module on a data x expert mesh == single-device Module: GSPMD EP
+    is a layout change, not a numerics change."""
+    mesh = make_mesh({"data": 2, "expert": 4})
+    args_ep = _train(mx.mod.Module(_moe_net(), context=mx.cpu(), mesh=mesh))
+    args_1d = _train(mx.mod.Module(_moe_net(), context=mx.cpu()))
+    for n in sorted(args_1d):
+        np.testing.assert_allclose(args_ep[n].asnumpy(),
+                                   args_1d[n].asnumpy(),
+                                   rtol=5e-4, atol=5e-5, err_msg=n)
+
+
+def test_moe_expert_params_sharded_at_rest():
+    """Op.input_axes shards expert params dim-0 over 'expert' at rest —
+    expert memory scales 1/E over the axis."""
+    from mxnet_tpu.parallel.mesh import P
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    mod = mx.mod.Module(_moe_net(), context=mx.cpu(), mesh=mesh)
+    mod.bind(data_shapes=[("data", (32, 10))],
+             label_shapes=[("softmax_label", (32,))])
+    exe = mod._exec_group.execs[0]
+    for n in ("moe_expert1_weight", "moe_expert1_bias", "moe_expert2_weight", "moe_expert2_bias"):
+        assert exe._param_shardings.get(n) == P("expert"), (
+            n, exe._param_shardings.get(n))
+    assert "moe_gate_weight" not in exe._param_shardings  # router replicated
+
+
+def test_moe_fit_converges():
+    mesh = make_mesh({"data": 2, "expert": 4})
+    rng = np.random.RandomState(4)
+    X = rng.randn(256, 10).astype(np.float32)
+    W = rng.randn(10, 3).astype(np.float32)
+    y = np.argmax(X @ W, 1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(_moe_net(), context=mx.cpu(), mesh=mesh)
+    mod.fit(it, num_epoch=15, optimizer="adam",
+            arg_params=_det_params(_moe_net(), 64),
+            optimizer_params={"learning_rate": 0.01})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=64), "acc")
+    assert score[0][1] > 0.85, score
+
+
+# ----------------------------------------------------------------------
+# RingAttention op — SP from the symbol API
+# ----------------------------------------------------------------------
+
+def _dense_attn(q, k, v, causal):
+    B, T, H, D = q.shape
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        s = np.where(np.tril(np.ones((T, T), bool))[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_nd_dense_fallback(causal):
+    rng = np.random.RandomState(2)
+    q, k, v = [rng.uniform(-1, 1, (2, 16, 2, 8)).astype(np.float32)
+               for _ in range(3)]
+    out = mx.nd.RingAttention(mx.nd.array(q), mx.nd.array(k),
+                              mx.nd.array(v), causal=causal)
+    np.testing.assert_allclose(out.asnumpy(), _dense_attn(q, k, v, causal),
+                               rtol=2e-4, atol=2e-5)
+
+
+def _attn_net(T=16, H=2, D=8, impl="auto"):
+    x = mx.sym.Variable("data")                       # (B, T, E)
+    qkv = mx.sym.FullyConnected(x, num_hidden=3 * H * D, flatten=False,
+                                name="qkv")
+    qkv = mx.sym.reshape(qkv, shape=(0, T, H, 3 * D))
+    q = mx.sym.slice_axis(qkv, axis=3, begin=0, end=D)
+    k = mx.sym.slice_axis(qkv, axis=3, begin=D, end=2 * D)
+    v = mx.sym.slice_axis(qkv, axis=3, begin=2 * D, end=3 * D)
+    a = mx.sym.RingAttention(q, k, v, causal=True, impl=impl, name="attn")
+    a = mx.sym.reshape(a, shape=(0, T * H * D))
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(a, num_hidden=3,
+                                                      name="out_fc"),
+                                name="softmax")
+
+
+def _attn_params(batch, T=16):
+    net = _attn_net(T)
+    arg_shapes, _, _ = net.infer_shape(data=(batch, T, 4),
+                                       softmax_label=(batch,))
+    out = {}
+    for n, shp in zip(net.list_arguments(), arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        rng = np.random.RandomState(_zlib.crc32(n.encode()) % (2 ** 31))
+        out[n] = mx.nd.array((rng.randn(*shp) * 0.2).astype(np.float32))
+    return out
+
+
+@pytest.mark.parametrize("impl", ["auto", "ulysses"])
+def test_ring_attention_module_seq_mesh_matches_single(impl):
+    """Module on a data x seq mesh == meshless Module: the op shards the
+    sequence automatically, numerics unchanged."""
+    batch, T = 8, 16
+
+    def train(mod):
+        mod.bind(data_shapes=[("data", (batch, T, 4))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params(arg_params=_attn_params(batch, T))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.2})
+        rng = np.random.RandomState(6)
+        X = rng.randn(batch, T, 4).astype(np.float32)
+        y = rng.randint(0, 3, batch).astype(np.float32)
+        b = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+        for _ in range(2):
+            mod.forward(b)
+            mod.backward()
+            mod.update()
+        return mod.get_params()[0]
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    args_sp = train(mx.mod.Module(_attn_net(T, impl=impl), context=mx.cpu(),
+                                  mesh=mesh))
+    args_1d = train(mx.mod.Module(_attn_net(T, impl=impl), context=mx.cpu()))
+    for n in sorted(args_1d):
+        np.testing.assert_allclose(args_sp[n].asnumpy(),
+                                   args_1d[n].asnumpy(),
+                                   rtol=5e-4, atol=5e-5, err_msg=n)

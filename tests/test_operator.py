@@ -740,6 +740,8 @@ COVERED_ELSEWHERE = {
     "RNN",
     # test_ring_attention.py
     "_contrib_BlockwiseAttention",
+    # test_moe_op.py (first-class parallel layers, ops/sharded_ops.py)
+    "MoE", "RingAttention",
     # test_contrib_ops2.py
     "_contrib_fft", "_contrib_ifft", "_contrib_quantize",
     "_contrib_dequantize", "_contrib_count_sketch", "_contrib_Proposal",
