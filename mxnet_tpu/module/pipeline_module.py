@@ -415,7 +415,11 @@ class PipelineModule(BaseModule):
             l = label.data if isinstance(label, NDArray) else jnp.asarray(label)
             l = l.reshape((M, self._mb_rows_global) + l.shape[1:])
         else:
-            l = jnp.zeros((M, self._mb_rows_global), jnp.float32)
+            # label-less eval: zeros in the BOUND label shape (multi-dim
+            # labels included) so the stage graphs trace consistently
+            tail = tuple(self._label_mb_shape[1:]) if self._label_mb_shape \
+                else ()
+            l = jnp.zeros((M, self._mb_rows_global) + tail, jnp.float32)
         return d, l
 
     def _assemble(self, outbuf):
@@ -555,3 +559,25 @@ class PipelineModule(BaseModule):
         from ..model import save_checkpoint as _save
         args, auxs = self.get_params()
         _save(prefix, epoch, self.symbol, args, auxs)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        arrs = {"state_%d" % i: _np.asarray(jax.device_get(s))
+                for i, s in enumerate(self._opt_state)}
+        arrs["num_update"] = _np.asarray(
+            self._optimizer._index_update_count.get("__pipeline__", 0))
+        with open(fname, "wb") as f:
+            _np.savez(f, **arrs)
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with _np.load(fname) as z:
+            n = len([k for k in z.files if k.startswith("state_")])
+            self._opt_state = tuple(
+                jax.device_put(jnp.asarray(z["state_%d" % i]),
+                               self._buf_sharding) for i in range(n))
+            t = int(z["num_update"])
+        self._optimizer._index_update_count["__pipeline__"] = t
+        self._optimizer.num_update = max(self._optimizer.num_update, t)

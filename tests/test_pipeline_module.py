@@ -3,6 +3,8 @@
 an ordinary model file; here mx.sym stages + Module.fit drive PP).
 
 Runs on the virtual 8-device CPU mesh (conftest)."""
+import zlib as _zlib
+
 import numpy as np
 import pytest
 
@@ -51,7 +53,7 @@ def _det_params(shapes):
     types, so explicit params are the only fair comparison)."""
     out = {}
     for n, shp in shapes.items():
-        rng = np.random.RandomState(abs(hash(n)) % (2 ** 31))
+        rng = np.random.RandomState(_zlib.crc32(n.encode()) % (2 ** 31))
         out[n] = mx.nd.array((rng.randn(*shp) * 0.1).astype(np.float32))
     return out
 
@@ -205,3 +207,19 @@ def test_pipeline_rejects_batchnorm_stage():
     with pytest.raises(mx.base.MXNetError, match="auxiliary states"):
         mx.mod.PipelineModule(bn_stage, num_stages=S, num_microbatches=4,
                               mesh=mesh)
+
+
+def test_pipeline_optimizer_states_roundtrip(tmp_path):
+    """save_checkpoint(save_optimizer_states=True) persists momentum so a
+    resumed run continues with identical dynamics."""
+    mod, _, _ = _run_pipeline_step("1f1b", {"pipe": S}, momentum=0.9)
+    f = str(tmp_path / "p-0001.states")
+    mod.save_optimizer_states(f)
+    st0 = [np.asarray(s) for s in mod._opt_state]
+    mod2, _, _ = _run_pipeline_step("1f1b", {"pipe": S}, momentum=0.9,
+                                    steps=3)
+    mod2.load_optimizer_states(f)
+    assert mod2._optimizer._index_update_count["__pipeline__"] == \
+        mod._optimizer._index_update_count["__pipeline__"]
+    for a, b in zip(st0, mod2._opt_state):
+        np.testing.assert_allclose(a, np.asarray(b))

@@ -9,11 +9,20 @@ vs_baseline compares against the reference's best published single-GPU
 number (1x P100) for that config where one exists; rows the reference
 never published a number for carry vs_baseline: null.
 
-Methodology: 30+ timed iterations after warmup, fenced by a one-element
-device fetch (block_until_ready is unreliable over the tunnel).  Batch-32
-configs are partially dispatch-latency-bound here (~11 ms per chained
-dispatch over the tunneled chip) — real-deployment numbers would be
-higher; they still clear the baselines by an order of magnitude.
+Methodology (CHIP-limited, not harness-limited): every row runs K
+batches per dispatch inside ONE compiled program — a `lax.scan` over a
+device-resident batch stack (inference: forward per tick; training:
+fwd+bwd+SGD with params/momentum/aux as the scan carry — exactly how a
+real TPU training loop amortizes host dispatch).  The ~11 ms/dispatch
+tunnel overhead is therefore paid once per K batches and the per-model
+numbers are FLOP-consistent instead of clamped at a dispatch floor.
+Each row reports `mfu` = XLA-counted FLOPs / time / 197 TFLOP/s (v5e
+bf16 peak, MAC=2 both sides).
+
+Quotable numbers: the per-row `value` here IS the quotable number for
+its config (chip-limited, batch as stated).  The repo headline remains
+`bench.py`'s batch-512 fused-Module step — the deployment-shaped config;
+batch-32 rows exist for reference-table parity (see README Benchmarks).
 """
 import argparse
 import json
@@ -25,156 +34,261 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+V5E_PEAK_FLOPS = 197e12
 ROWS = []
 
 
-def _fence(arr):
-    np.asarray(arr[(0,) * arr.ndim] if arr.ndim else arr)
-
-
-def _row(metric, value, unit, baseline, config):
+def _row(metric, value, unit, baseline, config, mfu=None):
     r = {"metric": metric, "value": round(value, 2), "unit": unit,
          "vs_baseline": round(value / baseline, 3) if baseline else None,
+         "mfu": round(mfu, 4) if mfu else None,
          "config": config}
     ROWS.append(r)
     print(json.dumps(r), flush=True)
 
 
-def bench_inference(name, sym_fn, image_shape, baseline, batch=32, steps=60):
+def _flops(compiled, trip_count=1):
+    """XLA cost analysis counts a while/scan body ONCE — multiply by the
+    scan trip count to get whole-program FLOPs (verified against
+    hand-computed model FLOPs: ResNet-50 fwd 7.8 GFLOP/img MAC=2)."""
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        return float(ca.get("flops", 0.0)) * trip_count
+    except Exception:
+        return 0.0
+
+
+def _bind_module(net, data_shape, label_shape=None, data_names=("data",),
+                 label_names=("softmax_label",), for_training=True):
     import mxnet_tpu as mx
 
     mx.random.seed(0)
-    net = sym_fn()
-    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
-    mod.bind(data_shapes=[("data", (batch,) + image_shape)],
-             label_shapes=None, for_training=False)
+    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16",
+                        data_names=list(data_names),
+                        label_names=list(label_names))
+    mod.bind(data_shapes=[(data_names[0], data_shape)],
+             label_shapes=[(label_names[0], label_shape)] if label_shape else None,
+             for_training=for_training)
     mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
                                    magnitude=2))
-    rng = np.random.RandomState(0)
-    batch_data = mx.io.DataBatch(
-        data=[mx.nd.array(rng.randn(batch, *image_shape).astype("float32"))],
-        label=None)
-    for _ in range(5):
-        mod.forward(batch_data, is_train=False)
-    _fence(mod.get_outputs()[0].data)
+    return mod
+
+
+def _scan_forward(mod, data_stack):
+    """One jitted program: forward over K device-resident batches."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu.executor import _run_graph
+
+    exe = mod._exec_group.execs[0]
+    an, xn = exe._arg_names, exe._aux_names
+    entries, order = exe._entries, exe._order
+    cast = exe._cast()
+    didx = an.index("data")
+
+    def run(args, aux, stack):
+        def tick(carry, xk):
+            vals = list(args)
+            vals[didx] = xk
+            outs, _ = _run_graph(entries, order, an, xn, tuple(vals), aux,
+                                 False, None, cast=cast)
+            return carry, outs[0].reshape(-1)[0]
+
+        _, ys = lax.scan(tick, jnp.float32(0), stack)
+        return ys
+
+    args = exe._place(exe._gather_args())
+    aux = exe._gather_aux()
+    jf = jax.jit(run)
+    compiled = jf.lower(args, aux, data_stack).compile()
+    return compiled, args, aux
+
+
+def _scan_train(mod, data_stack, label_stack, lr=0.05, momentum=0.9):
+    """One jitted program: K full train steps (fwd+bwd+SGD momentum),
+    params/momentum/aux carried through the scan — the compiled-loop
+    training pattern."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu.executor import _run_graph
+
+    exe = mod._exec_group.execs[0]
+    an, xn = exe._arg_names, exe._aux_names
+    entries, order = exe._entries, exe._order
+    cast = exe._cast()
+    input_names = set(mod._data_names) | set(mod._label_names)
+    diff_idx = [i for i, n in enumerate(an) if n not in input_names]
+    didx = an.index(mod._data_names[0])
+    lidx = an.index(mod._label_names[0]) if mod._label_names else None
+
+    def run(dv, mom, aux, xs, ys, seed):
+        rng0 = jax.random.key(seed)
+
+        def tick(carry, xy):
+            dv, mom, aux, i = carry
+            xk, yk = xy
+
+            def fwd(d):
+                vals = [None] * len(an)
+                for j, v in zip(diff_idx, d):
+                    vals[j] = v
+                vals[didx] = xk
+                if lidx is not None:
+                    vals[lidx] = yk
+                return _run_graph(entries, order, an, xn, tuple(vals), aux,
+                                  True, jax.random.fold_in(rng0, i),
+                                  cast=cast)
+
+            (outs, aux_upd), vjp_fn = jax.vjp(fwd, dv)
+            cots = tuple(jnp.ones_like(o) for o in outs)
+            (grads,) = vjp_fn((cots, tuple(jnp.zeros_like(a) for a in aux_upd)))
+            mom = tuple(momentum * m - lr * g for m, g in zip(mom, grads))
+            dv = tuple(w + m for w, m in zip(dv, mom))
+            return (dv, mom, aux_upd, i + 1), outs[0].reshape(-1)[0]
+
+        (dv, mom, aux, _), outs = lax.scan(
+            tick, (dv, mom, aux, jnp.uint32(0)), (xs, ys))
+        return dv, mom, aux, outs
+
+    args = exe._place(exe._gather_args())
+    dv = tuple(args[i] for i in diff_idx)
+    mom = tuple(jnp.zeros_like(v) for v in dv)
+    aux = exe._gather_aux()
+    jf = jax.jit(run, donate_argnums=(0, 1, 2))
+    compiled = jf.lower(dv, mom, aux, data_stack, label_stack,
+                        np.uint32(0)).compile()
+    return compiled, (dv, mom, aux)
+
+
+def _time_compiled(call, fence_of_result, repeats=6, warmup=2):
+    for _ in range(warmup):
+        r = call()
+    fence_of_result(r)
     t0 = time.time()
-    for _ in range(steps):
-        mod.forward(batch_data, is_train=False)
-    _fence(mod.get_outputs()[0].data)
-    dt = (time.time() - t0) / steps
-    _row("Inference %s img/s" % name, batch / dt, "img/s", baseline,
-         "batch %d bf16, 1 chip vs 1x P100 fp32" % batch)
+    for _ in range(repeats):
+        r = call()
+    fence_of_result(r)
+    return (time.time() - t0) / repeats
 
 
-def bench_train(name, sym_fn, image_shape, baseline, batch=32, steps=30):
-    import mxnet_tpu as mx
+def _stack(rng, k, shape, dtype="float32", hi=None):
+    import jax
 
-    mx.random.seed(0)
+    if hi is None:
+        a = rng.randn(k, *shape).astype(dtype)
+    else:
+        a = rng.randint(0, hi, (k,) + shape).astype(dtype)
+    return jax.device_put(a)
+
+
+def bench_inference(name, sym_fn, image_shape, baseline, batch=32, k=16):
     net = sym_fn()
-    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
-    mod.bind(data_shapes=[("data", (batch,) + image_shape)],
-             label_shapes=[("softmax_label", (batch,))])
-    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
-                                   magnitude=2))
-    mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    mod = _bind_module(net, (batch,) + image_shape, None, for_training=False)
     rng = np.random.RandomState(0)
-    b = mx.io.DataBatch(
-        data=[mx.nd.array(rng.randn(batch, *image_shape).astype("float32"))],
-        label=[mx.nd.array(rng.randint(0, 1000, batch).astype("float32"))])
-    for _ in range(4):
-        mod.forward_backward(b)
-        mod.update()
-    _fence(mod._exec_group.execs[0].arg_dict[
-        [n for n in mod._exec_group.execs[0].arg_dict if n.endswith("weight")][0]].data)
-    t0 = time.time()
-    for _ in range(steps):
-        mod.forward_backward(b)
-        mod.update()
-    _fence(mod._exec_group.execs[0].arg_dict[
-        [n for n in mod._exec_group.execs[0].arg_dict if n.endswith("weight")][0]].data)
-    dt = (time.time() - t0) / steps
-    _row("Training %s img/s" % name, batch / dt, "img/s", baseline,
-         "batch %d bf16+fp32 master, fwd+bwd+SGD, 1 chip vs 1x P100 fp32" % batch)
+    stack = _stack(rng, k, (batch,) + image_shape)
+    compiled, args, aux = _scan_forward(mod, stack)
+    dt = _time_compiled(lambda: compiled(args, aux, stack),
+                        lambda r: np.asarray(r[0]))
+    per_s = k * batch / dt
+    _row("Inference %s img/s" % name, per_s, "img/s", baseline,
+         "batch %d bf16, %d batches/dispatch (lax.scan), 1 chip vs 1x P100 "
+         "fp32" % (batch, k),
+         mfu=_flops(compiled, k) / dt / V5E_PEAK_FLOPS)
 
 
-def bench_lstm_ptb(steps=30):
+def bench_train(name, sym_fn, image_shape, baseline, batch=32, k=8,
+                classes=1000):
+    net = sym_fn()
+    mod = _bind_module(net, (batch,) + image_shape, (batch,))
+    rng = np.random.RandomState(0)
+    xs = _stack(rng, k, (batch,) + image_shape)
+    ys = _stack(rng, k, (batch,), hi=classes)
+    compiled, state = _scan_train(mod, xs, ys)
+
+    def call():
+        # donated args: re-feed the previous call's outputs (steady-state
+        # training: params/momentum/aux flow call to call)
+        call.state = compiled(*call.state, xs, ys, np.uint32(0))[:3]
+        return call.state
+
+    call.state = state
+    dt = _time_compiled(call, lambda r: np.asarray(r[0][0].reshape(-1)[0]))
+    per_s = k * batch / dt
+    _row("Training %s img/s" % name, per_s, "img/s", baseline,
+         "batch %d bf16+fp32 master, fwd+bwd+SGD, %d steps/dispatch "
+         "(lax.scan carry), 1 chip vs 1x P100 fp32" % (batch, k),
+         mfu=_flops(compiled, k) / dt / V5E_PEAK_FLOPS)
+
+
+def bench_lstm_ptb(k=8):
     """LSTM language model, PTB config (reference example/rnn/lstm_bucketing.py
     defaults: 2x200 LSTM, embed 200, vocab 10k, bptt 35, batch 32)."""
     import mxnet_tpu as mx
 
     vocab, embed, hidden, layers, seq, batch = 10000, 200, 200, 2, 35, 32
-    mx.random.seed(0)
     cell = mx.rnn.FusedRNNCell(hidden, num_layers=layers, mode="lstm",
                                prefix="lstm_")
     data = mx.sym.Variable("data")
     label = mx.sym.Variable("softmax_label")
-    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed, name="embed")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name="embed")
     output, _ = cell.unroll(seq, inputs=emb, layout="NTC", merge_outputs=True)
     pred = mx.sym.Reshape(output, shape=(-1, hidden))
     pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
     lab = mx.sym.Reshape(label, shape=(-1,))
     net = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
-    mod = mx.mod.Module(net, context=mx.tpu(), compute_dtype="bfloat16")
-    mod.bind(data_shapes=[("data", (batch, seq))],
-             label_shapes=[("softmax_label", (batch, seq))])
-    mod.init_params(mx.init.Xavier())
-    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    mod = _bind_module(net, (batch, seq), (batch, seq))
     rng = np.random.RandomState(0)
-    b = mx.io.DataBatch(
-        data=[mx.nd.array(rng.randint(1, vocab, (batch, seq)).astype("float32"))],
-        label=[mx.nd.array(rng.randint(1, vocab, (batch, seq)).astype("float32"))])
-    for _ in range(4):
-        mod.forward_backward(b)
-        mod.update()
-    _fence(mod._exec_group.execs[0].arg_dict["pred_weight"].data)
-    t0 = time.time()
-    for _ in range(steps):
-        mod.forward_backward(b)
-        mod.update()
-    _fence(mod._exec_group.execs[0].arg_dict["pred_weight"].data)
-    dt = (time.time() - t0) / steps
-    _row("Training LSTM-PTB tokens/s", batch * seq / dt, "tokens/s", None,
-         "2x200 LSTM (lax.scan fused), bptt 35, batch 32, bf16; reference "
-         "example/rnn/lstm_bucketing.py config (no published reference number)")
+    xs = _stack(rng, k, (batch, seq), hi=vocab)
+    ys = _stack(rng, k, (batch, seq), hi=vocab)
+    compiled, state = _scan_train(mod, xs, ys, lr=0.1, momentum=0.0)
+
+    def call():
+        call.state = compiled(*call.state, xs, ys, np.uint32(0))[:3]
+        return call.state
+
+    call.state = state
+    dt = _time_compiled(call, lambda r: np.asarray(r[0][0].reshape(-1)[0]))
+    _row("Training LSTM-PTB tokens/s", k * batch * seq / dt, "tokens/s", None,
+         "2x200 LSTM (lax.scan fused), bptt 35, batch 32, bf16, %d "
+         "steps/dispatch; reference example/rnn/lstm_bucketing.py config "
+         "(no published reference number)" % k,
+         mfu=_flops(compiled, k) / dt / V5E_PEAK_FLOPS)
 
 
-def bench_ssd(steps=20):
+def bench_ssd(k=6):
     """SSD-300 VGG16-reduced training step (reference example/ssd)."""
+    import jax
+
     import mxnet_tpu as mx
     from mxnet_tpu.models.ssd import get_ssd_vgg16
 
     batch = 32
-    mx.random.seed(0)
     net = get_ssd_vgg16(num_classes=20, mode="train")
-    mod = mx.mod.Module(net, context=mx.tpu(),
-                        data_names=["data"], label_names=["label"],
-                        compute_dtype="bfloat16")
-    mod.bind(data_shapes=[("data", (batch, 3, 300, 300))],
-             label_shapes=[("label", (batch, 3, 6))])
-    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
-                                   magnitude=2))
-    mod.init_optimizer(optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.001, "momentum": 0.9})
+    mod = _bind_module(net, (batch, 3, 300, 300), (batch, 3, 6),
+                       label_names=("label",))
     rng = np.random.RandomState(0)
-    label = np.full((batch, 3, 6), -1, np.float32)
-    label[:, 0] = [0, 0.1, 0.1, 0.5, 0.5, 0]
-    b = mx.io.DataBatch(
-        data=[mx.nd.array(rng.randn(batch, 3, 300, 300).astype("float32"))],
-        label=[mx.nd.array(label)])
-    for _ in range(3):
-        mod.forward_backward(b)
-        mod.update()
-    _fence(mod._exec_group.execs[0].arg_dict["conv1_1_weight"].data)
-    t0 = time.time()
-    for _ in range(steps):
-        mod.forward_backward(b)
-        mod.update()
-    _fence(mod._exec_group.execs[0].arg_dict["conv1_1_weight"].data)
-    dt = (time.time() - t0) / steps
-    _row("Training SSD-300 VGG16 img/s", batch / dt, "img/s", None,
-         "batch 32 bf16, MultiBoxTarget in-graph; reference example/ssd "
-         "config (no published reference number)")
+    xs = _stack(rng, k, (batch, 3, 300, 300))
+    label = np.full((k, batch, 3, 6), -1, np.float32)
+    label[:, :, 0] = [0, 0.1, 0.1, 0.5, 0.5, 0]
+    ys = jax.device_put(label)
+    compiled, state = _scan_train(mod, xs, ys, lr=0.001)
+
+    def call():
+        call.state = compiled(*call.state, xs, ys, np.uint32(0))[:3]
+        return call.state
+
+    call.state = state
+    dt = _time_compiled(call, lambda r: np.asarray(r[0][0].reshape(-1)[0]))
+    _row("Training SSD-300 VGG16 img/s", k * batch / dt, "img/s", None,
+         "batch 32 bf16, MultiBoxTarget in-graph, %d steps/dispatch; "
+         "reference example/ssd config (no published reference number)" % k,
+         mfu=_flops(compiled, k) / dt / V5E_PEAK_FLOPS)
 
 
 def main():

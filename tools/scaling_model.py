@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Analytic multi-chip scaling model from the compiled SPMD program.
+
+The reference publishes measured 1..256-GPU scaling for ResNet training
+(reference example/image-classification/README.md:277-305) and BASELINE.md
+gates this repo at >=70% efficiency at 64 chips.  Multi-chip hardware is
+not available here, but the SPMD partitioner IS: this tool compiles the
+actual DP (and DPxTP) ResNet-50 training step for mesh sizes 8/16/64 on
+virtual CPU devices, COUNTS the collective traffic in the optimized HLO,
+and models step time against TPU v5e interconnect bandwidth.
+
+    python tools/scaling_model.py --mesh 8            # one mesh, JSON
+    python tools/scaling_model.py --sweep 8,16,64     # table for SCALING.md
+
+Outputs per mesh: per-chip FLOPs (XLA cost analysis), per-collective
+payload bytes from the HLO (all-reduce / all-gather / reduce-scatter /
+all-to-all / collective-permute), the analytic expectation (ring
+all-reduce of the gradient bytes: 2(n-1)/n x params), and predicted step
+time / scaling efficiency under the bandwidth model in SCALING.md.
+
+The HLO byte-counting is validated against the analytic formula by
+tests/test_scaling_model.py on the 8-device CPU mesh.
+"""
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# ---- v5e model constants (documented in SCALING.md) ---------------------
+V5E_PEAK_FLOPS = 197e12       # bf16 MAC=2
+V5E_ICI_BW = 90e9             # B/s per chip effective all-reduce bandwidth
+V5E_DCN_BW = 6.25e9           # B/s per chip (50 Gbps NIC) for >1-pod DP
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "u64": 8, "s64": 8,
+                "u32": 4, "s32": 4, "u16": 2, "s16": 2, "u8": 1, "s8": 1,
+                "pred": 1, "c64": 8, "c128": 16}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute")
+
+
+def collective_bytes(hlo_text):
+    """Per-kind result-payload bytes of every collective in optimized HLO.
+
+    Handles tuple-typed collectives (XLA fuses many gradient all-reduces
+    into one tuple all-reduce).  Returns {kind: bytes}; bytes are the
+    RESULT buffer sizes — the ring-traffic factors (2(n-1)/n for
+    all-reduce etc.) are applied by the model, not here."""
+    out = {k: 0 for k in _COLLECTIVES}
+    counts = {k: 0 for k in _COLLECTIVES}
+    # '%name = TYPE <op>(' where TYPE is 'f32[8,16]{...}' or a tuple
+    pat = re.compile(
+        r"= *((?:\([^)]*\))|(?:[a-z0-9]+\[[0-9,]*\]\S*)) +(%s)\(" %
+        "|".join(_COLLECTIVES))
+    ty = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+    for m in pat.finditer(hlo_text):
+        tystr, kind = m.group(1), m.group(2)
+        total = 0
+        for t in ty.finditer(tystr):
+            dt, dims = t.group(1), t.group(2)
+            if dt not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES[dt]
+        out[kind] += total
+        counts[kind] += 1
+    out = {k: v for k, v in out.items() if v}
+    return out, {k: v for k, v in counts.items() if v}
+
+
+def _compile_step(n_devices, tp, batch_per_chip=32, depth=50, image=224,
+                  classes=1000):
+    """Compile the DP (or DPxTP) train step on an n-device mesh; return
+    (per-chip flops, collective bytes, param bytes, hlo len)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.executor import _run_graph
+    from mxnet_tpu.models.resnet import resnet
+
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices, \
+        "need %d devices, have %d" % (n_devices, len(jax.devices()))
+    if tp:
+        assert n_devices % 4 == 0
+        mesh = Mesh(np.array(devices).reshape(n_devices // 4, 4),
+                    ("data", "model"))
+    else:
+        mesh = Mesh(np.array(devices), ("data",))
+    dp = mesh.shape["data"]
+    batch = batch_per_chip * dp
+
+    net = resnet(depth, num_classes=classes,
+                 image_shape=(3, image, image))
+    exe = net.simple_bind(mx.cpu(), data=(batch, 3, image, image),
+                          softmax_label=(batch,),
+                          compute_dtype="bfloat16")
+    an, xn = exe._arg_names, exe._aux_names
+    entries, order = exe._entries, exe._order
+    cast = exe._cast()
+    diff_names = [n for n in an if n not in ("data", "softmax_label")]
+    diff_idx = [an.index(n) for n in diff_names]
+    nondiff_idx = [i for i in range(len(an)) if i not in diff_idx]
+
+    def train_step(dv, ndv, aux, lr):
+        def fwd(d):
+            vals = [None] * len(an)
+            for i, v in zip(diff_idx, d):
+                vals[i] = v
+            for i, v in zip(nondiff_idx, ndv):
+                vals[i] = v
+            return _run_graph(entries, order, an, xn, tuple(vals), aux,
+                              True, None, cast=cast)
+
+        (outs, aux_upd), vjp_fn = jax.vjp(fwd, dv)
+        cots = tuple(jnp.ones_like(o) for o in outs)
+        (grads,) = vjp_fn((cots, tuple(jnp.zeros_like(a) for a in aux_upd)))
+        return tuple(p - lr * g for p, g in zip(dv, grads)), aux_upd
+
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+
+    def aval(arr, sh):
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype, sharding=sh)
+
+    args = exe._gather_args()
+    param_bytes = 0
+    dv_avals = []
+    for name in diff_names:
+        v = args[an.index(name)]
+        sh = repl
+        if tp and name in ("fc1_weight",):
+            sh = NamedSharding(mesh, P("model", None))
+        elif tp and name in ("fc1_bias",):
+            sh = NamedSharding(mesh, P("model"))
+        else:
+            param_bytes += v.size * v.dtype.itemsize
+        dv_avals.append(aval(v, sh))
+    ndv_avals = tuple(aval(args[i], data_sh) for i in nondiff_idx)
+    aux_avals = tuple(aval(a, repl) for a in exe._gather_aux())
+
+    with mesh:
+        lowered = jax.jit(train_step).lower(
+            tuple(dv_avals), ndv_avals, aux_avals,
+            jax.ShapeDtypeStruct((), jnp.float32))
+        compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    flops = float(ca.get("flops", 0.0))
+    hlo = compiled.as_text()
+    coll, counts = collective_bytes(hlo)
+    return {"n_devices": n_devices, "tp": tp, "dp": dp,
+            "batch_per_chip": batch_per_chip, "global_batch": batch,
+            "per_chip_flops": flops, "replicated_param_bytes": param_bytes,
+            "collective_result_bytes": coll, "collective_counts": counts}
+
+
+def analyze(rec, measured_1chip_img_s=2502.0):
+    """Apply the bandwidth model; see SCALING.md for the derivation."""
+    n = rec["n_devices"]
+    bpc = rec["batch_per_chip"]
+    # compute time at this per-chip batch from the measured 1-chip rate
+    t_comp = bpc / measured_1chip_img_s
+    cb = rec["collective_result_bytes"]
+    # ring traffic per chip: all-reduce moves 2(n-1)/n x payload, gather/
+    # scatter (n-1)/n, all-to-all (n-1)/n, permute 1x
+    ring = {"all-reduce": 2.0 * (n - 1) / n, "all-gather": (n - 1) / n,
+            "reduce-scatter": (n - 1) / n, "all-to-all": (n - 1) / n,
+            "collective-permute": 1.0}
+    traffic = sum(v * ring[k] for k, v in cb.items())
+    t_comm_ici = traffic / V5E_ICI_BW
+    # overlap: XLA overlaps the gradient all-reduce with remaining backward
+    # compute; bound efficiency between zero and full overlap
+    t_no = t_comp + t_comm_ici
+    t_full = max(t_comp, t_comm_ici)
+    rec.update({
+        "per_chip_traffic_bytes": int(traffic),
+        "t_compute_s": round(t_comp, 5),
+        "t_comm_ici_s": round(t_comm_ici, 5),
+        "efficiency_no_overlap": round(t_comp / t_no, 4),
+        "efficiency_full_overlap": round(t_comp / t_full, 4),
+        "img_s_no_overlap": round(n * bpc / t_no, 1),
+        "img_s_full_overlap": round(n * bpc / t_full, 1),
+    })
+    return rec
+
+
+def run_child(n, tp, batch_per_chip, depth, image, classes):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if not f.startswith("--xla_force_host_platform_device_count"))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=%d"
+                        % n).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mesh", str(n),
+         "--batch-per-chip", str(batch_per_chip), "--depth", str(depth),
+         "--image", str(image), "--classes", str(classes)] +
+        (["--tp"] if tp else []),
+        env=env, capture_output=True, text=True, timeout=3600, cwd=REPO)
+    if proc.returncode != 0:
+        raise RuntimeError(proc.stdout + proc.stderr)
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mesh", type=int, default=None,
+                   help="child mode: compile on THIS process's devices")
+    p.add_argument("--tp", action="store_true")
+    p.add_argument("--sweep", default=None, help="e.g. 8,16,64")
+    p.add_argument("--batch-per-chip", type=int, default=32)
+    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--image", type=int, default=224)
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--out", default="SCALING.json")
+    args = p.parse_args()
+
+    if args.mesh is not None:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        rec = _compile_step(args.mesh, args.tp, args.batch_per_chip,
+                            args.depth, args.image, args.classes)
+        print(json.dumps(rec))
+        return
+
+    sizes = [int(s) for s in (args.sweep or "8,16,64").split(",")]
+    recs = []
+    for n in sizes:
+        for tp in (False, True):
+            if tp and n % 4:
+                continue
+            rec = analyze(run_child(n, tp, args.batch_per_chip, args.depth,
+                                    args.image, args.classes))
+            recs.append(rec)
+            print(json.dumps(rec), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(recs, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
