@@ -55,6 +55,14 @@ REGISTRY = [
            "Start profiling at import; dump via mx.profiler.dump_profile()"),
     EnvVar("MXNET_PROFILER_FILENAME", str, "profile.json",
            "Profiler output path (profiler.py)"),
+    EnvVar("MXNET_BN_STATS_SAMPLE", int, 0,
+           "Ghost-batch BN statistics: compute train-mode batch-norm "
+           "mean/var on the leading N samples only (0 = full batch). "
+           "A SEMANTICS knob (ghost batch norm, a large-batch "
+           "regularizer) — measured NOT a perf knob: ResNet-50 b512 "
+           "step time is unchanged at N=128 (README Roofline item 6; "
+           "the forward stats passes are already hidden by XLA). "
+           "Opt-in, never default"),
     EnvVar("MXNET_TPU_PALLAS_BN", int, 0,
            "Use the hand-tiled Pallas kernel for BatchNorm train-mode "
            "statistics on channel-minor TPU graphs (ops/pallas_kernels.py). "

@@ -49,3 +49,19 @@ def test_dp_step_allreduces_gradient_bytes():
     expect = 2.0 * 7 / 8 * ar
     assert abs(out["per_chip_traffic_bytes"] - expect) / expect < 1e-6
     assert 0 < out["efficiency_no_overlap"] <= 1.0
+
+
+def test_collective_bytes_async_forms():
+    """TPU backends lower collectives as -start/-done pairs; the -start
+    half carries the traffic and must be counted, -done must not."""
+    from scaling_model import collective_bytes
+
+    hlo = """
+  %s = f32[1000]{0} all-reduce-start(f32[1000]{0} %p), replica_groups={}
+  %d = f32[1000]{0} all-reduce-done(f32[1000]{0} %s)
+  %g = bf16[64]{0} all-gather-start(bf16[16]{0} %x), dimensions={0}
+"""
+    by, counts = collective_bytes(hlo)
+    assert by["all-reduce"] == 1000 * 4
+    assert by["all-gather"] == 64 * 2
+    assert counts == {"all-reduce": 1, "all-gather": 1}

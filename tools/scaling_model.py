@@ -32,11 +32,10 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # ---- v5e model constants (documented in SCALING.md) ---------------------
-V5E_PEAK_FLOPS = 197e12       # bf16 MAC=2
-V5E_ICI_BW = 90e9             # B/s per chip effective all-reduce bandwidth
-V5E_DCN_BW = 6.25e9           # B/s per chip (50 Gbps NIC) for >1-pod DP
+from tpu_constants import V5E_DCN_BW, V5E_ICI_BW, V5E_PEAK_FLOPS  # noqa: E402,F401
 
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "u64": 8, "s64": 8,
                 "u32": 4, "s32": 4, "u16": 2, "s16": 2, "u8": 1, "s8": 1,
@@ -55,9 +54,11 @@ def collective_bytes(hlo_text):
     all-reduce etc.) are applied by the model, not here."""
     out = {k: 0 for k in _COLLECTIVES}
     counts = {k: 0 for k in _COLLECTIVES}
-    # '%name = TYPE <op>(' where TYPE is 'f32[8,16]{...}' or a tuple
+    # '%name = TYPE <op>(' where TYPE is 'f32[8,16]{...}' or a tuple;
+    # async pairs count the -start half only (the -done carries no new
+    # traffic), so TPU-style async lowering is not undercounted
     pat = re.compile(
-        r"= *((?:\([^)]*\))|(?:[a-z0-9]+\[[0-9,]*\]\S*)) +(%s)\(" %
+        r"= *((?:\([^)]*\))|(?:[a-z0-9]+\[[0-9,]*\]\S*)) +(%s)(?:-start)?\(" %
         "|".join(_COLLECTIVES))
     ty = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
     for m in pat.finditer(hlo_text):
@@ -161,6 +162,11 @@ def _compile_step(n_devices, tp, batch_per_chip=32, depth=50, image=224,
     flops = float(ca.get("flops", 0.0))
     hlo = compiled.as_text()
     coll, counts = collective_bytes(hlo)
+    # a DP step with no detected all-reduce means the parser missed the
+    # lowering (e.g. a new async form) — fail loudly, never publish a
+    # zero-traffic "perfect scaling" record
+    assert coll.get("all-reduce") or coll.get("reduce-scatter"), \
+        "no gradient collective found in HLO — parser out of date?"
     return {"n_devices": n_devices, "tp": tp, "dp": dp,
             "batch_per_chip": batch_per_chip, "global_batch": batch,
             "per_chip_flops": flops, "replicated_param_bytes": param_bytes,

@@ -1,0 +1,7 @@
+"""Shared TPU v5e hardware constants — ONE source for the benchmark
+table's MFU (tools/benchmark_score.py, bench.py docs) and the scaling
+model's efficiency math (tools/scaling_model.py, SCALING.md)."""
+
+V5E_PEAK_FLOPS = 197e12   # bf16 peak, MAC=2 convention on both sides
+V5E_ICI_BW = 90e9         # B/s per chip effective all-reduce bandwidth
+V5E_DCN_BW = 6.25e9       # B/s per chip (50 Gbps NIC) for cross-pod DP
