@@ -1,0 +1,150 @@
+/*
+ * c_api.h — core C ABI: the training/graph surface beyond
+ * c_predict_api.h.
+ *
+ * ABI parity: the NDArray / op-invocation / Symbol / Executor / KVStore
+ * groups of reference include/mxnet/c_api.h (same naming and return
+ * conventions: 0 ok, -1 error, MXGetLastError() for the message).
+ * Implementation (src/c_api.cc) embeds CPython and delegates to
+ * mxnet_tpu/_capi_impl.py — the compute path is JAX/XLA on TPU.
+ *
+ * Link against libmxnet_tpu.so (which also exports the whole
+ * c_predict_api.h surface); see tests/c_api_smoke.c for the embedding
+ * recipe.  dev_type: 1 = cpu, 2 = accelerator (the TPU chip).
+ *
+ * Pointer-returning accessors follow the reference convention: the
+ * storage stays valid until the next API call on the same handle (or
+ * same thread, for handle-less calls).
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXNET_DLL
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+
+MXNET_DLL const char *MXGetLastError();  /* shared with c_predict_api.h */
+
+MXNET_DLL int MXGetVersion(int *out);
+MXNET_DLL int MXRandomSeed(int seed);
+MXNET_DLL int MXNotifyShutdown();
+
+/* ------------------------------------------------------------ NDArray.
+ * dtype codes follow the reference: 0 f32, 1 f64, 2 f16, 3 u8, 4 i32,
+ * 5 i8, 6 i64.  SyncCopy* sizes count ELEMENTS. */
+MXNET_DLL int MXNDArrayCreateNone(NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out);
+MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXNET_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
+                                       const void *data, size_t size);
+MXNET_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXNET_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayWaitAll();
+MXNET_DLL int MXNDArrayFree(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXNET_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+MXNET_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id);
+MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint begin,
+                             mx_uint end, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim,
+                               const int *dims, NDArrayHandle *out);
+MXNET_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys);
+MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr, mx_uint *out_name_size,
+                            const char ***out_names);
+
+/* ---------------------------------------------------- op invocation.
+ * Ops are addressed BY NAME (the registry is the one source of truth;
+ * the reference's creator-handle indirection collapses to a lookup). */
+MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+MXNET_DLL int MXImperativeInvoke(const char *op_name, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals);
+
+/* ------------------------------------------------------------- Symbol */
+MXNET_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+MXNET_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateAtomicSymbol(const char *op_name,
+                                         mx_uint num_param,
+                                         const char **keys,
+                                         const char **vals,
+                                         SymbolHandle *out);
+/* Composes IN PLACE: after this the handle holds the applied symbol. */
+MXNET_DLL int MXSymbolCompose(SymbolHandle handle, const char *name,
+                              mx_uint num_args, const char **keys,
+                              SymbolHandle *args);
+MXNET_DLL int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                                    const char ***out_array);
+MXNET_DLL int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                                  const char ***out_array);
+MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle handle,
+                                          mx_uint *out_size,
+                                          const char ***out_array);
+MXNET_DLL int MXSymbolInferShape(
+    SymbolHandle handle, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+MXNET_DLL int MXSymbolFree(SymbolHandle handle);
+
+/* ----------------------------------------------------------- Executor.
+ * grad_req codes: 0 null, 1 write, 2 inplace(=write), 3 add.
+ * Gradient arrays are allocated internally; read them back with
+ * MXExecutorGrads (name-aligned). */
+MXNET_DLL int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                             mx_uint num_args, NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             const mx_uint *grad_req_type,
+                             mx_uint aux_states_len,
+                             NDArrayHandle *aux_states,
+                             ExecutorHandle *out);
+MXNET_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
+MXNET_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads);
+MXNET_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXNET_DLL int MXExecutorGrads(ExecutorHandle handle, mx_uint *out_size,
+                              NDArrayHandle **out_arrs,
+                              const char ***out_names);
+MXNET_DLL int MXExecutorFree(ExecutorHandle handle);
+
+/* ------------------------------------------------------------ KVStore */
+MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+MXNET_DLL int MXKVStoreInit(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStorePush(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStorePull(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStoreFree(KVStoreHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

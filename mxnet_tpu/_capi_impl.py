@@ -1,0 +1,201 @@
+"""Python-side implementations behind the core C API (src/c_api.cc).
+
+The C translation unit only marshals argv; each exported MX* function
+maps onto ONE plain function here taking/returning simple types (bytes,
+tuples, strings), so the C glue stays thin and this logic is testable
+from Python directly (tests/test_c_api.py exercises both layers).
+
+Parity target: reference include/mxnet/c_api.h (the NDArray / op-invoke
+/ Symbol / Executor / KVStore groups — the training surface beyond
+c_predict_api.h).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as _nd
+from . import symbol as _sym
+from .base import MXNetError
+from .context import Context, cpu, tpu
+from .ndarray import NDArray
+from .ops.registry import OP_REGISTRY
+
+
+def _ctx(dev_type, dev_id):
+    return cpu(dev_id) if dev_type == 1 else tpu(dev_id)
+
+
+# ---------------------------------------------------------------- ndarray
+def nd_create(shape, dev_type, dev_id, dtype="float32"):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.zeros(tuple(shape), dtype=jnp.dtype(dtype)),
+                   _ctx(dev_type, dev_id))
+
+
+def nd_from_bytes(arr, data, dtype):
+    """SyncCopyFromCPU: raw little-endian bytes -> the array, in place."""
+    src = _np.frombuffer(data, dtype=_np.dtype(dtype)).reshape(arr.shape)
+    arr[:] = src.astype(arr.dtype, copy=False)
+
+
+def nd_to_bytes(arr):
+    """SyncCopyToCPU: the array's contents as contiguous raw bytes."""
+    return _np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def nd_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def nd_dtype_name(arr):
+    return str(_np.dtype(arr.dtype))
+
+
+def nd_context(arr):
+    c = arr.context
+    return (1 if c.device_type == "cpu" else 2, c.device_id)
+
+
+def nd_slice(arr, begin, end):
+    return arr[begin:end]
+
+
+def nd_reshape(arr, shape):
+    return arr.reshape(tuple(shape))
+
+
+def nd_save(fname, arrs, keys):
+    _nd.save(fname, dict(zip(keys, arrs)) if keys else list(arrs))
+
+
+def nd_load(fname):
+    loaded = _nd.load(fname)
+    if isinstance(loaded, dict):
+        keys = list(loaded.keys())
+        return [loaded[k] for k in keys], keys
+    return list(loaded), []
+
+
+def nd_wait(arr):
+    arr.wait_to_read()
+
+
+# ------------------------------------------------------------- op invoke
+def list_op_names():
+    return sorted(n for n in OP_REGISTRY if not n.startswith("Custom:"))
+
+
+def imperative_invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvoke analog: run a registered op on NDArray inputs
+    with string attrs; returns the list of output NDArrays."""
+    if op_name not in OP_REGISTRY:
+        raise MXNetError("unknown operator %s" % op_name)
+    fn = _nd._make_nd_function(OP_REGISTRY[op_name])
+    out = fn(*inputs, **dict(zip(keys, vals)))
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# ---------------------------------------------------------------- symbol
+def symbol_from_json(json_str):
+    return _sym.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_variable(name):
+    return _sym.Variable(name)
+
+
+def symbol_create(op_name, keys, vals, name):
+    """CreateAtomicSymbol+Compose in one step: inputs are composed later
+    via symbol_compose (reference two-phase creation)."""
+    if op_name not in OP_REGISTRY:
+        raise MXNetError("unknown operator %s" % op_name)
+    return (op_name, dict(zip(keys, vals)), name or None)
+
+
+def symbol_compose(creator, args):
+    op_name, attrs, name = creator
+    return _sym._create(op_name, list(args), attrs, name=name)
+
+
+def symbol_list(sym, which):
+    if which == "arguments":
+        return sym.list_arguments()
+    if which == "outputs":
+        return sym.list_outputs()
+    if which == "auxiliary_states":
+        return sym.list_auxiliary_states()
+    raise MXNetError("unknown list kind %s" % which)
+
+
+def symbol_infer_shape(sym, keys, shapes):
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(
+        **dict(zip(keys, [tuple(s) for s in shapes])))
+    return ([tuple(s) for s in arg_shapes or []],
+            [tuple(s) for s in out_shapes or []],
+            [tuple(s) for s in aux_shapes or []])
+
+
+# -------------------------------------------------------------- executor
+def executor_bind(sym, dev_type, dev_id, args, grad_reqs, auxs):
+    names = sym.list_arguments()
+    req = {n: r for n, r in zip(names, grad_reqs)}
+    grads = {n: NDArray(_np.zeros(a.shape, _np.float32))
+             for n, a, r in zip(names, args, grad_reqs) if r != "null"}
+    return sym.bind(_ctx(dev_type, dev_id), list(args), args_grad=grads,
+                    grad_req=req, aux_states=list(auxs) if auxs else None)
+
+
+def executor_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+
+
+def executor_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+
+
+def executor_outputs(exe):
+    return list(exe.outputs)
+
+
+def executor_grads(exe):
+    """Gradient arrays in list_arguments order (None -> omitted name)."""
+    names, arrs = [], []
+    for n in exe._symbol.list_arguments():
+        g = exe.grad_dict.get(n)
+        if g is not None:
+            names.append(n)
+            arrs.append(g)
+    return arrs, names
+
+
+# --------------------------------------------------------------- kvstore
+def kv_create(kind):
+    from . import kvstore as _kv
+
+    return _kv.create(kind)
+
+
+def kv_init(kv, keys, arrs):
+    for k, a in zip(keys, arrs):
+        kv.init(str(k), a)
+
+
+def kv_push(kv, keys, arrs):
+    for k, a in zip(keys, arrs):
+        kv.push(str(k), a)
+
+
+def kv_pull(kv, keys, arrs):
+    for k, a in zip(keys, arrs):
+        kv.pull(str(k), a)
+
+
+def random_seed(seed):
+    from . import random as _random
+
+    _random.seed(int(seed))

@@ -49,14 +49,9 @@ def _load(name, sources, extra=()):
         return lib
 
 
-def get_predict_lib_path():
-    """Build (if needed) and return the path of the C predict ABI library
-    (include/mxnet_tpu/c_predict_api.h ≙ reference c_predict_api.h).
-
-    Unlike the other natives this one EMBEDS CPython — it is meant to be
-    linked by non-Python processes — so it needs the interpreter's include
-    dir and libpython on the link line.  Returns None if no toolchain or
-    no shared libpython is available."""
+def _embed_flags():
+    """g++ flags to embed CPython (include dir + shared libpython), or
+    None when this interpreter has no shared library to embed."""
     import sysconfig
 
     inc = sysconfig.get_paths()["include"]
@@ -73,34 +68,52 @@ def get_predict_lib_path():
         link = "-l%s" % ldlib[len("lib"):-len(".so")]
     else:
         link = "-l:%s" % ldlib
-    extra = [
-        "-I%s" % inc,
-        "-L%s" % libdir,
-        link,
-        "-Wl,-rpath,%s" % libdir,
-    ]
+    return ["-I%s" % inc, "-L%s" % libdir, link, "-Wl,-rpath,%s" % libdir]
+
+
+def _embedded_lib_path(name, sources):
+    """Build (if needed) a CPython-embedding C ABI library.
+
+    These .so files are meant to be linked by non-Python processes, so
+    they carry the interpreter on the link line; the cache invalidates on
+    flag changes (interpreter moved) and on py_embed.h edits, which the
+    plain source-mtime check cannot see."""
+    extra = _embed_flags()
+    if extra is None:
+        return None
     with _LOCK:
         try:
-            # the .so embeds one specific interpreter; invalidate the cache
-            # when the link flags (interpreter/libdir) change, which the
-            # source-mtime check in _build cannot see
-            flags_path = os.path.join(_BUILD_DIR, "libmxnet_tpu_predict.flags")
+            flags_path = os.path.join(_BUILD_DIR, "lib%s.flags" % name)
+            hdr = os.path.join(_SRC_DIR, "py_embed.h")
             flags = " ".join(extra)
+            if os.path.exists(hdr):
+                flags += " py_embed.h:%d" % int(os.path.getmtime(hdr))
             old = None
             if os.path.exists(flags_path):
                 with open(flags_path) as f:
                     old = f.read()
-            if old != flags:
-                out = os.path.join(_BUILD_DIR, "libmxnet_tpu_predict.so")
-                if os.path.exists(out):
-                    os.remove(out)
-            path = _build("mxnet_tpu_predict", ["c_predict_api.cc"], extra)
+            out = os.path.join(_BUILD_DIR, "lib%s.so" % name)
+            if old != flags and os.path.exists(out):
+                os.remove(out)
+            path = _build(name, sources, extra)
             os.makedirs(_BUILD_DIR, exist_ok=True)
             with open(flags_path, "w") as f:
                 f.write(flags)
             return path
         except Exception:
             return None
+
+
+def get_predict_lib_path():
+    """The predict-only C ABI library (c_predict_api.h surface)."""
+    return _embedded_lib_path("mxnet_tpu_predict", ["c_predict_api.cc"])
+
+
+def get_c_api_lib_path():
+    """The FULL C ABI library: core c_api.h (NDArray / op invoke / Symbol
+    / Executor / KVStore) plus the whole c_predict_api.h surface."""
+    return _embedded_lib_path("mxnet_tpu",
+                              ["c_predict_api.cc", "c_api.cc"])
 
 
 def get_recordio_lib():

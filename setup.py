@@ -34,7 +34,8 @@ class BuildNative(Command):
 
         for name, fn in [("recordio", native.get_recordio_lib),
                          ("imdecode", native.get_imdecode_lib),
-                         ("predict ABI", native.get_predict_lib_path)]:
+                         ("predict ABI", native.get_predict_lib_path),
+                         ("c_api ABI", native.get_c_api_lib_path)]:
             ok = fn() is not None
             print("  native %-12s %s" % (name, "built" if ok else
                                          "SKIPPED (no toolchain)"))

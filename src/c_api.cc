@@ -1,0 +1,668 @@
+// Core C API — the training/graph surface beyond c_predict_api.cc
+// (include/mxnet_tpu/c_api.h).
+//
+// Parity: reference src/c_api/c_api.cc groups — NDArray create/copy/
+// save/load/shape, imperative op invocation, Symbol create/compose/
+// infer, Executor bind/forward/backward/outputs, KVStore — the subset a
+// C embedder needs to BUILD and TRAIN, not just run, a model.  The
+// reference links its C++ engine; here every function marshals onto one
+// plain-Python helper in mxnet_tpu/_capi_impl.py (same embedded-CPython
+// design as c_predict_api.cc: one executor implementation, no drift).
+//
+// Handles are opaque wrappers over Python objects; every function
+// returns 0/-1 with MXGetLastError() for the message (defined in
+// c_predict_api.cc — both TUs link into one libmxnet_tpu.so).
+#include "py_embed.h"
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+using mxtpu::Gil;
+using mxtpu::import_attr;
+using mxtpu::set_error;
+using mxtpu::set_error_from_python;
+
+namespace {
+
+struct Handle {
+  PyObject *obj = nullptr;
+  // scratch backing for pointer-returning accessors (valid until the
+  // next call on the same handle, the reference's convention)
+  std::vector<unsigned> shape;
+  std::vector<std::string> strs;
+  std::vector<const char *> cstrs;
+};
+
+Handle *wrap(PyObject *obj) {
+  Handle *h = new Handle();
+  h->obj = obj;
+  return h;
+}
+
+PyObject *unwrap(void *h) { return static_cast<Handle *>(h)->obj; }
+
+// call mxnet_tpu._capi_impl.<fn>(args...); returns new ref or null.
+PyObject *impl_call(const char *fn, PyObject *args) {
+  PyObject *f = import_attr("mxnet_tpu._capi_impl", fn);
+  if (!f) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *r = args ? PyObject_CallObject(f, args) : PyObject_CallObject(f, nullptr);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+PyObject *str_list(unsigned n, const char **v) {
+  PyObject *l = PyList_New(n);
+  for (unsigned i = 0; l && i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(v[i]));
+  return l;
+}
+
+PyObject *handle_list(unsigned n, void **v) {
+  PyObject *l = PyList_New(n);
+  for (unsigned i = 0; l && i < n; ++i) {
+    PyObject *o = unwrap(v[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+PyObject *shape_tuple(unsigned ndim, const unsigned *dims) {
+  PyObject *t = PyTuple_New(ndim);
+  for (unsigned i = 0; t && i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(dims[i]));
+  return t;
+}
+
+// stash a python list of str into the handle's scratch; return count.
+int stash_strs(Handle *h, PyObject *list, unsigned *out_size,
+               const char ***out_array) {
+  Py_ssize_t n = PyList_Size(list);
+  h->strs.clear();
+  h->cstrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (!c) return -1;
+    h->strs.emplace_back(c);
+  }
+  for (auto &s : h->strs) h->cstrs.push_back(s.c_str());
+  *out_size = static_cast<unsigned>(n);
+  *out_array = h->cstrs.data();
+  return 0;
+}
+
+// unpack a python list of NDArray into new handles written to out[i].
+// `scratch` is the CALLER-FAMILY's thread_local vector, so results from
+// different API families (Load / Invoke / Outputs / Grads) do not
+// invalidate each other — only the next call of the SAME function on
+// this thread reuses the storage (the header's documented lifetime).
+int unpack_handles(PyObject *list, unsigned *out_size, void ***out_array,
+                   std::vector<void *> &scratch) {
+  Py_ssize_t n = PyList_Size(list);
+  scratch.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(list, i);
+    Py_INCREF(o);
+    scratch.push_back(wrap(o));
+  }
+  *out_size = static_cast<unsigned>(n);
+  *out_array = scratch.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXGetVersion(int *out) {
+  *out = 1000;  // 0.10.x-compatible surface, TPU-native build
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject *r = impl_call("random_seed", Py_BuildValue("(i)", seed));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNotifyShutdown() { return 0; }
+
+/* ---------------------------------------------------------- NDArray */
+
+int MXNDArrayCreateEx(const unsigned *shape, unsigned ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype, void **out) {
+  (void)delay_alloc;
+  Gil gil;
+  static const char *names[] = {"float32", "float64", "float16",
+                                "uint8",   "int32",   "int8", "int64"};
+  const char *dt = (dtype >= 0 && dtype < 7) ? names[dtype] : "float32";
+  PyObject *shp = shape_tuple(ndim, shape);
+  PyObject *r = impl_call("nd_create", Py_BuildValue("(Oiis)", shp, dev_type,
+                                                     dev_id, dt));
+  Py_XDECREF(shp);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayCreate(const unsigned *shape, unsigned ndim, int dev_type,
+                    int dev_id, int delay_alloc, void **out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0, out);
+}
+
+int MXNDArrayCreateNone(void **out) {
+  unsigned one = 1;
+  return MXNDArrayCreate(&one, 1, 1, 0, 0, out);
+}
+
+int MXNDArraySyncCopyFromCPU(void *handle, const void *data, size_t size) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *dt = impl_call("nd_dtype_name", Py_BuildValue("(O)", h->obj));
+  if (!dt) { set_error_from_python(); return -1; }
+  // `size` counts ELEMENTS (reference ABI); bytes = size * itemsize
+  PyObject *bytes = nullptr;
+  {
+    PyObject *np = import_attr("numpy", "dtype");
+    PyObject *d = np ? PyObject_CallFunction(np, "O", dt) : nullptr;
+    PyObject *isz = d ? PyObject_GetAttrString(d, "itemsize") : nullptr;
+    long item = isz ? PyLong_AsLong(isz) : 4;
+    Py_XDECREF(np);
+    Py_XDECREF(d);
+    Py_XDECREF(isz);
+    bytes = PyBytes_FromStringAndSize(static_cast<const char *>(data),
+                                      static_cast<Py_ssize_t>(size) * item);
+  }
+  PyObject *r = bytes ? impl_call("nd_from_bytes",
+                                  Py_BuildValue("(OOO)", h->obj, bytes, dt))
+                      : nullptr;
+  Py_XDECREF(bytes);
+  Py_DECREF(dt);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(void *handle, void *data, size_t size) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("nd_to_bytes", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  // `size` counts elements (reference ABI): the caller's buffer must
+  // hold exactly the array — reject mismatches instead of overflowing
+  PyObject *shp = impl_call("nd_shape", Py_BuildValue("(O)", h->obj));
+  long nelem = 1;
+  if (shp) {
+    Py_ssize_t nd2 = PyTuple_Size(shp);
+    for (Py_ssize_t i = 0; i < nd2; ++i)
+      nelem *= PyLong_AsLong(PyTuple_GetItem(shp, i));
+    Py_DECREF(shp);
+  }
+  if (static_cast<long>(size) != nelem) {
+    Py_DECREF(r);
+    set_error("MXNDArraySyncCopyToCPU: size " + std::to_string(size) +
+              " != array elements " + std::to_string(nelem));
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(void *handle) {
+  Gil gil;
+  PyObject *r = impl_call("nd_wait", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll() { return 0; }  // PJRT fences per-array on read
+
+int MXNDArrayFree(void *handle) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXNDArrayGetShape(void *handle, unsigned *out_dim, const unsigned **out) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("nd_shape", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyTuple_Size(r);
+  h->shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape.push_back(
+        static_cast<unsigned>(PyLong_AsLong(PyTuple_GetItem(r, i))));
+  Py_DECREF(r);
+  *out_dim = static_cast<unsigned>(n);
+  *out = h->shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(void *handle, int *out) {
+  Gil gil;
+  PyObject *r = impl_call("nd_dtype_name", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  const char *c = PyUnicode_AsUTF8(r);
+  static const char *names[] = {"float32", "float64", "float16",
+                                "uint8",   "int32",   "int8", "int64"};
+  *out = 0;
+  for (int i = 0; c && i < 7; ++i)
+    if (std::strcmp(c, names[i]) == 0) *out = i;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetContext(void *handle, int *out_dev_type, int *out_dev_id) {
+  Gil gil;
+  PyObject *r = impl_call("nd_context", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySlice(void *handle, unsigned begin, unsigned end, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("nd_slice", Py_BuildValue("(OII)", unwrap(handle),
+                                                    begin, end));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayReshape(void *handle, int ndim, const int *dims, void **out) {
+  Gil gil;
+  PyObject *t = PyTuple_New(ndim);
+  for (int i = 0; t && i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(dims[i]));
+  PyObject *r = t ? impl_call("nd_reshape",
+                              Py_BuildValue("(OO)", unwrap(handle), t))
+                  : nullptr;
+  Py_XDECREF(t);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, unsigned num_args, void **args,
+                  const char **keys) {
+  Gil gil;
+  PyObject *arrs = handle_list(num_args, args);
+  PyObject *ks = keys ? str_list(num_args, keys) : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = impl_call("nd_save", Py_BuildValue("(sOO)", fname, arrs, ks));
+  Py_XDECREF(arrs);
+  Py_XDECREF(ks);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, unsigned *out_size, void ***out_arr,
+                  unsigned *out_name_size, const char ***out_names) {
+  Gil gil;
+  PyObject *r = impl_call("nd_load", Py_BuildValue("(s)", fname));
+  if (!r) { set_error_from_python(); return -1; }
+  PyObject *arrs = PyTuple_GetItem(r, 0);
+  PyObject *names = PyTuple_GetItem(r, 1);
+  static thread_local Handle name_scratch;
+  static thread_local std::vector<void *> load_scratch;
+  if (unpack_handles(arrs, out_size, out_arr, load_scratch) != 0 ||
+      stash_strs(&name_scratch, names, out_name_size, out_names) != 0) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* -------------------------------------------------------- op invoke */
+
+int MXListAllOpNames(unsigned *out_size, const char ***out_array) {
+  Gil gil;
+  PyObject *r = impl_call("list_op_names", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local Handle scratch;
+  int rc = stash_strs(&scratch, r, out_size, out_array);
+  Py_DECREF(r);
+  if (rc != 0) { set_error_from_python(); return -1; }
+  return 0;
+}
+
+int MXImperativeInvoke(const char *op_name, int num_inputs, void **inputs,
+                       int *num_outputs, void ***outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  Gil gil;
+  PyObject *ins = handle_list(num_inputs, inputs);
+  PyObject *ks = str_list(num_params, param_keys);
+  PyObject *vs = str_list(num_params, param_vals);
+  PyObject *r = (ins && ks && vs)
+                    ? impl_call("imperative_invoke",
+                                Py_BuildValue("(sOOO)", op_name, ins, ks, vs))
+                    : nullptr;
+  Py_XDECREF(ins);
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (!r) { set_error_from_python(); return -1; }
+  unsigned n = 0;
+  void **arr = nullptr;
+  static thread_local std::vector<void *> invoke_scratch;
+  unpack_handles(r, &n, &arr, invoke_scratch);
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = arr;
+  return 0;
+}
+
+/* ------------------------------------------------------------ symbol */
+
+int MXSymbolCreateFromJSON(const char *json, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("symbol_from_json", Py_BuildValue("(s)", json));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(void *handle, const char **out_json) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("symbol_to_json", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  const char *c = PyUnicode_AsUTF8(r);
+  h->strs.assign(1, c ? c : "");
+  Py_DECREF(r);
+  *out_json = h->strs[0].c_str();
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("symbol_variable", Py_BuildValue("(s)", name));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, unsigned num_param,
+                               const char **keys, const char **vals,
+                               void **out) {
+  Gil gil;
+  PyObject *ks = str_list(num_param, keys);
+  PyObject *vs = str_list(num_param, vals);
+  PyObject *r = (ks && vs) ? impl_call("symbol_create",
+                                       Py_BuildValue("(sOOs)", op_name, ks,
+                                                     vs, ""))
+                           : nullptr;
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolCompose(void *handle, const char *name, unsigned num_args,
+                    const char **keys, void **args) {
+  // only positional composition is implemented; silently treating named
+  // args as positional would bind them to the wrong inputs
+  if (keys != nullptr) {
+    set_error("MXSymbolCompose: named (keyword) composition is not "
+              "supported — pass args positionally with keys=NULL");
+    return -1;
+  }
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *creator = h->obj;
+  // re-tag the creator tuple with the instance name
+  PyObject *tagged = Py_BuildValue("(OOs)", PyTuple_GetItem(creator, 0),
+                                   PyTuple_GetItem(creator, 1),
+                                   name ? name : "");
+  PyObject *arg_list = handle_list(num_args, args);
+  PyObject *r = (tagged && arg_list)
+                    ? impl_call("symbol_compose",
+                                Py_BuildValue("(OO)", tagged, arg_list))
+                    : nullptr;
+  Py_XDECREF(tagged);
+  Py_XDECREF(arg_list);
+  if (!r) { set_error_from_python(); return -1; }
+  // composing REPLACES the handle's object (reference mutates in place)
+  Py_DECREF(h->obj);
+  h->obj = r;
+  return 0;
+}
+
+static int symbol_list_impl(void *handle, const char *which,
+                            unsigned *out_size, const char ***out_array) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("symbol_list",
+                          Py_BuildValue("(Os)", h->obj, which));
+  if (!r) { set_error_from_python(); return -1; }
+  int rc = stash_strs(h, r, out_size, out_array);
+  Py_DECREF(r);
+  if (rc != 0) { set_error_from_python(); return -1; }
+  return 0;
+}
+
+int MXSymbolListArguments(void *handle, unsigned *out_size,
+                          const char ***out_array) {
+  return symbol_list_impl(handle, "arguments", out_size, out_array);
+}
+
+int MXSymbolListOutputs(void *handle, unsigned *out_size,
+                        const char ***out_array) {
+  return symbol_list_impl(handle, "outputs", out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(void *handle, unsigned *out_size,
+                                const char ***out_array) {
+  return symbol_list_impl(handle, "auxiliary_states", out_size, out_array);
+}
+
+int MXSymbolFree(void *handle) { return MXNDArrayFree(handle); }
+
+int MXSymbolInferShape(void *handle, unsigned num_args, const char **keys,
+                       const unsigned *arg_ind_ptr, const unsigned *arg_shape_data,
+                       unsigned *in_shape_size, const unsigned **in_shape_ndim,
+                       const unsigned ***in_shape_data,
+                       unsigned *out_shape_size, const unsigned **out_shape_ndim,
+                       const unsigned ***out_shape_data,
+                       unsigned *aux_shape_size, const unsigned **aux_shape_ndim,
+                       const unsigned ***aux_shape_data, int *complete) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *ks = str_list(num_args, keys);
+  PyObject *shapes = PyList_New(num_args);
+  for (unsigned i = 0; shapes && i < num_args; ++i)
+    PyList_SET_ITEM(shapes, i,
+                    shape_tuple(arg_ind_ptr[i + 1] - arg_ind_ptr[i],
+                                arg_shape_data + arg_ind_ptr[i]));
+  PyObject *r = (ks && shapes)
+                    ? impl_call("symbol_infer_shape",
+                                Py_BuildValue("(OOO)", h->obj, ks, shapes))
+                    : nullptr;
+  Py_XDECREF(ks);
+  Py_XDECREF(shapes);
+  if (!r) { set_error_from_python(); return -1; }
+  // stash all three groups into per-thread scratch
+  static thread_local std::vector<unsigned> ndims[3];
+  static thread_local std::vector<std::vector<unsigned>> dims[3];
+  static thread_local std::vector<const unsigned *> ptrs[3];
+  unsigned sizes[3];
+  for (int g = 0; g < 3; ++g) {
+    PyObject *group = PyTuple_GetItem(r, g);
+    Py_ssize_t n = PyList_Size(group);
+    ndims[g].clear();
+    dims[g].clear();
+    ptrs[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *t = PyList_GetItem(group, i);
+      Py_ssize_t nd = PyTuple_Size(t);
+      std::vector<unsigned> d;
+      for (Py_ssize_t j = 0; j < nd; ++j)
+        d.push_back(static_cast<unsigned>(
+            PyLong_AsLong(PyTuple_GetItem(t, j))));
+      ndims[g].push_back(static_cast<unsigned>(nd));
+      dims[g].push_back(std::move(d));
+    }
+    for (auto &d : dims[g]) ptrs[g].push_back(d.data());
+    sizes[g] = static_cast<unsigned>(n);
+  }
+  Py_DECREF(r);
+  *in_shape_size = sizes[0];
+  *in_shape_ndim = ndims[0].data();
+  *in_shape_data = ptrs[0].data();
+  *out_shape_size = sizes[1];
+  *out_shape_ndim = ndims[1].data();
+  *out_shape_data = ptrs[1].data();
+  *aux_shape_size = sizes[2];
+  *aux_shape_ndim = ndims[2].data();
+  *aux_shape_data = ptrs[2].data();
+  // underdetermined inference returns empty groups: report incomplete so
+  // callers honoring the reference contract never index empty results
+  *complete = (sizes[0] || sizes[1]) ? 1 : 0;
+  return 0;
+}
+
+/* ---------------------------------------------------------- executor */
+
+int MXExecutorBind(void *sym_handle, int dev_type, int dev_id,
+                   unsigned num_args, void **in_args, void **arg_grad_store,
+                   const unsigned *grad_req_type, unsigned aux_states_len,
+                   void **aux_states, void **out) {
+  (void)arg_grad_store;  // grads are allocated per grad_req internally
+  Gil gil;
+  static const char *reqs[] = {"null", "write", "inplace", "add"};
+  PyObject *args = handle_list(num_args, in_args);
+  PyObject *auxs = handle_list(aux_states_len, aux_states);
+  PyObject *rq = PyList_New(num_args);
+  for (unsigned i = 0; rq && i < num_args; ++i)
+    PyList_SET_ITEM(rq, i, PyUnicode_FromString(
+                               reqs[grad_req_type[i] < 4 ? grad_req_type[i]
+                                                         : 1]));
+  PyObject *r = (args && auxs && rq)
+                    ? impl_call("executor_bind",
+                                Py_BuildValue("(OiiOOO)", unwrap(sym_handle),
+                                              dev_type, dev_id, args, rq,
+                                              auxs))
+                    : nullptr;
+  Py_XDECREF(args);
+  Py_XDECREF(auxs);
+  Py_XDECREF(rq);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXExecutorForward(void *handle, int is_train) {
+  Gil gil;
+  PyObject *r = impl_call("executor_forward",
+                          Py_BuildValue("(Oi)", unwrap(handle), is_train));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(void *handle, unsigned len, void **head_grads) {
+  Gil gil;
+  PyObject *heads = handle_list(len, head_grads);
+  PyObject *r = heads ? impl_call("executor_backward",
+                                  Py_BuildValue("(OO)", unwrap(handle), heads))
+                      : nullptr;
+  Py_XDECREF(heads);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(void *handle, unsigned *out_size, void ***out) {
+  Gil gil;
+  PyObject *r = impl_call("executor_outputs",
+                          Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local std::vector<void *> outputs_scratch;
+  unpack_handles(r, out_size, out, outputs_scratch);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorGrads(void *handle, unsigned *out_size, void ***out_arrs,
+                    const char ***out_names) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("executor_grads", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  unsigned ns = 0;
+  static thread_local std::vector<void *> grads_scratch;
+  unpack_handles(PyTuple_GetItem(r, 0), out_size, out_arrs, grads_scratch);
+  int rc = stash_strs(h, PyTuple_GetItem(r, 1), &ns, out_names);
+  Py_DECREF(r);
+  if (rc != 0) { set_error_from_python(); return -1; }
+  return 0;
+}
+
+int MXExecutorFree(void *handle) { return MXNDArrayFree(handle); }
+
+/* ----------------------------------------------------------- kvstore */
+
+int MXKVStoreCreate(const char *type, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("kv_create", Py_BuildValue("(s)", type));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+static int kv_op(const char *fn, void *handle, unsigned num, const int *keys,
+                 void **vals) {
+  Gil gil;
+  PyObject *ks = PyList_New(num);
+  for (unsigned i = 0; ks && i < num; ++i)
+    PyList_SET_ITEM(ks, i, PyLong_FromLong(keys[i]));
+  PyObject *vs = handle_list(num, vals);
+  PyObject *r = (ks && vs) ? impl_call(fn, Py_BuildValue("(OOO)",
+                                                         unwrap(handle), ks,
+                                                         vs))
+                           : nullptr;
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInit(void *handle, unsigned num, const int *keys, void **vals) {
+  return kv_op("kv_init", handle, num, keys, vals);
+}
+
+int MXKVStorePush(void *handle, unsigned num, const int *keys, void **vals) {
+  return kv_op("kv_push", handle, num, keys, vals);
+}
+
+int MXKVStorePull(void *handle, unsigned num, const int *keys, void **vals) {
+  return kv_op("kv_pull", handle, num, keys, vals);
+}
+
+int MXKVStoreFree(void *handle) { return MXNDArrayFree(handle); }
+
+}  // extern "C"

@@ -15,88 +15,19 @@
 // see tests/c_predict_smoke.c for the canonical embedding recipe.
 // All calls are GIL-safe and may come from any thread.
 
-#define PY_SSIZE_T_CLEAN
-#include <Python.h>
+#include "py_embed.h"
 
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
 
+using mxtpu::Gil;
+using mxtpu::g_last_error;
+using mxtpu::import_attr;
+using mxtpu::set_error;
+using mxtpu::set_error_from_python;
+
 namespace {
-
-thread_local std::string g_last_error;
-
-void set_error(const std::string &msg) { g_last_error = msg; }
-
-// Format the pending Python exception into g_last_error and clear it.
-void set_error_from_python() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value) {
-    PyObject *s = PyObject_Str(value);
-    if (s) {
-      const char *c = PyUnicode_AsUTF8(s);
-      if (c) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  if (type) {
-    PyObject *n = PyObject_GetAttrString(type, "__name__");
-    if (n) {
-      const char *c = PyUnicode_AsUTF8(n);
-      if (c) msg = std::string(c) + ": " + msg;
-      Py_DECREF(n);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  set_error(msg);
-}
-
-std::once_flag g_py_once;
-
-// Start CPython once, then drop the GIL so per-call PyGILState_Ensure
-// works from arbitrary threads.  If the host process already runs an
-// interpreter (e.g. a Python process dlopening this library), reuse it.
-void ensure_python() {
-  std::call_once(g_py_once, [] {
-    if (Py_IsInitialized()) return;
-    PyConfig config;
-    PyConfig_InitPythonConfig(&config);
-    config.parse_argv = 0;
-    config.install_signal_handlers = 0;  // never steal the host's handlers
-    Py_InitializeFromConfig(&config);
-    PyConfig_Clear(&config);
-    // Some site configs register accelerator plugins that override the
-    // platform choice at import; re-assert the caller's JAX_PLATFORMS so
-    // the documented env contract holds for embedders too.
-    PyRun_SimpleString(
-        "import os\n"
-        "_p = os.environ.get('JAX_PLATFORMS')\n"
-        "if _p and ',' not in _p:\n"
-        "    try:\n"
-        "        import jax\n"
-        "        jax.config.update('jax_platforms', _p)\n"
-        "    except Exception:\n"
-        "        pass\n"
-        "del _p\n");
-    PyEval_SaveThread();
-  });
-}
-
-// RAII GIL hold for one API call.
-struct Gil {
-  PyGILState_STATE state;
-  Gil() {
-    ensure_python();
-    state = PyGILState_Ensure();
-  }
-  ~Gil() { PyGILState_Release(state); }
-};
 
 struct Pred {
   PyObject *obj = nullptr;             // mxnet_tpu.predict.Predictor
@@ -112,14 +43,6 @@ struct NDItem {
 struct NDList {
   std::vector<NDItem> items;
 };
-
-PyObject *import_attr(const char *module, const char *attr) {
-  PyObject *mod = PyImport_ImportModule(module);
-  if (!mod) return nullptr;
-  PyObject *a = PyObject_GetAttrString(mod, attr);
-  Py_DECREF(mod);
-  return a;
-}
 
 // Build the ctx object for (dev_type, dev_id): 1 -> cpu, else the chip.
 PyObject *make_ctx(int dev_type, int dev_id) {

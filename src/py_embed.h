@@ -1,0 +1,102 @@
+// Shared CPython-embedding machinery for the C ABI translation units
+// (c_predict_api.cc, c_api.cc).  The reference links its C++ engine into
+// libmxnet; here the engine IS the Python-hosted JAX/XLA runtime, so the
+// C surface embeds one interpreter and delegates — one executor
+// implementation, no C/Python drift.  Everything is `inline` (C++17
+// inline variables) so both TUs share one definition when linked into
+// one library.
+#ifndef MXNET_TPU_SRC_PY_EMBED_H_
+#define MXNET_TPU_SRC_PY_EMBED_H_
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu {
+
+inline thread_local std::string g_last_error;
+
+inline void set_error(const std::string &msg) { g_last_error = msg; }
+
+// Format the pending Python exception into g_last_error and clear it.
+inline void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (type) {
+    PyObject *n = PyObject_GetAttrString(type, "__name__");
+    if (n) {
+      const char *c = PyUnicode_AsUTF8(n);
+      if (c) msg = std::string(c) + ": " + msg;
+      Py_DECREF(n);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+inline std::once_flag g_py_once;
+
+// Start CPython once, then drop the GIL so per-call PyGILState_Ensure
+// works from arbitrary threads.  If the host process already runs an
+// interpreter (e.g. a Python process dlopening this library), reuse it.
+inline void ensure_python() {
+  std::call_once(g_py_once, [] {
+    if (Py_IsInitialized()) return;
+    PyConfig config;
+    PyConfig_InitPythonConfig(&config);
+    config.parse_argv = 0;
+    config.install_signal_handlers = 0;  // never steal the host's handlers
+    Py_InitializeFromConfig(&config);
+    PyConfig_Clear(&config);
+    // Some site configs register accelerator plugins that override the
+    // platform choice at import; re-assert the caller's JAX_PLATFORMS so
+    // the documented env contract holds for embedders too.
+    PyRun_SimpleString(
+        "import os\n"
+        "_p = os.environ.get('JAX_PLATFORMS')\n"
+        "if _p and ',' not in _p:\n"
+        "    try:\n"
+        "        import jax\n"
+        "        jax.config.update('jax_platforms', _p)\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "del _p\n");
+    PyEval_SaveThread();
+  });
+}
+
+// RAII GIL hold for one API call.
+struct Gil {
+  PyGILState_STATE state;
+  Gil() {
+    ensure_python();
+    state = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+inline PyObject *import_attr(const char *module, const char *attr) {
+  PyObject *mod = PyImport_ImportModule(module);
+  if (!mod) return nullptr;
+  PyObject *a = PyObject_GetAttrString(mod, attr);
+  Py_DECREF(mod);
+  return a;
+}
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_SRC_PY_EMBED_H_

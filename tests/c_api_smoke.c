@@ -1,0 +1,143 @@
+/* Plain-C embedding smoke for the CORE C API (include/mxnet_tpu/c_api.h):
+ * build arrays, invoke an op imperatively, compose a symbol, bind an
+ * executor, run forward+backward, and print what the Python test
+ * (tests/test_c_api.py) cross-checks in-process.
+ *
+ *   cc c_api_smoke.c -I include -L <libdir> -lmxnet_tpu -Wl,-rpath,<libdir>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxnet_tpu/c_api.h>
+
+#define CHECK(stmt)                                                        \
+  do {                                                                     \
+    if ((stmt) != 0) {                                                     \
+      fprintf(stderr, "FAIL %s: %s\n", #stmt, MXGetLastError());           \
+      return 1;                                                            \
+    }                                                                      \
+  } while (0)
+
+int main(void) {
+  int version = 0;
+  CHECK(MXGetVersion(&version));
+  printf("version: %d\n", version);
+
+  /* ---- NDArray create + copy + imperative op ---- */
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a));
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &b));
+  float av[6] = {1, 2, 3, 4, 5, 6};
+  float bv[6] = {10, 20, 30, 40, 50, 60};
+  CHECK(MXNDArraySyncCopyFromCPU(a, av, 6));
+  CHECK(MXNDArraySyncCopyFromCPU(b, bv, 6));
+
+  int n_out = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK(MXImperativeInvoke("broadcast_add", 2, (NDArrayHandle[]){a, b},
+                           &n_out, &outs, 0, NULL, NULL));
+  float sum[6];
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], sum, 6));
+  printf("sum:");
+  for (int i = 0; i < 6; ++i) printf(" %g", sum[i]);
+  printf("\n");
+
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  CHECK(MXNDArrayGetShape(outs[0], &ndim, &dims));
+  printf("sum_shape: %u %u %u\n", ndim, dims[0], dims[1]);
+  CHECK(MXNDArrayFree(outs[0]));
+
+  /* ---- Symbol: variable -> FullyConnected -> infer/save ---- */
+  SymbolHandle data, fc;
+  CHECK(MXSymbolCreateVariable("data", &data));
+  const char *k[] = {"num_hidden"};
+  const char *v[] = {"4"};
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, k, v, &fc));
+  CHECK(MXSymbolCompose(fc, "fc1", 1, NULL, (SymbolHandle[]){data}));
+
+  mx_uint n_args = 0;
+  const char **arg_names = NULL;
+  CHECK(MXSymbolListArguments(fc, &n_args, &arg_names));
+  printf("args:");
+  for (mx_uint i = 0; i < n_args; ++i) printf(" %s", arg_names[i]);
+  printf("\n");
+
+  mx_uint indptr[2] = {0, 2};
+  mx_uint sdata[2] = {2, 3};
+  const char *skeys[1] = {"data"};
+  mx_uint in_n, out_n, aux_n;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sh, **out_sh, **aux_sh;
+  int complete = 0;
+  CHECK(MXSymbolInferShape(fc, 1, skeys, indptr, sdata, &in_n, &in_nd,
+                           &in_sh, &out_n, &out_nd, &out_sh, &aux_n,
+                           &aux_nd, &aux_sh, &complete));
+  printf("infer: in=%u out=%u out0=%u,%u weight=%u,%u\n", in_n, out_n,
+         out_sh[0][0], out_sh[0][1], in_sh[1][0], in_sh[1][1]);
+
+  const char *json = NULL;
+  CHECK(MXSymbolSaveToJSON(fc, &json));
+  SymbolHandle fc2;
+  CHECK(MXSymbolCreateFromJSON(json, &fc2));
+  mx_uint n2 = 0;
+  const char **names2 = NULL;
+  CHECK(MXSymbolListArguments(fc2, &n2, &names2));
+  printf("json_roundtrip_args: %u\n", n2);
+  CHECK(MXSymbolFree(fc2));
+
+  /* ---- Executor: bind, forward, backward, grads ---- */
+  NDArrayHandle args[3];
+  mx_uint shp_x[2] = {2, 3}, shp_w[2] = {4, 3}, shp_b[1] = {4};
+  CHECK(MXNDArrayCreate(shp_x, 2, 1, 0, 0, &args[0]));
+  CHECK(MXNDArrayCreate(shp_w, 2, 1, 0, 0, &args[1]));
+  CHECK(MXNDArrayCreate(shp_b, 1, 1, 0, 0, &args[2]));
+  float xv[6] = {1, 0, -1, 2, 1, 0};
+  float wv[12], biasv[4] = {0, 0, 0, 0};
+  for (int i = 0; i < 12; ++i) wv[i] = 0.1f * (float)(i + 1);
+  CHECK(MXNDArraySyncCopyFromCPU(args[0], xv, 6));
+  CHECK(MXNDArraySyncCopyFromCPU(args[1], wv, 12));
+  CHECK(MXNDArraySyncCopyFromCPU(args[2], biasv, 4));
+
+  mx_uint reqs[3] = {0, 1, 1}; /* data: null, weight/bias: write */
+  ExecutorHandle exe;
+  CHECK(MXExecutorBind(fc, 1, 0, 3, args, NULL, reqs, 0, NULL, &exe));
+  CHECK(MXExecutorForward(exe, 1));
+  mx_uint n_eo = 0;
+  NDArrayHandle *eouts = NULL;
+  CHECK(MXExecutorOutputs(exe, &n_eo, &eouts));
+  float y[8];
+  CHECK(MXNDArraySyncCopyToCPU(eouts[0], y, 8));
+  printf("fwd:");
+  for (int i = 0; i < 8; ++i) printf(" %.4f", y[i]);
+  printf("\n");
+
+  NDArrayHandle head;
+  mx_uint shp_h[2] = {2, 4};
+  CHECK(MXNDArrayCreate(shp_h, 2, 1, 0, 0, &head));
+  float ones[8] = {1, 1, 1, 1, 1, 1, 1, 1};
+  CHECK(MXNDArraySyncCopyFromCPU(head, ones, 8));
+  CHECK(MXExecutorBackward(exe, 1, (NDArrayHandle[]){head}));
+
+  mx_uint n_g = 0;
+  NDArrayHandle *grads = NULL;
+  const char **gnames = NULL;
+  CHECK(MXExecutorGrads(exe, &n_g, &grads, &gnames));
+  printf("grads:");
+  for (mx_uint i = 0; i < n_g; ++i) printf(" %s", gnames[i]);
+  printf("\n");
+  float gw[12];
+  CHECK(MXNDArraySyncCopyToCPU(grads[0], gw, 12));
+  printf("gw0: %.4f %.4f %.4f\n", gw[0], gw[1], gw[2]);
+
+  CHECK(MXExecutorFree(exe));
+  CHECK(MXSymbolFree(fc));
+  CHECK(MXSymbolFree(data));
+  CHECK(MXNDArrayFree(a));
+  CHECK(MXNDArrayFree(b));
+  CHECK(MXNotifyShutdown());
+  printf("C_API_OK\n");
+  return 0;
+}
