@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Sort a sequence of tokens with a bidirectional LSTM.
+
+Parity: reference example/bi-lstm-sort (lstm_sort.py + lstm.py
+bi_lstm_unroll + sort_io.py) — the classic seq2seq-lite demo: the model
+reads k numbers and emits them in sorted order, one output per position,
+needing context from BOTH directions (hence the bidirectional cell).
+
+TPU-native shape: the hand-rolled per-timestep unroll + explicit
+init_c/init_h states of the reference collapse into
+`mx.rnn.BidirectionalCell(LSTMCell, LSTMCell).unroll(...)` — the cells
+lower to `lax.scan` inside the one jitted training step.  Data is
+generated in-process (the reference ships text files of digit lines).
+
+    JAX_PLATFORMS=cpu python examples/bi-lstm-sort/lstm_sort.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def build_net(seq_len, vocab, num_hidden, num_embed):
+    """bi_lstm_unroll analog (reference lstm.py:34-86)."""
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")                       # (B, T) token ids
+    label = mx.sym.Variable("softmax_label")             # (B, T) sorted ids
+    embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=num_embed,
+                             name="embed")
+    cell = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden, prefix="l0_"),
+        mx.rnn.LSTMCell(num_hidden, prefix="r0_"),
+        output_prefix="bi_")
+    outputs, _ = cell.unroll(seq_len, inputs=embed, layout="NTC",
+                             merge_outputs=True)          # (B, T, 2H)
+    pred = mx.sym.Reshape(outputs, shape=(-1, 2 * num_hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+    lab = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+
+
+def make_data(n, seq_len, vocab, seed=0):
+    """Random token sequences and their sorted order (reference
+    sort.train.txt generator's effect, in memory)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randint(1, vocab, (n, seq_len)).astype(np.float32)
+    Y = np.sort(X, axis=1)
+    return X, Y
+
+
+def sort_accuracy(mod, X, Y, batch_size):
+    """Fraction of POSITIONS predicted correctly (the reference evaluates
+    perplexity; exact-position accuracy is the stricter, clearer gate)."""
+    import mxnet_tpu as mx
+
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch_size)
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy()            # (B*T, vocab)
+        lab = batch.label[0].asnumpy().reshape(-1)
+        correct += (pred.argmax(1) == lab).sum()
+        total += lab.size
+    return correct / total
+
+
+def main(seq_len=6, vocab=12, num_hidden=64, num_embed=32, batch_size=50,
+         num_epoch=15, n_train=2000, quiet=False):
+    import mxnet_tpu as mx
+
+    net = build_net(seq_len, vocab, num_hidden, num_embed)
+    X, Y = make_data(n_train, seq_len, vocab)
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch_size, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="adam",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.004})
+    Xv, Yv = make_data(400, seq_len, vocab, seed=1)
+    acc = sort_accuracy(mod, Xv, Yv, batch_size)
+    if not quiet:
+        gate = 0.9 if n_train >= 2000 else 0.5
+        print("bi-lstm-sort%s: position accuracy %.3f on held-out sequences"
+              % (" OK" if acc > gate else " FAILED", acc))
+        # example contract (tests/test_examples.py): exit nonzero on a
+        # missed convergence gate, not just print
+        assert acc > gate, "sort accuracy %.3f below gate %.2f" % (acc, gate)
+        x0 = Xv[0].astype(int)
+        mod.forward(mx.io.DataBatch(data=[mx.nd.array(Xv[:batch_size])],
+                                    label=[mx.nd.array(Yv[:batch_size])]),
+                    is_train=False)
+        p0 = mod.get_outputs()[0].asnumpy()[:seq_len].argmax(1).astype(int)
+        print("  input %s -> predicted %s (sorted: %s)"
+              % (list(x0), list(p0), sorted(x0)))
+    return acc
+
+
+if __name__ == "__main__":
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("MXTPU_EXAMPLE_FAST"):
+        # CI config: smaller model/corpus, looser gate (test_examples.py)
+        main(seq_len=5, vocab=8, num_hidden=32, num_embed=16,
+             num_epoch=8, n_train=600)
+    else:
+        main()

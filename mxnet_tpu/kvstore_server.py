@@ -20,10 +20,11 @@ def init_server_module():
         return
     from .parallel import dist
 
+    rc = 0
     if role == "scheduler":
-        dist.run_scheduler()
+        rc = dist.run_scheduler() or 0
     elif role == "server":
         dist.run_server()
     else:
         raise ValueError("unknown DMLC_ROLE %s" % role)
-    sys.exit(0)
+    sys.exit(rc)

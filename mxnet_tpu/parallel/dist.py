@@ -768,9 +768,15 @@ class DistKVStore:
 
 
 def run_scheduler():
+    """Returns 0 when every worker deregistered cleanly (_FINALIZE), 1 if
+    any vanished — launchers that cannot see worker exit codes directly
+    (qsub array jobs) propagate failure through this."""
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
     sched = Scheduler(port, int(os.environ["DMLC_NUM_WORKER"]), int(os.environ["DMLC_NUM_SERVER"]))
     sched.serve_forever()
+    with sched._lock:
+        unclean = sched._left - sched._finalized
+    return 1 if unclean else 0
 
 
 def _start_heartbeat(sock, send_lock, stop_event=None):

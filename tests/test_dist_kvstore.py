@@ -257,3 +257,98 @@ sys.exit(max(p.wait() for p in procs))
         env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("DIST_OK") == 2, proc.stdout + proc.stderr
+
+
+def test_launcher_sge_mode(tmp_path):
+    """--launcher sge maps role sets onto qsub array jobs (reference
+    dmlc_tracker/sge.py).  A shim qsub validates the submission contract
+    (-t ranges, generated job scripts with exported DMLC env) and runs
+    the tasks locally; the dist_sync job must converge through it."""
+    shim = tmp_path / "qsub"
+    shim.write_text("""#!/usr/bin/env python3
+import subprocess, sys
+args = sys.argv[1:]
+n = None; script = None; i = 0
+while i < len(args):
+    if args[i] == "-t":
+        lo, _, hi = args[i + 1].partition("-"); n = int(hi); i += 2
+    elif args[i] in ("-cwd", "-V"):
+        i += 1
+    elif args[i] in ("-b", "-q"):
+        i += 2
+    else:
+        script = args[i]; i += 1
+assert n and script, (n, script)
+for _ in range(n):
+    subprocess.Popen(["/bin/sh", script])
+print("Your job-array submitted")
+""")
+    shim.chmod(0o755)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MXTPU_QSUB"] = str(shim)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "--launcher", "sge",
+         sys.executable, os.path.join(REPO, "tests", "dist_check_script.py")],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("DIST_OK") == 2, proc.stdout + proc.stderr
+
+
+def test_launcher_sge_propagates_worker_failure(tmp_path):
+    """A worker that dies without deregistering must surface as a nonzero
+    launcher exit (the scheduler exits 1 on unclean departures — qsub
+    gives the launcher no worker exit codes to read)."""
+    import signal
+
+    pidfile = tmp_path / "pids"
+    shim = tmp_path / "qsub"
+    shim.write_text("""#!/usr/bin/env python3
+import subprocess, sys
+args = sys.argv[1:]
+n = None; script = None; i = 0
+while i < len(args):
+    if args[i] == "-t":
+        lo, _, hi = args[i + 1].partition("-"); n = int(hi); i += 2
+    elif args[i] in ("-cwd", "-V"):
+        i += 1
+    elif args[i] in ("-b", "-q"):
+        i += 2
+    else:
+        script = args[i]; i += 1
+with open(%r, "a") as f:
+    for _ in range(n):
+        f.write("%%d\\n" %% subprocess.Popen(["/bin/sh", script]).pid)
+""" % str(pidfile))
+    shim.chmod(0o755)
+    crash = tmp_path / "crash_worker.py"
+    crash.write_text(
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_sync')\n"   # registers with the scheduler
+        "import os; os._exit(1)\n")          # vanishes without FINALIZE
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MXTPU_QSUB"] = str(shim)
+    try:
+        # DEVNULL, not pipes: the orphaned server "jobs" inherit stdio and
+        # would hold captured pipes open past the launcher's own exit
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "2", "-s", "1", "--launcher", "sge",
+             sys.executable, str(crash)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=240, cwd=REPO)
+        assert proc.returncode != 0
+    finally:
+        # reap the orphaned array-job processes (real SGE: qdel).  The job
+        # script `exec`s its command, so the recorded pid IS the worker.
+        if pidfile.exists():
+            for pid in pidfile.read_text().split():
+                try:
+                    os.kill(int(pid), signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass

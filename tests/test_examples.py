@@ -18,6 +18,7 @@ FAST_EXAMPLES = [
     "examples/adversary/fgsm_mnist.py",
     "examples/nce-loss/nce_lm.py",
     "examples/stochastic-depth/sd_mlp.py",
+    "examples/bi-lstm-sort/lstm_sort.py",
 ]
 
 
@@ -28,6 +29,7 @@ def test_example_runs(script):
     # force CPU before any jax import (the example files don't assume a
     # conftest); examples that need multiple devices set their own flags
     env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_EXAMPLE_FAST"] = "1"
     for k in list(env):
         if k.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
             env.pop(k)

@@ -17,6 +17,12 @@ runs locally, then one mpirun per role set carries the cluster env via
 OpenMPI -x (or MPICH -genv with --mpi-flavor mpich):
 
     python tools/launch.py -n 4 -s 2 -H hosts --launcher mpi python train.py
+
+SGE mode submits one array job per role set via qsub (parity: reference
+dmlc_tracker/sge.py); the scheduler stays on the launch host and the
+launcher exits when it does (all workers deregistered):
+
+    python tools/launch.py -n 8 -s 4 --launcher sge -q gpu.q python train.py
 """
 from __future__ import annotations
 
@@ -46,6 +52,17 @@ def _routable_ip():
         s.close()
 
 
+def _spawn_local_scheduler(base_env):
+    """Run the scheduler on the launch host at a routable address (the
+    pattern shared by the mpi and sge launchers)."""
+    base_env["DMLC_PS_ROOT_URI"] = _routable_ip()
+    env = dict(os.environ)
+    env.update(base_env)
+    env["DMLC_ROLE"] = "scheduler"
+    return subprocess.Popen([sys.executable, "-c", _SERVER_BOOTSTRAP],
+                            env=env)
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("", 0))
@@ -59,8 +76,11 @@ def main():
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("-s", "--num-servers", type=int, default=None)
     parser.add_argument("-H", "--hostfile", type=str, default=None)
-    parser.add_argument("--launcher", choices=["local", "ssh", "mpi"],
+    parser.add_argument("--launcher", choices=["local", "ssh", "mpi", "sge",
+                                               "yarn"],
                         default="local")
+    parser.add_argument("-q", "--sge-queue", default=None,
+                        help="(sge) queue name passed to qsub -q")
     parser.add_argument("--sync-dst-dir", type=str, default=None,
                         help="(ssh) rsync working dir to this path on each host")
     parser.add_argument("--mpi-flavor", choices=["openmpi", "mpich"],
@@ -114,12 +134,7 @@ def main():
         # env forwarded per MPI flavor).  MXTPU_MPIRUN overrides the
         # binary so tests can shim it without an MPI install.
         mpirun = os.environ.get("MXTPU_MPIRUN", "mpirun")
-        base_env["DMLC_PS_ROOT_URI"] = _routable_ip()
-        sched_env = dict(os.environ)
-        sched_env.update(base_env)
-        sched_env["DMLC_ROLE"] = "scheduler"
-        sched = subprocess.Popen(
-            [sys.executable, "-c", _SERVER_BOOTSTRAP], env=sched_env)
+        sched = _spawn_local_scheduler(base_env)
 
         def mpi_cmd(role, n, cmd):
             argv = [mpirun, "-n", str(n)]
@@ -145,6 +160,60 @@ def main():
         rc = workers.wait()
         for p in (servers, sched):
             p.terminate()
+        sys.exit(rc)
+
+    if args.launcher == "yarn":
+        parser.error(
+            "yarn launching is not supported: this framework's DCN "
+            "scale-out paths are the TCP parameter server (local/ssh/mpi/"
+            "sge launchers) and jax.distributed multi-host SPMD "
+            "(parallel/multihost.py); submit those through your cluster's "
+            "own job wrapper")
+
+    if args.launcher == "sge":
+        # scheduler local; one qsub ARRAY JOB per role set (reference
+        # dmlc_tracker/sge.py).  MXTPU_QSUB overrides the binary so tests
+        # can shim it without a grid engine install.
+        import shlex
+        import tempfile
+
+        qsub = os.environ.get("MXTPU_QSUB", "qsub")
+        sched = _spawn_local_scheduler(base_env)
+        scripts = []
+
+        def submit(role, count, cmd):
+            script = tempfile.NamedTemporaryFile(
+                "w", suffix=".sh", prefix="mxtpu_%s_" % role, delete=False)
+            scripts.append(script.name)
+            lines = ["#!/bin/sh"]
+            lines += ["export %s=%s" % (k, shlex.quote(v))
+                      for k, v in base_env.items()]
+            lines.append("export DMLC_ROLE=%s" % role)
+            lines.append("exec %s" % " ".join(shlex.quote(c) for c in cmd))
+            script.write("\n".join(lines) + "\n")
+            script.close()
+            os.chmod(script.name, 0o755)
+            argv = [qsub, "-t", "1-%d" % count, "-cwd", "-V", "-b", "n"]
+            if args.sge_queue:
+                argv += ["-q", args.sge_queue]
+            subprocess.run(argv + [script.name], check=True)
+
+        try:
+            submit("server", args.num_servers,
+                   [sys.executable, "-c", _SERVER_BOOTSTRAP])
+            submit("worker", args.num_workers, args.command)
+            # qsub is asynchronous: completion is observed through the
+            # scheduler, which exits 0 only when every worker FINALIZEd
+            # cleanly (dist.run_scheduler)
+            rc = sched.wait()
+        finally:
+            if sched.poll() is None:
+                sched.terminate()
+            for sc in scripts:
+                try:
+                    os.unlink(sc)
+                except OSError:
+                    pass
         sys.exit(rc)
 
     # ssh launcher
