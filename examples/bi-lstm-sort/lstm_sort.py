@@ -100,6 +100,9 @@ def main(seq_len=6, vocab=12, num_hidden=64, num_embed=32, batch_size=50,
 
 if __name__ == "__main__":
     if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # not redundant: site configs may register an accelerator plugin
+        # that overrides the env var; the config knob set before first
+        # backend touch wins
         import jax
 
         jax.config.update("jax_platforms", "cpu")

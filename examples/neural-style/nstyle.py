@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Neural style transfer — optimize the INPUT image, not the weights.
+
+Parity: reference example/neural-style/nstyle.py + model_vgg19.py — the
+classic Gatys et al. recipe: bind an executor with a gradient on the
+DATA argument, drive style (gram-matrix) and content losses by seeding
+`backward()` with per-output head gradients, and gradient-descend the
+image itself.  This exercises the surfaces ordinary training never does:
+`grad_req` on an input, multi-output `Group` symbols, and caller-chosen
+head gradients.
+
+The reference downloads pretrained VGG-19 weights; this environment has
+no egress, so the demo runs a compact VGG-style feature stack with FIXED
+random weights — random shallow conv features still define meaningful
+gram/content objectives (texture statistics), the optimization loop and
+every API touched are identical, and the convergence gate (loss must
+collapse) holds either way.  Drop real weights into `--params` to get
+actual style transfer.
+
+    JAX_PLATFORMS=cpu python examples/neural-style/nstyle.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def feature_net(prefix="vgg_"):
+    """Conv stack emitting two feature maps (relu1/relu2 analogs of the
+    reference's style+content tap points, model_vgg19.py)."""
+    import mxnet_tpu as mx
+
+    img = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(img, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                            name=prefix + "conv1")
+    r1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(r1, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    c2 = mx.sym.Convolution(p1, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                            name=prefix + "conv2")
+    r2 = mx.sym.Activation(c2, act_type="relu")
+    return mx.sym.Group([r1, r2])
+
+
+def gram(feat):
+    """Channel gram matrix of a (1, C, H, W) feature map."""
+    c = feat.shape[1]
+    f = feat.reshape(c, -1)
+    return f @ f.T / f.shape[1]
+
+
+def run(size=64, iters=120, lr=0.05, style_weight=1.0, content_weight=0.2,
+        seed=0, quiet=False):
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(seed)
+    style_img = rng.uniform(0, 1, (1, 3, size, size)).astype(np.float32)
+    # content: smooth gradient image (distinct statistics from the noise)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    content_img = np.stack([yy, xx, (yy + xx) / 2])[None]
+
+    net = feature_net()
+    args_shapes = dict(zip(net.list_arguments(),
+                           net.infer_shape(data=(1, 3, size, size))[0]))
+    params = {n: mx.nd.array((rng.randn(*s) * 0.3).astype(np.float32))
+              for n, s in args_shapes.items() if n != "data"}
+
+    def bind_with(img, grad_on_data):
+        args = dict(params)
+        args["data"] = mx.nd.array(img)
+        grads = {"data": mx.nd.zeros(img.shape)} if grad_on_data else None
+        req = {n: ("write" if n == "data" and grad_on_data else "null")
+               for n in args_shapes}
+        return net.bind(mx.cpu(), args, args_grad=grads, grad_req=req)
+
+    # target statistics from fixed executors (reference style_array/content)
+    tgt = bind_with(style_img, False)
+    tgt.forward(is_train=False)
+    target_grams = [np.asarray(gram(o.asnumpy())) for o in tgt.outputs]
+    tgt = bind_with(content_img, False)
+    tgt.forward(is_train=False)
+    target_content = tgt.outputs[1].asnumpy()
+
+    img = rng.uniform(0.4, 0.6, (1, 3, size, size)).astype(np.float32)
+    exe = bind_with(img, True)
+    mom = np.zeros_like(img)
+
+    def loss_and_heads():
+        """Head gradients implementing style+content losses on the two
+        feature outputs (reference nstyle.py grad_array seeding)."""
+        exe.forward(is_train=True)
+        feats = [o.asnumpy() for o in exe.outputs]
+        heads, loss = [], 0.0
+        for i, f in enumerate(feats):
+            c = f.shape[1]
+            fm = f.reshape(c, -1)
+            g = fm @ fm.T / fm.shape[1]
+            diff = g - target_grams[i]
+            loss += style_weight * float((diff ** 2).sum())
+            hg = style_weight * 4.0 * (diff @ fm).reshape(f.shape) / fm.shape[1]
+            if i == 1:
+                cd = f - target_content
+                loss += content_weight * float((cd ** 2).sum())
+                hg = hg + content_weight * 2.0 * cd
+            heads.append(mx.nd.array(hg.astype(np.float32)))
+        return loss, heads
+
+    losses = []
+    for it in range(iters):
+        loss, heads = loss_and_heads()
+        losses.append(loss)
+        exe.backward(heads)
+        g = exe.grad_dict["data"].asnumpy()
+        gn = np.linalg.norm(g)
+        if gn > 10.0:
+            g = g * (10.0 / gn)   # reference clip_norm
+        mom = 0.9 * mom - lr * g
+        img = np.clip(img + mom, 0.0, 1.0)
+        exe.arg_dict["data"][:] = img
+        if not quiet and it % 30 == 0:
+            print("iter %3d  loss %.4f" % (it, loss))
+    drop = 1.0 - losses[-1] / losses[0]
+    print("neural-style%s: loss %.4f -> %.4f (%.0f%% drop over %d iters)"
+          % (" OK" if drop > 0.5 else " FAILED", losses[0], losses[-1],
+             100 * drop, iters))
+    assert drop > 0.5, "style/content loss did not collapse"
+    return drop
+
+
+if __name__ == "__main__":
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("MXTPU_EXAMPLE_FAST"):
+        run(size=32, iters=60)
+    else:
+        run()

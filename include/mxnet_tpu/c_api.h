@@ -133,6 +133,22 @@ MXNET_DLL int MXExecutorGrads(ExecutorHandle handle, mx_uint *out_size,
                               const char ***out_names);
 MXNET_DLL int MXExecutorFree(ExecutorHandle handle);
 
+/* ----------------------------------------------------------- DataIter.
+ * File-backed iterators creatable by name (MNISTIter, CSVIter,
+ * ImageRecordIter, ImageDetRecordIter); param values are python
+ * literals as strings (e.g. data_shape "(3,32,32)"). */
+typedef void *DataIterHandle;
+MXNET_DLL int MXListDataIters(mx_uint *out_size, const char ***out_array);
+MXNET_DLL int MXDataIterCreateIter(const char *name, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   DataIterHandle *out);
+MXNET_DLL int MXDataIterBeforeFirst(DataIterHandle handle);
+MXNET_DLL int MXDataIterNext(DataIterHandle handle, int *out);
+MXNET_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *out);
+MXNET_DLL int MXDataIterFree(DataIterHandle handle);
+
 /* ------------------------------------------------------------ KVStore */
 MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
 MXNET_DLL int MXKVStoreInit(KVStoreHandle handle, mx_uint num,

@@ -199,3 +199,59 @@ def random_seed(seed):
     from . import random as _random
 
     _random.seed(int(seed))
+
+
+# --------------------------------------------------------------- dataiter
+# the C-creatable set (the reference's C iterator registry likewise
+# exposes only the file-backed iterators; NDArrayIter needs in-process
+# arrays and stays a python-surface iterator)
+_ITER_NAMES = ("MNISTIter", "CSVIter", "ImageRecordIter",
+               "ImageDetRecordIter")
+
+
+def list_data_iters():
+    return list(_ITER_NAMES)
+
+
+def _parse_iter_param(v):
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def iter_create(name, keys, vals):
+    """MXDataIterCreateIter analog: construct an iterator by name from
+    string params (shapes/ints/floats given as python literals)."""
+    from . import io as _io
+
+    if name not in _ITER_NAMES:
+        raise MXNetError("unknown data iterator %s" % name)
+    kwargs = {k: _parse_iter_param(v) for k, v in zip(keys, vals)}
+    return getattr(_io, name)(**kwargs)
+
+
+def iter_next(it):
+    try:
+        it.iter_next_batch = it.next()
+        return 1
+    except StopIteration:
+        return 0
+
+
+def iter_reset(it):
+    it.reset()
+
+
+def iter_data(it):
+    return it.iter_next_batch.data[0]
+
+
+def iter_label(it):
+    return it.iter_next_batch.label[0]
+
+
+def iter_pad(it):
+    return int(getattr(it.iter_next_batch, "pad", 0) or 0)

@@ -190,8 +190,23 @@ class Scheduler:
         until all workers disconnect."""
         conns = []
         pending_recovery = []
+        # a role that dies BEFORE registering would otherwise hang this
+        # loop (and any launcher waiting on the scheduler) forever
+        reg_timeout = float(os.environ.get(
+            "MXNET_KVSTORE_REGISTER_TIMEOUT", "600"))
+        deadline = time.monotonic() + reg_timeout
+        self.sock.settimeout(1.0)
         while len(conns) < self.num_workers + self.num_servers:
-            conn, _ = self.sock.accept()
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        "scheduler: only %d/%d nodes registered within "
+                        "%.0fs (MXNET_KVSTORE_REGISTER_TIMEOUT)"
+                        % (len(conns), self.num_workers + self.num_servers,
+                           reg_timeout))
+                continue
             cmd, meta, _ = _recv_frame(conn)
             assert cmd == _REGISTER
             info = _parse_meta(meta)
@@ -216,6 +231,7 @@ class Scheduler:
                 self._last_seen[node] = time.monotonic()
                 self._current_conn[node] = conn
             conns.append((conn, role, rank))
+        self.sock.settimeout(None)
         # everyone registered: broadcast address book + ranks
         addrs = [self._server_addrs[r] for r in sorted(self._server_addrs)]
         for conn, role, rank in conns:
@@ -773,7 +789,13 @@ def run_scheduler():
     (qsub array jobs) propagate failure through this."""
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
     sched = Scheduler(port, int(os.environ["DMLC_NUM_WORKER"]), int(os.environ["DMLC_NUM_SERVER"]))
-    sched.serve_forever()
+    try:
+        sched.serve_forever()
+    except MXNetError as e:
+        import sys as _sys
+
+        print("scheduler: %s" % e, file=_sys.stderr)
+        return 1
     with sched._lock:
         unclean = sched._left - sched._finalized
     return 1 if unclean else 0
@@ -806,7 +828,11 @@ def run_server():
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
     server = Server(0, int(os.environ["DMLC_NUM_WORKER"]))
     sched = _connect_retry((root, port))
-    _send_frame(sched, _REGISTER, _meta(role="server", host="127.0.0.1", port=server.port))
+    # advertise the address workers can actually REACH: the local address
+    # of the route to the scheduler (a literal 127.0.0.1 would break any
+    # cross-host launch — workers would dial their own loopback)
+    my_host = sched.getsockname()[0]
+    _send_frame(sched, _REGISTER, _meta(role="server", host=my_host, port=server.port))
     cmd, meta, _ = _recv_frame(sched)
     assert cmd == _ADDRS
     _start_heartbeat(sched, threading.Lock(), server._stop)

@@ -665,4 +665,76 @@ int MXKVStorePull(void *handle, unsigned num, const int *keys, void **vals) {
 
 int MXKVStoreFree(void *handle) { return MXNDArrayFree(handle); }
 
+/* ---------------------------------------------------------- data iter */
+
+int MXListDataIters(unsigned *out_size, const char ***out_array) {
+  Gil gil;
+  PyObject *r = impl_call("list_data_iters", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local Handle scratch;
+  int rc = stash_strs(&scratch, r, out_size, out_array);
+  Py_DECREF(r);
+  if (rc != 0) { set_error_from_python(); return -1; }
+  return 0;
+}
+
+int MXDataIterCreateIter(const char *name, unsigned num_param,
+                         const char **keys, const char **vals, void **out) {
+  Gil gil;
+  PyObject *ks = str_list(num_param, keys);
+  PyObject *vs = str_list(num_param, vals);
+  PyObject *r = (ks && vs) ? impl_call("iter_create",
+                                       Py_BuildValue("(sOO)", name, ks, vs))
+                           : nullptr;
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(void *handle) {
+  Gil gil;
+  PyObject *r = impl_call("iter_reset", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterNext(void *handle, int *out) {
+  Gil gil;
+  PyObject *r = impl_call("iter_next", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int iter_fetch(const char *fn, void *handle, void **out) {
+  Gil gil;
+  PyObject *r = impl_call(fn, Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXDataIterGetData(void *handle, void **out) {
+  return iter_fetch("iter_data", handle, out);
+}
+
+int MXDataIterGetLabel(void *handle, void **out) {
+  return iter_fetch("iter_label", handle, out);
+}
+
+int MXDataIterGetPadNum(void *handle, int *out) {
+  Gil gil;
+  PyObject *r = impl_call("iter_pad", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterFree(void *handle) { return MXNDArrayFree(handle); }
+
 }  // extern "C"

@@ -8,6 +8,7 @@ import ctypes
 import os
 import shutil
 import subprocess
+import sys
 import sysconfig
 
 import numpy as np
@@ -172,3 +173,70 @@ def test_c_api_kvstore_local(tmp_path):
         dest, back.ctypes.data_as(ctypes.c_void_p), 4) == 0
     np.testing.assert_array_equal(back, vals)
     assert lib.MXKVStoreFree(kv) == 0
+
+
+def test_c_api_dataiter(tmp_path):
+    """DataIter C API: create an ImageRecordIter by name over a packed
+    .rec, drain batches, fetch data/label arrays (reference
+    MXDataIterCreateIter + friends)."""
+    pytest.importorskip("PIL.Image")
+    from PIL import Image
+
+    # pack a tiny 2-class JPEG dataset
+    root = tmp_path / "imgs"
+    for label in range(2):
+        d = root / ("c%d" % label)
+        d.mkdir(parents=True)
+        arr = np.full((16, 16, 3), 60 + label * 120, np.uint8)
+        for i in range(8):
+            Image.fromarray(arr).save(str(d / ("i%d.jpg" % i)), "JPEG")
+    prefix = str(tmp_path / "tiny")
+    subprocess.run([sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+                    prefix, str(root)], check=True, capture_output=True)
+
+    libpath = _lib_path()
+    lib = ctypes.CDLL(libpath)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    n = ctypes.c_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(names)) == 0
+    kinds = {names[i] for i in range(n.value)}
+    assert b"ImageRecordIter" in kinds and b"MNISTIter" in kinds
+
+    keys = (ctypes.c_char_p * 3)(b"path_imgrec", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)((prefix + ".rec").encode(),
+                                 b"(3,16,16)", b"4")
+    it = ctypes.c_void_p()
+    assert lib.MXDataIterCreateIter(b"ImageRecordIter", 3, keys, vals,
+                                    ctypes.byref(it)) == 0, \
+        lib.MXGetLastError()
+    total = 0
+    labels = []
+    has = ctypes.c_int()
+    while True:
+        assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0
+        if not has.value:
+            break
+        data_h = ctypes.c_void_p()
+        lab_h = ctypes.c_void_p()
+        assert lib.MXDataIterGetData(it, ctypes.byref(data_h)) == 0
+        assert lib.MXDataIterGetLabel(it, ctypes.byref(lab_h)) == 0
+        nd = ctypes.c_uint()
+        dims = ctypes.POINTER(ctypes.c_uint)()
+        assert lib.MXNDArrayGetShape(data_h, ctypes.byref(nd),
+                                     ctypes.byref(dims)) == 0
+        assert [dims[i] for i in range(nd.value)] == [4, 3, 16, 16]
+        lab = np.zeros(4, np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(
+            lab_h, lab.ctypes.data_as(ctypes.c_void_p), 4) == 0
+        labels.extend(lab.tolist())
+        total += 4
+        lib.MXNDArrayFree(data_h)
+        lib.MXNDArrayFree(lab_h)
+    assert total == 16
+    assert sorted(set(labels)) == [0.0, 1.0]
+    # reset rewinds
+    assert lib.MXDataIterBeforeFirst(it) == 0
+    assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value
+    assert lib.MXDataIterFree(it) == 0

@@ -265,8 +265,12 @@ def test_launcher_sge_mode(tmp_path):
     (-t ranges, generated job scripts with exported DMLC env) and runs
     the tasks locally; the dist_sync job must converge through it."""
     shim = tmp_path / "qsub"
+    outdir = tmp_path / "joblogs"
+    outdir.mkdir()
+    # like real qsub, job stdout goes to per-task output FILES, never to
+    # the submitter's stdout
     shim.write_text("""#!/usr/bin/env python3
-import subprocess, sys
+import os, subprocess, sys
 args = sys.argv[1:]
 n = None; script = None; i = 0
 while i < len(args):
@@ -279,10 +283,11 @@ while i < len(args):
     else:
         script = args[i]; i += 1
 assert n and script, (n, script)
-for _ in range(n):
-    subprocess.Popen(["/bin/sh", script])
-print("Your job-array submitted")
-""")
+for t in range(n):
+    o = open(os.path.join(%r, os.path.basename(script) + ".o%%d" %% t), "w")
+    subprocess.Popen(["/bin/sh", script], stdout=o, stderr=o)
+print("Your job-array 1234 submitted")
+""" % str(outdir))
     shim.chmod(0o755)
 
     env = dict(os.environ)
@@ -295,7 +300,8 @@ print("Your job-array submitted")
          sys.executable, os.path.join(REPO, "tests", "dist_check_script.py")],
         env=env, capture_output=True, text=True, timeout=240, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert proc.stdout.count("DIST_OK") == 2, proc.stdout + proc.stderr
+    logs = "".join(f.read_text() for f in outdir.iterdir())
+    assert logs.count("DIST_OK") == 2, logs + proc.stdout + proc.stderr
 
 
 def test_launcher_sge_propagates_worker_failure(tmp_path):

@@ -19,6 +19,7 @@ FAST_EXAMPLES = [
     "examples/nce-loss/nce_lm.py",
     "examples/stochastic-depth/sd_mlp.py",
     "examples/bi-lstm-sort/lstm_sort.py",
+    "examples/neural-style/nstyle.py",
 ]
 
 

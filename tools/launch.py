@@ -196,8 +196,22 @@ def main():
             argv = [qsub, "-t", "1-%d" % count, "-cwd", "-V", "-b", "n"]
             if args.sge_queue:
                 argv += ["-q", args.sge_queue]
-            subprocess.run(argv + [script.name], check=True)
+            # qsub output goes to a FILE, not a pipe: grid jobs (or shim
+            # children) inheriting a pipe would block this read past
+            # qsub's own exit
+            with tempfile.TemporaryFile("w+") as qout:
+                subprocess.run(argv + [script.name], check=True,
+                               stdout=qout, stderr=subprocess.STDOUT)
+                qout.seek(0)
+                out = qout.read()
+            # "Your job-array <id>.…" — remember ids so failures qdel
+            for tok in out.split():
+                if tok.split(".")[0].isdigit():
+                    job_ids.append(tok.split(".")[0])
+                    break
 
+        job_ids = []
+        rc = 1  # submit/wait failures surface as nonzero
         try:
             submit("server", args.num_servers,
                    [sys.executable, "-c", _SERVER_BOOTSTRAP])
@@ -209,6 +223,10 @@ def main():
         finally:
             if sched.poll() is None:
                 sched.terminate()
+            if rc != 0 and job_ids:
+                # cancel still-queued/running array jobs (best effort)
+                qdel = os.environ.get("MXTPU_QDEL", "qdel")
+                subprocess.run([qdel] + job_ids, capture_output=True)
             for sc in scripts:
                 try:
                     os.unlink(sc)
