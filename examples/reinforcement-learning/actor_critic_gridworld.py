@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Advantage actor-critic on a gridworld — RL through the executor API.
+
+Parity: reference example/reinforcement-learning/{a3c, parallel_actor_
+critic} — those drive OpenAI Gym (unavailable here: no egress, no gym),
+so this demo ships its own environment: an NxN gridworld with a goal and
+pits; the agent sees a one-hot board and walks to the goal for +1
+(-1 in a pit, small step penalty).
+
+What it exercises (the same surfaces the reference RL examples do):
+  * a two-headed policy/value symbol (shared torso, Group outputs)
+  * forward(is_train=True) + backward(head_grads) with CALLER-BUILT
+    gradients — policy gradient * advantage and value-regression heads
+    seeded exactly like a3c.py's `executor.backward([policy_grad, ...])`
+  * batched rollouts as ordinary NDArray math, the optimizer applied
+    through mx.optimizer updaters
+
+    JAX_PLATFORMS=cpu python examples/reinforcement-learning/actor_critic_gridworld.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+N = 5                       # board side
+GOAL, PIT = (4, 4), (2, 2)
+ACTIONS = [(-1, 0), (1, 0), (0, -1), (0, 1)]   # up/down/left/right
+
+
+def obs(pos):
+    o = np.zeros((N, N), np.float32)
+    o[pos] = 1.0
+    return o.reshape(-1)
+
+
+def step(pos, a):
+    dy, dx = ACTIONS[a]
+    ny, nx = min(max(pos[0] + dy, 0), N - 1), min(max(pos[1] + dx, 0), N - 1)
+    pos = (ny, nx)
+    if pos == GOAL:
+        return pos, 1.0, True
+    if pos == PIT:
+        return pos, -1.0, True
+    return pos, -0.02, False
+
+
+def build_net():
+    import mxnet_tpu as mx
+
+    s = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(s, num_hidden=64,
+                                                name="fc1"),
+                          act_type="relu")
+    policy = mx.sym.softmax(mx.sym.FullyConnected(h, num_hidden=4,
+                                                  name="policy_fc"))
+    value = mx.sym.FullyConnected(h, num_hidden=1, name="value_fc")
+    return mx.sym.Group([policy, value])
+
+
+def run(episodes=400, batch=16, gamma=0.95, lr=0.02, seed=0, quiet=False):
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(seed)
+    net = build_net()
+    arg_shapes, _, _ = net.infer_shape(data=(batch, N * N))
+    names = net.list_arguments()
+    args = {}
+    for n, shp in zip(names, arg_shapes):
+        if n == "data":
+            args[n] = mx.nd.zeros(shp)
+        else:
+            args[n] = mx.nd.array((rng.randn(*shp) * 0.1).astype(np.float32))
+    grads = {n: mx.nd.zeros(a.shape) for n, a in args.items() if n != "data"}
+    exe = net.bind(mx.cpu(), args, args_grad=grads, grad_req={
+        n: ("null" if n == "data" else "write") for n in names})
+    opt = mx.optimizer.Adam(learning_rate=lr, rescale_grad=1.0 / batch)
+    updater = mx.optimizer.get_updater(opt)
+
+    returns_hist = []
+    for ep in range(episodes):
+        # batched rollouts (the reference batches envs the same way)
+        poses = [(0, 0)] * batch
+        done = [False] * batch
+        traj = []           # list of (obs[B,NN], act[B], rew[B], alive[B])
+        for _ in range(2 * N * N):
+            ob = np.stack([obs(p) for p in poses])
+            exe.arg_dict["data"][:] = ob
+            exe.forward(is_train=False)
+            probs = exe.outputs[0].asnumpy()
+            acts = np.array([rng.choice(4, p=probs[i] / probs[i].sum())
+                             for i in range(batch)])
+            rews = np.zeros(batch, np.float32)
+            alive = np.array([not d for d in done], np.float32)
+            for i in range(batch):
+                if done[i]:
+                    continue
+                poses[i], rews[i], d = step(poses[i], acts[i])
+                done[i] = d
+            traj.append((ob, acts, rews, alive))
+            if all(done):
+                break
+        # discounted returns per step
+        R = np.zeros(batch, np.float32)
+        rets = [None] * len(traj)
+        for t in range(len(traj) - 1, -1, -1):
+            R = traj[t][2] + gamma * R
+            rets[t] = R.copy()
+        returns_hist.append(float(np.mean(rets[0])))
+
+        # one update per rollout step: policy head gets  d(-logpi*A)/dlogits
+        # = (pi - onehot(a)) * A, value head gets d((V-R)^2)/dV  — the
+        # caller-built head-gradient seeding of a3c.py
+        for (ob, acts, _, alive), R in zip(traj, rets):
+            exe.arg_dict["data"][:] = ob
+            exe.forward(is_train=True)
+            probs = exe.outputs[0].asnumpy()
+            V = exe.outputs[1].asnumpy().reshape(-1)
+            adv = (R - V) * alive
+            gpol = probs.copy()
+            gpol[np.arange(batch), acts] -= 1.0
+            gpol *= adv[:, None]
+            gval = (2.0 * (V - R) * alive).reshape(-1, 1).astype(np.float32)
+            exe.backward([mx.nd.array(gpol), mx.nd.array(0.5 * gval)])
+            for i, n in enumerate(names):
+                if n != "data":
+                    updater(n, exe.grad_dict[n], exe.arg_dict[n])
+        if not quiet and ep % 100 == 0:
+            print("episode %4d  mean return %.3f" % (ep, returns_hist[-1]))
+
+    # windows must not overlap or the strict improvement gate below can
+    # never pass (episodes <= 2*w would compare a slice with itself)
+    w = max(1, min(20, len(returns_hist) // 2))
+    early = np.mean(returns_hist[:w])
+    late = np.mean(returns_hist[-w:])
+    ok = late > 0.5 and late > early
+    print("actor-critic gridworld%s: mean return %.3f -> %.3f"
+          % (" OK" if ok else " FAILED", early, late))
+    assert ok, "policy did not improve (return %.3f -> %.3f)" % (early, late)
+    return late
+
+
+if __name__ == "__main__":
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # site configs may override the env var; the config knob wins if
+        # set before first backend touch
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("MXTPU_EXAMPLE_FAST"):
+        run(episodes=150)
+    else:
+        run()

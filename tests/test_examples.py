@@ -20,6 +20,7 @@ FAST_EXAMPLES = [
     "examples/stochastic-depth/sd_mlp.py",
     "examples/bi-lstm-sort/lstm_sort.py",
     "examples/neural-style/nstyle.py",
+    "examples/reinforcement-learning/actor_critic_gridworld.py",
 ]
 
 
