@@ -74,7 +74,15 @@ MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
 
 /* ---------------------------------------------------- op invocation.
  * Ops are addressed BY NAME (the registry is the one source of truth;
- * the reference's creator-handle indirection collapses to a lookup). */
+ * the reference's creator-handle indirection collapses to a lookup).
+ *
+ * MXImperativeInvoke: num_outputs/outputs are IN/OUT (reference ABI).
+ * Pass *num_outputs=0 and *outputs=NULL for library-allocated results
+ * (valid until the next invoke on this thread; free each handle).
+ * Pass *num_outputs>0 with caller-created NDArray handles in *outputs
+ * for in-place invocation — results are copied into them (all shapes
+ * validated before any buffer is touched).  Callers looping with the
+ * library-alloc pattern MUST re-zero both before every call. */
 MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
 MXNET_DLL int MXImperativeInvoke(const char *op_name, int num_inputs,
                                  NDArrayHandle *inputs, int *num_outputs,

@@ -81,6 +81,23 @@ def nd_wait(arr):
     arr.wait_to_read()
 
 
+def nd_copy_into_all(srcs, dsts):
+    """Write each src into the caller-provided dst (in-place invoke ABI).
+
+    Validates EVERY shape before mutating anything so a mismatch fails
+    atomically — no partially-overwritten caller buffers."""
+    if len(srcs) != len(dsts):
+        raise MXNetError("copy_into_all: %d results vs %d destinations"
+                         % (len(srcs), len(dsts)))
+    for src, dst in zip(srcs, dsts):
+        if tuple(src.shape) != tuple(dst.shape):
+            raise MXNetError(
+                "pre-allocated output shape %s != result shape %s"
+                % (tuple(dst.shape), tuple(src.shape)))
+    for src, dst in zip(srcs, dsts):
+        dst[:] = src  # __setitem__ casts to dst.dtype on device
+
+
 # ------------------------------------------------------------- op invoke
 def list_op_names():
     return sorted(n for n in OP_REGISTRY if not n.startswith("Custom:"))
@@ -133,8 +150,18 @@ def symbol_list(sym, which):
 
 
 def symbol_infer_shape(sym, keys, shapes):
-    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(
-        **dict(zip(keys, [tuple(s) for s in shapes])))
+    if keys is None:
+        # positional (reference ABI keys=NULL): zip onto list_arguments
+        # order; excess shapes are a caller bug, not silently dropped
+        names = sym.list_arguments()
+        if len(shapes) > len(names):
+            raise MXNetError("infer_shape: %d positional shapes for a "
+                             "symbol with %d arguments"
+                             % (len(shapes), len(names)))
+        keys = names[:len(shapes)]
+    # ndim-0 slots mean "unknown, infer me" (reference ABI), not scalar
+    known = {n: tuple(s) for n, s in zip(keys, shapes) if len(s)}
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**known)
     return ([tuple(s) for s in arg_shapes or []],
             [tuple(s) for s in out_shapes or []],
             [tuple(s) for s in aux_shapes or []])
@@ -144,7 +171,7 @@ def symbol_infer_shape(sym, keys, shapes):
 def executor_bind(sym, dev_type, dev_id, args, grad_reqs, auxs):
     names = sym.list_arguments()
     req = {n: r for n, r in zip(names, grad_reqs)}
-    grads = {n: NDArray(_np.zeros(a.shape, _np.float32))
+    grads = {n: NDArray(_np.zeros(a.shape, _np.dtype(a.dtype)))
              for n, a, r in zip(names, args, grad_reqs) if r != "null"}
     return sym.bind(_ctx(dev_type, dev_id), list(args), args_grad=grads,
                     grad_req=req, aux_states=list(auxs) if auxs else None)

@@ -520,7 +520,13 @@ class PipelineModule(BaseModule):
         return (self._base_seed + self._step_count) % (2 ** 31)
 
     def update(self):
-        assert self.optimizer_initialized and self._pending_batch is not None
+        if not self.optimizer_initialized:
+            raise RuntimeError("update() before init_optimizer()")
+        if self._pending_batch is None:
+            raise RuntimeError(
+                "update() with no pending batch: call forward(batch, "
+                "is_train=True) first (PipelineModule runs the whole "
+                "step here)")
         batch = self._pending_batch
         self._pending_batch = None
         data = batch.data[0]
@@ -539,8 +545,16 @@ class PipelineModule(BaseModule):
         self._outputs_cache = [NDArray(o) for o in outs]
 
     def get_outputs(self, merge_multi_context=True):
-        assert self._outputs_cache is not None, \
-            "no outputs: run forward (eval) or update (train) first"
+        if self._outputs_cache is None:
+            if self._pending_batch is not None:
+                raise RuntimeError(
+                    "PipelineModule runs the whole training step inside "
+                    "update(): train outputs are available only AFTER "
+                    "update(), not between forward() and update() as with "
+                    "Module. Call update() first (or forward(is_train="
+                    "False) for inference outputs).")
+            raise RuntimeError(
+                "no outputs: run forward (eval) or update (train) first")
         return self._outputs_cache
 
     def update_metric(self, eval_metric, labels):

@@ -364,6 +364,33 @@ int MXImperativeInvoke(const char *op_name, int num_inputs, void **inputs,
   Py_XDECREF(ks);
   Py_XDECREF(vs);
   if (!r) { set_error_from_python(); return -1; }
+  // reference ABI: *num_outputs > 0 with non-NULL *outputs means the
+  // caller pre-allocated destination arrays — copy results into them.
+  // NOTE (also in c_api.h): num_outputs/outputs are IN/OUT; callers
+  // using library allocation must re-zero both before EVERY call, or a
+  // loop's second iteration reads the first call's results as
+  // pre-allocated destinations.
+  if (*num_outputs > 0 && *outputs != nullptr) {
+    Py_ssize_t n = PyList_Size(r);
+    if (n != *num_outputs) {
+      Py_DECREF(r);
+      set_error("MXImperativeInvoke: op produced " + std::to_string(n) +
+                " outputs but caller pre-allocated " +
+                std::to_string(*num_outputs));
+      return -1;
+    }
+    // one impl call validates ALL shapes before mutating anything, so a
+    // mismatch cannot leave caller buffers partially overwritten
+    PyObject *dsts = handle_list(n, *outputs);
+    PyObject *c = dsts ? impl_call("nd_copy_into_all",
+                                   Py_BuildValue("(OO)", r, dsts))
+                       : nullptr;
+    Py_XDECREF(dsts);
+    Py_DECREF(r);
+    if (!c) { set_error_from_python(); return -1; }
+    Py_DECREF(c);
+    return 0;
+  }
   unsigned n = 0;
   void **arr = nullptr;
   static thread_local std::vector<void *> invoke_scratch;
@@ -491,7 +518,10 @@ int MXSymbolInferShape(void *handle, unsigned num_args, const char **keys,
                        const unsigned ***aux_shape_data, int *complete) {
   Gil gil;
   Handle *h = static_cast<Handle *>(handle);
-  PyObject *ks = str_list(num_args, keys);
+  // keys==NULL means positional inference (reference ABI): shapes are
+  // zipped onto list_arguments order python-side
+  PyObject *ks = keys ? str_list(num_args, keys)
+                      : (Py_INCREF(Py_None), Py_None);
   PyObject *shapes = PyList_New(num_args);
   for (unsigned i = 0; shapes && i < num_args; ++i)
     PyList_SET_ITEM(shapes, i,
@@ -538,9 +568,14 @@ int MXSymbolInferShape(void *handle, unsigned num_args, const char **keys,
   *aux_shape_size = sizes[2];
   *aux_shape_ndim = ndims[2].data();
   *aux_shape_data = ptrs[2].data();
-  // underdetermined inference returns empty groups: report incomplete so
-  // callers honoring the reference contract never index empty results
-  *complete = (sizes[0] || sizes[1]) ? 1 : 0;
+  // reference semantics: complete=1 only when every shape in every
+  // group is fully known (non-empty groups, no unknown/zero dims)
+  bool full = (sizes[0] || sizes[1]);
+  for (int g = 0; full && g < 3; ++g)
+    for (auto &d : dims[g])
+      for (unsigned x : d)
+        if (x == 0) { full = false; break; }
+  *complete = full ? 1 : 0;
   return 0;
 }
 

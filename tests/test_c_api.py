@@ -240,3 +240,83 @@ def test_c_api_dataiter(tmp_path):
     assert lib.MXDataIterBeforeFirst(it) == 0
     assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value
     assert lib.MXDataIterFree(it) == 0
+
+def test_c_api_prealloc_invoke_and_positional_infer():
+    """Reference-ABI corners: pre-allocated in-place MXImperativeInvoke,
+    keys=NULL positional MXSymbolInferShape with ndim-0 unknown slots,
+    and strict `complete` semantics (reference c_api.h:827,:940)."""
+    libpath = _lib_path()
+    lib = ctypes.CDLL(libpath)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ck(rc, what):
+        assert rc == 0, "%s: %s" % (what, lib.MXGetLastError())
+
+    # --- pre-allocated outputs: result copied into the caller's array
+    a, b, dst = ctypes.c_void_p(), ctypes.c_void_p(), ctypes.c_void_p()
+    sh = (ctypes.c_uint * 1)(5)
+    for hh in (a, b, dst):
+        ck(lib.MXNDArrayCreate(sh, 1, 1, 0, 0, ctypes.byref(hh)), "create")
+    va = np.arange(5, dtype=np.float32)
+    vb = np.full(5, 2, np.float32)
+    ck(lib.MXNDArraySyncCopyFromCPU(a, va.ctypes.data_as(ctypes.c_void_p), 5),
+       "copy a")
+    ck(lib.MXNDArraySyncCopyFromCPU(b, vb.ctypes.data_as(ctypes.c_void_p), 5),
+       "copy b")
+    nout = ctypes.c_int(1)
+    outs = (ctypes.c_void_p * 1)(dst)
+    pouts = ctypes.cast(outs, ctypes.POINTER(ctypes.c_void_p))
+    ck(lib.MXImperativeInvoke(b"elemwise_add", 2, (ctypes.c_void_p * 2)(a, b),
+                              ctypes.byref(nout), ctypes.pointer(pouts),
+                              0, None, None), "prealloc invoke")
+    got = np.zeros(5, np.float32)
+    ck(lib.MXNDArraySyncCopyToCPU(dst, got.ctypes.data_as(ctypes.c_void_p), 5),
+       "readback")
+    np.testing.assert_allclose(got, va + vb)
+
+    # shape mismatch fails atomically (-1, dst untouched)
+    bad = ctypes.c_void_p()
+    sh3 = (ctypes.c_uint * 1)(3)
+    ck(lib.MXNDArrayCreate(sh3, 1, 1, 0, 0, ctypes.byref(bad)), "create bad")
+    nout2 = ctypes.c_int(1)
+    outs2 = (ctypes.c_void_p * 1)(bad)
+    pouts2 = ctypes.cast(outs2, ctypes.POINTER(ctypes.c_void_p))
+    rc = lib.MXImperativeInvoke(b"elemwise_add", 2,
+                                (ctypes.c_void_p * 2)(a, b),
+                                ctypes.byref(nout2), ctypes.pointer(pouts2),
+                                0, None, None)
+    assert rc == -1 and b"shape" in lib.MXGetLastError()
+
+    # --- positional InferShape: data known, weight/bias ndim-0 (unknown)
+    data = ctypes.c_void_p()
+    ck(lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)), "var")
+    fc = ctypes.c_void_p()
+    ck(lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, (ctypes.c_char_p * 1)(b"num_hidden"),
+        (ctypes.c_char_p * 1)(b"7"), ctypes.byref(fc)), "atomic")
+    ck(lib.MXSymbolCompose(fc, b"fc1", 1, None, (ctypes.c_void_p * 1)(data)),
+       "compose")
+    shp = (ctypes.c_uint * 2)(4, 3)
+    ind = (ctypes.c_uint * 4)(0, 2, 2, 2)  # 3 args: known, unknown, unknown
+    iss, oss, ass_ = ctypes.c_uint(), ctypes.c_uint(), ctypes.c_uint()
+    ind_nd = ctypes.POINTER(ctypes.c_uint)()
+    ind_dt = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+    ond = ctypes.POINTER(ctypes.c_uint)()
+    odt = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+    andim = ctypes.POINTER(ctypes.c_uint)()
+    adt = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+    comp = ctypes.c_int(-5)
+    ck(lib.MXSymbolInferShape(
+        fc, 3, None, ind, shp,
+        ctypes.byref(iss), ctypes.byref(ind_nd), ctypes.byref(ind_dt),
+        ctypes.byref(oss), ctypes.byref(ond), ctypes.byref(odt),
+        ctypes.byref(ass_), ctypes.byref(andim), ctypes.byref(adt),
+        ctypes.byref(comp)), "positional infer")
+    ins = [[ind_dt[i][j] for j in range(ind_nd[i])] for i in range(iss.value)]
+    assert ins == [[4, 3], [7, 3], [7]], ins
+    assert comp.value == 1  # everything fully inferred -> complete
+
+    for hh in (a, b, dst, bad):
+        lib.MXNDArrayFree(hh)
+    lib.MXSymbolFree(data)
+    lib.MXSymbolFree(fc)
