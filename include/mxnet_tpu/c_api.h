@@ -2,11 +2,12 @@
  * c_api.h — core C ABI: the training/graph surface beyond
  * c_predict_api.h.
  *
- * ABI parity: the NDArray / op-invocation / Symbol / Executor / KVStore
- * groups of reference include/mxnet/c_api.h (same naming and return
- * conventions: 0 ok, -1 error, MXGetLastError() for the message).
- * Implementation (src/c_api.cc) embeds CPython and delegates to
- * mxnet_tpu/_capi_impl.py — the compute path is JAX/XLA on TPU.
+ * ABI parity: the FULL reference include/mxnet/c_api.h surface — all
+ * 126 functions, including MXCustomOpRegister — with the same naming
+ * and return conventions (0 ok, -1 error, MXGetLastError() for the
+ * message).  Implementation (src/c_api.cc)
+ * embeds CPython and delegates to mxnet_tpu/_capi_impl.py — the compute
+ * path is JAX/XLA on TPU.
  *
  * Link against libmxnet_tpu.so (which also exports the whole
  * c_predict_api.h surface); see tests/c_api_smoke.c for the embedding
@@ -15,11 +16,29 @@
  * Pointer-returning accessors follow the reference convention: the
  * storage stays valid until the next API call on the same handle (or
  * same thread, for handle-less calls).
+ *
+ * Creator handles (AtomicSymbolCreator / FunctionHandle /
+ * DataIterCreator) wrap operator/iterator NAMES; every entry point that
+ * takes one ALSO accepts a plain NUL-terminated name string on the same
+ * argument (this ABI's name-addressing convention).
+ *
+ * Documented deviations from the reference (TPU-native design):
+ *  - MXNDArrayGetData returns a read-only HOST SNAPSHOT (XLA device
+ *    buffers are immutable HBM; write via MXNDArraySyncCopyFromCPU).
+ *  - Push/Pull `priority` is accepted and ignored (PJRT async dispatch
+ *    has no engine queue to prioritize).
+ *  - MXRtcCreate takes PYTHON source of a JAX-traceable function named
+ *    `name` (jnp/lax/pallas) — CUDA source cannot target a TPU.
+ *    grid/block dims on MXRtcPush are ignored (XLA owns the schedule).
+ *  - The executor monitor callback fires per OUTPUT + AUX STATE after
+ *    each forward (XLA fuses the per-op interior); each reported handle
+ *    is valid only for the duration of the callback.
  */
 #ifndef MXNET_TPU_C_API_H_
 #define MXNET_TPU_C_API_H_
 
 #include <stddef.h>
+#include <stdint.h>
 
 #ifdef __cplusplus
 extern "C" {
@@ -33,12 +52,25 @@ typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
 typedef void *ExecutorHandle;
 typedef void *KVStoreHandle;
+typedef void *FunctionHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *CachedOpHandle;
+typedef void *DataIterHandle;
+typedef void *DataIterCreator;
+typedef void *RecordIOHandle;
+typedef void *RtcHandle;
 
 MXNET_DLL const char *MXGetLastError();  /* shared with c_predict_api.h */
 
 MXNET_DLL int MXGetVersion(int *out);
 MXNET_DLL int MXRandomSeed(int seed);
 MXNET_DLL int MXNotifyShutdown();
+MXNET_DLL int MXSetNumOMPThreads(int thread_num);
+
+/* ----------------------------------------------------------- profiler */
+MXNET_DLL int MXSetProfilerConfig(int mode, const char *filename);
+MXNET_DLL int MXSetProfilerState(int state);
+MXNET_DLL int MXDumpProfile();
 
 /* ------------------------------------------------------------ NDArray.
  * dtype codes follow the reference: 0 f32, 1 f64, 2 f16, 3 u8, 4 i32,
@@ -50,32 +82,63 @@ MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
 MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
                                 int dev_type, int dev_id, int delay_alloc,
                                 int dtype, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                        NDArrayHandle *out);
+MXNET_DLL int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                                    const char **out_buf);
 MXNET_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
                                        const void *data, size_t size);
 MXNET_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
                                      size_t size);
 MXNET_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayWaitToWrite(NDArrayHandle handle);
 MXNET_DLL int MXNDArrayWaitAll();
 MXNET_DLL int MXNDArrayFree(NDArrayHandle handle);
 MXNET_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
                                 const mx_uint **out_pdata);
+/* read-only host snapshot; valid until the next call on this handle */
+MXNET_DLL int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
 MXNET_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out);
 MXNET_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
                                   int *out_dev_id);
 MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint begin,
                              mx_uint end, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
+                          NDArrayHandle *out);
 MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim,
                                const int *dims, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXNDArraySetGradState(NDArrayHandle handle, int state);
+MXNET_DLL int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
 MXNET_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
                             NDArrayHandle *args, const char **keys);
 MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                             NDArrayHandle **out_arr, mx_uint *out_name_size,
                             const char ***out_names);
 
+/* -------------------------------------------- legacy Function group.
+ * FunctionHandle entries cover the whole op registry (the reference
+ * merged its NDArray-function registry into the op registry too). */
+MXNET_DLL int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+MXNET_DLL int MXGetFunction(const char *name, FunctionHandle *out);
+MXNET_DLL int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                            const char **description, mx_uint *num_args,
+                            const char ***arg_names,
+                            const char ***arg_type_infos,
+                            const char ***arg_descriptions,
+                            const char **return_type);
+MXNET_DLL int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                             mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                             int *type_mask);
+MXNET_DLL int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                           mx_float *scalar_args,
+                           NDArrayHandle *mutate_vars);
+MXNET_DLL int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                             mx_float *scalar_args,
+                             NDArrayHandle *mutate_vars, int num_params,
+                             char **param_keys, char **param_vals);
+
 /* ---------------------------------------------------- op invocation.
- * Ops are addressed BY NAME (the registry is the one source of truth;
- * the reference's creator-handle indirection collapses to a lookup).
- *
  * MXImperativeInvoke: num_outputs/outputs are IN/OUT (reference ABI).
  * Pass *num_outputs=0 and *outputs=NULL for library-allocated results
  * (valid until the next invoke on this thread; free each handle).
@@ -84,25 +147,74 @@ MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
  * validated before any buffer is touched).  Callers looping with the
  * library-alloc pattern MUST re-zero both before every call. */
 MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
-MXNET_DLL int MXImperativeInvoke(const char *op_name, int num_inputs,
-                                 NDArrayHandle *inputs, int *num_outputs,
-                                 NDArrayHandle **outputs, int num_params,
-                                 const char **param_keys,
+MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator_or_name,
+                                 int num_inputs, NDArrayHandle *inputs,
+                                 int *num_outputs, NDArrayHandle **outputs,
+                                 int num_params, const char **param_keys,
                                  const char **param_vals);
 
+/* ----------------------------------------------------------- autograd */
+MXNET_DLL int MXAutogradSetIsTraining(int is_training, int *prev);
+/* reqs: 0 null, 1 write, 2 inplace(=write), 3 add */
+MXNET_DLL int MXAutogradMarkVariables(mx_uint num_var,
+                                      NDArrayHandle *var_handles,
+                                      mx_uint *reqs_array,
+                                      NDArrayHandle *grad_handles);
+MXNET_DLL int MXAutogradComputeGradient(mx_uint num_output,
+                                        NDArrayHandle *output_handles);
+MXNET_DLL int MXAutogradBackward(mx_uint num_output,
+                                 NDArrayHandle *output_handles,
+                                 NDArrayHandle *ograd_handles,
+                                 int retain_graph);
+
+/* ----------------------------------------------------------- CachedOp.
+ * MXInvokeCachedOp follows the MXImperativeInvoke IN/OUT outputs ABI. */
+MXNET_DLL int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
+MXNET_DLL int MXFreeCachedOp(CachedOpHandle handle);
+MXNET_DLL int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                               NDArrayHandle *inputs, int *num_outputs,
+                               NDArrayHandle **outputs);
+
 /* ------------------------------------------------------------- Symbol */
+MXNET_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               AtomicSymbolCreator **out);
+MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char **name);
+MXNET_DLL int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name, const char **description,
+    mx_uint *num_args, const char ***arg_names,
+    const char ***arg_type_infos, const char ***arg_descriptions,
+    const char **key_var_num_args, const char **return_type);
 MXNET_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
 MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+MXNET_DLL int MXSymbolSaveToFile(SymbolHandle handle, const char *fname);
 MXNET_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
-MXNET_DLL int MXSymbolCreateAtomicSymbol(const char *op_name,
+MXNET_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator_or_name,
                                          mx_uint num_param,
                                          const char **keys,
                                          const char **vals,
                                          SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                                  SymbolHandle *out);
 /* Composes IN PLACE: after this the handle holds the applied symbol. */
 MXNET_DLL int MXSymbolCompose(SymbolHandle handle, const char *name,
                               mx_uint num_args, const char **keys,
                               SymbolHandle *args);
+MXNET_DLL int MXSymbolCopy(SymbolHandle handle, SymbolHandle *out);
+MXNET_DLL int MXSymbolPrint(SymbolHandle handle, const char **out_str);
+MXNET_DLL int MXSymbolGetName(SymbolHandle handle, const char **out,
+                              int *success);
+MXNET_DLL int MXSymbolGetAttr(SymbolHandle handle, const char *key,
+                              const char **out, int *success);
+MXNET_DLL int MXSymbolSetAttr(SymbolHandle handle, const char *key,
+                              const char *value);
+/* out_size counts PAIRS; *out holds 2*out_size strings (k0,v0,k1,v1...).
+ * Deep (ListAttr) keys are "nodename$key" (the reference convention). */
+MXNET_DLL int MXSymbolListAttr(SymbolHandle handle, mx_uint *out_size,
+                               const char ***out);
+MXNET_DLL int MXSymbolListAttrShallow(SymbolHandle handle, mx_uint *out_size,
+                                      const char ***out);
 MXNET_DLL int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
                                     const char ***out_array);
 MXNET_DLL int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
@@ -110,6 +222,12 @@ MXNET_DLL int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
 MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle handle,
                                           mx_uint *out_size,
                                           const char ***out_array);
+MXNET_DLL int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle *out);
+MXNET_DLL int MXSymbolGetChildren(SymbolHandle handle, SymbolHandle *out);
+MXNET_DLL int MXSymbolGetOutput(SymbolHandle handle, mx_uint index,
+                                SymbolHandle *out);
+MXNET_DLL int MXSymbolGrad(SymbolHandle handle, mx_uint num_wrt,
+                           const char **wrt, SymbolHandle *out);
 MXNET_DLL int MXSymbolInferShape(
     SymbolHandle handle, mx_uint num_args, const char **keys,
     const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
@@ -118,12 +236,29 @@ MXNET_DLL int MXSymbolInferShape(
     const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
     mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
     const mx_uint ***aux_shape_data, int *complete);
+MXNET_DLL int MXSymbolInferShapePartial(
+    SymbolHandle handle, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+/* dtype codes as above; -1 = unknown/infer */
+MXNET_DLL int MXSymbolInferType(SymbolHandle handle, mx_uint num_args,
+                                const char **keys, const int *arg_type_data,
+                                mx_uint *in_type_size,
+                                const int **in_type_data,
+                                mx_uint *out_type_size,
+                                const int **out_type_data,
+                                mx_uint *aux_type_size,
+                                const int **aux_type_data, int *complete);
 MXNET_DLL int MXSymbolFree(SymbolHandle handle);
 
 /* ----------------------------------------------------------- Executor.
  * grad_req codes: 0 null, 1 write, 2 inplace(=write), 3 add.
  * Gradient arrays are allocated internally; read them back with
- * MXExecutorGrads (name-aligned). */
+ * MXExecutorGrads (name-aligned) or SimpleBind's arg_grads. */
 MXNET_DLL int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
                              mx_uint num_args, NDArrayHandle *in_args,
                              NDArrayHandle *arg_grad_store,
@@ -131,6 +266,54 @@ MXNET_DLL int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
                              mx_uint aux_states_len,
                              NDArrayHandle *aux_states,
                              ExecutorHandle *out);
+MXNET_DLL int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                              mx_uint num_map_keys, const char **map_keys,
+                              const int *map_dev_types,
+                              const int *map_dev_ids, mx_uint num_args,
+                              NDArrayHandle *in_args,
+                              NDArrayHandle *arg_grad_store,
+                              const mx_uint *grad_req_type,
+                              mx_uint aux_states_len,
+                              NDArrayHandle *aux_states,
+                              ExecutorHandle *out);
+MXNET_DLL int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                               mx_uint num_map_keys, const char **map_keys,
+                               const int *map_dev_types,
+                               const int *map_dev_ids, mx_uint num_args,
+                               NDArrayHandle *in_args,
+                               NDArrayHandle *arg_grad_store,
+                               const mx_uint *grad_req_type,
+                               mx_uint aux_states_len,
+                               NDArrayHandle *aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle *out);
+/* Allocate-and-bind (the binding every reference frontend calls).
+ * grad_req: names==NULL + len==1 -> one global req; names!=NULL -> per-
+ * name dict.  Shapes are CSR (names + data + idx).  dtypes by code.
+ * *shared_buffer_len < 0 means no shared buffer; otherwise matching
+ * entries are REUSED (memory shared) and the union is returned through
+ * the updated_* lists with the new length in *shared_buffer_len.
+ * arg_grads entries are NULL where grad_req is null. */
+MXNET_DLL int MXExecutorSimpleBind(
+    SymbolHandle sym, int dev_type, int dev_id, const mx_uint num_g2c_keys,
+    const char **g2c_keys, const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list, mx_uint *num_in_args,
+    NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out);
 MXNET_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
 MXNET_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
                                  NDArrayHandle *head_grads);
@@ -139,33 +322,167 @@ MXNET_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
 MXNET_DLL int MXExecutorGrads(ExecutorHandle handle, mx_uint *out_size,
                               NDArrayHandle **out_arrs,
                               const char ***out_names);
+MXNET_DLL int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+typedef void (*ExecutorMonitorCallback)(const char *name,
+                                        NDArrayHandle arr, void *data);
+MXNET_DLL int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                           ExecutorMonitorCallback callback,
+                                           void *callback_handle);
 MXNET_DLL int MXExecutorFree(ExecutorHandle handle);
 
 /* ----------------------------------------------------------- DataIter.
- * File-backed iterators creatable by name (MNISTIter, CSVIter,
- * ImageRecordIter, ImageDetRecordIter); param values are python
- * literals as strings (e.g. data_shape "(3,32,32)"). */
-typedef void *DataIterHandle;
-MXNET_DLL int MXListDataIters(mx_uint *out_size, const char ***out_array);
-MXNET_DLL int MXDataIterCreateIter(const char *name, mx_uint num_param,
-                                   const char **keys, const char **vals,
-                                   DataIterHandle *out);
+ * MXListDataIters returns DataIterCreator handles (reference ABI); read
+ * names via MXDataIterGetIterInfo.  CreateIter/GetIterInfo also accept
+ * the iterator NAME directly (MNISTIter, CSVIter, ImageRecordIter,
+ * ImageDetRecordIter); param values are python literals as strings
+ * (e.g. data_shape "(3,32,32)"). */
+MXNET_DLL int MXListDataIters(mx_uint *out_size, DataIterCreator **out);
+MXNET_DLL int MXDataIterCreateIter(DataIterCreator creator_or_name,
+                                   mx_uint num_param, const char **keys,
+                                   const char **vals, DataIterHandle *out);
+MXNET_DLL int MXDataIterGetIterInfo(DataIterCreator creator_or_name,
+                                    const char **name,
+                                    const char **description,
+                                    mx_uint *num_args,
+                                    const char ***arg_names,
+                                    const char ***arg_type_infos,
+                                    const char ***arg_descriptions);
 MXNET_DLL int MXDataIterBeforeFirst(DataIterHandle handle);
 MXNET_DLL int MXDataIterNext(DataIterHandle handle, int *out);
 MXNET_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                                 uint64_t *out_size);
 MXNET_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
 MXNET_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *out);
 MXNET_DLL int MXDataIterFree(DataIterHandle handle);
 
 /* ------------------------------------------------------------ KVStore */
+MXNET_DLL int MXInitPSEnv(mx_uint num_vars, const char **keys,
+                          const char **vals);
 MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
 MXNET_DLL int MXKVStoreInit(KVStoreHandle handle, mx_uint num,
                             const int *keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals);
+/* priority accepted for ABI parity, ignored (see header comment) */
 MXNET_DLL int MXKVStorePush(KVStoreHandle handle, mx_uint num,
-                            const int *keys, NDArrayHandle *vals);
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+MXNET_DLL int MXKVStorePushEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority);
 MXNET_DLL int MXKVStorePull(KVStoreHandle handle, mx_uint num,
-                            const int *keys, NDArrayHandle *vals);
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+MXNET_DLL int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority);
+/* The updater OWNS recv and local: free both when done (reference
+ * contract).  Handles are minted through the trampoline bridge. */
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void *handle);
+MXNET_DLL int MXKVStoreSetUpdater(KVStoreHandle handle,
+                                  MXKVStoreUpdater updater,
+                                  void *updater_handle);
+MXNET_DLL int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+MXNET_DLL int MXKVStoreGetRank(KVStoreHandle handle, int *ret);
+MXNET_DLL int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
+MXNET_DLL int MXKVStoreIsWorkerNode(int *ret);
+MXNET_DLL int MXKVStoreIsServerNode(int *ret);
+MXNET_DLL int MXKVStoreIsSchedulerNode(int *ret);
+MXNET_DLL int MXKVStoreBarrier(KVStoreHandle handle);
+MXNET_DLL int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                            const int barrier_before_exit);
+typedef void (MXKVStoreServerController)(int head, const char *body,
+                                         void *controller_handle);
+/* Blocks in the server/scheduler loop (DMLC_ROLE decides which); the
+ * controller sees every MXKVStoreSendCommmandToServers (head, body). */
+MXNET_DLL int MXKVStoreRunServer(KVStoreHandle handle,
+                                 MXKVStoreServerController controller,
+                                 void *controller_handle);
+MXNET_DLL int MXKVStoreSendCommmandToServers(KVStoreHandle handle,
+                                             int cmd_id,
+                                             const char *cmd_body);
+/* node_id groups: kScheduler=1, kServerGroup=2, kWorkerGroup=4 */
+MXNET_DLL int MXKVStoreGetNumDeadNode(KVStoreHandle handle,
+                                      const int node_id, int *number,
+                                      const int timeout_sec);
 MXNET_DLL int MXKVStoreFree(KVStoreHandle handle);
+
+/* ----------------------------------------------------------- RecordIO */
+MXNET_DLL int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOWriterFree(RecordIOHandle handle);
+MXNET_DLL int MXRecordIOWriterWriteRecord(RecordIOHandle handle,
+                                          const char *buf, size_t size);
+MXNET_DLL int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+MXNET_DLL int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOReaderFree(RecordIOHandle handle);
+/* EOF: *buf = NULL, *size = 0, returns 0 */
+MXNET_DLL int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                                         char const **buf, size_t *size);
+MXNET_DLL int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+
+/* ---------------------------------------------------------------- RTC */
+MXNET_DLL int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                          char **input_names, char **output_names,
+                          NDArrayHandle *inputs, NDArrayHandle *outputs,
+                          char *kernel, RtcHandle *out);
+MXNET_DLL int MXRtcPush(RtcHandle handle, mx_uint num_input,
+                        mx_uint num_output, NDArrayHandle *inputs,
+                        NDArrayHandle *outputs, mx_uint gridDimX,
+                        mx_uint gridDimY, mx_uint gridDimZ,
+                        mx_uint blockDimX, mx_uint blockDimY,
+                        mx_uint blockDimZ);
+MXNET_DLL int MXRtcFree(RtcHandle handle);
+
+/* ----------------------------------------------------------- CustomOp.
+ * Reference MXCallbackList protocol (include/mxnet/c_api.h:107-145):
+ * the creator fills an MXCallbackList whose slots follow the
+ * CustomOpPropCallbacks enum (Delete, ListArguments, ListOutputs,
+ * ListAuxiliaryStates, InferShape, DeclareBackwardDependency,
+ * CreateOperator, InferType); CreateOperator fills a second list
+ * (Delete, Forward, Backward).  Forward/Backward receive NDArrayHandles
+ * they OWN (free each), tagged 0 in_data / 1 out_data / 2 in_grad /
+ * 3 out_grad / 4 aux.  The op runs on the host (pure_callback path). */
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+enum CustomOpCallbacks { kCustomOpDelete, kCustomOpForward,
+                         kCustomOpBackward };
+enum CustomOpPropCallbacks {
+  kCustomOpPropDelete, kCustomOpPropListArguments,
+  kCustomOpPropListOutputs, kCustomOpPropListAuxiliaryStates,
+  kCustomOpPropInferShape, kCustomOpPropDeclareBackwardDependency,
+  kCustomOpPropCreateOperator, kCustomOpPropInferType
+};
+typedef int (*CustomOpFBFunc)(int size, void **ptrs, int *tags,
+                              const int *reqs, const int is_train,
+                              void *state);
+typedef int (*CustomOpDelFunc)(void *state);
+typedef int (*CustomOpListFunc)(char ***args, void *state);
+typedef int (*CustomOpInferShapeFunc)(int num_input, int *ndims,
+                                      unsigned **shapes, void *state);
+typedef int (*CustomOpInferTypeFunc)(int num_input, int *types,
+                                     void *state);
+typedef int (*CustomOpBwdDepFunc)(const int *out_grad, const int *in_data,
+                                  const int *out_data, int *num_deps,
+                                  int **rdeps, void *state);
+typedef int (*CustomOpCreateFunc)(const char *ctx, int num_inputs,
+                                  unsigned **shapes, int *ndims,
+                                  int *dtypes, struct MXCallbackList *ret,
+                                  void *state);
+typedef int (*CustomOpPropCreator)(const char *op_type,
+                                   const int num_kwargs, const char **keys,
+                                   const char **values,
+                                   struct MXCallbackList *ret);
+MXNET_DLL int MXCustomOpRegister(const char *op_type,
+                                 CustomOpPropCreator creator);
+
+/* --- bridge used by the ctypes updater trampoline (not reference ABI):
+ * wraps a live CPython object (by address) into a fresh NDArrayHandle */
+MXNET_DLL int MXTPUWrapForCallback(void *py_obj, NDArrayHandle *out);
 
 #ifdef __cplusplus
 }

@@ -149,16 +149,20 @@ def symbol_list(sym, which):
     raise MXNetError("unknown list kind %s" % which)
 
 
+def _positional_keys(sym, keys, items, what):
+    """Reference ABI keys=NULL means positional: zip onto list_arguments
+    order.  Excess entries are a caller bug, not silently dropped."""
+    if keys is not None:
+        return keys
+    names = sym.list_arguments()
+    if len(items) > len(names):
+        raise MXNetError("%s: %d positional entries for a symbol with %d "
+                         "arguments" % (what, len(items), len(names)))
+    return names[:len(items)]
+
+
 def symbol_infer_shape(sym, keys, shapes):
-    if keys is None:
-        # positional (reference ABI keys=NULL): zip onto list_arguments
-        # order; excess shapes are a caller bug, not silently dropped
-        names = sym.list_arguments()
-        if len(shapes) > len(names):
-            raise MXNetError("infer_shape: %d positional shapes for a "
-                             "symbol with %d arguments"
-                             % (len(shapes), len(names)))
-        keys = names[:len(shapes)]
+    keys = _positional_keys(sym, keys, shapes, "infer_shape")
     # ndim-0 slots mean "unknown, infer me" (reference ABI), not scalar
     known = {n: tuple(s) for n, s in zip(keys, shapes) if len(s)}
     arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**known)
@@ -282,3 +286,836 @@ def iter_label(it):
 
 def iter_pad(it):
     return int(getattr(it.iter_next_batch, "pad", 0) or 0)
+
+
+# ======================================================================
+# round-5 expansion: the remaining reference c_api.h groups.  Each block
+# cites the reference declarations it marshals for
+# (/root/reference/include/mxnet/c_api.h line refs in comments).
+# ======================================================================
+
+_DTYPE_NAMES = ("float32", "float64", "float16", "uint8", "int32", "int8",
+                "int64")
+
+
+def _dtype_code(name):
+    name = str(name)
+    return _DTYPE_NAMES.index(name) if name in _DTYPE_NAMES else -1
+
+
+# ------------------------------------------------- ndarray extras (:230-460)
+def nd_at(arr, idx):
+    return arr[int(idx)]
+
+
+def nd_detach(arr):
+    """Share data, drop autograd association (reference MXNDArrayDetach)."""
+    return NDArray(arr.data, arr.context)
+
+
+def nd_set_grad_state(arr, state):
+    arr._fresh_grad = int(state)
+
+
+def nd_get_grad_state(arr):
+    return int(getattr(arr, "_fresh_grad", 0))
+
+
+def nd_save_raw(arr):
+    """One NDArray -> reference NDArray::Save record bytes (:254)."""
+    import struct
+
+    from .ndarray import _DTYPE_TO_FLAG, _NDARRAY_V1_MAGIC
+
+    np_arr = _np.ascontiguousarray(arr.asnumpy())
+    if np_arr.dtype.name not in _DTYPE_TO_FLAG or np_arr.ndim == 0:
+        raise MXNetError(
+            "dtype %s / ndim %d cannot be expressed in the reference raw "
+            "NDArray format" % (np_arr.dtype.name, np_arr.ndim))
+    out = [struct.pack("<II", _NDARRAY_V1_MAGIC, np_arr.ndim),
+           struct.pack("<%dq" % np_arr.ndim, *np_arr.shape),
+           struct.pack("<ii", 1, 0),
+           struct.pack("<i", _DTYPE_TO_FLAG[np_arr.dtype.name]),
+           np_arr.tobytes()]
+    return b"".join(out)
+
+
+def nd_load_raw(data):
+    """Inverse of nd_save_raw (reference MXNDArrayLoadFromRawBytes :242)."""
+    import struct
+
+    from .ndarray import _FLAG_TO_DTYPE, _NDARRAY_V1_MAGIC, array
+
+    (magic,) = struct.unpack_from("<I", data, 0)
+    if magic == _NDARRAY_V1_MAGIC:
+        (ndim,) = struct.unpack_from("<I", data, 4)
+        shape = struct.unpack_from("<%dq" % ndim, data, 8)
+        off = 8 + 8 * ndim
+    else:
+        ndim = magic  # legacy TShape: u32 ndim + u32 dims
+        shape = struct.unpack_from("<%dI" % ndim, data, 4)
+        off = 4 + 4 * ndim
+    (type_flag,) = struct.unpack_from("<i", data, off + 8)  # skip Context
+    off += 12
+    dt = _np.dtype(_FLAG_TO_DTYPE[type_flag])
+    count = int(_np.prod(shape)) if ndim else 1
+    np_arr = _np.frombuffer(data, dtype=dt, count=count,
+                            offset=off).reshape(shape)
+    return array(np_arr)
+
+
+# ----------------------------------- legacy Function group (:443-530)
+# FunctionHandle wraps the op NAME; describe/info come from the registry.
+def func_describe(name):
+    """-> (num_use_vars, num_scalars, num_mutate_vars, type_mask)."""
+    if name not in OP_REGISTRY:
+        raise MXNetError("unknown function %s" % name)
+    op = OP_REGISTRY[name]
+    n_in = 0 if op.variadic else len(op.inputs)
+    # kNDArrayArgBeforeScalar=1 | kAcceptEmptyMutateTarget=1<<2 (reference
+    # include/mxnet/c_api.h FunctionHandle flags)
+    return (n_in, 0, op.num_outputs, 1 | (1 << 2))
+
+
+def _op_param_info(op):
+    names, types, descs = [], [], []
+    for key, spec in (op.params or {}).items():
+        names.append(key)
+        t = type(spec).__name__.lower()
+        req = "required" if getattr(spec, "required", False) else \
+            "optional, default=%r" % (getattr(spec, "default", None),)
+        types.append("%s, %s" % (t, req))
+        descs.append(getattr(spec, "desc", "") or "")
+    return names, types, descs
+
+
+def func_info(name):
+    """-> (name, description, arg_names, arg_types, arg_descs, ret_type)."""
+    if name not in OP_REGISTRY:
+        raise MXNetError("unknown function %s" % name)
+    op = OP_REGISTRY[name]
+    names, types, descs = _op_param_info(op)
+    return (op.name, op.doc or "", names, types, descs, "NDArray")
+
+
+def func_invoke(name, use_vars, keys, vals, mutate_vars):
+    """MXFuncInvoke(Ex): run the op on use_vars, write into mutate_vars."""
+    res = imperative_invoke(name, use_vars, keys, vals)
+    nd_copy_into_all(res, mutate_vars)
+
+
+# --------------------------------------------- autograd group (:545-586)
+_GRAD_REQS = ("null", "write", "inplace", "add")
+
+
+def autograd_set_training(is_training):
+    from .contrib import autograd as _ag
+
+    return 1 if _ag.set_is_training(bool(is_training)) else 0
+
+
+def autograd_mark_variables(variables, reqs, gradients):
+    from .contrib import autograd as _ag
+
+    _ag.mark_variables(list(variables), list(gradients),
+                       [_GRAD_REQS[r if 0 <= r < 4 else 1] for r in reqs])
+
+
+def autograd_backward(outputs, ograds, retain_graph):
+    from .contrib import autograd as _ag
+
+    _ag.backward(list(outputs), list(ograds) if ograds else None,
+                 bool(retain_graph))
+
+
+# --------------------------------------------- CachedOp group (:588-600)
+class _CachedOp:
+    """Reference CachedOp ≙ one bound executor per input-signature, reused
+    across invokes (the jit cache below it makes replay one dispatch)."""
+
+    def __init__(self, sym):
+        self.sym = sym
+        self.names = sym.list_arguments()
+        self._exes = {}
+
+    def __call__(self, inputs):
+        if len(inputs) != len(self.names):
+            raise MXNetError("CachedOp: %d inputs for %d arguments"
+                             % (len(inputs), len(self.names)))
+        key = tuple((tuple(a.shape), str(_np.dtype(a.dtype))) for a in inputs)
+        exe = self._exes.get(key)
+        if exe is None:
+            exe = self.sym.bind(inputs[0].context if inputs else None,
+                                [a.copy() for a in inputs], grad_req="null")
+            self._exes[key] = exe
+        for name, arr in zip(self.names, inputs):
+            exe.arg_dict[name][:] = arr
+        exe.forward(is_train=False)
+        return list(exe.outputs)
+
+
+def cached_op_create(sym):
+    return _CachedOp(sym)
+
+
+def cached_op_invoke(cop, inputs):
+    return cop(list(inputs))
+
+
+# --------------------------------------------- symbol extras (:640-997)
+def symbol_group(syms):
+    return _sym.Group(list(syms))
+
+
+def symbol_from_file(fname):
+    return _sym.load(fname)
+
+
+def symbol_save_file(sym, fname):
+    sym.save(fname)
+
+
+def symbol_copy(sym):
+    import copy
+
+    return copy.deepcopy(sym)
+
+
+def symbol_print(sym):
+    """Debug string (reference MXSymbolPrint ≙ Symbol::DebugStr)."""
+    lines = ["Symbol outputs=%s" % ",".join(sym.list_outputs())]
+    for node, out_i in getattr(sym, "entries", []):
+        lines.append("  output[%d] <- %s(%s) inputs=%s attrs=%s"
+                     % (out_i, getattr(node.op, "name", node.op) or "var",
+                        node.name,
+                        [inp[0].name for inp in node.inputs], node.attrs))
+    return "\n".join(lines)
+
+
+def symbol_get_name(sym):
+    n = sym.name
+    return n if n is not None else None
+
+
+def symbol_get_attr(sym, key):
+    return sym.attr(key)
+
+
+def symbol_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+
+
+def symbol_list_attr(sym, shallow):
+    """Flat [k0, v0, k1, v1, ...]; deep keys are 'nodename$key' (the
+    reference MXSymbolListAttr contract python attr_dict parses)."""
+    flat = []
+    if shallow:
+        for k, v in (sym.list_attr() or {}).items():
+            flat += [str(k), str(v)]
+    else:
+        for name, attrs in (sym.attr_dict() or {}).items():
+            for k, v in attrs.items():
+                flat += ["%s$%s" % (name, k), str(v)]
+    return flat
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_children(sym):
+    return sym.get_children()
+
+
+def symbol_get_output(sym, index):
+    return sym[int(index)]
+
+
+def symbol_grad(sym, wrt):
+    return sym.grad(list(wrt))
+
+
+def symbol_infer_shape_partial(sym, keys, shapes):
+    keys = _positional_keys(sym, keys, shapes, "infer_shape_partial")
+    known = {n: tuple(s) for n, s in zip(keys, shapes) if len(s)}
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape_partial(**known)
+    return ([tuple(s) if s else () for s in arg_shapes or []],
+            [tuple(s) if s else () for s in out_shapes or []],
+            [tuple(s) if s else () for s in aux_shapes or []])
+
+
+def symbol_infer_type(sym, keys, codes):
+    """MXSymbolInferType (:978): dtype codes in, three code groups out."""
+    keys = _positional_keys(sym, keys, codes, "infer_type")
+    known = {k: _np.dtype(_DTYPE_NAMES[c]) for k, c in zip(keys, codes)
+             if 0 <= c < len(_DTYPE_NAMES)}
+    arg_types, out_types, aux_types = sym.infer_type(**known)
+
+    def codes_of(ts):
+        return [(-1 if t is None else _dtype_code(_np.dtype(t).name))
+                for t in (ts or [])]
+
+    a, o, x = codes_of(arg_types), codes_of(out_types), codes_of(aux_types)
+    complete = 1 if (a or o) and all(c >= 0 for c in a + o + x) else 0
+    return a, o, x, complete
+
+
+# ---------------------------------------- op introspection (:646-672)
+def op_info(name):
+    """MXSymbolGetAtomicSymbolInfo: (name, desc, arg_names, arg_types,
+    arg_descs, key_var_num_args, return_type)."""
+    if name not in OP_REGISTRY:
+        raise MXNetError("unknown operator %s" % name)
+    op = OP_REGISTRY[name]
+    names, types, descs = _op_param_info(op)
+    key_var = "num_args" if op.variadic else ""
+    ret = "Symbol" if op.num_outputs == 1 else "Symbol[]"
+    return (op.name, op.doc or "", names, types, descs, key_var, ret)
+
+
+# --------------------------------------------- executor extras (:999-1180)
+def executor_print(exe):
+    sym = exe._symbol
+    lines = ["Executor (XLA whole-graph jit)",
+             "  arguments: %s" % ", ".join(sym.list_arguments()),
+             "  outputs:   %s" % ", ".join(sym.list_outputs()),
+             "  aux:       %s" % ", ".join(sym.list_auxiliary_states())]
+    for name, arr in exe.arg_dict.items():
+        lines.append("  arg %-20s %s %s" % (name, tuple(arr.shape),
+                                            _np.dtype(arr.dtype).name))
+    return "\n".join(lines)
+
+
+def _g2c_map(keys, dev_types, dev_ids):
+    if not keys:
+        return None
+    return {k: _ctx(t, i) for k, t, i in zip(keys, dev_types, dev_ids)}
+
+
+def executor_bind_x(sym, dev_type, dev_id, g2c_keys, g2c_types, g2c_ids,
+                    args, grad_reqs, auxs, shared_exec):
+    names = sym.list_arguments()
+    req = {n: r for n, r in zip(names, grad_reqs)}
+    grads = {n: NDArray(_np.zeros(a.shape, _np.dtype(a.dtype)))
+             for n, a, r in zip(names, args, grad_reqs) if r != "null"}
+    return sym.bind(_ctx(dev_type, dev_id), list(args), args_grad=grads,
+                    grad_req=req, aux_states=list(auxs) if auxs else None,
+                    group2ctx=_g2c_map(g2c_keys, g2c_types, g2c_ids),
+                    shared_exec=shared_exec)
+
+
+def executor_simple_bind(sym, dev_type, dev_id, g2c_keys, g2c_types,
+                         g2c_ids, req_names, req_types, shape_names, shapes,
+                         dtype_names, dtype_codes, shared_arg_names,
+                         shared_buf_names, shared_buf_arrs, shared_exec):
+    """MXExecutorSimpleBind (:1136): infer + allocate + bind in one step.
+
+    Returns (exe, in_args, arg_grads-with-None, aux_states,
+    updated_shared_names, updated_shared_arrs)."""
+    from .executor import Executor
+
+    if req_names:
+        grad_req = dict(zip(req_names, req_types))
+    elif req_types:
+        grad_req = list(req_types) if len(req_types) > 1 else req_types[0]
+    else:
+        grad_req = "write"
+    type_dict = {n: _np.dtype(_DTYPE_NAMES[c])
+                 for n, c in zip(dtype_names or [], dtype_codes or [])
+                 if 0 <= c < len(_DTYPE_NAMES)}
+    kwargs = {n: tuple(s) for n, s in zip(shape_names, shapes)}
+    exe = Executor.simple_bind(sym, _ctx(dev_type, dev_id),
+                               grad_req=grad_req,
+                               type_dict=type_dict or None,
+                               shared_exec=shared_exec,
+                               group2ctx=_g2c_map(g2c_keys, g2c_types,
+                                                  g2c_ids),
+                               **kwargs)
+    arg_names = sym.list_arguments()
+    # shared buffer: caller-provided arrays REPLACE freshly-allocated args
+    # of matching shape/dtype so memory is genuinely shared, then the
+    # union flows back (reference shared_buffer grow-only contract)
+    shared_buf = dict(zip(shared_buf_names or [], shared_buf_arrs or []))
+    if shared_buf_names is not None:
+        for n in arg_names:
+            cur = exe.arg_dict.get(n)
+            prev = shared_buf.get(n)
+            if prev is not None and cur is not None and \
+                    tuple(prev.shape) == tuple(cur.shape) and \
+                    str(_np.dtype(prev.dtype)) == str(_np.dtype(cur.dtype)):
+                # forward() reads arg_dict[n].data each step, so swapping
+                # the dict entry makes the sharing real
+                exe.arg_dict[n] = prev
+            shared_buf[n] = exe.arg_dict[n]
+    in_args = [exe.arg_dict[n] for n in arg_names]
+    arg_grads = [exe.grad_dict.get(n) for n in arg_names]
+    aux_states = [exe.aux_dict[n] for n in sym.list_auxiliary_states()]
+    upd_names = list(shared_buf.keys())
+    upd_arrs = [shared_buf[n] for n in upd_names]
+    return exe, in_args, arg_grads, aux_states, upd_names, upd_arrs
+
+
+def executor_monitor_arrays(exe):
+    """(names, arrays) the C monitor callback reports after forward:
+    outputs then aux states (the per-op interior is fused by XLA)."""
+    names, arrs = [], []
+    for n, a in zip(exe._symbol.list_outputs(), exe.outputs):
+        names.append(n)
+        arrs.append(a)
+    for n, a in exe.aux_dict.items():
+        names.append(n)
+        arrs.append(a)
+    return names, arrs
+
+
+# --------------------------------------------- dataiter extras (:1203-1240)
+def iter_info(name):
+    import inspect
+
+    from . import io as _io
+
+    if name not in _ITER_NAMES:
+        raise MXNetError("unknown data iterator %s" % name)
+    cls = getattr(_io, name)
+    names, types, descs = [], [], []
+    try:
+        sig = inspect.signature(cls.__init__)
+        for pname, p in sig.parameters.items():
+            if pname in ("self", "args", "kwargs"):
+                continue
+            names.append(pname)
+            if p.default is inspect.Parameter.empty:
+                types.append("required")
+            else:
+                types.append("optional, default=%r" % (p.default,))
+            descs.append("")
+    except (TypeError, ValueError):
+        pass
+    return (name, (cls.__doc__ or "").strip(), names, types, descs)
+
+
+def iter_index(it):
+    idx = getattr(it.iter_next_batch, "index", None)
+    if idx is None:
+        return []
+    return [int(i) for i in idx]
+
+
+# --------------------------------------------- kvstore extras (:1273-1533)
+def kv_create_role_aware(kind):
+    """Reference servers/schedulers create a kvstore handle too, but only
+    workers connect as clients (KVStoreDist ctor checks IsServerNode)."""
+    import os
+
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if kind.startswith("dist") and role != "worker":
+
+        class _ServerSideKV:
+            type = kind
+            rank = 0
+            num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+        return _ServerSideKV()
+    return kv_create(kind)
+
+
+def kv_type(kv):
+    return kv.type
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kv_barrier(kv):
+    kv.barrier()
+
+
+def kv_set_barrier_before_exit(kv, do_barrier):
+    kv._do_barrier_before_exit = bool(do_barrier)
+
+
+def kv_send_command(kv, head, body):
+    kv._send_command_to_servers(int(head), body)
+
+
+def kv_num_dead_node(kv, node_id, timeout_sec):
+    """node_id groups (reference): kScheduler=1, kServerGroup=2,
+    kWorkerGroup=4 (OR-able).  timeout_sec is the heartbeat-death
+    threshold, which here lives scheduler-side (DEAD_NODE_TIMEOUT)."""
+    dead = kv.check_dead_nodes() if hasattr(kv, "check_dead_nodes") else []
+    prefixes = []
+    if node_id & 1:
+        prefixes.append("scheduler")
+    if node_id & 2:
+        prefixes.append("server")
+    if node_id & 4:
+        prefixes.append("worker")
+    return sum(1 for d in dead
+               if str(d).split(":")[0] in prefixes or str(d) == str(node_id))
+
+
+def kv_role_flags():
+    import os
+
+    role = os.environ.get("DMLC_ROLE", "worker")
+    return (1 if role == "worker" else 0, 1 if role == "server" else 0,
+            1 if role == "scheduler" else 0)
+
+
+def init_ps_env(keys, vals):
+    import os
+
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+
+
+def kv_set_updater_c(kv, updater_addr, user_handle, lib_path):
+    """Wire a C MXKVStoreUpdater through a ctypes trampoline: the stored C
+    function pointer is called with freshly-wrapped NDArray handles made
+    by the lib's own MXTPUWrapForCallback (the updater owns + frees them,
+    per the reference typedef contract)."""
+    import ctypes
+
+    lib = ctypes.CDLL(lib_path)
+    cfn = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)(updater_addr)
+
+    # string keys (PushEx) get stable per-store int ids so a C updater
+    # keeping per-key state never sees two keys collide (reference int-key
+    # updater contract; numeric strings keep their numeric value)
+    key_ids = getattr(kv, "_c_updater_key_ids", None)
+    if key_ids is None:
+        key_ids = kv._c_updater_key_ids = {}
+
+    def updater(key, recv, local):
+        hr, hl = ctypes.c_void_p(), ctypes.c_void_p()
+        for obj, out in ((recv, hr), (local, hl)):
+            rc = lib.MXTPUWrapForCallback(ctypes.c_void_p(id(obj)),
+                                          ctypes.byref(out))
+            if rc != 0:
+                raise MXNetError("wrap for C updater failed")
+        try:
+            ikey = int(key)
+        except (TypeError, ValueError):
+            ikey = key_ids.setdefault(key, len(key_ids))
+        cfn(ikey, hr, hl, ctypes.c_void_p(user_handle or 0))
+
+    kv._set_updater(updater)
+
+
+def kv_run_server(kv, controller_addr, user_handle):
+    """MXKVStoreRunServer (:1498): block in the server/scheduler loop; the
+    C controller sees every command a worker sends (head, body)."""
+    import ctypes
+    import os
+
+    from .parallel import dist
+
+    role = os.environ.get("DMLC_ROLE", "worker")
+    hook = None
+    if controller_addr:
+        cfn = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_void_p)(controller_addr)
+
+        def hook(head, body):
+            cfn(int(head), bytes(body), ctypes.c_void_p(user_handle or 0))
+
+    if role == "server":
+        dist.run_server(command_hook=hook)
+        return 0
+    if role == "scheduler":
+        return dist.run_scheduler() or 0
+    raise MXNetError("MXKVStoreRunServer called in a %r process "
+                     "(DMLC_ROLE must be server or scheduler)" % role)
+
+
+# --------------------------------------------- RecordIO group (:1535-1596)
+def recordio_writer_create(uri):
+    from .recordio import MXRecordIO
+
+    return MXRecordIO(uri, "w")
+
+
+def recordio_reader_create(uri):
+    from .recordio import MXRecordIO
+
+    return MXRecordIO(uri, "r")
+
+
+def recordio_write(rec, data):
+    rec.write(data)
+
+
+def recordio_read(rec):
+    return rec.read()  # None at EOF
+
+
+def recordio_tell(rec):
+    return int(rec.tell())
+
+
+def recordio_seek(rec, pos):
+    rec.handle.seek(int(pos))
+
+
+def recordio_close(rec):
+    rec.close()
+
+
+# --------------------------------------------------- RTC group (:1598-1625)
+def rtc_create(name, input_names, output_names, inputs, outputs, kernel_src):
+    """TPU-native MXRtc: `kernel` is PYTHON source of a JAX-traceable
+    function named `name` (jnp/lax/pallas), not CUDA (documented deviation
+    — include/mxnet_tpu/c_api.h RTC section)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import rtc as _rtc
+
+    ns = {"jnp": jnp, "jax": jax, "np": _np}
+    exec(compile(kernel_src, "<mx.rtc:%s>" % name, "exec"), ns)
+    fn = ns.get(name)
+    if not callable(fn):
+        raise MXNetError("RTC source must define a function named %r" % name)
+    return _rtc.Rtc(name, [(n,) for n in input_names],
+                    [(n,) for n in output_names], fn)
+
+
+def rtc_push(rtc_obj, inputs, outputs, grid_block):
+    rtc_obj.push(list(inputs), list(outputs), *grid_block)
+
+
+# --------------------------------------------------- profiler (:185-199)
+def profiler_set_config(mode, filename):
+    from . import profiler as _prof
+
+    _prof.profiler_set_config("symbolic" if int(mode) == 0 else "all",
+                              filename)
+
+
+def profiler_set_state(state):
+    from . import profiler as _prof
+
+    _prof.profiler_set_state("run" if int(state) else "stop")
+
+
+def profiler_dump():
+    from . import profiler as _prof
+
+    _prof.dump_profile()
+
+
+def set_num_omp_threads(n):
+    import os
+
+    os.environ["MXTPU_OMP_MAX_THREADS"] = str(int(n))
+
+
+# --------------------------------------------- CustomOp from C (:1620)
+def custom_op_register_c(op_type, creator_addr, lib_path):
+    """MXCustomOpRegister: adapt a C CustomOpPropCreator (the reference
+    MXCallbackList protocol, c_api.h:107-145) into this framework's
+    CustomOpProp registry.  The registered op is inherently a host op —
+    its C callbacks do synchronous NDArray reads — so the Custom-op
+    machinery's pure_callback path executes it (operator.py docstring)."""
+    import ctypes
+
+    from . import operator as _op
+
+    lib = ctypes.CDLL(lib_path)
+    c_int_p = ctypes.POINTER(ctypes.c_int)
+    mx_uint_p = ctypes.POINTER(ctypes.c_uint)
+
+    class MXCallbackList(ctypes.Structure):
+        _fields_ = [("num_callbacks", ctypes.c_int),
+                    ("callbacks",
+                     ctypes.POINTER(ctypes.CFUNCTYPE(ctypes.c_int))),
+                    ("contexts", ctypes.POINTER(ctypes.c_void_p))]
+
+    CREATOR = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(MXCallbackList))
+    LIST_FT = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.c_void_p)
+    INFERSHAPE_FT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, c_int_p,
+                                     ctypes.POINTER(mx_uint_p),
+                                     ctypes.c_void_p)
+    INFERTYPE_FT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, c_int_p,
+                                    ctypes.c_void_p)
+    DEPS_FT = ctypes.CFUNCTYPE(ctypes.c_int, c_int_p, c_int_p, c_int_p,
+                               c_int_p, ctypes.POINTER(c_int_p),
+                               ctypes.c_void_p)
+    CREATEOP_FT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.POINTER(mx_uint_p),
+                                   c_int_p, c_int_p,
+                                   ctypes.POINTER(MXCallbackList),
+                                   ctypes.c_void_p)
+    FB_FT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_void_p), c_int_p,
+                             c_int_p, ctypes.c_int, ctypes.c_void_p)
+
+    creator = CREATOR(creator_addr)
+    # CustomOpPropCallbacks / CustomOpCallbacks enum order (c_api.h:113-128)
+    (P_DEL, P_LIST_ARG, P_LIST_OUT, P_LIST_AUX, P_INFSHAPE, P_DEPS,
+     P_CREATE, P_INFTYPE) = range(8)
+    O_DEL, O_FWD, O_BWD = range(3)
+    _REQ_CODES = {"null": 0, "write": 1, "inplace": 2, "add": 3}
+
+    def _cb(cblist, idx, ftype):
+        if idx >= cblist.num_callbacks or not cblist.callbacks[idx]:
+            return None, None
+        return (ctypes.cast(cblist.callbacks[idx], ftype),
+                cblist.contexts[idx])
+
+    def _read_str_list(pp):
+        out, i = [], 0
+        while pp[i]:
+            out.append(pp[i].decode())
+            i += 1
+        return out
+
+    def _mint(arr):
+        h = ctypes.c_void_p()
+        rc = lib.MXTPUWrapForCallback(ctypes.c_void_p(id(arr)),
+                                      ctypes.byref(h))
+        if rc != 0:
+            raise MXNetError("wrap for C custom op failed")
+        return h
+
+    class _COp(_op.CustomOp):
+        def __init__(self, cblist):
+            self._cb = cblist
+
+        def _fire(self, idx, groups, tags, reqs, is_train):
+            # force a host value read first: under jax tracing this raises
+            # TracerArrayConversionError, which flips the Custom machinery
+            # onto its pure_callback host path (operator.py:192-204)
+            for g in groups:
+                for a in g:
+                    _np.asarray(a.data)
+            fn, ctx = _cb(self._cb, idx, FB_FT)
+            if fn is None:
+                raise MXNetError("C custom op lacks callback %d" % idx)
+            arrs = [a for g in groups for a in g]
+            tag_arr = (ctypes.c_int * len(arrs))(
+                *[t for g, t in zip(groups, tags) for _ in g])
+            ptrs = (ctypes.c_void_p * len(arrs))(
+                *[_mint(a) for a in arrs])  # callee owns + frees (ref ABI)
+            req_arr = (ctypes.c_int * len(reqs))(
+                *[_REQ_CODES.get(r, 1) for r in reqs])
+            if not fn(len(arrs), ptrs, tag_arr, req_arr, int(is_train),
+                      ctx):
+                raise MXNetError("C custom op callback %d reported failure"
+                                 % idx)
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self._fire(O_FWD, (in_data, out_data, aux), (0, 1, 4), req,
+                       is_train)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self._fire(O_BWD, (out_grad, in_data, out_data, in_grad, aux),
+                       (3, 0, 1, 2, 4), req, True)
+
+    class _CProp(_op.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            keys = [k.encode() for k in kwargs]
+            vals = [str(v).encode() for v in kwargs.values()]
+            ka = (ctypes.c_char_p * max(1, len(keys)))(*(keys or [None]))
+            va = (ctypes.c_char_p * max(1, len(vals)))(*(vals or [None]))
+            self._cblist = MXCallbackList()
+            if not creator(op_type.encode(), len(keys), ka, va,
+                           ctypes.byref(self._cblist)):
+                raise MXNetError("C CustomOpPropCreator for %r failed"
+                                 % op_type)
+
+        def _list(self, idx):
+            fn, ctx = _cb(self._cblist, idx, LIST_FT)
+            if fn is None:
+                return []
+            out = ctypes.POINTER(ctypes.c_char_p)()
+            if not fn(ctypes.byref(out), ctx):
+                raise MXNetError("C custom op list callback failed")
+            return _read_str_list(out)
+
+        def list_arguments(self):
+            return self._list(P_LIST_ARG)
+
+        def list_outputs(self):
+            return self._list(P_LIST_OUT)
+
+        def list_auxiliary_states(self):
+            return self._list(P_LIST_AUX)
+
+        def infer_shape(self, in_shape):
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_in + n_out + n_aux
+            fn, ctx = _cb(self._cblist, P_INFSHAPE, INFERSHAPE_FT)
+            if fn is None:
+                return super().infer_shape(in_shape)
+            dims = (ctypes.c_int * total)(
+                *([len(s) for s in in_shape] + [0] * (n_out + n_aux)))
+            shapes = (mx_uint_p * total)()
+            keep = []
+            for i, s in enumerate(in_shape):
+                buf = (ctypes.c_uint * max(1, len(s)))(*s)
+                keep.append(buf)
+                shapes[i] = ctypes.cast(buf, mx_uint_p)
+            if not fn(total, dims, shapes, ctx):
+                raise MXNetError("C custom op infer_shape failed")
+            groups = [[tuple(shapes[i][j] for j in range(dims[i]))
+                       for i in range(lo, hi)]
+                      for lo, hi in ((0, n_in), (n_in, n_in + n_out),
+                                     (n_in + n_out, total))]
+            return groups[0], groups[1], groups[2]
+
+        def infer_type(self, in_type):
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_in + n_out + n_aux
+            fn, ctx = _cb(self._cblist, P_INFTYPE, INFERTYPE_FT)
+            if fn is None:
+                return super().infer_type(in_type)
+            codes = (ctypes.c_int * total)(
+                *([_dtype_code(_np.dtype(t).name) for t in in_type]
+                  + [-1] * (n_out + n_aux)))
+            if not fn(total, codes, ctx):
+                raise MXNetError("C custom op infer_type failed")
+            names = [_np.dtype(_DTYPE_NAMES[codes[i]]) for i in range(total)]
+            return (names[:n_in], names[n_in:n_in + n_out],
+                    names[n_in + n_out:])
+
+        def create_operator(self, ctx_str, in_shapes, in_dtypes):
+            fn, cctx = _cb(self._cblist, P_CREATE, CREATEOP_FT)
+            if fn is None:
+                raise MXNetError("C custom op lacks CreateOperator")
+            n = len(in_shapes)
+            dims = (ctypes.c_int * max(1, n))(*[len(s) for s in in_shapes])
+            shapes = (mx_uint_p * max(1, n))()
+            keep = []
+            for i, s in enumerate(in_shapes):
+                buf = (ctypes.c_uint * max(1, len(s)))(*s)
+                keep.append(buf)
+                shapes[i] = ctypes.cast(buf, mx_uint_p)
+            codes = (ctypes.c_int * max(1, n))(
+                *([_dtype_code(_np.dtype(d).name) for d in in_dtypes]
+                  or [0]))
+            op_cb = MXCallbackList()
+            if not fn((ctx_str or "cpu(0)").encode(), n, shapes, dims,
+                      codes, ctypes.byref(op_cb), cctx):
+                raise MXNetError("C custom op CreateOperator failed")
+            cop = _COp(op_cb)
+            cop._keep = keep
+            return cop
+
+    _op.register(op_type)(_CProp)

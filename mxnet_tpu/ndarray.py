@@ -61,7 +61,10 @@ def _as_jax(value, dtype=None):
 class NDArray:
     """Multi-dimensional array on a device (parity: python/mxnet/ndarray.py NDArray)."""
 
-    __slots__ = ("_data", "_ctx", "_parent", "_index", "writable")
+    # _fresh_grad backs MXNDArray{Set,Get}GradState (set lazily; unset
+    # slot reads as 0 through the C API)
+    __slots__ = ("_data", "_ctx", "_parent", "_index", "writable",
+                 "_fresh_grad")
 
     def __init__(self, data, ctx=None, _parent=None, _index=None):
         self._parent = _parent

@@ -406,6 +406,7 @@ class Server:
         self.num_workers = num_workers
         self.sync_mode = False
         self.updater = None  # (key:str, recv np, stored np) -> None
+        self.command_hook = None  # (head:int, body:bytes) -> None
         self.store = {}
         self._store_lock = threading.Lock()
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -441,6 +442,31 @@ class Server:
         else:
             st.value = recved.copy()
         st.version += 1
+
+    def _handle_command(self, head, payload):
+        """One worker command (reference kStopServer/kController heads).
+
+        head 0 carries the pickled optimizer (set_optimizer); a user
+        controller (MXKVStoreRunServer) sees every command first."""
+        if self.command_hook is not None:
+            self.command_hook(head, payload)
+            if head != 0:
+                return
+        if head != 0:
+            return
+        optimizer = pickle.loads(payload)
+        from .. import optimizer as opt_mod
+        from ..ndarray import array
+
+        updater = opt_mod.get_updater(optimizer)
+
+        def apply_update(st_, recved, _updater=updater):
+            w = array(st_.value)
+            g = array(recved)
+            _updater(st_.key, g, w)
+            st_.value = np.asarray(w.asnumpy())
+
+        self.updater = apply_update
 
     def _serve_conn(self, conn):
         try:
@@ -508,22 +534,16 @@ class Server:
                     self.sync_mode = bool(info["sync"])
                     _send_frame(conn, _ACK)
                 elif cmd == _COMMAND:
-                    # optimizer shipped from rank-0 worker (reference
-                    # set_optimizer pickling, kvstore.py)
-                    optimizer = pickle.loads(payload)
-                    from .. import optimizer as opt_mod
-                    from ..ndarray import NDArray, array
-
-                    updater = opt_mod.get_updater(optimizer)
-
-                    def apply_update(st_, recved, _updater=updater):
-                        w = array(st_.value)
-                        g = array(recved)
-                        _updater(st_.key, g, w)
-                        st_.value = np.asarray(w.asnumpy())
-
-                    self.updater = apply_update
-                    _send_frame(conn, _ACK)
+                    # a bad command (unpicklable head-0 body, raising user
+                    # controller) must answer _ERROR, not kill this
+                    # connection thread and strand the worker's RPC
+                    try:
+                        self._handle_command(info.get("head", 0), payload)
+                    except Exception as e:  # noqa: BLE001
+                        _send_frame(conn, _ERROR,
+                                    _meta(msg="command failed: %s" % e))
+                    else:
+                        _send_frame(conn, _ACK)
                 elif cmd == _STOP:
                     _send_frame(conn, _ACK)
                     self._stop.set()
@@ -735,10 +755,16 @@ class DistKVStore:
 
     def set_optimizer(self, optimizer):
         if self._rank == 0:
-            blob = pickle.dumps(optimizer, 0)
-            for i in range(len(self._servers)):
-                self._rpc(i, _COMMAND, b"", blob)
+            self._send_command_to_servers(0, pickle.dumps(optimizer, 0))
         self.barrier()
+
+    def _send_command_to_servers(self, head, body):
+        """Reference MXKVStoreSendCommmandToServers: (head, body) to every
+        server; head 0 carries the pickled optimizer (set_optimizer)."""
+        if isinstance(body, str):
+            body = body.encode()
+        for i in range(len(self._servers)):
+            self._rpc(i, _COMMAND, _meta(head=int(head)), bytes(body))
 
     def _set_updater(self, updater):
         self._updater = updater
@@ -823,10 +849,11 @@ def _start_heartbeat(sock, send_lock, stop_event=None):
     return t
 
 
-def run_server():
+def run_server(command_hook=None):
     root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
     server = Server(0, int(os.environ["DMLC_NUM_WORKER"]))
+    server.command_hook = command_hook
     sched = _connect_retry((root, port))
     # advertise the address workers can actually REACH: the local address
     # of the route to the scheduler (a literal 127.0.0.1 would break any

@@ -14,7 +14,12 @@
 // c_predict_api.cc — both TUs link into one libmxnet_tpu.so).
 #include "py_embed.h"
 
+#include <dlfcn.h>
+
+#include <cstdint>
 #include <cstring>
+#include <map>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -32,7 +37,33 @@ struct Handle {
   std::vector<unsigned> shape;
   std::vector<std::string> strs;
   std::vector<const char *> cstrs;
+  // keeps a bytes/array object alive while a raw pointer into it is
+  // exposed (GetData / SaveRawBytes / RecordIO read)
+  PyObject *keeper = nullptr;
 };
+
+// live creator handles (AtomicSymbolCreator / FunctionHandle /
+// DataIterCreator wrap a python name string).  Lets name-based entry
+// points accept EITHER a creator handle (reference ABI) or a plain C
+// string (this ABI's documented name-addressing) on the same argument.
+std::set<void *> *g_creators = new std::set<void *>();
+
+// monitor callbacks keyed by executor handle; fired after each forward
+// over outputs + aux states (XLA fuses the per-op interior away —
+// documented deviation from the reference's per-op firing)
+typedef void (*ExecutorMonitorCallback)(const char *, void *, void *);
+std::map<void *, std::pair<ExecutorMonitorCallback, void *>> *g_monitors =
+    new std::map<void *, std::pair<ExecutorMonitorCallback, void *>>();
+
+const char *creator_name(const void *maybe_creator) {
+  // returns the wrapped name when the pointer is a known creator handle,
+  // else treats the pointer as a NUL-terminated op name
+  if (g_creators->count(const_cast<void *>(maybe_creator))) {
+    return PyUnicode_AsUTF8(
+        static_cast<const Handle *>(maybe_creator)->obj);
+  }
+  return static_cast<const char *>(maybe_creator);
+}
 
 Handle *wrap(PyObject *obj) {
   Handle *h = new Handle();
@@ -237,6 +268,7 @@ int MXNDArrayFree(void *handle) {
   Gil gil;
   Handle *h = static_cast<Handle *>(handle);
   Py_XDECREF(h->obj);
+  Py_XDECREF(h->keeper);
   delete h;
   return 0;
 }
@@ -349,10 +381,12 @@ int MXListAllOpNames(unsigned *out_size, const char ***out_array) {
   return 0;
 }
 
-int MXImperativeInvoke(const char *op_name, int num_inputs, void **inputs,
-                       int *num_outputs, void ***outputs, int num_params,
-                       const char **param_keys, const char **param_vals) {
+int MXImperativeInvoke(const void *creator_or_name, int num_inputs,
+                       void **inputs, int *num_outputs, void ***outputs,
+                       int num_params, const char **param_keys,
+                       const char **param_vals) {
   Gil gil;
+  const char *op_name = creator_name(creator_or_name);
   PyObject *ins = handle_list(num_inputs, inputs);
   PyObject *ks = str_list(num_params, param_keys);
   PyObject *vs = str_list(num_params, param_vals);
@@ -431,10 +465,11 @@ int MXSymbolCreateVariable(const char *name, void **out) {
   return 0;
 }
 
-int MXSymbolCreateAtomicSymbol(const char *op_name, unsigned num_param,
-                               const char **keys, const char **vals,
-                               void **out) {
+int MXSymbolCreateAtomicSymbol(const void *creator_or_name,
+                               unsigned num_param, const char **keys,
+                               const char **vals, void **out) {
   Gil gil;
+  const char *op_name = creator_name(creator_or_name);
   PyObject *ks = str_list(num_param, keys);
   PyObject *vs = str_list(num_param, vals);
   PyObject *r = (ks && vs) ? impl_call("symbol_create",
@@ -508,14 +543,14 @@ int MXSymbolListAuxiliaryStates(void *handle, unsigned *out_size,
 
 int MXSymbolFree(void *handle) { return MXNDArrayFree(handle); }
 
-int MXSymbolInferShape(void *handle, unsigned num_args, const char **keys,
-                       const unsigned *arg_ind_ptr, const unsigned *arg_shape_data,
-                       unsigned *in_shape_size, const unsigned **in_shape_ndim,
-                       const unsigned ***in_shape_data,
-                       unsigned *out_shape_size, const unsigned **out_shape_ndim,
-                       const unsigned ***out_shape_data,
-                       unsigned *aux_shape_size, const unsigned **aux_shape_ndim,
-                       const unsigned ***aux_shape_data, int *complete) {
+static int infer_shape_common(
+    const char *impl_fn, void *handle, unsigned num_args, const char **keys,
+    const unsigned *arg_ind_ptr, const unsigned *arg_shape_data,
+    unsigned *in_shape_size, const unsigned **in_shape_ndim,
+    const unsigned ***in_shape_data, unsigned *out_shape_size,
+    const unsigned **out_shape_ndim, const unsigned ***out_shape_data,
+    unsigned *aux_shape_size, const unsigned **aux_shape_ndim,
+    const unsigned ***aux_shape_data, int *complete) {
   Gil gil;
   Handle *h = static_cast<Handle *>(handle);
   // keys==NULL means positional inference (reference ABI): shapes are
@@ -528,7 +563,7 @@ int MXSymbolInferShape(void *handle, unsigned num_args, const char **keys,
                     shape_tuple(arg_ind_ptr[i + 1] - arg_ind_ptr[i],
                                 arg_shape_data + arg_ind_ptr[i]));
   PyObject *r = (ks && shapes)
-                    ? impl_call("symbol_infer_shape",
+                    ? impl_call(impl_fn,
                                 Py_BuildValue("(OOO)", h->obj, ks, shapes))
                     : nullptr;
   Py_XDECREF(ks);
@@ -579,6 +614,39 @@ int MXSymbolInferShape(void *handle, unsigned num_args, const char **keys,
   return 0;
 }
 
+int MXSymbolInferShape(void *handle, unsigned num_args, const char **keys,
+                       const unsigned *arg_ind_ptr,
+                       const unsigned *arg_shape_data,
+                       unsigned *in_shape_size, const unsigned **in_shape_ndim,
+                       const unsigned ***in_shape_data,
+                       unsigned *out_shape_size,
+                       const unsigned **out_shape_ndim,
+                       const unsigned ***out_shape_data,
+                       unsigned *aux_shape_size,
+                       const unsigned **aux_shape_ndim,
+                       const unsigned ***aux_shape_data, int *complete) {
+  return infer_shape_common("symbol_infer_shape", handle, num_args, keys,
+                            arg_ind_ptr, arg_shape_data, in_shape_size,
+                            in_shape_ndim, in_shape_data, out_shape_size,
+                            out_shape_ndim, out_shape_data, aux_shape_size,
+                            aux_shape_ndim, aux_shape_data, complete);
+}
+
+int MXSymbolInferShapePartial(
+    void *handle, unsigned num_args, const char **keys,
+    const unsigned *arg_ind_ptr, const unsigned *arg_shape_data,
+    unsigned *in_shape_size, const unsigned **in_shape_ndim,
+    const unsigned ***in_shape_data, unsigned *out_shape_size,
+    const unsigned **out_shape_ndim, const unsigned ***out_shape_data,
+    unsigned *aux_shape_size, const unsigned **aux_shape_ndim,
+    const unsigned ***aux_shape_data, int *complete) {
+  return infer_shape_common("symbol_infer_shape_partial", handle, num_args,
+                            keys, arg_ind_ptr, arg_shape_data, in_shape_size,
+                            in_shape_ndim, in_shape_data, out_shape_size,
+                            out_shape_ndim, out_shape_data, aux_shape_size,
+                            aux_shape_ndim, aux_shape_data, complete);
+}
+
 /* ---------------------------------------------------------- executor */
 
 int MXExecutorBind(void *sym_handle, int dev_type, int dev_id,
@@ -615,6 +683,26 @@ int MXExecutorForward(void *handle, int is_train) {
                           Py_BuildValue("(Oi)", unwrap(handle), is_train));
   if (!r) { set_error_from_python(); return -1; }
   Py_DECREF(r);
+  auto mon = g_monitors->find(handle);
+  if (mon != g_monitors->end()) {
+    // fire the monitor over outputs + aux states; each handle is valid
+    // for the duration of the callback only (freed on return)
+    PyObject *m = impl_call("executor_monitor_arrays",
+                            Py_BuildValue("(O)", unwrap(handle)));
+    if (!m) { set_error_from_python(); return -1; }
+    PyObject *names = PyTuple_GetItem(m, 0);
+    PyObject *arrs = PyTuple_GetItem(m, 1);
+    Py_ssize_t n = PyList_Size(names);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char *nm = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+      PyObject *a = PyList_GetItem(arrs, i);
+      Py_INCREF(a);
+      Handle *ah = wrap(a);
+      mon->second.first(nm, ah, mon->second.second);
+      MXNDArrayFree(ah);
+    }
+    Py_DECREF(m);
+  }
   return 0;
 }
 
@@ -656,13 +744,21 @@ int MXExecutorGrads(void *handle, unsigned *out_size, void ***out_arrs,
   return 0;
 }
 
-int MXExecutorFree(void *handle) { return MXNDArrayFree(handle); }
+int MXExecutorFree(void *handle) {
+  {
+    Gil gil;  // g_monitors is GIL-guarded (see SetMonitorCallback)
+    g_monitors->erase(handle);
+  }
+  return MXNDArrayFree(handle);
+}
 
 /* ----------------------------------------------------------- kvstore */
 
 int MXKVStoreCreate(const char *type, void **out) {
   Gil gil;
-  PyObject *r = impl_call("kv_create", Py_BuildValue("(s)", type));
+  // role-aware: server/scheduler processes get a non-connecting handle
+  // (reference KVStoreDist ctor checks IsServerNode the same way)
+  PyObject *r = impl_call("kv_create_role_aware", Py_BuildValue("(s)", type));
   if (!r) { set_error_from_python(); return -1; }
   *out = wrap(r);
   return 0;
@@ -690,11 +786,18 @@ int MXKVStoreInit(void *handle, unsigned num, const int *keys, void **vals) {
   return kv_op("kv_init", handle, num, keys, vals);
 }
 
-int MXKVStorePush(void *handle, unsigned num, const int *keys, void **vals) {
+// priority is accepted for reference-ABI parity and ignored: PJRT async
+// dispatch + XLA collectives order transfers, there is no engine queue
+// to prioritize (reference priority feeds ThreadedEngine scheduling)
+int MXKVStorePush(void *handle, unsigned num, const int *keys, void **vals,
+                  int priority) {
+  (void)priority;
   return kv_op("kv_push", handle, num, keys, vals);
 }
 
-int MXKVStorePull(void *handle, unsigned num, const int *keys, void **vals) {
+int MXKVStorePull(void *handle, unsigned num, const int *keys, void **vals,
+                  int priority) {
+  (void)priority;
   return kv_op("kv_pull", handle, num, keys, vals);
 }
 
@@ -702,20 +805,42 @@ int MXKVStoreFree(void *handle) { return MXNDArrayFree(handle); }
 
 /* ---------------------------------------------------------- data iter */
 
-int MXListDataIters(unsigned *out_size, const char ***out_array) {
+// builds (once) a process-lifetime creator-handle array for the names the
+// given impl fn lists; creators are never freed (reference registry
+// entries are static too)
+static int list_creators(const char *impl_fn, std::vector<void *> &cache,
+                         unsigned *out_size, void ***out_array) {
   Gil gil;
-  PyObject *r = impl_call("list_data_iters", nullptr);
-  if (!r) { set_error_from_python(); return -1; }
-  static thread_local Handle scratch;
-  int rc = stash_strs(&scratch, r, out_size, out_array);
-  Py_DECREF(r);
-  if (rc != 0) { set_error_from_python(); return -1; }
+  if (cache.empty()) {
+    PyObject *r = impl_call(impl_fn, nullptr);
+    if (!r) { set_error_from_python(); return -1; }
+    Py_ssize_t n = PyList_Size(r);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *name = PyList_GetItem(r, i);
+      Py_INCREF(name);
+      Handle *h = wrap(name);
+      g_creators->insert(h);
+      cache.push_back(h);
+    }
+    Py_DECREF(r);
+  }
+  *out_size = static_cast<unsigned>(cache.size());
+  *out_array = cache.data();
   return 0;
 }
 
-int MXDataIterCreateIter(const char *name, unsigned num_param,
+// reference ABI: returns DataIterCreator handles; pass one to
+// MXDataIterCreateIter / MXDataIterGetIterInfo (both also accept the
+// iterator NAME directly, this ABI's name-addressing convention)
+int MXListDataIters(unsigned *out_size, void ***out_array) {
+  static std::vector<void *> cache;
+  return list_creators("list_data_iters", cache, out_size, out_array);
+}
+
+int MXDataIterCreateIter(const void *creator_or_name, unsigned num_param,
                          const char **keys, const char **vals, void **out) {
   Gil gil;
+  const char *name = creator_name(creator_or_name);
   PyObject *ks = str_list(num_param, keys);
   PyObject *vs = str_list(num_param, vals);
   PyObject *r = (ks && vs) ? impl_call("iter_create",
@@ -771,5 +896,1235 @@ int MXDataIterGetPadNum(void *handle, int *out) {
 }
 
 int MXDataIterFree(void *handle) { return MXNDArrayFree(handle); }
+
+/* ================================================================== */
+/* round-5 expansion: the remaining reference c_api.h surface.        */
+/* Groups: NDArray extras, legacy Function, autograd, CachedOp,       */
+/* symbol attrs/introspection, InferType, executor BindX/SimpleBind/  */
+/* monitor, DataIter info/index, full KVStore, RecordIO, RTC,         */
+/* profiler.  Reference decls: include/mxnet/c_api.h (line refs on    */
+/* each function).                                                    */
+/* ================================================================== */
+
+/* ------------------------------------------- NDArray extras (:230-460) */
+
+int MXNDArraySaveRawBytes(void *handle, size_t *out_size,
+                          const char **out_buf) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("nd_save_raw", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_XDECREF(h->keeper);
+  h->keeper = r;  // keeps the bytes alive while the caller reads *out_buf
+  *out_size = static_cast<size_t>(len);
+  *out_buf = buf;
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size, void **out) {
+  Gil gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  PyObject *r = bytes ? impl_call("nd_load_raw", Py_BuildValue("(O)", bytes))
+                      : nullptr;
+  Py_XDECREF(bytes);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayWaitToWrite(void *handle) {
+  // XLA buffers are immutable; a write is a new buffer, so waiting for
+  // pending reads (the same PJRT fence) is the whole contract
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayAt(void *handle, unsigned idx, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("nd_at", Py_BuildValue("(OI)", unwrap(handle), idx));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+/* read-only HOST SNAPSHOT of the array (documented deviation: reference
+ * returns the live CPU buffer; XLA device buffers are immutable and live
+ * in HBM, so mutation goes through MXNDArraySyncCopyFromCPU).  Pointer
+ * valid until the next call on this handle or MXNDArrayFree. */
+int MXNDArrayGetData(void *handle, void **out_pdata) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("nd_to_bytes", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_XDECREF(h->keeper);
+  h->keeper = r;
+  *out_pdata = buf;
+  return 0;
+}
+
+int MXNDArrayDetach(void *handle, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("nd_detach", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArraySetGradState(void *handle, int state) {
+  Gil gil;
+  PyObject *r = impl_call("nd_set_grad_state",
+                          Py_BuildValue("(Oi)", unwrap(handle), state));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGradState(void *handle, int *out) {
+  Gil gil;
+  PyObject *r = impl_call("nd_get_grad_state",
+                          Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* -------------------------------- legacy Function group (:443-530).
+ * FunctionHandle is a creator handle over the op name; every registry
+ * op is exposed (the reference's NDArray function registry merged into
+ * the op registry long before v0.10; this keeps the old C entry
+ * points working against the one registry). */
+
+int MXListFunctions(unsigned *out_size, void ***out_array) {
+  static std::vector<void *> cache;
+  return list_creators("list_op_names", cache, out_size, out_array);
+}
+
+int MXGetFunction(const char *name, void **out) {
+  Gil gil;
+  unsigned n = 0;
+  void **arr = nullptr;
+  if (MXListFunctions(&n, &arr) != 0) return -1;
+  for (unsigned i = 0; i < n; ++i) {
+    const char *c = creator_name(arr[i]);
+    if (c && std::strcmp(c, name) == 0) {
+      *out = arr[i];
+      return 0;
+    }
+  }
+  set_error(std::string("unknown function ") + name);
+  return -1;
+}
+
+/* stash block for the info calls (name/desc/arrays live until the next
+ * info call on this thread — the reference's convention) */
+struct InfoScratch {
+  std::string name, desc, key_var, ret;
+  std::vector<std::string> strs[3];
+  std::vector<const char *> cstrs[3];
+};
+
+static int fill_info(PyObject *r, int first_list_index, InfoScratch *s,
+                     const char **name, const char **description,
+                     unsigned *num_args, const char ***arg_names,
+                     const char ***arg_type_infos,
+                     const char ***arg_descriptions) {
+  s->name = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  s->desc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  for (int g = 0; g < 3; ++g) {
+    PyObject *lst = PyTuple_GetItem(r, first_list_index + g);
+    Py_ssize_t n = PyList_Size(lst);
+    s->strs[g].clear();
+    s->cstrs[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i)
+      s->strs[g].emplace_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+    for (auto &x : s->strs[g]) s->cstrs[g].push_back(x.c_str());
+  }
+  *name = s->name.c_str();
+  *description = s->desc.c_str();
+  *num_args = static_cast<unsigned>(s->strs[0].size());
+  *arg_names = s->cstrs[0].data();
+  *arg_type_infos = s->cstrs[1].data();
+  *arg_descriptions = s->cstrs[2].data();
+  return 0;
+}
+
+int MXFuncGetInfo(void *fun, const char **name, const char **description,
+                  unsigned *num_args, const char ***arg_names,
+                  const char ***arg_type_infos,
+                  const char ***arg_descriptions,
+                  const char **return_type) {
+  Gil gil;
+  PyObject *r = impl_call("func_info",
+                          Py_BuildValue("(s)", creator_name(fun)));
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local InfoScratch s;
+  fill_info(r, 2, &s, name, description, num_args, arg_names,
+            arg_type_infos, arg_descriptions);
+  s.ret = PyUnicode_AsUTF8(PyTuple_GetItem(r, 5));
+  if (return_type) *return_type = s.ret.c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncDescribe(void *fun, unsigned *num_use_vars, unsigned *num_scalars,
+                   unsigned *num_mutate_vars, int *type_mask) {
+  Gil gil;
+  PyObject *r = impl_call("func_describe",
+                          Py_BuildValue("(s)", creator_name(fun)));
+  if (!r) { set_error_from_python(); return -1; }
+  *num_use_vars = static_cast<unsigned>(
+      PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *num_scalars = static_cast<unsigned>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  *num_mutate_vars = static_cast<unsigned>(
+      PyLong_AsLong(PyTuple_GetItem(r, 2)));
+  *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int func_invoke_common(void *fun, void **use_vars, float *scalar_args,
+                              void **mutate_vars, int num_params,
+                              const char **param_keys,
+                              const char **param_vals) {
+  (void)scalar_args;  // registry ops take attrs, not positional scalars
+  Gil gil;
+  unsigned n_use = 0, n_scalar = 0, n_mut = 0;
+  int mask = 0;
+  if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask) != 0) return -1;
+  PyObject *ins = handle_list(n_use, use_vars);
+  PyObject *muts = handle_list(n_mut, mutate_vars);
+  PyObject *ks = str_list(num_params, param_keys);
+  PyObject *vs = str_list(num_params, param_vals);
+  PyObject *r = (ins && muts && ks && vs)
+                    ? impl_call("func_invoke",
+                                Py_BuildValue("(sOOOO)", creator_name(fun),
+                                              ins, ks, vs, muts))
+                    : nullptr;
+  Py_XDECREF(ins);
+  Py_XDECREF(muts);
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncInvoke(void *fun, void **use_vars, float *scalar_args,
+                 void **mutate_vars) {
+  return func_invoke_common(fun, use_vars, scalar_args, mutate_vars, 0,
+                            nullptr, nullptr);
+}
+
+int MXFuncInvokeEx(void *fun, void **use_vars, float *scalar_args,
+                   void **mutate_vars, int num_params, char **param_keys,
+                   char **param_vals) {
+  return func_invoke_common(fun, use_vars, scalar_args, mutate_vars,
+                            num_params,
+                            const_cast<const char **>(param_keys),
+                            const_cast<const char **>(param_vals));
+}
+
+/* --------------------------------------------- autograd (:545-586) */
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  Gil gil;
+  PyObject *r = impl_call("autograd_set_training",
+                          Py_BuildValue("(i)", is_training));
+  if (!r) { set_error_from_python(); return -1; }
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradMarkVariables(unsigned num_var, void **var_handles,
+                            unsigned *reqs_array, void **grad_handles) {
+  Gil gil;
+  PyObject *vars = handle_list(num_var, var_handles);
+  PyObject *grads = handle_list(num_var, grad_handles);
+  PyObject *reqs = PyList_New(num_var);
+  for (unsigned i = 0; reqs && i < num_var; ++i)
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  PyObject *r = (vars && grads && reqs)
+                    ? impl_call("autograd_mark_variables",
+                                Py_BuildValue("(OOO)", vars, reqs, grads))
+                    : nullptr;
+  Py_XDECREF(vars);
+  Py_XDECREF(grads);
+  Py_XDECREF(reqs);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackward(unsigned num_output, void **output_handles,
+                       void **ograd_handles, int retain_graph) {
+  Gil gil;
+  PyObject *outs = handle_list(num_output, output_handles);
+  PyObject *ogs = ograd_handles
+                      ? handle_list(num_output, ograd_handles)
+                      : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = (outs && ogs)
+                    ? impl_call("autograd_backward",
+                                Py_BuildValue("(OOi)", outs, ogs,
+                                              retain_graph))
+                    : nullptr;
+  Py_XDECREF(outs);
+  Py_XDECREF(ogs);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradComputeGradient(unsigned num_output, void **output_handles) {
+  return MXAutogradBackward(num_output, output_handles, nullptr, 0);
+}
+
+/* --------------------------------------------- CachedOp (:588-600) */
+
+int MXCreateCachedOp(void *sym_handle, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("cached_op_create",
+                          Py_BuildValue("(O)", unwrap(sym_handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXFreeCachedOp(void *handle) { return MXNDArrayFree(handle); }
+
+int MXInvokeCachedOp(void *handle, int num_inputs, void **inputs,
+                     int *num_outputs, void ***outputs) {
+  Gil gil;
+  PyObject *ins = handle_list(num_inputs, inputs);
+  PyObject *r = ins ? impl_call("cached_op_invoke",
+                                Py_BuildValue("(OO)", unwrap(handle), ins))
+                    : nullptr;
+  Py_XDECREF(ins);
+  if (!r) { set_error_from_python(); return -1; }
+  if (*num_outputs > 0 && *outputs != nullptr) {  // in-place (same ABI as
+    Py_ssize_t n = PyList_Size(r);                // MXImperativeInvoke)
+    if (n != *num_outputs) {
+      Py_DECREF(r);
+      set_error("MXInvokeCachedOp: output count mismatch");
+      return -1;
+    }
+    PyObject *dsts = handle_list(n, *outputs);
+    PyObject *c = dsts ? impl_call("nd_copy_into_all",
+                                   Py_BuildValue("(OO)", r, dsts))
+                       : nullptr;
+    Py_XDECREF(dsts);
+    Py_DECREF(r);
+    if (!c) { set_error_from_python(); return -1; }
+    Py_DECREF(c);
+    return 0;
+  }
+  unsigned n = 0;
+  void **arr = nullptr;
+  static thread_local std::vector<void *> cached_scratch;
+  unpack_handles(r, &n, &arr, cached_scratch);
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = arr;
+  return 0;
+}
+
+/* -------------------------------------- symbol extras (:640-997) */
+
+int MXSymbolCreateGroup(unsigned num_symbols, void **symbols, void **out) {
+  Gil gil;
+  PyObject *syms = handle_list(num_symbols, symbols);
+  PyObject *r = syms ? impl_call("symbol_group", Py_BuildValue("(O)", syms))
+                     : nullptr;
+  Py_XDECREF(syms);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("symbol_from_file", Py_BuildValue("(s)", fname));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolSaveToFile(void *handle, const char *fname) {
+  Gil gil;
+  PyObject *r = impl_call("symbol_save_file",
+                          Py_BuildValue("(Os)", unwrap(handle), fname));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolCopy(void *handle, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("symbol_copy", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+static int string_out(const char *fn, void *handle, const char **out_str) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call(fn, Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  const char *c = PyUnicode_AsUTF8(r);
+  h->strs.assign(1, c ? c : "");
+  h->cstrs.clear();
+  Py_DECREF(r);
+  *out_str = h->strs[0].c_str();
+  return 0;
+}
+
+int MXSymbolPrint(void *handle, const char **out_str) {
+  return string_out("symbol_print", handle, out_str);
+}
+
+int MXSymbolGetName(void *handle, const char **out, int *success) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("symbol_get_name", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    const char *c = PyUnicode_AsUTF8(r);
+    h->strs.assign(1, c ? c : "");
+    h->cstrs.clear();
+    *out = h->strs[0].c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolGetAttr(void *handle, const char *key, const char **out,
+                    int *success) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("symbol_get_attr",
+                          Py_BuildValue("(Os)", h->obj, key));
+  if (!r) { set_error_from_python(); return -1; }
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    const char *c = PyUnicode_AsUTF8(r);
+    h->strs.assign(1, c ? c : "");
+    h->cstrs.clear();
+    *out = h->strs[0].c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolSetAttr(void *handle, const char *key, const char *value) {
+  Gil gil;
+  PyObject *r = impl_call("symbol_set_attr",
+                          Py_BuildValue("(Oss)", unwrap(handle), key, value));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+static int list_attr_common(void *handle, int shallow, unsigned *out_size,
+                            const char ***out) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("symbol_list_attr",
+                          Py_BuildValue("(Oi)", h->obj, shallow));
+  if (!r) { set_error_from_python(); return -1; }
+  unsigned n2 = 0;
+  int rc = stash_strs(h, r, &n2, out);
+  Py_DECREF(r);
+  if (rc != 0) { set_error_from_python(); return -1; }
+  *out_size = n2 / 2;  // reference returns PAIR count; array has 2N strings
+  return 0;
+}
+
+int MXSymbolListAttr(void *handle, unsigned *out_size, const char ***out) {
+  return list_attr_common(handle, 0, out_size, out);
+}
+
+int MXSymbolListAttrShallow(void *handle, unsigned *out_size,
+                            const char ***out) {
+  return list_attr_common(handle, 1, out_size, out);
+}
+
+static int symbol_out(const char *fn, void *handle, void **out) {
+  Gil gil;
+  PyObject *r = impl_call(fn, Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolGetInternals(void *handle, void **out) {
+  return symbol_out("symbol_get_internals", handle, out);
+}
+
+int MXSymbolGetChildren(void *handle, void **out) {
+  return symbol_out("symbol_get_children", handle, out);
+}
+
+int MXSymbolGetOutput(void *handle, unsigned index, void **out) {
+  Gil gil;
+  PyObject *r = impl_call("symbol_get_output",
+                          Py_BuildValue("(OI)", unwrap(handle), index));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolGrad(void *handle, unsigned num_wrt, const char **wrt,
+                 void **out) {
+  Gil gil;
+  PyObject *ws = str_list(num_wrt, wrt);
+  PyObject *r = ws ? impl_call("symbol_grad",
+                               Py_BuildValue("(OO)", unwrap(handle), ws))
+                   : nullptr;
+  Py_XDECREF(ws);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXSymbolInferType(void *handle, unsigned num_args, const char **keys,
+                      const int *arg_type_data, unsigned *in_type_size,
+                      const int **in_type_data, unsigned *out_type_size,
+                      const int **out_type_data, unsigned *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *ks = keys ? str_list(num_args, keys)
+                      : (Py_INCREF(Py_None), Py_None);
+  PyObject *codes = PyList_New(num_args);
+  for (unsigned i = 0; codes && i < num_args; ++i)
+    PyList_SET_ITEM(codes, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject *r = (ks && codes)
+                    ? impl_call("symbol_infer_type",
+                                Py_BuildValue("(OOO)", h->obj, ks, codes))
+                    : nullptr;
+  Py_XDECREF(ks);
+  Py_XDECREF(codes);
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local std::vector<int> tcodes[3];
+  unsigned sizes[3];
+  for (int g = 0; g < 3; ++g) {
+    PyObject *lst = PyTuple_GetItem(r, g);
+    Py_ssize_t n = PyList_Size(lst);
+    tcodes[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i)
+      tcodes[g].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(lst, i))));
+    sizes[g] = static_cast<unsigned>(n);
+  }
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+  Py_DECREF(r);
+  *in_type_size = sizes[0];
+  *in_type_data = tcodes[0].data();
+  *out_type_size = sizes[1];
+  *out_type_data = tcodes[1].data();
+  *aux_type_size = sizes[2];
+  *aux_type_data = tcodes[2].data();
+  return 0;
+}
+
+/* ------------------------------- op introspection (:646-672) */
+
+int MXSymbolListAtomicSymbolCreators(unsigned *out_size, void ***out_array) {
+  static std::vector<void *> cache;
+  return list_creators("list_op_names", cache, out_size, out_array);
+}
+
+int MXSymbolGetAtomicSymbolName(void *creator, const char **name) {
+  Gil gil;
+  const char *c = creator_name(creator);
+  if (!c) { set_error("not a creator handle"); return -1; }
+  *name = c;  // backed by the creator's wrapped python string (immortal)
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(void *creator, const char **name,
+                                const char **description, unsigned *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type) {
+  Gil gil;
+  PyObject *r = impl_call("op_info",
+                          Py_BuildValue("(s)", creator_name(creator)));
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local InfoScratch s;
+  fill_info(r, 2, &s, name, description, num_args, arg_names,
+            arg_type_infos, arg_descriptions);
+  s.key_var = PyUnicode_AsUTF8(PyTuple_GetItem(r, 5));
+  s.ret = PyUnicode_AsUTF8(PyTuple_GetItem(r, 6));
+  *key_var_num_args = s.key_var.c_str();
+  if (return_type) *return_type = s.ret.c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* -------------------------------- executor extras (:999-1180) */
+
+int MXExecutorPrint(void *handle, const char **out_str) {
+  return string_out("executor_print", handle, out_str);
+}
+
+static PyObject *g2c_lists(unsigned n, const char **keys, const int *types,
+                           const int *ids) {
+  PyObject *ks = str_list(n, keys);
+  PyObject *ts = PyList_New(n);
+  PyObject *is = PyList_New(n);
+  for (unsigned i = 0; ts && is && i < n; ++i) {
+    PyList_SET_ITEM(ts, i, PyLong_FromLong(types[i]));
+    PyList_SET_ITEM(is, i, PyLong_FromLong(ids[i]));
+  }
+  return Py_BuildValue("(NNN)", ks, ts, is);
+}
+
+static int bind_x_common(void *sym_handle, int dev_type, int dev_id,
+                         unsigned num_map_keys, const char **map_keys,
+                         const int *map_dev_types, const int *map_dev_ids,
+                         unsigned num_args, void **in_args,
+                         void **arg_grad_store,
+                         const unsigned *grad_req_type,
+                         unsigned aux_states_len, void **aux_states,
+                         void *shared_exec, void **out) {
+  (void)arg_grad_store;
+  Gil gil;
+  static const char *reqs[] = {"null", "write", "inplace", "add"};
+  PyObject *g2c = g2c_lists(num_map_keys, map_keys, map_dev_types,
+                            map_dev_ids);
+  PyObject *args = handle_list(num_args, in_args);
+  PyObject *auxs = handle_list(aux_states_len, aux_states);
+  PyObject *rq = PyList_New(num_args);
+  for (unsigned i = 0; rq && i < num_args; ++i)
+    PyList_SET_ITEM(rq, i, PyUnicode_FromString(
+                               reqs[grad_req_type[i] < 4 ? grad_req_type[i]
+                                                         : 1]));
+  PyObject *shared = shared_exec ? unwrap(shared_exec) : Py_None;
+  Py_INCREF(shared);
+  PyObject *r = (g2c && args && auxs && rq)
+                    ? impl_call("executor_bind_x",
+                                Py_BuildValue("(OiiOOOOOOO)",
+                                              unwrap(sym_handle), dev_type,
+                                              dev_id, PyTuple_GetItem(g2c, 0),
+                                              PyTuple_GetItem(g2c, 1),
+                                              PyTuple_GetItem(g2c, 2), args,
+                                              rq, auxs, shared))
+                    : nullptr;
+  Py_XDECREF(g2c);
+  Py_XDECREF(args);
+  Py_XDECREF(auxs);
+  Py_XDECREF(rq);
+  Py_DECREF(shared);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXExecutorBindX(void *sym_handle, int dev_type, int dev_id,
+                    unsigned num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    unsigned num_args, void **in_args, void **arg_grad_store,
+                    const unsigned *grad_req_type, unsigned aux_states_len,
+                    void **aux_states, void **out) {
+  return bind_x_common(sym_handle, dev_type, dev_id, num_map_keys, map_keys,
+                       map_dev_types, map_dev_ids, num_args, in_args,
+                       arg_grad_store, grad_req_type, aux_states_len,
+                       aux_states, nullptr, out);
+}
+
+int MXExecutorBindEX(void *sym_handle, int dev_type, int dev_id,
+                     unsigned num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     unsigned num_args, void **in_args, void **arg_grad_store,
+                     const unsigned *grad_req_type, unsigned aux_states_len,
+                     void **aux_states, void *shared_exec, void **out) {
+  return bind_x_common(sym_handle, dev_type, dev_id, num_map_keys, map_keys,
+                       map_dev_types, map_dev_ids, num_args, in_args,
+                       arg_grad_store, grad_req_type, aux_states_len,
+                       aux_states, shared_exec, out);
+}
+
+// like unpack_handles but maps python None -> NULL handle (grads of
+// grad_req "null" arguments come back as NULL, reference SimpleBind)
+static int unpack_handles_opt(PyObject *list, unsigned *out_size,
+                              void ***out_array,
+                              std::vector<void *> &scratch) {
+  Py_ssize_t n = PyList_Size(list);
+  scratch.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(list, i);
+    if (o == Py_None) {
+      scratch.push_back(nullptr);
+    } else {
+      Py_INCREF(o);
+      scratch.push_back(wrap(o));
+    }
+  }
+  *out_size = static_cast<unsigned>(n);
+  *out_array = scratch.data();
+  return 0;
+}
+
+int MXExecutorSimpleBind(
+    void *sym_handle, int dev_type, int dev_id, const unsigned num_g2c_keys,
+    const char **g2c_keys, const int *g2c_dev_types, const int *g2c_dev_ids,
+    const unsigned provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const unsigned num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const unsigned *provided_arg_shape_data,
+    const unsigned *provided_arg_shape_idx,
+    const unsigned num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const unsigned num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    void **shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    void ***updated_shared_buffer_handle_list, unsigned *num_in_args,
+    void ***in_args, void ***arg_grads, unsigned *num_aux_states,
+    void ***aux_states, void *shared_exec_handle, void **out) {
+  Gil gil;
+  PyObject *g2c = g2c_lists(num_g2c_keys, g2c_keys, g2c_dev_types,
+                            g2c_dev_ids);
+  // grad req: names may be NULL (single global req or per-arg list)
+  PyObject *req_names = provided_grad_req_names
+                            ? str_list(provided_grad_req_list_len,
+                                       provided_grad_req_names)
+                            : (Py_INCREF(Py_None), Py_None);
+  PyObject *req_types = str_list(provided_grad_req_list_len,
+                                 provided_grad_req_types);
+  PyObject *shape_names = str_list(num_provided_arg_shapes,
+                                   provided_arg_shape_names);
+  PyObject *shapes = PyList_New(num_provided_arg_shapes);
+  for (unsigned i = 0; shapes && i < num_provided_arg_shapes; ++i)
+    PyList_SET_ITEM(
+        shapes, i,
+        shape_tuple(provided_arg_shape_idx[i + 1] - provided_arg_shape_idx[i],
+                    provided_arg_shape_data + provided_arg_shape_idx[i]));
+  PyObject *dtype_names = str_list(num_provided_arg_dtypes,
+                                   provided_arg_dtype_names);
+  PyObject *dtype_codes = PyList_New(num_provided_arg_dtypes);
+  for (unsigned i = 0; dtype_codes && i < num_provided_arg_dtypes; ++i)
+    PyList_SET_ITEM(dtype_codes, i, PyLong_FromLong(provided_arg_dtypes[i]));
+  PyObject *shared_args = str_list(num_shared_arg_names,
+                                   shared_arg_name_list);
+  // shared buffer: *shared_buffer_len < 0 means "no shared buffer"
+  PyObject *buf_names = Py_None, *buf_arrs = Py_None;
+  int buf_n = shared_buffer_len ? *shared_buffer_len : -1;
+  if (buf_n >= 0) {
+    buf_names = str_list(static_cast<unsigned>(buf_n),
+                         shared_buffer_name_list);
+    buf_arrs = handle_list(static_cast<unsigned>(buf_n),
+                           shared_buffer_handle_list);
+  } else {
+    Py_INCREF(Py_None);
+    Py_INCREF(Py_None);
+  }
+  PyObject *shared = shared_exec_handle ? unwrap(shared_exec_handle)
+                                        : Py_None;
+  Py_INCREF(shared);
+  PyObject *r = impl_call(
+      "executor_simple_bind",
+      Py_BuildValue("(OiiOOOOOOOOOOOOO)", unwrap(sym_handle), dev_type,
+                    dev_id, PyTuple_GetItem(g2c, 0), PyTuple_GetItem(g2c, 1),
+                    PyTuple_GetItem(g2c, 2), req_names, req_types,
+                    shape_names, shapes, dtype_names, dtype_codes,
+                    shared_args, buf_names, buf_arrs, shared));
+  Py_XDECREF(g2c);
+  Py_XDECREF(req_names);
+  Py_XDECREF(req_types);
+  Py_XDECREF(shape_names);
+  Py_XDECREF(shapes);
+  Py_XDECREF(dtype_names);
+  Py_XDECREF(dtype_codes);
+  Py_XDECREF(shared_args);
+  Py_XDECREF(buf_names);
+  Py_XDECREF(buf_arrs);
+  Py_DECREF(shared);
+  if (!r) { set_error_from_python(); return -1; }
+  // r = (exe, in_args, arg_grads_with_None, aux, upd_names, upd_arrs)
+  static thread_local std::vector<void *> sb_args, sb_grads, sb_aux, sb_upd;
+  static thread_local Handle upd_name_scratch;
+  unpack_handles(PyTuple_GetItem(r, 1), num_in_args, in_args, sb_args);
+  unsigned ng = 0;
+  unpack_handles_opt(PyTuple_GetItem(r, 2), &ng, arg_grads, sb_grads);
+  unpack_handles(PyTuple_GetItem(r, 3), num_aux_states, aux_states, sb_aux);
+  if (buf_n >= 0 && updated_shared_buffer_name_list &&
+      updated_shared_buffer_handle_list) {
+    unsigned nu = 0;
+    stash_strs(&upd_name_scratch, PyTuple_GetItem(r, 4), &nu,
+               updated_shared_buffer_name_list);
+    unpack_handles(PyTuple_GetItem(r, 5), &nu,
+                   updated_shared_buffer_handle_list, sb_upd);
+    *shared_buffer_len = static_cast<int>(nu);
+  }
+  PyObject *exe = PyTuple_GetItem(r, 0);
+  Py_INCREF(exe);
+  Py_DECREF(r);
+  *out = wrap(exe);
+  return 0;
+}
+
+int MXExecutorSetMonitorCallback(void *handle,
+                                 void (*callback)(const char *, void *,
+                                                  void *),
+                                 void *callback_handle) {
+  Gil gil;  // the GIL is the lock every entry point serializes on —
+            // g_monitors must only ever be touched while holding it
+  (*g_monitors)[handle] = {callback, callback_handle};
+  return 0;
+}
+
+/* ---------------------------- dataiter extras (:1203-1240) */
+
+int MXDataIterGetIterInfo(const void *creator_or_name, const char **name,
+                          const char **description, unsigned *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  Gil gil;
+  PyObject *r = impl_call("iter_info",
+                          Py_BuildValue("(s)",
+                                        creator_name(creator_or_name)));
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local InfoScratch s;
+  fill_info(r, 2, &s, name, description, num_args, arg_names,
+            arg_type_infos, arg_descriptions);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterGetIndex(void *handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  Gil gil;
+  PyObject *r = impl_call("iter_index", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  static thread_local std::vector<uint64_t> idx;
+  Py_ssize_t n = PyList_Size(r);
+  idx.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    idx.push_back(static_cast<uint64_t>(
+        PyLong_AsUnsignedLongLong(PyList_GetItem(r, i))));
+  Py_DECREF(r);
+  *out_index = idx.data();
+  *out_size = static_cast<uint64_t>(n);
+  return 0;
+}
+
+/* -------------------------------- KVStore extras (:1273-1533) */
+
+int MXInitPSEnv(unsigned num_vars, const char **keys, const char **vals) {
+  Gil gil;
+  PyObject *ks = str_list(num_vars, keys);
+  PyObject *vs = str_list(num_vars, vals);
+  PyObject *r = (ks && vs) ? impl_call("init_ps_env",
+                                       Py_BuildValue("(OO)", ks, vs))
+                           : nullptr;
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+static int kv_op_str(const char *fn, void *handle, unsigned num,
+                     const char **keys, void **vals) {
+  Gil gil;
+  PyObject *ks = str_list(num, keys);
+  PyObject *vs = handle_list(num, vals);
+  PyObject *r = (ks && vs) ? impl_call(fn, Py_BuildValue("(OOO)",
+                                                         unwrap(handle), ks,
+                                                         vs))
+                           : nullptr;
+  Py_XDECREF(ks);
+  Py_XDECREF(vs);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInitEx(void *handle, unsigned num, const char **keys,
+                    void **vals) {
+  return kv_op_str("kv_init", handle, num, keys, vals);
+}
+
+int MXKVStorePushEx(void *handle, unsigned num, const char **keys,
+                    void **vals, int priority) {
+  (void)priority;
+  return kv_op_str("kv_push", handle, num, keys, vals);
+}
+
+int MXKVStorePullEx(void *handle, unsigned num, const char **keys,
+                    void **vals, int priority) {
+  (void)priority;
+  return kv_op_str("kv_pull", handle, num, keys, vals);
+}
+
+/* wraps a live python object (passed by address) into a fresh handle —
+ * the bridge the ctypes updater trampoline uses to hand NDArrays to a
+ * C MXKVStoreUpdater, which then owns and frees them */
+int MXTPUWrapForCallback(void *py_obj, void **out) {
+  Gil gil;
+  PyObject *o = static_cast<PyObject *>(py_obj);
+  Py_INCREF(o);
+  *out = wrap(o);
+  return 0;
+}
+
+int MXKVStoreSetUpdater(void *handle,
+                        void (*updater)(int, void *, void *, void *),
+                        void *updater_handle) {
+  Gil gil;
+  Dl_info info;
+  if (!dladdr(reinterpret_cast<void *>(&MXKVStoreSetUpdater), &info) ||
+      !info.dli_fname) {
+    set_error("cannot resolve libmxnet_tpu path for the updater bridge");
+    return -1;
+  }
+  PyObject *r = impl_call(
+      "kv_set_updater_c",
+      Py_BuildValue("(OKKs)", unwrap(handle),
+                    static_cast<unsigned long long>(
+                        reinterpret_cast<uintptr_t>(updater)),
+                    static_cast<unsigned long long>(
+                        reinterpret_cast<uintptr_t>(updater_handle)),
+                    info.dli_fname));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+static int kv_str_out(const char *fn, void *handle, const char **out) {
+  return string_out(fn, handle, out);
+}
+
+int MXKVStoreGetType(void *handle, const char **type) {
+  return kv_str_out("kv_type", handle, type);
+}
+
+static int kv_int_out(const char *fn, void *handle, int *ret) {
+  Gil gil;
+  PyObject *r = impl_call(fn, Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetRank(void *handle, int *ret) {
+  return kv_int_out("kv_rank", handle, ret);
+}
+
+int MXKVStoreGetGroupSize(void *handle, int *ret) {
+  return kv_int_out("kv_group_size", handle, ret);
+}
+
+static int role_flag(int which, int *ret) {
+  Gil gil;
+  PyObject *r = impl_call("kv_role_flags", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  *ret = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, which)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) { return role_flag(0, ret); }
+int MXKVStoreIsServerNode(int *ret) { return role_flag(1, ret); }
+int MXKVStoreIsSchedulerNode(int *ret) { return role_flag(2, ret); }
+
+int MXKVStoreBarrier(void *handle) {
+  Gil gil;
+  PyObject *r = impl_call("kv_barrier", Py_BuildValue("(O)", unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(void *handle,
+                                  const int barrier_before_exit) {
+  Gil gil;
+  PyObject *r = impl_call("kv_set_barrier_before_exit",
+                          Py_BuildValue("(Oi)", unwrap(handle),
+                                        barrier_before_exit));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreRunServer(void *handle,
+                       void (*controller)(int, const char *, void *),
+                       void *controller_handle) {
+  Gil gil;
+  PyObject *r = impl_call(
+      "kv_run_server",
+      Py_BuildValue("(OKK)", unwrap(handle),
+                    static_cast<unsigned long long>(
+                        reinterpret_cast<uintptr_t>(controller)),
+                    static_cast<unsigned long long>(
+                        reinterpret_cast<uintptr_t>(controller_handle))));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(void *handle, int cmd_id,
+                                   const char *cmd_body) {
+  Gil gil;
+  PyObject *body = PyBytes_FromString(cmd_body ? cmd_body : "");
+  PyObject *r = body ? impl_call("kv_send_command",
+                                 Py_BuildValue("(OiO)", unwrap(handle),
+                                               cmd_id, body))
+                     : nullptr;
+  Py_XDECREF(body);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(void *handle, const int node_id, int *number,
+                            const int timeout_sec) {
+  Gil gil;
+  PyObject *r = impl_call("kv_num_dead_node",
+                          Py_BuildValue("(Oii)", unwrap(handle), node_id,
+                                        timeout_sec));
+  if (!r) { set_error_from_python(); return -1; }
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ------------------------------------ RecordIO (:1535-1596) */
+
+static int recordio_create(const char *fn, const char *uri, void **out) {
+  Gil gil;
+  PyObject *r = impl_call(fn, Py_BuildValue("(s)", uri));
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXRecordIOWriterCreate(const char *uri, void **out) {
+  return recordio_create("recordio_writer_create", uri, out);
+}
+
+int MXRecordIOReaderCreate(const char *uri, void **out) {
+  return recordio_create("recordio_reader_create", uri, out);
+}
+
+static int recordio_free(void *handle) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("recordio_close", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  Py_XDECREF(h->obj);
+  Py_XDECREF(h->keeper);
+  delete h;
+  return 0;
+}
+
+int MXRecordIOWriterFree(void *handle) { return recordio_free(handle); }
+int MXRecordIOReaderFree(void *handle) { return recordio_free(handle); }
+
+int MXRecordIOWriterWriteRecord(void *handle, const char *buf, size_t size) {
+  Gil gil;
+  PyObject *data = PyBytes_FromStringAndSize(buf,
+                                             static_cast<Py_ssize_t>(size));
+  PyObject *r = data ? impl_call("recordio_write",
+                                 Py_BuildValue("(OO)", unwrap(handle), data))
+                     : nullptr;
+  Py_XDECREF(data);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOWriterTell(void *handle, size_t *pos) {
+  Gil gil;
+  PyObject *r = impl_call("recordio_tell", Py_BuildValue("(O)",
+                                                         unwrap(handle)));
+  if (!r) { set_error_from_python(); return -1; }
+  *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderReadRecord(void *handle, char const **buf,
+                               size_t *size) {
+  Gil gil;
+  Handle *h = static_cast<Handle *>(handle);
+  PyObject *r = impl_call("recordio_read", Py_BuildValue("(O)", h->obj));
+  if (!r) { set_error_from_python(); return -1; }
+  if (r == Py_None) {  // EOF: reference sets buf=NULL, size=0
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char *data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &len) != 0) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_XDECREF(h->keeper);
+  h->keeper = r;  // record bytes stay alive until the next read/free
+  *buf = data;
+  *size = static_cast<size_t>(len);
+  return 0;
+}
+
+int MXRecordIOReaderSeek(void *handle, size_t pos) {
+  Gil gil;
+  PyObject *r = impl_call("recordio_seek",
+                          Py_BuildValue("(OK)", unwrap(handle),
+                                        static_cast<unsigned long long>(pos)));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ------------------------------------------ RTC (:1598-1625).
+ * TPU-native deviation (documented in c_api.h): `kernel` is PYTHON
+ * source of a JAX-traceable function named `name` (jnp/lax/pallas),
+ * since CUDA source cannot target a TPU.  grid/block dims are accepted
+ * and ignored — XLA/Pallas own the schedule. */
+
+int MXRtcCreate(char *name, unsigned num_input, unsigned num_output,
+                char **input_names, char **output_names, void **inputs,
+                void **outputs, char *kernel, void **out) {
+  Gil gil;
+  PyObject *ins = str_list(num_input,
+                           const_cast<const char **>(input_names));
+  PyObject *outs = str_list(num_output,
+                            const_cast<const char **>(output_names));
+  PyObject *in_arrs = handle_list(num_input, inputs);
+  PyObject *out_arrs = handle_list(num_output, outputs);
+  PyObject *r = (ins && outs && in_arrs && out_arrs)
+                    ? impl_call("rtc_create",
+                                Py_BuildValue("(sOOOOs)", name, ins, outs,
+                                              in_arrs, out_arrs, kernel))
+                    : nullptr;
+  Py_XDECREF(ins);
+  Py_XDECREF(outs);
+  Py_XDECREF(in_arrs);
+  Py_XDECREF(out_arrs);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = wrap(r);
+  return 0;
+}
+
+int MXRtcPush(void *handle, unsigned num_input, unsigned num_output,
+              void **inputs, void **outputs, unsigned gridDimX,
+              unsigned gridDimY, unsigned gridDimZ, unsigned blockDimX,
+              unsigned blockDimY, unsigned blockDimZ) {
+  Gil gil;
+  PyObject *ins = handle_list(num_input, inputs);
+  PyObject *outs = handle_list(num_output, outputs);
+  PyObject *grid = Py_BuildValue("(IIIIII)", gridDimX, gridDimY, gridDimZ,
+                                 blockDimX, blockDimY, blockDimZ);
+  PyObject *r = (ins && outs && grid)
+                    ? impl_call("rtc_push",
+                                Py_BuildValue("(OOOO)", unwrap(handle), ins,
+                                              outs, grid))
+                    : nullptr;
+  Py_XDECREF(ins);
+  Py_XDECREF(outs);
+  Py_XDECREF(grid);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRtcFree(void *handle) { return MXNDArrayFree(handle); }
+
+/* --------------------------------------- profiler (:185-199) */
+
+int MXSetProfilerConfig(int mode, const char *filename) {
+  Gil gil;
+  PyObject *r = impl_call("profiler_set_config",
+                          Py_BuildValue("(is)", mode, filename));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  Gil gil;
+  PyObject *r = impl_call("profiler_set_state", Py_BuildValue("(i)", state));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDumpProfile() {
+  Gil gil;
+  PyObject *r = impl_call("profiler_dump", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  Gil gil;
+  PyObject *r = impl_call("set_num_omp_threads",
+                          Py_BuildValue("(i)", thread_num));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ------------------------------------- CustomOp from C (:1620).
+ * Adapts a reference CustomOpPropCreator (MXCallbackList protocol,
+ * c_api.h:107-145) into the CustomOpProp registry; the op executes on
+ * the host through the Custom machinery's pure_callback path. */
+int MXCustomOpRegister(const char *op_type,
+                       int (*creator)(const char *, int, const char **,
+                                      const char **, void *)) {
+  Gil gil;
+  Dl_info info;
+  if (!dladdr(reinterpret_cast<void *>(&MXCustomOpRegister), &info) ||
+      !info.dli_fname) {
+    set_error("cannot resolve libmxnet_tpu path for the custom-op bridge");
+    return -1;
+  }
+  PyObject *r = impl_call(
+      "custom_op_register_c",
+      Py_BuildValue("(sKs)", op_type,
+                    static_cast<unsigned long long>(
+                        reinterpret_cast<uintptr_t>(creator)),
+                    info.dli_fname));
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
 
 }  // extern "C"

@@ -133,6 +133,133 @@ int main(void) {
   printf("gw0: %.4f %.4f %.4f\n", gw[0], gw[1], gw[2]);
 
   CHECK(MXExecutorFree(exe));
+
+  /* ---- CachedOp: record once, replay twice, outputs identical ---- */
+  CachedOpHandle cop;
+  CHECK(MXCreateCachedOp(fc, &cop));
+  float rep1[8], rep2[8];
+  for (int rep = 0; rep < 2; ++rep) {
+    int nco = 0;
+    NDArrayHandle *couts = NULL;
+    CHECK(MXInvokeCachedOp(cop, 3, args, &nco, &couts));
+    CHECK(MXNDArraySyncCopyToCPU(couts[0], rep ? rep2 : rep1, 8));
+    CHECK(MXNDArrayFree(couts[0]));
+  }
+  int cached_same = 1;
+  for (int i = 0; i < 8; ++i)
+    if (rep1[i] != rep2[i]) cached_same = 0;
+  printf("cachedop_replay_same: %d (y0=%.4f)\n", cached_same, rep1[0]);
+  CHECK(MXFreeCachedOp(cop));
+
+  /* ---- SimpleBind: allocate-and-bind, then TRAIN (grad descent on a
+   * least-squares head) until the loss drops ---- */
+  SymbolHandle fit;
+  {
+    SymbolHandle d2, fc_s;
+    CHECK(MXSymbolCreateVariable("data", &d2));
+    const char *k2[] = {"num_hidden"};
+    const char *v2[] = {"1"};
+    CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, k2, v2, &fc_s));
+    CHECK(MXSymbolCompose(fc_s, "fit", 1, NULL, (SymbolHandle[]){d2}));
+    fit = fc_s;
+    CHECK(MXSymbolFree(d2));
+  }
+  const char *sb_shape_names[] = {"data"};
+  mx_uint sb_shape_data[2] = {4, 2};
+  mx_uint sb_shape_idx[2] = {0, 2};
+  /* per-name grad req dict: params train, data stays null -> its
+   * arg_grads slot comes back NULL (reference SimpleBind contract) */
+  const char *sb_req_names[] = {"fit_weight", "fit_bias"};
+  const char *sb_req_types[] = {"write", "write"};
+  int shared_len = -1;
+  mx_uint n_in = 0, n_aux = 0;
+  NDArrayHandle *sb_args = NULL, *sb_grads = NULL, *sb_aux = NULL;
+  ExecutorHandle sexe;
+  CHECK(MXExecutorSimpleBind(
+      fit, 1, 0, 0, NULL, NULL, NULL, 2, sb_req_names, sb_req_types, 1,
+      sb_shape_names, sb_shape_data, sb_shape_idx, 0, NULL, NULL, 0, NULL,
+      &shared_len, NULL, NULL, NULL, NULL, &n_in, &sb_args, &sb_grads,
+      &n_aux, &sb_aux, NULL, &sexe));
+  printf("simplebind: in=%u aux=%u grad0_null=%d\n", n_in, n_aux,
+         sb_grads[0] == NULL);
+  /* target: y = x0 + 2*x1; data fixed, learn weight (bias included) */
+  float sx[8] = {1, 0, 0, 1, 1, 1, 2, -1};
+  float target[4] = {1, 2, 3, 0};
+  CHECK(MXNDArraySyncCopyFromCPU(sb_args[0], sx, 8));
+  float w0[2] = {0, 0}, b0[1] = {0};
+  CHECK(MXNDArraySyncCopyFromCPU(sb_args[1], w0, 2));
+  CHECK(MXNDArraySyncCopyFromCPU(sb_args[2], b0, 1));
+  float first_loss = -1, last_loss = -1;
+  for (int step = 0; step < 60; ++step) {
+    CHECK(MXExecutorForward(sexe, 1));
+    mx_uint n_so = 0;
+    NDArrayHandle *souts = NULL;
+    CHECK(MXExecutorOutputs(sexe, &n_so, &souts));
+    float pred[4];
+    CHECK(MXNDArraySyncCopyToCPU(souts[0], pred, 4));
+    float loss = 0, residual[4];
+    for (int i = 0; i < 4; ++i) {
+      residual[i] = pred[i] - target[i];
+      loss += residual[i] * residual[i];
+    }
+    if (step == 0) first_loss = loss;
+    last_loss = loss;
+    /* dL/dy = 2*(y - t); push through backward, then SGD on w and b */
+    NDArrayHandle hg;
+    mx_uint shp_hg[2] = {4, 1};
+    CHECK(MXNDArrayCreate(shp_hg, 2, 1, 0, 0, &hg));
+    float hgv[4];
+    for (int i = 0; i < 4; ++i) hgv[i] = 2.0f * residual[i];
+    CHECK(MXNDArraySyncCopyFromCPU(hg, hgv, 4));
+    CHECK(MXExecutorBackward(sexe, 1, (NDArrayHandle[]){hg}));
+    CHECK(MXNDArrayFree(hg));
+    mx_uint n_sg = 0;
+    NDArrayHandle *sgrads = NULL;
+    const char **sgnames = NULL;
+    CHECK(MXExecutorGrads(sexe, &n_sg, &sgrads, &sgnames));
+    float gw2[2], gb[1], wcur[2], bcur[1];
+    for (mx_uint gi = 0; gi < n_sg; ++gi) {
+      if (strcmp(sgnames[gi], "fit_weight") == 0)
+        CHECK(MXNDArraySyncCopyToCPU(sgrads[gi], gw2, 2));
+      else if (strcmp(sgnames[gi], "fit_bias") == 0)
+        CHECK(MXNDArraySyncCopyToCPU(sgrads[gi], gb, 1));
+    }
+    CHECK(MXNDArraySyncCopyToCPU(sb_args[1], wcur, 2));
+    CHECK(MXNDArraySyncCopyToCPU(sb_args[2], bcur, 1));
+    const float lr = 0.05f;
+    wcur[0] -= lr * gw2[0];
+    wcur[1] -= lr * gw2[1];
+    bcur[0] -= lr * gb[0];
+    CHECK(MXNDArraySyncCopyFromCPU(sb_args[1], wcur, 2));
+    CHECK(MXNDArraySyncCopyFromCPU(sb_args[2], bcur, 1));
+  }
+  printf("simplebind_train: first_loss=%.4f last_loss=%.6f trained=%d\n",
+         first_loss, last_loss,
+         last_loss < 0.05f * first_loss && last_loss < 0.1f);
+  CHECK(MXExecutorFree(sexe));
+  CHECK(MXSymbolFree(fit));
+
+  /* ---- op introspection: what a binding generator reads ---- */
+  mx_uint n_creators = 0;
+  AtomicSymbolCreator *creators = NULL;
+  CHECK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+  int found_conv = 0;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *cname = NULL;
+    CHECK(MXSymbolGetAtomicSymbolName(creators[i], &cname));
+    if (strcmp(cname, "Convolution") == 0) {
+      const char *nm, *desc, *keyvar, *rett;
+      mx_uint nargs;
+      const char **anames, **atypes, **adescs;
+      CHECK(MXSymbolGetAtomicSymbolInfo(creators[i], &nm, &desc, &nargs,
+                                        &anames, &atypes, &adescs, &keyvar,
+                                        &rett));
+      printf("conv_info: args=%u ret=%s\n", nargs, rett);
+      found_conv = 1;
+    }
+  }
+  printf("creators: %u found_conv=%d\n", n_creators, found_conv);
+
   CHECK(MXSymbolFree(fc));
   CHECK(MXSymbolFree(data));
   CHECK(MXNDArrayFree(a));

@@ -61,6 +61,10 @@ def test_c_api_smoke_binary(tmp_path):
     assert "infer: in=3 out=1 out0=2,4 weight=4,3" in out, out
     assert "json_roundtrip_args: 3" in out, out
     assert "grads: fc1_weight fc1_bias" in out, out
+    assert "cachedop_replay_same: 1" in out, out
+    assert "simplebind: in=3 aux=0 grad0_null=1" in out, out
+    assert "trained=1" in out, out
+    assert "found_conv=1" in out, out
 
     # forward numerics: y = x @ W.T + b with the smoke's ramp weights
     x = np.array([[1, 0, -1], [2, 1, 0]], np.float32)
@@ -163,11 +167,11 @@ def test_c_api_kvstore_local(tmp_path):
     keys = (ctypes.c_int * 1)(3)
     arrs = (ctypes.c_void_p * 1)(h)
     assert lib.MXKVStoreInit(kv, 1, keys, arrs) == 0, lib.MXGetLastError()
-    assert lib.MXKVStorePush(kv, 1, keys, arrs) == 0, lib.MXGetLastError()
+    assert lib.MXKVStorePush(kv, 1, keys, arrs, 0) == 0, lib.MXGetLastError()
     dest = ctypes.c_void_p()
     assert lib.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(dest)) == 0
     darr = (ctypes.c_void_p * 1)(dest)
-    assert lib.MXKVStorePull(kv, 1, keys, darr) == 0, lib.MXGetLastError()
+    assert lib.MXKVStorePull(kv, 1, keys, darr, 0) == 0, lib.MXGetLastError()
     back = np.zeros(4, np.float32)
     assert lib.MXNDArraySyncCopyToCPU(
         dest, back.ctypes.data_as(ctypes.c_void_p), 4) == 0
@@ -199,9 +203,21 @@ def test_c_api_dataiter(tmp_path):
     lib.MXGetLastError.restype = ctypes.c_char_p
 
     n = ctypes.c_uint()
-    names = ctypes.POINTER(ctypes.c_char_p)()
-    assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(names)) == 0
-    kinds = {names[i] for i in range(n.value)}
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(creators)) == 0
+    kinds = set()
+    for i in range(n.value):
+        nm = ctypes.c_char_p()
+        desc = ctypes.c_char_p()
+        na = ctypes.c_uint()
+        an = ctypes.POINTER(ctypes.c_char_p)()
+        at = ctypes.POINTER(ctypes.c_char_p)()
+        ad = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXDataIterGetIterInfo(
+            ctypes.c_void_p(creators[i]), ctypes.byref(nm),
+            ctypes.byref(desc), ctypes.byref(na), ctypes.byref(an),
+            ctypes.byref(at), ctypes.byref(ad)) == 0, lib.MXGetLastError()
+        kinds.add(nm.value)
     assert b"ImageRecordIter" in kinds and b"MNISTIter" in kinds
 
     keys = (ctypes.c_char_p * 3)(b"path_imgrec", b"data_shape", b"batch_size")
@@ -320,3 +336,512 @@ def test_c_api_prealloc_invoke_and_positional_infer():
         lib.MXNDArrayFree(hh)
     lib.MXSymbolFree(data)
     lib.MXSymbolFree(fc)
+
+def _load_lib():
+    lib = ctypes.CDLL(_lib_path())
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _ck(lib, rc, what):
+    assert rc == 0, "%s: %s" % (what, lib.MXGetLastError())
+
+
+def _make_nd(lib, values):
+    values = np.ascontiguousarray(values, np.float32)
+    sh = (ctypes.c_uint * values.ndim)(*values.shape)
+    h = ctypes.c_void_p()
+    _ck(lib, lib.MXNDArrayCreate(sh, values.ndim, 1, 0, 0, ctypes.byref(h)),
+        "create")
+    _ck(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, values.ctypes.data_as(ctypes.c_void_p), values.size), "copy in")
+    return h
+
+
+def _read_nd(lib, h, shape):
+    out = np.zeros(shape, np.float32)
+    _ck(lib, lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.size), "copy out")
+    return out
+
+
+def test_c_api_recordio_roundtrip(tmp_path):
+    """RecordIO through the C surface (reference c_api.h:1535-1596):
+    write records + Tell, read them back, Seek to replay, EOF contract."""
+    lib = _load_lib()
+    uri = str(tmp_path / "c.rec").encode()
+    w = ctypes.c_void_p()
+    _ck(lib, lib.MXRecordIOWriterCreate(uri, ctypes.byref(w)), "wcreate")
+    payloads = [b"hello", b"recordio \x00 with nul", b"x" * 1000]
+    positions = []
+    for p in payloads:
+        pos = ctypes.c_size_t()
+        _ck(lib, lib.MXRecordIOWriterTell(w, ctypes.byref(pos)), "tell")
+        positions.append(pos.value)
+        _ck(lib, lib.MXRecordIOWriterWriteRecord(w, p, len(p)), "write")
+    _ck(lib, lib.MXRecordIOWriterFree(w), "wfree")
+
+    r = ctypes.c_void_p()
+    _ck(lib, lib.MXRecordIOReaderCreate(uri, ctypes.byref(r)), "rcreate")
+    got = []
+    while True:
+        buf = ctypes.c_char_p()
+        size = ctypes.c_size_t()
+        _ck(lib, lib.MXRecordIOReaderReadRecord(
+            r, ctypes.byref(buf), ctypes.byref(size)), "read")
+        if not buf.value and size.value == 0 and buf.value is None:
+            break
+        got.append(ctypes.string_at(buf, size.value))
+    assert got == payloads, got
+    # seek back to record 1 and re-read it
+    _ck(lib, lib.MXRecordIOReaderSeek(r, positions[1]), "seek")
+    buf = ctypes.c_char_p()
+    size = ctypes.c_size_t()
+    _ck(lib, lib.MXRecordIOReaderReadRecord(
+        r, ctypes.byref(buf), ctypes.byref(size)), "read2")
+    assert ctypes.string_at(buf, size.value) == payloads[1]
+    _ck(lib, lib.MXRecordIOReaderFree(r), "rfree")
+    # the file is this repo's native .rec format too
+    from mxnet_tpu import recordio as rio
+    rec = rio.MXRecordIO(uri.decode(), "r")
+    assert rec.read() == payloads[0]
+    rec.close()
+
+
+def test_c_api_autograd_group():
+    """MXAutograd* (reference c_api.h:545-586): mark, record imperatively
+    through MXImperativeInvoke, backward, read the grad."""
+    lib = _load_lib()
+    x = _make_nd(lib, np.array([1.0, 2.0, 3.0]))
+    gx = _make_nd(lib, np.zeros(3))
+    reqs = (ctypes.c_uint * 1)(1)  # write
+    _ck(lib, lib.MXAutogradMarkVariables(
+        1, (ctypes.c_void_p * 1)(x), reqs, (ctypes.c_void_p * 1)(gx)),
+        "mark")
+    prev = ctypes.c_int(-1)
+    _ck(lib, lib.MXAutogradSetIsTraining(1, ctypes.byref(prev)), "train on")
+    assert prev.value == 0
+    # y = x * x (recorded)
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    _ck(lib, lib.MXImperativeInvoke(
+        b"elemwise_mul", 2, (ctypes.c_void_p * 2)(x, x),
+        ctypes.byref(n_out), ctypes.byref(outs), 0, None, None), "mul")
+    y = ctypes.c_void_p(outs[0])
+    _ck(lib, lib.MXAutogradSetIsTraining(0, ctypes.byref(prev)), "train off")
+    assert prev.value == 1
+    _ck(lib, lib.MXAutogradBackward(1, (ctypes.c_void_p * 1)(y), None, 0),
+        "backward")
+    np.testing.assert_allclose(_read_nd(lib, gx, (3,)), [2.0, 4.0, 6.0])
+    for h in (x, gx, y):
+        lib.MXNDArrayFree(h)
+
+
+def test_c_api_function_group():
+    """Legacy MXFunc* group: lookup by name, describe, invoke into
+    mutate targets (reference c_api.h:443-530)."""
+    lib = _load_lib()
+    fun = ctypes.c_void_p()
+    _ck(lib, lib.MXGetFunction(b"elemwise_add", ctypes.byref(fun)), "get")
+    nuse = ctypes.c_uint()
+    nscalar = ctypes.c_uint()
+    nmut = ctypes.c_uint()
+    mask = ctypes.c_int()
+    _ck(lib, lib.MXFuncDescribe(fun, ctypes.byref(nuse),
+                                ctypes.byref(nscalar), ctypes.byref(nmut),
+                                ctypes.byref(mask)), "describe")
+    assert (nuse.value, nscalar.value, nmut.value) == (2, 0, 1)
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    na = ctypes.c_uint()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    at = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    rt = ctypes.c_char_p()
+    _ck(lib, lib.MXFuncGetInfo(fun, ctypes.byref(name), ctypes.byref(desc),
+                               ctypes.byref(na), ctypes.byref(an),
+                               ctypes.byref(at), ctypes.byref(ad),
+                               ctypes.byref(rt)), "info")
+    assert name.value == b"elemwise_add"
+    a = _make_nd(lib, np.array([1.0, 2.0]))
+    b = _make_nd(lib, np.array([10.0, 20.0]))
+    dst = _make_nd(lib, np.zeros(2))
+    _ck(lib, lib.MXFuncInvoke(fun, (ctypes.c_void_p * 2)(a, b), None,
+                              (ctypes.c_void_p * 1)(dst)), "invoke")
+    np.testing.assert_allclose(_read_nd(lib, dst, (2,)), [11.0, 22.0])
+    for h in (a, b, dst):
+        lib.MXNDArrayFree(h)
+
+
+def test_c_api_ndarray_extras():
+    """At / Detach / GetData snapshot / raw-bytes round-trip / grad
+    state (reference c_api.h:230-460)."""
+    lib = _load_lib()
+    arr = _make_nd(lib, np.arange(6, dtype=np.float32).reshape(2, 3))
+    # At -> row view
+    row = ctypes.c_void_p()
+    _ck(lib, lib.MXNDArrayAt(arr, 1, ctypes.byref(row)), "at")
+    np.testing.assert_allclose(_read_nd(lib, row, (3,)), [3, 4, 5])
+    # Detach shares values
+    det = ctypes.c_void_p()
+    _ck(lib, lib.MXNDArrayDetach(arr, ctypes.byref(det)), "detach")
+    np.testing.assert_allclose(_read_nd(lib, det, (2, 3)),
+                               np.arange(6).reshape(2, 3))
+    # GetData host snapshot
+    p = ctypes.c_void_p()
+    _ck(lib, lib.MXNDArrayGetData(arr, ctypes.byref(p)), "getdata")
+    snap = np.frombuffer(ctypes.string_at(p, 6 * 4), np.float32)
+    np.testing.assert_allclose(snap, np.arange(6))
+    # raw bytes round-trip
+    size = ctypes.c_size_t()
+    buf = ctypes.c_char_p()
+    _ck(lib, lib.MXNDArraySaveRawBytes(arr, ctypes.byref(size),
+                                       ctypes.byref(buf)), "save raw")
+    raw = ctypes.string_at(buf, size.value)
+    back = ctypes.c_void_p()
+    _ck(lib, lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                           ctypes.byref(back)), "load raw")
+    np.testing.assert_allclose(_read_nd(lib, back, (2, 3)),
+                               np.arange(6).reshape(2, 3))
+    # grad state flag
+    st = ctypes.c_int(-1)
+    _ck(lib, lib.MXNDArrayGetGradState(arr, ctypes.byref(st)), "get gs")
+    assert st.value == 0
+    _ck(lib, lib.MXNDArraySetGradState(arr, 1), "set gs")
+    _ck(lib, lib.MXNDArrayGetGradState(arr, ctypes.byref(st)), "get gs2")
+    assert st.value == 1
+    for h in (arr, row, det, back):
+        lib.MXNDArrayFree(h)
+
+
+def test_c_api_infer_type_and_symbol_attrs():
+    lib = _load_lib()
+    data = ctypes.c_void_p()
+    _ck(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)), "var")
+    fc = ctypes.c_void_p()
+    _ck(lib, lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, (ctypes.c_char_p * 1)(b"num_hidden"),
+        (ctypes.c_char_p * 1)(b"4"), ctypes.byref(fc)), "atomic")
+    _ck(lib, lib.MXSymbolCompose(fc, b"fc1", 1, None,
+                                 (ctypes.c_void_p * 1)(data)), "compose")
+    # InferType: data float32 -> everything float32
+    codes = (ctypes.c_int * 1)(0)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    iss = ctypes.c_uint()
+    oss = ctypes.c_uint()
+    ass_ = ctypes.c_uint()
+    ind = ctypes.POINTER(ctypes.c_int)()
+    ond = ctypes.POINTER(ctypes.c_int)()
+    and_ = ctypes.POINTER(ctypes.c_int)()
+    comp = ctypes.c_int(-1)
+    _ck(lib, lib.MXSymbolInferType(
+        fc, 1, keys, codes, ctypes.byref(iss), ctypes.byref(ind),
+        ctypes.byref(oss), ctypes.byref(ond), ctypes.byref(ass_),
+        ctypes.byref(and_), ctypes.byref(comp)), "infer type")
+    assert comp.value == 1 and iss.value == 3
+    assert [ind[i] for i in range(3)] == [0, 0, 0]
+    # attrs: set/get/list
+    _ck(lib, lib.MXSymbolSetAttr(fc, b"lr_mult", b"2.0"), "set attr")
+    out = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _ck(lib, lib.MXSymbolGetAttr(fc, b"lr_mult", ctypes.byref(out),
+                                 ctypes.byref(ok)), "get attr")
+    assert ok.value == 1 and out.value == b"2.0"
+    _ck(lib, lib.MXSymbolGetAttr(fc, b"nope", ctypes.byref(out),
+                                 ctypes.byref(ok)), "get missing")
+    assert ok.value == 0
+    # name + copy + internals + output indexing
+    nm = ctypes.c_char_p()
+    _ck(lib, lib.MXSymbolGetName(fc, ctypes.byref(nm), ctypes.byref(ok)),
+        "name")
+    assert ok.value == 1 and nm.value == b"fc1"
+    cp = ctypes.c_void_p()
+    _ck(lib, lib.MXSymbolCopy(fc, ctypes.byref(cp)), "copy")
+    internals = ctypes.c_void_p()
+    _ck(lib, lib.MXSymbolGetInternals(fc, ctypes.byref(internals)),
+        "internals")
+    n_int = ctypes.c_uint()
+    outs = ctypes.POINTER(ctypes.c_char_p)()
+    _ck(lib, lib.MXSymbolListOutputs(internals, ctypes.byref(n_int),
+                                     ctypes.byref(outs)), "int outs")
+    assert n_int.value >= 2  # data + ... + fc1 output
+    sel = ctypes.c_void_p()
+    _ck(lib, lib.MXSymbolGetOutput(internals, n_int.value - 1,
+                                   ctypes.byref(sel)), "get output")
+    dbg = ctypes.c_char_p()
+    _ck(lib, lib.MXSymbolPrint(fc, ctypes.byref(dbg)), "print")
+    assert b"fc1" in dbg.value
+    for h in (data, fc, cp, internals, sel):
+        lib.MXSymbolFree(h)
+
+
+def test_c_api_rtc_python_kernel():
+    """MXRtc with a jnp python-source kernel (documented TPU deviation)."""
+    lib = _load_lib()
+    a = _make_nd(lib, np.array([1.0, 2.0, 3.0]))
+    o = _make_nd(lib, np.zeros(3))
+    src = b"def saxpy3(x):\n    return 3.0 * x + 1.0\n"
+    h = ctypes.c_void_p()
+    _ck(lib, lib.MXRtcCreate(b"saxpy3", 1, 1,
+                             (ctypes.c_char_p * 1)(b"x"),
+                             (ctypes.c_char_p * 1)(b"y"),
+                             (ctypes.c_void_p * 1)(a),
+                             (ctypes.c_void_p * 1)(o), src,
+                             ctypes.byref(h)), "rtc create")
+    _ck(lib, lib.MXRtcPush(h, 1, 1, (ctypes.c_void_p * 1)(a),
+                           (ctypes.c_void_p * 1)(o), 1, 1, 1, 1, 1, 1),
+        "rtc push")
+    np.testing.assert_allclose(_read_nd(lib, o, (3,)), [4.0, 7.0, 10.0])
+    _ck(lib, lib.MXRtcFree(h), "rtc free")
+    from mxnet_tpu import rtc as _rtc
+    _rtc.unregister_kernel("saxpy3")
+    for hh in (a, o):
+        lib.MXNDArrayFree(hh)
+
+
+def test_c_api_monitor_callback():
+    """MXExecutorSetMonitorCallback fires per output after forward."""
+    lib = _load_lib()
+    data = ctypes.c_void_p()
+    _ck(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)), "var")
+    fc = ctypes.c_void_p()
+    _ck(lib, lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, (ctypes.c_char_p * 1)(b"num_hidden"),
+        (ctypes.c_char_p * 1)(b"2"), ctypes.byref(fc)), "atomic")
+    _ck(lib, lib.MXSymbolCompose(fc, b"m", 1, None,
+                                 (ctypes.c_void_p * 1)(data)), "compose")
+    args = [_make_nd(lib, np.ones((3, 2), np.float32)),
+            _make_nd(lib, np.ones((2, 2), np.float32)),
+            _make_nd(lib, np.zeros(2, np.float32))]
+    reqs = (ctypes.c_uint * 3)(0, 0, 0)
+    exe = ctypes.c_void_p()
+    _ck(lib, lib.MXExecutorBind(fc, 1, 0, 3,
+                                (ctypes.c_void_p * 3)(*args), None, reqs, 0,
+                                None, ctypes.byref(exe)), "bind")
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)
+
+    def cb(name, arr_handle, user):
+        vals = _read_nd(lib, ctypes.c_void_p(arr_handle), (3, 2))
+        seen.append((name.decode(), float(vals[0, 0])))
+
+    cb_keep = CB(cb)
+    _ck(lib, lib.MXExecutorSetMonitorCallback(exe, cb_keep, None), "set cb")
+    _ck(lib, lib.MXExecutorForward(exe, 0), "fwd")
+    assert seen and seen[0][0].startswith("m_output")
+    assert seen[0][1] == 2.0  # 1*1+1*1 + bias 0
+    _ck(lib, lib.MXExecutorFree(exe), "free")
+    for h in args:
+        lib.MXNDArrayFree(h)
+    lib.MXSymbolFree(data)
+    lib.MXSymbolFree(fc)
+
+
+def test_c_api_kvstore_updater_and_ex():
+    """String-key kvstore ops + a C updater through the trampoline
+    (reference MXKVStoreSetUpdater contract: updater owns recv/local)."""
+    lib = _load_lib()
+    kv = ctypes.c_void_p()
+    _ck(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)), "create")
+    t = ctypes.c_char_p()
+    _ck(lib, lib.MXKVStoreGetType(kv, ctypes.byref(t)), "type")
+    assert t.value == b"local"
+    rank = ctypes.c_int(-1)
+    size = ctypes.c_int(-1)
+    _ck(lib, lib.MXKVStoreGetRank(kv, ctypes.byref(rank)), "rank")
+    _ck(lib, lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)), "size")
+    assert (rank.value, size.value) == (0, 1)
+    flag = ctypes.c_int(-1)
+    _ck(lib, lib.MXKVStoreIsWorkerNode(ctypes.byref(flag)), "isworker")
+    assert flag.value == 1
+    keys = (ctypes.c_char_p * 1)(b"w")
+    init = _make_nd(lib, np.array([1.0, 1.0]))
+    _ck(lib, lib.MXKVStoreInitEx(kv, 1, keys, (ctypes.c_void_p * 1)(init),
+                                 ), "init ex")
+
+    calls = []
+    UPD = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+
+    def updater(key, recv, local, user):
+        # local -= 0.5 * recv, through the C surface itself
+        r = _read_nd(lib, ctypes.c_void_p(recv), (2,))
+        l = _read_nd(lib, ctypes.c_void_p(local), (2,))
+        newv = np.ascontiguousarray(l - 0.5 * r, np.float32)
+        _ck(lib, lib.MXNDArraySyncCopyFromCPU(
+            ctypes.c_void_p(local), newv.ctypes.data_as(ctypes.c_void_p),
+            2), "upd write")
+        calls.append(key)
+        lib.MXNDArrayFree(ctypes.c_void_p(recv))
+        lib.MXNDArrayFree(ctypes.c_void_p(local))
+
+    upd_keep = UPD(updater)
+    _ck(lib, lib.MXKVStoreSetUpdater(kv, upd_keep, None), "set updater")
+    grad = _make_nd(lib, np.array([2.0, 4.0]))
+    _ck(lib, lib.MXKVStorePushEx(kv, 1, keys, (ctypes.c_void_p * 1)(grad),
+                                 0), "push ex")
+    out = _make_nd(lib, np.zeros(2))
+    _ck(lib, lib.MXKVStorePullEx(kv, 1, keys, (ctypes.c_void_p * 1)(out),
+                                 0), "pull ex")
+    np.testing.assert_allclose(_read_nd(lib, out, (2,)), [0.0, -1.0])
+    assert calls == [0]  # string key "w" -> int 0 fallback
+    _ck(lib, lib.MXKVStoreBarrier(kv), "barrier")
+    _ck(lib, lib.MXKVStoreSetBarrierBeforeExit(kv, 0), "sbbe")
+    dead = ctypes.c_int(-1)
+    _ck(lib, lib.MXKVStoreGetNumDeadNode(kv, 2, ctypes.byref(dead), 60),
+        "dead")
+    assert dead.value == 0
+    _ck(lib, lib.MXKVStoreFree(kv), "free")
+    for h in (init, grad, out):
+        lib.MXNDArrayFree(h)
+
+def test_c_api_custom_op_register():
+    """MXCustomOpRegister: a C-protocol custom op (creator -> prop
+    callbacks -> operator callbacks, reference MXCallbackList ABI) built
+    here with ctypes exactly as a C embedder would, then driven through
+    symbol compose + bind + forward + backward."""
+    lib = _load_lib()
+    c_int_p = ctypes.POINTER(ctypes.c_int)
+    mx_uint_p = ctypes.POINTER(ctypes.c_uint)
+
+    class MXCallbackList(ctypes.Structure):
+        _fields_ = [("num_callbacks", ctypes.c_int),
+                    ("callbacks",
+                     ctypes.POINTER(ctypes.CFUNCTYPE(ctypes.c_int))),
+                    ("contexts", ctypes.POINTER(ctypes.c_void_p))]
+
+    GEN = ctypes.CFUNCTYPE(ctypes.c_int)
+    LIST_FT = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.c_void_p)
+    INFERSHAPE_FT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, c_int_p,
+                                     ctypes.POINTER(mx_uint_p),
+                                     ctypes.c_void_p)
+    CREATEOP_FT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.POINTER(mx_uint_p),
+                                   c_int_p, c_int_p,
+                                   ctypes.POINTER(MXCallbackList),
+                                   ctypes.c_void_p)
+    FB_FT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_void_p), c_int_p,
+                             c_int_p, ctypes.c_int, ctypes.c_void_p)
+    CREATOR = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(MXCallbackList))
+
+    keep = []  # every ctypes object the C side may dereference later
+
+    def _mk_list(names):
+        def entry(out, _state):
+            arr = (ctypes.c_char_p * (len(names) + 1))(
+                *[n.encode() for n in names], None)
+            keep.append(arr)
+            out[0] = ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p))
+            return 1
+        f = LIST_FT(entry)
+        keep.append(f)
+        return f
+
+    def infer_shape(num_tensor, dims, shapes, _state):
+        # triple2: 1 input, 1 output, same shape
+        assert num_tensor == 2
+        buf = (ctypes.c_uint * dims[0])(*[shapes[0][j]
+                                          for j in range(dims[0])])
+        keep.append(buf)
+        shapes[1] = ctypes.cast(buf, mx_uint_p)
+        dims[1] = dims[0]
+        return 1
+
+    def fb_forward(size, ptrs, tags, reqs, is_train, _state):
+        # y = 3 * x, through the C API itself (tag 0 = in, 1 = out)
+        ins = [i for i in range(size) if tags[i] == 0]
+        outs = [i for i in range(size) if tags[i] == 1]
+        nd = ctypes.c_uint()
+        dd = ctypes.POINTER(ctypes.c_uint)()
+        _ck(lib, lib.MXNDArrayGetShape(ctypes.c_void_p(ptrs[ins[0]]),
+                                       ctypes.byref(nd), ctypes.byref(dd)),
+            "shape")
+        shape = tuple(dd[i] for i in range(nd.value))
+        x = _read_nd(lib, ctypes.c_void_p(ptrs[ins[0]]), shape)
+        y = np.ascontiguousarray(3.0 * x, np.float32)
+        _ck(lib, lib.MXNDArraySyncCopyFromCPU(
+            ctypes.c_void_p(ptrs[outs[0]]),
+            y.ctypes.data_as(ctypes.c_void_p), y.size), "write out")
+        for i in range(size):  # callee owns every handle (reference ABI)
+            lib.MXNDArrayFree(ctypes.c_void_p(ptrs[i]))
+        return 1
+
+    def fb_backward(size, ptrs, tags, reqs, is_train, _state):
+        # dx = 3 * dy  (tags: 3 out_grad, 2 in_grad)
+        ogs = [i for i in range(size) if tags[i] == 3]
+        igs = [i for i in range(size) if tags[i] == 2]
+        nd = ctypes.c_uint()
+        dd = ctypes.POINTER(ctypes.c_uint)()
+        _ck(lib, lib.MXNDArrayGetShape(ctypes.c_void_p(ptrs[ogs[0]]),
+                                       ctypes.byref(nd), ctypes.byref(dd)),
+            "shape")
+        shape = tuple(dd[i] for i in range(nd.value))
+        g = _read_nd(lib, ctypes.c_void_p(ptrs[ogs[0]]), shape)
+        gx = np.ascontiguousarray(3.0 * g, np.float32)
+        _ck(lib, lib.MXNDArraySyncCopyFromCPU(
+            ctypes.c_void_p(ptrs[igs[0]]),
+            gx.ctypes.data_as(ctypes.c_void_p), gx.size), "write grad")
+        for i in range(size):
+            lib.MXNDArrayFree(ctypes.c_void_p(ptrs[i]))
+        return 1
+
+    fwd_f = FB_FT(fb_forward)
+    bwd_f = FB_FT(fb_backward)
+    keep += [fwd_f, bwd_f]
+
+    def create_operator(ctx, num_inputs, shapes, ndims, dtypes, ret,
+                        _state):
+        cbs = (ctypes.CFUNCTYPE(ctypes.c_int) * 3)(
+            ctypes.cast(None, GEN), ctypes.cast(fwd_f, GEN),
+            ctypes.cast(bwd_f, GEN))
+        ctxs = (ctypes.c_void_p * 3)(None, None, None)
+        keep.extend([cbs, ctxs])
+        ret[0].num_callbacks = 3
+        ret[0].callbacks = cbs
+        ret[0].contexts = ctxs
+        return 1
+
+    la = _mk_list(["data"])
+    lo = _mk_list(["output"])
+    lx = _mk_list([])
+    is_f = INFERSHAPE_FT(infer_shape)
+    co_f = CREATEOP_FT(create_operator)
+    keep += [is_f, co_f]
+
+    def creator(op_type, argc, keys, vals, ret):
+        assert op_type == b"triple2"
+        cbs = (ctypes.CFUNCTYPE(ctypes.c_int) * 8)(
+            ctypes.cast(None, GEN), ctypes.cast(la, GEN),
+            ctypes.cast(lo, GEN), ctypes.cast(lx, GEN),
+            ctypes.cast(is_f, GEN), ctypes.cast(None, GEN),
+            ctypes.cast(co_f, GEN), ctypes.cast(None, GEN))
+        ctxs = (ctypes.c_void_p * 8)(*([None] * 8))
+        keep.extend([cbs, ctxs])
+        ret[0].num_callbacks = 8
+        ret[0].callbacks = cbs
+        ret[0].contexts = ctxs
+        return 1
+
+    creator_f = CREATOR(creator)
+    keep.append(creator_f)
+    _ck(lib, lib.MXCustomOpRegister(b"triple2", creator_f), "register")
+
+    # drive through the python surface exactly like a reference script
+    x = mx.sym.Variable("x")
+    y = mx.sym.Custom(x, op_type="triple2")
+    xv = mx.nd.array(np.array([1.0, 2.0, -4.0], np.float32))
+    gx = mx.nd.zeros((3,))
+    exe = y.bind(mx.cpu(), [xv], args_grad={"x": gx}, grad_req="write")
+    exe.forward(is_train=True)
+    np.testing.assert_allclose(np.asarray(exe.outputs[0].asnumpy()),
+                               [3.0, 6.0, -12.0])
+    exe.backward([mx.nd.array(np.array([1.0, 1.0, 2.0], np.float32))])
+    np.testing.assert_allclose(np.asarray(exe.grad_dict["x"].asnumpy()),
+                               [3.0, 3.0, 6.0])

@@ -358,3 +358,23 @@ with open(%r, "a") as f:
                     os.kill(int(pid), signal.SIGKILL)
                 except (OSError, ProcessLookupError):
                     pass
+
+
+def test_server_command_error_does_not_kill_handler():
+    """A head-0 command with an unpicklable body must raise from
+    _handle_command (the conn loop turns it into an _ERROR frame) instead
+    of killing the connection thread; a user controller sees every
+    command first and its errors propagate the same way."""
+    import pytest
+
+    from mxnet_tpu.parallel.dist import Server
+
+    srv = Server.__new__(Server)
+    srv.command_hook = None
+    srv.updater = None
+    with pytest.raises(Exception):
+        srv._handle_command(0, b"not-a-pickle")
+    seen = []
+    srv.command_hook = lambda head, body: seen.append((head, bytes(body)))
+    srv._handle_command(7, b"payload")  # non-zero head: hook only
+    assert seen == [(7, b"payload")]
