@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Fully-convolutional semantic segmentation (FCN) — the Deconvolution +
+Crop upsampling pattern end-to-end.
+
+Parity: reference example/fcn-xs (symbol_fcnxs.py fcn32/16/8s): a conv
+backbone downsamples, 1x1 convs score per class, `Deconvolution`
+(learned bilinear-style upsampling) brings the score map back to input
+resolution, `Crop` aligns it to the input, and a per-pixel
+`SoftmaxOutput(multi_output=True)` trains against the dense label map.
+The fcn-16s skip connection (summing a shallower score map through a
+second deconv) is included.  Data is synthetic: images containing a
+bright square whose pixels are class 1, background class 0 — the
+reference uses Pascal VOC, which cannot be fetched here.
+
+    JAX_PLATFORMS=cpu python examples/fcn-xs/fcn_xs.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_data(n, size, rng):
+    X = 0.1 * rng.randn(n, 1, size, size).astype(np.float32)
+    Y = np.zeros((n, size, size), np.float32)
+    for i in range(n):
+        s = rng.randint(size // 4, size // 2)
+        r, c = rng.randint(0, size - s, 2)
+        X[i, 0, r:r + s, c:c + s] += 1.0
+        Y[i, r:r + s, c:c + s] = 1.0
+    return X, Y
+
+
+def build_fcn16s(num_classes=2):
+    """symbol_fcnxs.py fcn-16s analog on a small backbone: two conv
+    stages (stride 4 total), per-stage 1x1 score heads, deconv x2 on the
+    deep head + skip-sum with the shallow head, deconv x4 to full res,
+    Crop, per-pixel softmax."""
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    # stage 1 (stride 2)
+    c1 = mx.sym.Convolution(data, num_filter=16, kernel=(5, 5), pad=(2, 2),
+                            name="conv1")
+    r1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(r1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="pool1")
+    # stage 2 (stride 4)
+    c2 = mx.sym.Convolution(p1, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                            name="conv2")
+    r2 = mx.sym.Activation(c2, act_type="relu")
+    p2 = mx.sym.Pooling(r2, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="pool2")
+    # score heads (1x1 convs, reference symbol_fcnxs.py score/score_pool4)
+    score2 = mx.sym.Convolution(p2, num_filter=num_classes, kernel=(1, 1),
+                                name="score2")
+    score1 = mx.sym.Convolution(p1, num_filter=num_classes, kernel=(1, 1),
+                                name="score1")
+    # deconv deep head x2, crop to the shallow head, skip-sum (fcn-16s)
+    up2 = mx.sym.Deconvolution(score2, num_filter=num_classes,
+                               kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                               name="up2")
+    up2c = mx.sym.Crop(up2, score1, name="crop1")
+    fused = up2c + score1
+    # deconv fused map x2 back to input resolution, crop to data
+    up1 = mx.sym.Deconvolution(fused, num_filter=num_classes,
+                               kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                               name="up1")
+    up1c = mx.sym.Crop(up1, data, name="crop2")
+    # normalization='valid' divides the per-pixel gradient by the pixel
+    # count — without it the summed dense grad forces the reference's
+    # infamous 1e-10 learning rate (fcn_xs.py run_fcnxs.sh)
+    return mx.sym.SoftmaxOutput(up1c, label, multi_output=True,
+                                normalization="valid", name="softmax")
+
+
+def main():
+    import mxnet_tpu as mx
+
+    fast = bool(os.environ.get("MXTPU_EXAMPLE_FAST"))
+    n, size = (128, 16) if fast else (512, 32)
+    epochs = 16 if fast else 24
+    rng = np.random.RandomState(11)
+    X, Y = make_data(n, size, rng)
+    Xv, Yv = make_data(n // 4, size, rng)
+
+    net = build_fcn16s()
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    # lr looks large because normalization='valid' already divides the
+    # dense gradient by the pixel count and Module's rescale_grad divides
+    # by batch again
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 4.0, "momentum": 0.9},
+            num_epoch=epochs)
+
+    # per-pixel accuracy on held-out squares
+    vit = mx.io.NDArrayIter(Xv, Yv, batch_size=16)
+    correct = total = 0
+    for batch in vit:
+        mod.forward(batch, is_train=False)
+        pred = np.argmax(mod.get_outputs()[0].asnumpy(), axis=1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum()
+        total += lab.size
+    acc = correct / total
+    print("per-pixel accuracy: %.3f" % acc)
+    assert acc > 0.9, "FCN failed to segment (pixel acc %.3f)" % acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Train a hinge-loss classifier with the SVMOutput head.
+
+Parity: reference example/svm_mnist/svm_mnist.py — an ordinary MLP whose
+softmax head is swapped for `mx.sym.SVMOutput` (L2-regularized hinge
+loss, margin semantics of src/operator/svm_output-inl.h), trained with
+plain SGD.  Data is synthetic separable clusters standing in for MNIST
+(the reference downloads the real set; mldata.org is long gone and this
+environment has no egress).
+
+    JAX_PLATFORMS=cpu python examples/svm_mnist/svm_mnist.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_data(n, dim, classes, rng, centers=None):
+    """Gaussian clusters — linearly separable-ish like flattened digits.
+    Pass the SAME `centers` for train and validation splits."""
+    if centers is None:
+        centers = rng.randn(classes, dim) * 2.0
+    y = rng.randint(0, classes, n)
+    X = centers[y] + rng.randn(n, dim).astype(np.float32)
+    return X.astype(np.float32), y.astype(np.float32), centers
+
+
+def main():
+    import mxnet_tpu as mx
+
+    fast = bool(os.environ.get("MXTPU_EXAMPLE_FAST"))
+    n, dim, classes = (512, 20, 5) if fast else (4096, 784, 10)
+    epochs = 8 if fast else 20
+    rng = np.random.RandomState(7)
+    X, y, centers = make_data(n, dim, classes, rng)
+    Xv, yv, _ = make_data(n // 4, dim, classes, rng, centers=centers)
+
+    # the reference net verbatim: fc -> relu -> fc -> relu -> fc -> SVM
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=512)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=512)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=classes)
+    net = mx.sym.SVMOutput(net, name="svm", margin=1.0,
+                           regularization_coefficient=1.0)
+
+    train = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True,
+                              label_name="svm_label")
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=64, label_name="svm_label")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=("svm_label",))
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9,
+                              "wd": 1e-4},
+            num_epoch=epochs,
+            batch_end_callback=mx.callback.Speedometer(64, 50))
+    acc = mod.score(val, "acc")[0][1]
+    print("validation accuracy: %.3f" % acc)
+    assert acc > 0.9, "SVM head failed to converge (acc %.3f)" % acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -134,7 +134,12 @@ def backward(outputs, out_grads=None, retain_graph=False):
     else:
         if isinstance(out_grads, NDArray):
             out_grads = [out_grads]
-        cots = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads)
+        # per-entry None means "ones-gradient for this output" (the
+        # reference C ABI passes NULL head-grad handles for defaults)
+        cots = tuple(
+            jnp.ones_like(o) if g is None
+            else (g.data if isinstance(g, NDArray) else jnp.asarray(g))
+            for g, o in zip(out_grads, outs))
     (grads,) = vjp_fn(cots)
     for (var, grad_arr, req), g in zip(marked, grads):
         if grad_arr is None or req == "null":

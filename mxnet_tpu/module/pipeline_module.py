@@ -33,9 +33,14 @@ Contract:
     stage 0's is the batch, later ones the previous stage's output[0]
   * label variables (`label_names`) may appear in any stage (typically
     the last, for SoftmaxOutput-style heads)
-  * stages must not carry auxiliary states (BatchNorm running stats are
-    microbatch-order-dependent inside a pipeline; use LayerNorm or
-    InstanceNorm in pipelined blocks — the standard pipeline recipe)
+  * BatchNorm stages use GPipe microbatch semantics: each microbatch is
+    normalized with ITS OWN batch statistics and the running-stats EMA
+    accumulates once per microbatch in microbatch order — numerically
+    identical to sequential gradient accumulation over the same
+    microbatches (NOT to one whole-batch Module step, whose batch stats
+    span all microbatches; exact whole-batch BN would serialize the
+    pipe per layer).  Verified against a grad-accumulating sequential
+    run in tests/test_pipeline_module.py.
 """
 from __future__ import annotations
 
@@ -71,14 +76,17 @@ class _Stage:
         self.order = _topo_order(symbol._entries)
         self.arg_names = symbol.list_arguments()
         self.output_names = symbol.list_outputs()
-        if symbol.list_auxiliary_states():
-            raise MXNetError(
-                "pipeline stage %d carries auxiliary states %s: BatchNorm "
-                "running statistics are microbatch-order-dependent inside a "
-                "pipeline schedule; use LayerNorm/InstanceNorm in pipelined "
-                "blocks" % (index, symbol.list_auxiliary_states()))
+        # BatchNorm stages are supported with GPipe microbatch semantics:
+        # each microbatch normalizes with its own batch statistics and
+        # the running stats EMA accumulates once per microbatch, in
+        # microbatch order — exactly what sequential gradient
+        # accumulation over the same microbatches computes (see
+        # run_schedule docstring)
+        self.aux_names = symbol.list_auxiliary_states()
         self.param_names = None   # set at bind
         self.layout = None        # name -> (offset, size, shape, dtype)
+        self.aux_layout = None    # name -> (offset, size, shape)
+        self.aux_size = 0
         self.size = 0
         self.in_shape = None
         self.in_size = 0
@@ -217,7 +225,8 @@ class PipelineModule(BaseModule):
             for ln in self._label_names:
                 if ln in st.arg_names and lab_shape is not None:
                     kwargs[ln] = lab_shape
-            arg_shapes, out_shapes, _ = st.symbol.infer_shape(**kwargs)
+            arg_shapes, out_shapes, aux_shapes = st.symbol.infer_shape(
+                **kwargs)
             st.param_names = [n for n in st.arg_names if n not in inputs]
             shapes = dict(zip(st.arg_names, arg_shapes))
             off = 0
@@ -231,6 +240,17 @@ class PipelineModule(BaseModule):
                     collide = True
                 seen[n] = st.index
             st.size = off
+            off = 0
+            st.aux_layout = {}
+            for n, shp in zip(st.aux_names, aux_shapes or []):
+                shp = tuple(shp)
+                sz = int(_np.prod(shp)) if shp else 1
+                st.aux_layout[n] = (off, sz, shp)
+                off += sz
+                if n in seen:
+                    collide = True
+                seen[n] = st.index
+            st.aux_size = off
             st.out_shapes = [tuple(s) for s in out_shapes]
             off = 0
             st.out_layout = []
@@ -247,6 +267,10 @@ class PipelineModule(BaseModule):
         sharding = NamedSharding(self._mesh, P(self._pipe_axis))
         self._buffer = jax.device_put(
             jnp.zeros((self._num_stages, self._psize), jnp.float32), sharding)
+        self._asize = max([st.aux_size for st in self._stages] + [1])
+        self._aux_buffer = jax.device_put(
+            jnp.zeros((self._num_stages, self._asize), jnp.float32),
+            sharding)
         self._buf_sharding = sharding
         self.binded = True
         self._train_jit = None
@@ -265,8 +289,10 @@ class PipelineModule(BaseModule):
             # a partial update (allow_missing set_params) must KEEP the
             # current values of absent keys, matching Module semantics
             buf = _np.asarray(jax.device_get(self._buffer)).copy()
+            abuf = _np.asarray(jax.device_get(self._aux_buffer)).copy()
         else:
             buf = _np.zeros((self._num_stages, self._psize), _np.float32)
+            abuf = _np.zeros((self._num_stages, self._asize), _np.float32)
         for st in self._stages:
             attrs = st.symbol.attr_dict()
             for n in st.param_names:
@@ -283,19 +309,40 @@ class PipelineModule(BaseModule):
                 else:
                     continue  # missing + no initializer: keep current value
                 buf[st.index, off:off + sz] = val.reshape(-1)
+            for n in st.aux_names:
+                off, sz, shp = st.aux_layout[n]
+                key = self._pname(st.index, n)
+                if aux_params and key in aux_params:
+                    val = aux_params[key].asnumpy()
+                elif initializer is not None:
+                    # Module initializes aux through the initializer too
+                    # (moving_mean -> 0, moving_var -> 1 by name)
+                    arr = NDArray(jnp.zeros(shp, jnp.float32))
+                    initializer(InitDesc(n, attrs.get(n, None) or {}), arr)
+                    val = arr.asnumpy()
+                else:
+                    continue
+                abuf[st.index, off:off + sz] = val.reshape(-1)
         self._buffer = jax.device_put(jnp.asarray(buf), self._buf_sharding)
+        self._aux_buffer = jax.device_put(jnp.asarray(abuf),
+                                          self._buf_sharding)
         self.params_initialized = True
 
     def get_params(self):
         assert self.binded and self.params_initialized
         buf = _np.asarray(jax.device_get(self._buffer))
-        args = {}
+        abuf = _np.asarray(jax.device_get(self._aux_buffer))
+        args, auxs = {}, {}
         for st in self._stages:
             for n in st.param_names:
                 off, sz, shp, _ = st.layout[n]
                 args[self._pname(st.index, n)] = NDArray(
                     jnp.asarray(buf[st.index, off:off + sz].reshape(shp)))
-        return args, {}
+            for n in st.aux_names:
+                off, sz, shp = st.aux_layout[n]
+                auxs[self._pname(st.index, n)] = NDArray(
+                    jnp.asarray(abuf[st.index, off:off + sz].reshape(shp)))
+        return args, auxs
 
     def set_params(self, arg_params, aux_params=None, allow_missing=False,
                    force_init=True, allow_extra=False):
@@ -379,7 +426,7 @@ class PipelineModule(BaseModule):
         cast = self._cast_spec()
         bmax = self._bmax
 
-        def branch(params_row, x_flat, label_mb, rng):
+        def branch(params_row, aux_row, x_flat, label_mb, rng):
             vals = []
             for n in st.arg_names:
                 if n == in_name:
@@ -389,15 +436,26 @@ class PipelineModule(BaseModule):
                 else:
                     off, sz, shp, dt = st.layout[n]
                     vals.append(params_row[off:off + sz].reshape(shp))
+            aux_vals = tuple(
+                aux_row[st.aux_layout[n][0]:st.aux_layout[n][0]
+                        + st.aux_layout[n][1]].reshape(st.aux_layout[n][2])
+                for n in st.aux_names)
             with jax.named_scope("pipe_stage_%d" % i):
-                outs, _ = _run_graph(st.entries, st.order, st.arg_names, (),
-                                     tuple(vals), (), is_train, rng, cast=cast)
+                outs, aux_upd = _run_graph(st.entries, st.order,
+                                           st.arg_names, st.aux_names,
+                                           tuple(vals), aux_vals, is_train,
+                                           rng, cast=cast)
+            for n, upd in zip(st.aux_names, aux_upd):
+                off, sz, _ = st.aux_layout[n]
+                aux_row = aux_row.at[off:off + sz].set(
+                    upd.reshape(-1).astype(jnp.float32))
             if last:
                 flat = jnp.concatenate(
                     [o.reshape(-1).astype(jnp.float32) for o in outs])
             else:
                 flat = outs[0].reshape(-1).astype(jnp.float32)
-            return jnp.zeros((bmax,), jnp.float32).at[:flat.shape[0]].set(flat)
+            y = jnp.zeros((bmax,), jnp.float32).at[:flat.shape[0]].set(flat)
+            return y, aux_row
 
         return branch
 
@@ -442,27 +500,32 @@ class PipelineModule(BaseModule):
         mesh = self._mesh
         mb_spec = self._mb_specs()
 
-        def engine(buf, mbs, labels, seed):
+        def engine(buf, aux_buf, mbs, labels, seed):
             params_row = buf[0]
+            aux_row = aux_buf[0]
             rng = jax.random.key(seed[0])
             mb_flat = mbs.reshape(M, -1).astype(jnp.float32)
             pad = bmax - mb_flat.shape[1]
             if pad:
                 mb_flat = jnp.pad(mb_flat, ((0, 0), (0, pad)))
             if is_train:
-                out, pgrad = run_schedule(sched, branches, params_row,
-                                          mb_flat, labels, rng, pipe)
+                out, pgrad, aux_row = run_schedule(
+                    sched, branches, params_row, mb_flat, labels, rng,
+                    pipe, aux_row=aux_row)
                 if dax:
                     pgrad = lax.psum(pgrad, dax)
-                return out, pgrad[None]
+                    # BN running stats are DP-replicated state: average
+                    # the per-replica EMAs (each saw its own batch slice)
+                    aux_row = lax.pmean(aux_row, dax)
+                return out, pgrad[None], aux_row[None]
             out = run_forward(S, M, branches, params_row, mb_flat, labels,
-                              rng, pipe)
-            return out, buf * 0.0    # grads unused on the eval path
+                              rng, pipe, aux_row=aux_row)
+            return out, buf * 0.0, aux_buf  # grads/aux unchanged on eval
 
         return shard_map(
             engine, mesh=mesh,
-            in_specs=(P(pipe), mb_spec, mb_spec, P()),
-            out_specs=(mb_spec, P(pipe)),
+            in_specs=(P(pipe), P(pipe), mb_spec, mb_spec, P()),
+            out_specs=(mb_spec, P(pipe), P(pipe)),
             check_vma=False)
 
     def _get_train_jit(self):
@@ -471,21 +534,21 @@ class PipelineModule(BaseModule):
             opt = self._optimizer
             lr_mask, wd_mask = self._lr_mask, self._wd_mask
 
-            def step(buf, states, mbs, labels, seed, lr0, wd0, t):
-                out, pgrad = smapped(buf, mbs, labels, seed)
+            def step(buf, aux_buf, states, mbs, labels, seed, lr0, wd0, t):
+                out, pgrad, naux = smapped(buf, aux_buf, mbs, labels, seed)
                 nw, nst = opt._fused(buf, pgrad, states, lr0 * lr_mask,
                                      wd0 * wd_mask, t)
-                return tuple(self._assemble(out)), nw, tuple(nst)
+                return tuple(self._assemble(out)), nw, tuple(nst), naux
 
-            self._train_jit = jax.jit(step, donate_argnums=(0, 1))
+            self._train_jit = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._train_jit
 
     def _get_eval_jit(self):
         if self._eval_jit is None:
             smapped = self._build_engine(False)
 
-            def step(buf, mbs, labels, seed):
-                out, _ = smapped(buf, mbs, labels, seed)
+            def step(buf, aux_buf, mbs, labels, seed):
+                out, _, _ = smapped(buf, aux_buf, mbs, labels, seed)
                 return tuple(self._assemble(out))
 
             self._eval_jit = jax.jit(step)
@@ -508,7 +571,8 @@ class PipelineModule(BaseModule):
         label = data_batch.label[0] if data_batch.label else None
         mbs, labs = self._split_host(data, label)
         seed = jnp.asarray([self._next_seed()], jnp.uint32)
-        outs = self._get_eval_jit()(self._buffer, mbs, labs, seed)
+        outs = self._get_eval_jit()(self._buffer, self._aux_buffer, mbs,
+                                    labs, seed)
         self._outputs_cache = [NDArray(o) for o in outs]
 
     def backward(self, out_grads=None):
@@ -537,11 +601,12 @@ class PipelineModule(BaseModule):
         t = opt._index_update_count["__pipeline__"]
         lr0 = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler else opt.lr
         seed = jnp.asarray([self._next_seed()], jnp.uint32)
-        outs, nbuf, nstates = self._get_train_jit()(
-            self._buffer, self._opt_state, mbs, labs, seed,
-            jnp.float32(lr0), jnp.float32(opt.wd), jnp.uint32(t))
+        outs, nbuf, nstates, naux = self._get_train_jit()(
+            self._buffer, self._aux_buffer, self._opt_state, mbs, labs,
+            seed, jnp.float32(lr0), jnp.float32(opt.wd), jnp.uint32(t))
         self._buffer = nbuf
         self._opt_state = nstates
+        self._aux_buffer = naux
         self._outputs_cache = [NDArray(o) for o in outs]
 
     def get_outputs(self, merge_multi_context=True):

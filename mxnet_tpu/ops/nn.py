@@ -507,14 +507,23 @@ def l2_normalization(data, eps=1e-10, mode="instance", **kw):
 
 @register("LRN")
 def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0, **kw):
-    """Local response norm across channels (reference src/operator/lrn-inl.h)."""
+    """Local response norm across channels (reference src/operator/lrn-inl.h).
+
+    The window sum is nsize explicitly-shifted adds, NOT a
+    `lax.reduce_window` over the channel axis: channels are the tiled
+    minor dim on TPU, and a cross-lane windowed reduce there dominated
+    the whole AlexNet inference step (19.4 of 36.4 device ms — the
+    round-5 MFU audit, tools/mfu_decompose.py).  Shifted slices of a
+    zero-padded copy fuse into plain elementwise adds instead."""
     nsize = int(_lit(nsize))
     alpha, beta, knorm = float(_lit(alpha)), float(_lit(beta)), float(_lit(knorm))
     sq = jnp.square(data)
     half = nsize // 2
-    summed = lax.reduce_window(
-        sq, 0.0, lax.add, (1, nsize, 1, 1), (1, 1, 1, 1), ((0, 0), (half, half), (0, 0), (0, 0))
-    )
+    c = data.shape[1]
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    summed = padded[:, 0:c]
+    for k in range(1, nsize):
+        summed = summed + padded[:, k:k + c]
     return data * jnp.power(knorm + alpha / nsize * summed, -beta)
 
 

@@ -444,14 +444,15 @@ class Server:
         st.version += 1
 
     def _handle_command(self, head, payload):
-        """One worker command (reference kStopServer/kController heads).
-
-        head 0 carries the pickled optimizer (set_optimizer); a user
-        controller (MXKVStoreRunServer) sees every command first."""
+        """One worker command.  A user controller (MXKVStoreRunServer)
+        OWNS command semantics — every head goes to it and the default
+        handling is skipped (reference KVStoreDistServer::set_controller
+        replaces the built-in controller).  Without one, head 0 carries
+        the pickled optimizer (set_optimizer) and other heads are
+        acknowledged no-ops."""
         if self.command_hook is not None:
             self.command_hook(head, payload)
-            if head != 0:
-                return
+            return
         if head != 0:
             return
         optimizer = pickle.loads(payload)

@@ -213,16 +213,24 @@ def _perms(n):
 
 
 def run_schedule(sched, branches, params_row, mb_flat, labels_mb, base_rng,
-                 axis_name="pipe"):
+                 axis_name="pipe", aux_row=None):
     """Execute a Schedule inside `shard_map` over `axis_name`.
 
-    branches  : S fns (params_row, x_flat, label_mb, rng) -> y_flat, all
-                operating on [Bmax] flat boundary buffers (see module doc).
+    branches  : S fns (params_row, aux_row, x_flat, label_mb, rng) ->
+                (y_flat, new_aux_row), all operating on [Bmax] flat
+                boundary buffers (see module doc).
     params_row: [P] — this device's stage parameters, flat.
+    aux_row   : [A] — this device's stage auxiliary states (BatchNorm
+                running stats), flat; updated on every F pass in
+                microbatch order (the GPipe recipe: each microbatch is
+                normalized with ITS OWN batch statistics — identical to
+                sequential gradient accumulation over the microbatches —
+                and the EMA accumulates once per microbatch).
     mb_flat   : [M, Bmax] — flattened input microbatches (stage 0 injects).
     labels_mb : [M, ...] — per-microbatch labels (consumed by stages whose
                 graphs have label arguments, typically the last).
-    Returns (outputs [M, Bmax] replicated along the axis, param_grad [P]).
+    Returns (outputs [M, Bmax] replicated along the axis, param_grad [P],
+    updated aux_row [A]).
     """
     S = sched.num_stages
     M = sched.num_microbatches
@@ -233,12 +241,14 @@ def run_schedule(sched, branches, params_row, mb_flat, labels_mb, base_rng,
            "xrecv_w", "grecv_w")}
     bmax = mb_flat.shape[1]
     zero_buf = jnp.zeros((bmax,), mb_flat.dtype)
+    if aux_row is None:
+        aux_row = jnp.zeros((1,), jnp.float32)
 
-    def fwd_at(p, x, lab, rng):
-        return lax.switch(s_idx, branches, p, x, lab, rng)
+    def fwd_at(p, a, x, lab, rng):
+        return lax.switch(s_idx, branches, p, a, x, lab, rng)
 
     def step(carry, t):
-        x_ring, g_ring, stash, pgrad, outbuf = carry
+        x_ring, g_ring, stash, pgrad, outbuf, aux = carry
         act = tb["act"][t, s_idx]
         m = tb["mb"][t, s_idx]
         lab = labels_mb[m]
@@ -247,29 +257,35 @@ def run_schedule(sched, branches, params_row, mb_flat, labels_mb, base_rng,
         # stage), never off the step index
         rng = jax.random.fold_in(jax.random.fold_in(base_rng, m), s_idx)
 
-        def do_noop(x_ring, g_ring, stash, pgrad, outbuf):
-            return zero_buf, zero_buf, stash, pgrad, outbuf
+        def do_noop(x_ring, g_ring, stash, pgrad, outbuf, aux):
+            return zero_buf, zero_buf, stash, pgrad, outbuf, aux
 
-        def do_f(x_ring, g_ring, stash, pgrad, outbuf):
+        def do_f(x_ring, g_ring, stash, pgrad, outbuf, aux):
             xr = tb["xin_r"][t, s_idx]
             x_in = jnp.where(xr < 0, mb_flat[m], x_ring[jnp.maximum(xr, 0)])
-            y = fwd_at(params_row, x_in, lab, rng)
+            y, aux = fwd_at(params_row, aux, x_in, lab, rng)
             stash = stash.at[tb["stash_w"][t, s_idx]].set(x_in)
             outbuf = jnp.where(s_idx == S - 1, outbuf.at[m].set(y), outbuf)
-            return y, zero_buf, stash, pgrad, outbuf
+            return y, zero_buf, stash, pgrad, outbuf, aux
 
-        def do_b(x_ring, g_ring, stash, pgrad, outbuf):
+        def do_b(x_ring, g_ring, stash, pgrad, outbuf, aux):
             x_in = stash[tb["stash_r"][t, s_idx]]
+            # aux is closed over, not differentiated: train-mode BN
+            # normalizes with batch stats recomputed from the stashed
+            # x_in, so the recompute reproduces F exactly; the EMA
+            # update was already taken at F time
             _, vjpf = jax.vjp(
-                lambda p, x: fwd_at(p, x, lab, rng), params_row, x_in)
+                lambda p, x: fwd_at(p, aux, x, lab, rng)[0],
+                params_row, x_in)
             gr = tb["gin_r"][t, s_idx]
             g_in = jnp.where(gr < 0, jnp.ones_like(zero_buf),
                              g_ring[jnp.maximum(gr, 0)])
             dp, dx = vjpf(g_in)
-            return zero_buf, dx, stash, pgrad + dp, outbuf
+            return zero_buf, dx, stash, pgrad + dp, outbuf, aux
 
-        send_x, send_g, stash, pgrad, outbuf = lax.switch(
-            act, (do_noop, do_f, do_b), x_ring, g_ring, stash, pgrad, outbuf)
+        send_x, send_g, stash, pgrad, outbuf, aux = lax.switch(
+            act, (do_noop, do_f, do_b), x_ring, g_ring, stash, pgrad,
+            outbuf, aux)
         x_in_flight = lax.ppermute(send_x, axis_name, fwd_perm)
         g_in_flight = lax.ppermute(send_g, axis_name, bwd_perm)
         xw = tb["xrecv_w"][t, s_idx]
@@ -278,7 +294,7 @@ def run_schedule(sched, branches, params_row, mb_flat, labels_mb, base_rng,
         gw = tb["grecv_w"][t, s_idx]
         g_ring = jnp.where(gw < 0, g_ring,
                            g_ring.at[jnp.maximum(gw, 0)].set(g_in_flight))
-        return (x_ring, g_ring, stash, pgrad, outbuf), None
+        return (x_ring, g_ring, stash, pgrad, outbuf, aux), None
 
     carry0 = (
         jnp.zeros((sched.n_xring, bmax), mb_flat.dtype),
@@ -286,21 +302,27 @@ def run_schedule(sched, branches, params_row, mb_flat, labels_mb, base_rng,
         jnp.zeros((sched.n_stash, bmax), mb_flat.dtype),
         jnp.zeros_like(params_row),
         jnp.zeros((M, bmax), mb_flat.dtype),
+        aux_row,
     )
-    (_, _, _, pgrad, outbuf), _ = lax.scan(
+    (_, _, _, pgrad, outbuf, aux_row), _ = lax.scan(
         step, carry0, jnp.arange(sched.num_steps))
     # only the last stage wrote outputs; psum replicates them along 'pipe'
     outbuf = lax.psum(outbuf, axis_name)
-    return outbuf, pgrad
+    return outbuf, pgrad, aux_row
 
 
 def run_forward(num_stages, num_microbatches, branches, params_row, mb_flat,
-                labels_mb, base_rng, axis_name="pipe"):
-    """Forward-only pipeline (inference/eval): plain fill-and-drain shifts."""
+                labels_mb, base_rng, axis_name="pipe", aux_row=None):
+    """Forward-only pipeline (inference/eval): plain fill-and-drain shifts.
+
+    Eval-mode BN reads the moving stats from aux_row and leaves them
+    unchanged (branch aux updates are discarded)."""
     S, M = num_stages, num_microbatches
     s_idx = lax.axis_index(axis_name)
     fwd_perm, _ = _perms(S)
     ticks = M + S - 1
+    if aux_row is None:
+        aux_row = jnp.zeros((1,), jnp.float32)
 
     def tick(carry, t):
         x_recv, outbuf = carry
@@ -308,7 +330,8 @@ def run_forward(num_stages, num_microbatches, branches, params_row, mb_flat,
         lab = labels_mb[m]
         rng = jax.random.fold_in(jax.random.fold_in(base_rng, m), s_idx)
         x_in = jnp.where(s_idx == 0, mb_flat[jnp.clip(t, 0, M - 1)], x_recv)
-        y = lax.switch(s_idx, branches, params_row, x_in, lab, rng)
+        y, _ = lax.switch(s_idx, branches, params_row, aux_row, x_in, lab,
+                          rng)
         write = (s_idx == S - 1) & (t >= S - 1)
         outbuf = jnp.where(write, outbuf.at[jnp.clip(t - S + 1, 0, M - 1)].set(y),
                            outbuf)

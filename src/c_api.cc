@@ -1175,9 +1175,26 @@ int MXAutogradBackward(unsigned num_output, void **output_handles,
                        void **ograd_handles, int retain_graph) {
   Gil gil;
   PyObject *outs = handle_list(num_output, output_handles);
-  PyObject *ogs = ograd_handles
-                      ? handle_list(num_output, ograd_handles)
-                      : (Py_INCREF(Py_None), Py_None);
+  // reference ABI: individual ograd entries may be NULL ("use a
+  // ones-gradient for this output") — map them to python None instead
+  // of dereferencing
+  PyObject *ogs;
+  if (ograd_handles) {
+    ogs = PyList_New(num_output);
+    for (unsigned i = 0; ogs && i < num_output; ++i) {
+      if (ograd_handles[i]) {
+        PyObject *o = unwrap(ograd_handles[i]);
+        Py_INCREF(o);
+        PyList_SET_ITEM(ogs, i, o);
+      } else {
+        Py_INCREF(Py_None);
+        PyList_SET_ITEM(ogs, i, Py_None);
+      }
+    }
+  } else {
+    Py_INCREF(Py_None);
+    ogs = Py_None;
+  }
   PyObject *r = (outs && ogs)
                     ? impl_call("autograd_backward",
                                 Py_BuildValue("(OOi)", outs, ogs,

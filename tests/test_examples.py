@@ -21,6 +21,9 @@ FAST_EXAMPLES = [
     "examples/bi-lstm-sort/lstm_sort.py",
     "examples/neural-style/nstyle.py",
     "examples/reinforcement-learning/actor_critic_gridworld.py",
+    "examples/svm_mnist/svm_mnist.py",
+    "examples/fcn-xs/fcn_xs.py",
+    "examples/warpctc/lstm_ocr.py",
 ]
 
 

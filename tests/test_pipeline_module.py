@@ -194,7 +194,108 @@ def test_pipeline_checkpoint_roundtrip(tmp_path):
                                    err_msg=n)
 
 
-def test_pipeline_rejects_batchnorm_stage():
+def test_pipeline_batchnorm_matches_grad_accumulation():
+    """Conv+BN stages pipeline with GPipe microbatch-BN semantics: params
+    AND aux states after 2 SGD steps match a sequential executor doing
+    per-microbatch gradient accumulation over the same microbatches
+    (each microbatch normalized by its own stats, EMA per microbatch —
+    the documented equivalence, pipeline_module.py module doc)."""
+    F = (4, 6, 8, 4)
+    B, M, LR, STEPS = 16, 4, 0.1, 2
+    rows = B // M
+
+    def bn_stage(i):
+        x = mx.sym.Variable("data")
+        x = mx.sym.Convolution(x, num_filter=F[i], kernel=(3, 3),
+                               pad=(1, 1), name="conv%d" % i)
+        x = mx.sym.BatchNorm(x, name="bn%d" % i)
+        x = mx.sym.Activation(x, act_type="relu", name="relu%d" % i)
+        if i == S - 1:
+            x = mx.sym.Flatten(x)
+            x = mx.sym.FullyConnected(x, num_hidden=5, name="head")
+            x = mx.sym.SoftmaxOutput(x, name="softmax")
+        return x
+
+    def full_net():
+        x = mx.sym.Variable("data")
+        for i in range(S):
+            x = mx.sym.Convolution(x, num_filter=F[i], kernel=(3, 3),
+                                   pad=(1, 1), name="conv%d" % i)
+            x = mx.sym.BatchNorm(x, name="bn%d" % i)
+            x = mx.sym.Activation(x, act_type="relu", name="relu%d" % i)
+        x = mx.sym.Flatten(x)
+        x = mx.sym.FullyConnected(x, num_hidden=5, name="head")
+        return mx.sym.SoftmaxOutput(x, name="softmax")
+
+    net = full_net()
+    arg_shapes, _, aux_shapes = net.infer_shape(
+        data=(B, 3, 8, 8), softmax_label=(B,))
+    arg_names = net.list_arguments()
+    shapes = {n: tuple(s) for n, s in zip(arg_names, arg_shapes)
+              if n not in ("data", "softmax_label")}
+    init = _det_params(shapes)
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(B, 3, 8, 8).astype(np.float32)
+    y = rng.randint(0, 5, B).astype(np.float32)
+
+    # --- pipeline run
+    mesh = _mesh({"pipe": S})
+    mod = mx.mod.PipelineModule(bn_stage, num_stages=S, num_microbatches=M,
+                                mesh=mesh, schedule="1f1b")
+    mod.bind(data_shapes=[("data", (B, 3, 8, 8))],
+             label_shapes=[("softmax_label", (B,))])
+    mod.init_params(arg_params=init)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": LR,
+                                         "momentum": 0.0, "wd": 0.0})
+    for _ in range(STEPS):
+        mod.forward(_batch(X, y))
+        mod.backward()
+        mod.update()
+    args_p, auxs_p = mod.get_params()
+
+    # --- sequential grad-accumulation reference: one executor at
+    # microbatch size, grad_req=add, M fwd/bwd per step, manual SGD
+    import jax.numpy as jnp
+    exe = net.simple_bind(mx.cpu(), grad_req={
+        n: ("null" if n in ("data", "softmax_label") else "add")
+        for n in arg_names}, data=(rows, 3, 8, 8),
+        softmax_label=(rows,))
+    for n, v in init.items():
+        exe.arg_dict[n][:] = v
+    for n, a in exe.aux_dict.items():  # match Module aux init by name
+        a[:] = (np.ones(a.shape, np.float32) if "moving_var" in n
+                else np.zeros(a.shape, np.float32))
+    for st in range(STEPS):
+        for g in exe.grad_dict.values():
+            if g is not None:
+                g[:] = np.zeros(g.shape, np.float32)
+        for m in range(M):
+            exe.arg_dict["data"][:] = X[m * rows:(m + 1) * rows]
+            exe.arg_dict["softmax_label"][:] = y[m * rows:(m + 1) * rows]
+            exe.forward(is_train=True)
+            exe.backward()
+        for n in shapes:
+            g = exe.grad_dict[n]
+            exe.arg_dict[n][:] = (exe.arg_dict[n].asnumpy()
+                                  - LR * g.asnumpy() / B)
+
+    for n in sorted(shapes):
+        np.testing.assert_allclose(
+            args_p[n].asnumpy(), exe.arg_dict[n].asnumpy(),
+            rtol=2e-4, atol=2e-5, err_msg=n)
+    aux_names = net.list_auxiliary_states()
+    assert set(auxs_p) == set(aux_names)
+    for n in sorted(aux_names):
+        np.testing.assert_allclose(
+            auxs_p[n].asnumpy(), exe.aux_dict[n].asnumpy(),
+            rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_pipeline_batchnorm_with_data_parallel_smoke():
+    """Conv+BN pipeline composed with a data axis runs and converges a
+    step (aux EMAs are pmean-merged across DP replicas)."""
     def bn_stage(i):
         x = mx.sym.Variable("data")
         x = mx.sym.FullyConnected(x, num_hidden=8, name="fc%d" % i)
@@ -203,10 +304,23 @@ def test_pipeline_rejects_batchnorm_stage():
             x = mx.sym.SoftmaxOutput(x, name="softmax")
         return x
 
-    mesh = _mesh({"pipe": S})
-    with pytest.raises(mx.base.MXNetError, match="auxiliary states"):
-        mx.mod.PipelineModule(bn_stage, num_stages=S, num_microbatches=4,
-                              mesh=mesh)
+    mesh = _mesh({"pipe": S, "data": 2})
+    mod = mx.mod.PipelineModule(bn_stage, num_stages=S, num_microbatches=4,
+                                mesh=mesh)
+    mod.bind(data_shapes=[("data", (32, 10))],
+             label_shapes=[("softmax_label", (32,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    X, y = _data(32)
+    mod.forward(_batch(X, y))
+    mod.backward()
+    mod.update()
+    _, auxs = mod.get_params()
+    assert any("moving_mean" in n for n in auxs)
+    # moving stats moved off their init after a training step
+    mm = [a.asnumpy() for n, a in auxs.items() if "moving_mean" in n]
+    assert any(np.abs(a).max() > 0 for a in mm)
 
 
 def test_pipeline_optimizer_states_roundtrip(tmp_path):

@@ -65,3 +65,62 @@ def test_collective_bytes_async_forms():
     assert by["all-reduce"] == 1000 * 4
     assert by["all-gather"] == 64 * 2
     assert counts == {"all-reduce": 1, "all-gather": 1}
+
+
+def test_pp_leg_counts_ring_traffic():
+    """PipelineModule leg: the HLO's collective-permute payload is exactly
+    the two boundary rings (x forward + g backward), each one flat
+    microbatch buffer of rows*hidden fp32; the schedule multiplies by its
+    step count in the model."""
+    from scaling_model import _compile_pp, analyze_axis
+
+    rec = _compile_pp(8, stages=4, microbatches=4, rows_per_replica=4,
+                      hidden=64)
+    unit = rec["boundary_floats"] * 4  # bmax floats (widest boundary)
+    assert rec["collective_result_bytes"]["collective-permute"] == 2 * unit
+    assert rec["collective_counts"]["collective-permute"] == 2
+    assert rec["scan_trip_count"] > 0
+    assert 0.0 < rec["bubble_fraction"] < 1.0
+    out = analyze_axis(dict(rec))
+    assert 0 < out["efficiency_axis"] < 1.0
+    assert out["efficiency_bubble_only"] == round(
+        1.0 - rec["bubble_fraction"], 4)
+
+
+def test_ep_leg_counts_all_to_all():
+    """MoE leg (explicit lax.all_to_all path): every all_to_all moves the
+    per-device dispatch buffer [E, capacity, D] fp32."""
+    from scaling_model import _compile_ep, analyze_axis
+
+    experts, d_model, tokens = 4, 32, 64
+    rec = _compile_ep(8, experts=experts, d_model=d_model, hidden=64,
+                      tokens_per_replica=tokens, capacity_factor=2.0)
+    # tokens are sharded over data x expert: per-device token count
+    per_dev_tokens = tokens * rec["dp"] // (rec["dp"] * experts)
+    capacity = int(np.ceil(2 * per_dev_tokens * 2.0 / experts))
+    unit = experts * capacity * d_model * 4
+    a2a = rec["collective_result_bytes"]["all-to-all"]
+    assert a2a % unit == 0, (a2a, unit)
+    assert a2a // unit >= 3  # fwd dispatch+combine and backward
+    out = analyze_axis(dict(rec))
+    assert 0 < out["efficiency_axis"] <= 1.0
+    assert out["balance_hidden"] > 0
+
+
+def test_sp_leg_counts_kv_ring():
+    """RingAttention leg: each collective-permute moves one K or V block
+    [B_local, S_local, H, Dh] fp32 (K+V, forward + backward)."""
+    from scaling_model import _compile_sp, analyze_axis
+
+    rec = _compile_sp(8, seq_shards=4, seq=64, heads=2, head_dim=8,
+                      batch_per_replica=2)
+    b_loc = 2  # per data replica
+    s_loc = 64 // 4
+    unit = b_loc * s_loc * 2 * 8 * 4
+    cp = rec["collective_result_bytes"]["collective-permute"]
+    assert cp % unit == 0, (cp, unit)
+    assert cp // unit == 4  # K,V in forward and backward
+    assert rec["scan_trip_count"] == 3  # seq_shards - 1 ring hops
+    out = analyze_axis(dict(rec))
+    assert 0 < out["efficiency_axis"] <= 1.0
+    assert out["balance_seq_per_shard"] > 0

@@ -188,7 +188,11 @@ def _stack(rng, k, shape, dtype="float32", hi=None):
     return jax.device_put(a)
 
 
-def bench_inference(name, sym_fn, image_shape, baseline, batch=32, k=16):
+def bench_inference(name, sym_fn, image_shape, baseline, batch=32, k=64,
+                    note=""):
+    # k=64: a fast model at batch 32 finishes 16 batches in ~20-40 ms of
+    # device time, so k=16 left the ~11 ms tunnel dispatch as 20-30% of
+    # wall (round-5 MFU audit) — 64 batches/dispatch amortizes it <7%
     net = sym_fn()
     mod = _bind_module(net, (batch,) + image_shape, None, for_training=False)
     rng = np.random.RandomState(0)
@@ -199,12 +203,12 @@ def bench_inference(name, sym_fn, image_shape, baseline, batch=32, k=16):
     per_s = k * batch / dt
     _row("Inference %s img/s" % name, per_s, "img/s", baseline,
          "batch %d bf16, %d batches/dispatch (lax.scan), 1 chip vs 1x P100 "
-         "fp32" % (batch, k),
+         "fp32%s" % (batch, k, (". MFU: " + note) if note else ""),
          mfu=_flops(compiled, k) / dt / V5E_PEAK_FLOPS)
 
 
-def bench_train(name, sym_fn, image_shape, baseline, batch=32, k=8,
-                classes=1000):
+def bench_train(name, sym_fn, image_shape, baseline, batch=32, k=16,
+                classes=1000, note=""):
     net = sym_fn()
     mod = _bind_module(net, (batch,) + image_shape, (batch,))
     rng = np.random.RandomState(0)
@@ -223,16 +227,13 @@ def bench_train(name, sym_fn, image_shape, baseline, batch=32, k=8,
     per_s = k * batch / dt
     _row("Training %s img/s" % name, per_s, "img/s", baseline,
          "batch %d bf16+fp32 master, fwd+bwd+SGD, %d steps/dispatch "
-         "(lax.scan carry), 1 chip vs 1x P100 fp32" % (batch, k),
+         "(lax.scan carry), 1 chip vs 1x P100 fp32%s"
+         % (batch, k, (". MFU: " + note) if note else ""),
          mfu=_flops(compiled, k) / dt / V5E_PEAK_FLOPS)
 
 
-def bench_lstm_ptb(k=8):
-    """LSTM language model, PTB config (reference example/rnn/lstm_bucketing.py
-    defaults: 2x200 LSTM, embed 200, vocab 10k, bptt 35, batch 32)."""
+def _lstm_row(row_name, vocab, embed, hidden, layers, seq, batch, k, note=""):
     import mxnet_tpu as mx
-
-    vocab, embed, hidden, layers, seq, batch = 10000, 200, 200, 2, 35, 32
     cell = mx.rnn.FusedRNNCell(hidden, num_layers=layers, mode="lstm",
                                prefix="lstm_")
     data = mx.sym.Variable("data")
@@ -256,11 +257,32 @@ def bench_lstm_ptb(k=8):
 
     call.state = state
     dt = _time_compiled(call, lambda r: np.asarray(r[0][0].reshape(-1)[0]))
-    _row("Training LSTM-PTB tokens/s", k * batch * seq / dt, "tokens/s", None,
-         "2x200 LSTM (lax.scan fused), bptt 35, batch 32, bf16, %d "
-         "steps/dispatch; reference example/rnn/lstm_bucketing.py config "
-         "(no published reference number)" % k,
+    _row("Training %s tokens/s" % row_name, k * batch * seq / dt, "tokens/s",
+         None,
+         "%dx%d LSTM (lax.scan fused), bptt %d, batch %d, bf16, %d "
+         "steps/dispatch%s" % (layers, hidden, seq, batch, k,
+                               (". MFU: " + note) if note else ""),
          mfu=_flops(compiled, k) / dt / V5E_PEAK_FLOPS)
+
+
+def bench_lstm_ptb(k=8):
+    """LSTM language model, PTB config (reference example/rnn/lstm_bucketing.py
+    defaults: 2x200 LSTM, embed 200, vocab 10k, bptt 35, batch 32)."""
+    _lstm_row("LSTM-PTB", 10000, 200, 200, 2, 35, 32, k,
+              note="latency-bound by design: per scan tick each layer's "
+                   "gate matmul is [32,400]x[400,800] (20 MFLOP) — M=32 "
+                   "rows underfill the MXU and 70 sequential tick-layers "
+                   "serialize; the MXU-shaped row below is the same code "
+                   "at a modern size. Reference "
+                   "example/rnn/lstm_bucketing.py config (no published "
+                   "reference number)")
+
+
+def bench_lstm_large(k=8):
+    """MXU-shaped LSTM: 4x1024, batch 512 — the same fused-RNN code path
+    at a size whose gate matmuls ([512,2048]x[2048,4096]) fill the MXU."""
+    _lstm_row("LSTM-4x1024", 10000, 1024, 1024, 4, 35, 512, k,
+              note="same fused-RNN kernel as LSTM-PTB at MXU-filling size; residual vs conv models is the sequential scan dependency (140 tick-layers serialize per step)")
 
 
 def bench_ssd(k=6):
@@ -430,20 +452,48 @@ def main():
     from mxnet_tpu.models.inception_v3 import get_inception_v3
     from mxnet_tpu.models.resnet import resnet
 
+    # MFU notes: measured per-stage device-trace attribution
+    # (tools/mfu_decompose.py, round-5 audit) — see README "Per-model MFU"
     jobs = [
         ("inference resnet-50", lambda: bench_inference(
-            "ResNet-50", lambda: resnet(50), (3, 224, 224), 713.17)),
+            "ResNet-50", lambda: resnet(50), (3, 224, 224), 713.17,
+            note="resolution mix — 34% of device time is the 3-block "
+                 "56x56/C=64 stage (~25% stage MFU: 64-wide channels fill "
+                 "half the 128-lane MXU on both contraction and output) "
+                 "plus stem conv C_in=3 at ~12%; the 14x14/C=1024 blocks "
+                 "run near peak")),
         ("inference resnet-152", lambda: bench_inference(
-            "ResNet-152", lambda: resnet(152), (3, 224, 224), 294.17)),
+            "ResNet-152", lambda: resnet(152), (3, 224, 224), 294.17,
+            note="its 30 extra blocks over RN-50 are all 14x14/C=1024 "
+                 "near-peak stages (53% of device time), diluting the "
+                 "same fixed stem/56x56 cost RN-50 pays")),
         ("inference inception-v3", lambda: bench_inference(
-            "Inception-v3", get_inception_v3, (3, 299, 299), 493.72)),
+            "Inception-v3", get_inception_v3, (3, 299, 299), 493.72,
+            note="stem-bound — 46% of device time is the 147x147/71x71 "
+                 "C=32..192 stem convs (tiny channel counts at huge "
+                 "resolution), a structural property of the v3 stem")),
         ("inference alexnet", lambda: bench_inference(
-            "AlexNet", get_alexnet, (3, 224, 224), 4883.77)),
+            "AlexNet", get_alexnet, (3, 224, 224), 4883.77,
+            note="was LRN-bound (53% of device time in cross-channel "
+                 "reduce_window, now 5 shifted adds — round-5 fix "
+                 "halved device time); remainder is 54x54/C=96 convs "
+                 "and the grouped-conv split")),
         ("training resnet-50 b32", lambda: bench_train(
-            "ResNet-50 (batch 32)", lambda: resnet(50), (3, 224, 224), 181.53)),
+            "ResNet-50 (batch 32)", lambda: resnet(50), (3, 224, 224),
+            181.53,
+            note="same 56x56/C=64 + stem fractions as inference, plus "
+                 "exact-BN backward reductions (README Roofline item 6: "
+                 "frozen-BN +17.9%)")),
         ("training inception-v3 b32", lambda: bench_train(
-            "Inception-v3 (batch 32)", get_inception_v3, (3, 299, 299), 129.98)),
+            "Inception-v3 (batch 32)", get_inception_v3, (3, 299, 299),
+            129.98,
+            note="fragmentation — 27% of device time is small-kernel "
+                 "weight-grad convs (f32 [C,C,3,3] outputs, C<=384) and "
+                 "~40% per-branch BN/bias backward reductions at "
+                 "C=32..192: hundreds of tiny ops that underfill the "
+                 "MXU, vs ResNet's uniform large blocks")),
         ("lstm ptb", bench_lstm_ptb),
+        ("lstm large", bench_lstm_large),
         ("ssd", bench_ssd),
         ("input pipeline", bench_input_pipeline),
     ]

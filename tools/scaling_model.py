@@ -203,6 +203,313 @@ def analyze(rec, measured_1chip_img_s=2502.0):
     return rec
 
 
+def _lower_text_and_flops(jitted, *args, mesh=None):
+    import contextlib
+
+    cm = mesh or contextlib.nullcontext()
+    with cm:
+        compiled = jitted.lower(*args).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    return compiled.as_text(), float(ca.get("flops", 0.0))
+
+
+def _compile_pp(n_devices, stages=4, microbatches=8, rows_per_replica=8,
+                hidden=2048):
+    """PipelineModule leg: count the schedule's ppermute ring traffic and
+    combine with the simulator's bubble fraction.
+
+    The x/g boundary rings live INSIDE the schedule's lax.scan, so the
+    HLO counts each permute once — multiply by the schedule step count.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()[:n_devices]
+    dp = n_devices // stages
+    mesh = make_mesh({"data": dp, "pipe": stages} if dp > 1
+                     else {"pipe": stages}, devices=devices)
+    batch = rows_per_replica * microbatches * max(dp, 1)
+
+    def stage(i):
+        x = mx.sym.Variable("data")
+        x = mx.sym.FullyConnected(x, num_hidden=hidden, name="fc%da" % i)
+        x = mx.sym.Activation(x, act_type="relu")
+        x = mx.sym.FullyConnected(x, num_hidden=hidden, name="fc%db" % i)
+        x = mx.sym.Activation(x, act_type="relu")
+        if i == stages - 1:
+            x = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+                x, num_hidden=128, name="head"), name="softmax")
+        return x
+
+    mod = mx.mod.PipelineModule(stage, num_stages=stages,
+                                num_microbatches=microbatches, mesh=mesh,
+                                schedule="1f1b")
+    mod.bind(data_shapes=[("data", (batch, hidden))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    mbs, labs = mod._split_host(
+        np.zeros((batch, hidden), np.float32),
+        np.zeros((batch,), np.float32))
+    jf = mod._get_train_jit()
+    text, flops = _lower_text_and_flops(
+        jf, mod._buffer, mod._aux_buffer, mod._opt_state, mbs, labs,
+        jnp.asarray([0], jnp.uint32), jnp.float32(0.1), jnp.float32(0.0),
+        jnp.uint32(1))
+    coll, counts = collective_bytes(text)
+    st = mod.schedule_stats
+    trip = int(mod._sched.num_steps)
+    assert coll.get("collective-permute"), \
+        "no ppermute found in the pipeline HLO — parser out of date?"
+    return {"leg": "pp", "n_devices": n_devices, "stages": stages,
+            "dp": dp, "microbatches": microbatches,
+            "global_batch": batch, "hidden": hidden,
+            "boundary_floats": int(mod._bmax),
+            "per_chip_flops": flops,
+            "collective_result_bytes": coll, "collective_counts": counts,
+            "scan_trip_count": trip,
+            "bubble_fraction": float(st["bubble_fraction"]),
+            "stash_slots": int(st["max_stash_slots"])}
+
+
+def _compile_ep(n_devices, experts=4, d_model=1024, hidden=2048,
+                tokens_per_replica=256, capacity_factor=2.0):
+    """Expert-parallel leg on the EXPLICIT all_to_all path
+    (parallel/moe.py moe_sharded): count the token dispatch/combine
+    all_to_all traffic of a full grad step.
+
+    The library path is the modeling object because its collectives are
+    hand-written `lax.all_to_all` — the GSPMD path (mx.sym.MoE) leaves
+    the resharding strategy to the partitioner, which on the CPU backend
+    lowers it as all-gather+all-reduce (observed; the analytic all_to_all
+    volume is the TPU lower bound either way)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from mxnet_tpu.parallel.mesh import P, make_mesh
+    from mxnet_tpu.parallel.moe import moe_sharded
+
+    devices = jax.devices()[:n_devices]
+    dp = n_devices // experts
+    mesh = make_mesh({"data": dp, "expert": experts} if dp > 1
+                     else {"expert": experts}, devices=devices)
+    data_axis = "data" if dp > 1 else None
+    tokens = tokens_per_replica * max(dp, 1)
+
+    def expert_fn(p, x):
+        h = jax.nn.relu(x @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    params = {
+        "w1": jnp.zeros((experts, d_model, hidden), jnp.float32),
+        "b1": jnp.zeros((experts, hidden), jnp.float32),
+        "w2": jnp.zeros((experts, hidden, d_model), jnp.float32),
+        "b2": jnp.zeros((experts, d_model), jnp.float32),
+    }
+
+    def train_step(p, gate_w, x, lr):
+        def loss(pp, gw):
+            y = moe_sharded(mesh, expert_fn, pp, x, gw, k=2,
+                            capacity_factor=capacity_factor,
+                            data_axis=data_axis)
+            return jnp.mean(y ** 2)
+
+        gp, gg = jax.grad(loss, argnums=(0, 1))(p, gate_w)
+        newp = jax.tree_util.tree_map(lambda w, g: w - lr * g, p, gp)
+        return newp, gate_w - lr * gg
+
+    pspec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, P("expert"))),
+        params)
+    tok_axes = P((data_axis, "expert")) if data_axis else P("expert")
+    x_aval = jax.ShapeDtypeStruct((tokens, d_model), jnp.float32,
+                                  sharding=NamedSharding(mesh, tok_axes))
+    gw_aval = jax.ShapeDtypeStruct((d_model, experts), jnp.float32,
+                                   sharding=NamedSharding(mesh, P()))
+    text, flops = _lower_text_and_flops(
+        jax.jit(train_step), pspec, gw_aval, x_aval,
+        jax.ShapeDtypeStruct((), jnp.float32), mesh=mesh)
+    coll, counts = collective_bytes(text)
+    assert coll.get("all-to-all"), \
+        "no all_to_all found in the MoE HLO — parser out of date?"
+    return {"leg": "ep", "n_devices": n_devices, "experts": experts,
+            "dp": dp, "d_model": d_model, "hidden": hidden,
+            "tokens_per_replica": tokens_per_replica,
+            "capacity_factor": capacity_factor,
+            "per_chip_flops": flops,
+            "collective_result_bytes": coll,
+            "collective_counts": counts, "scan_trip_count": 1}
+
+
+def _compile_sp(n_devices, seq_shards=4, seq=1024, heads=8, head_dim=64,
+                batch_per_replica=4):
+    """mx.sym.RingAttention leg: count the ring K/V ppermute traffic (the
+    ring lives inside a scan — multiply by its trip count)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.executor import _run_graph
+    from mxnet_tpu.parallel.mesh import P, make_mesh
+
+    devices = jax.devices()[:n_devices]
+    dp = n_devices // seq_shards
+    mesh = make_mesh({"data": dp, "seq": seq_shards} if dp > 1
+                     else {"seq": seq_shards}, devices=devices)
+    batch = batch_per_replica * max(dp, 1)
+    D = heads * head_dim
+
+    def net():
+        x = mx.sym.Variable("data")
+        qkv = mx.sym.FullyConnected(x, num_hidden=3 * D, flatten=False,
+                                    name="qkv")
+        qkv = mx.sym.reshape(qkv, shape=(0, seq, heads, 3 * head_dim))
+        q = mx.sym.slice_axis(qkv, axis=3, begin=0, end=head_dim)
+        k = mx.sym.slice_axis(qkv, axis=3, begin=head_dim,
+                              end=2 * head_dim)
+        v = mx.sym.slice_axis(qkv, axis=3, begin=2 * head_dim,
+                              end=3 * head_dim)
+        a = mx.sym.RingAttention(q, k, v, causal=True, name="attn")
+        a = mx.sym.reshape(a, shape=(0, seq, D))
+        # mean-pool the sequence before the head so head params stay
+        # O(D) — a flattened [seq*D] head would add an unrealistic
+        # multi-hundred-MB parameter whose DP all-reduce drowns the
+        # ring-attention traffic this leg exists to count
+        a = mx.sym.mean(a, axis=1)
+        return mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(a, num_hidden=128, name="out_fc"),
+            name="softmax")
+
+    exe = net().simple_bind(mx.cpu(), mesh=mesh, data=(batch, seq, D),
+                            softmax_label=(batch,))
+    an, xn = exe._arg_names, exe._aux_names
+    entries, order = exe._entries, exe._order
+    diff_idx = [an.index(nm) for nm in an
+                if nm not in ("data", "softmax_label")]
+    nondiff_idx = [i for i in range(len(an)) if i not in diff_idx]
+
+    def train_step(dv, ndv, lr):
+        def fwd(d):
+            vals = [None] * len(an)
+            for i, v in zip(diff_idx, d):
+                vals[i] = v
+            for i, v in zip(nondiff_idx, ndv):
+                vals[i] = v
+            outs, _ = _run_graph(entries, order, an, xn, tuple(vals), (),
+                                 True, None, mesh=mesh)
+            return outs
+        outs, vjp_fn = jax.vjp(fwd, dv)
+        (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+        return tuple(p - lr * g for p, g in zip(dv, grads))
+
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(
+        mesh, P("data", "seq") if dp > 1 else P(None, "seq"))
+    label_sh = NamedSharding(mesh, P("data") if dp > 1 else P())
+    args = exe._gather_args()
+
+    def aval(arr, sh):
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype, sharding=sh)
+
+    dv_avals = tuple(aval(args[an.index(nm)], repl) for nm in an
+                     if nm not in ("data", "softmax_label"))
+    ndv_avals = tuple(
+        aval(args[i], data_sh if an[i] == "data" else label_sh)
+        for i in nondiff_idx)
+    text, flops = _lower_text_and_flops(
+        jax.jit(train_step), dv_avals, ndv_avals,
+        jax.ShapeDtypeStruct((), jnp.float32), mesh=mesh)
+    coll, counts = collective_bytes(text)
+    assert coll.get("collective-permute"), \
+        "no ring permute found in the RingAttention HLO"
+    return {"leg": "sp", "n_devices": n_devices, "seq_shards": seq_shards,
+            "dp": dp, "seq": seq, "heads": heads, "head_dim": head_dim,
+            "batch_per_replica": batch_per_replica,
+            "per_chip_flops": flops,
+            "collective_result_bytes": coll,
+            "collective_counts": counts,
+            # the K/V ring advances once per scan tick; each rank sends
+            # its block seq_shards-1 times per traversal
+            "scan_trip_count": seq_shards - 1}
+
+
+def analyze_axis(rec, effective_flops=0.305 * V5E_PEAK_FLOPS):
+    """Bandwidth model for the PP/EP/SP legs.
+
+    Two traffic components are reported SEPARATELY:
+      * axis traffic — the collectives the axis itself introduces
+        (boundary ppermute for PP, token all_to_all for EP, K/V ring
+        for SP); `efficiency_axis` charges only these (+ the PP bubble),
+        i.e. the marginal cost of turning the axis on.
+      * the data-parallel gradient all-reduce, which these toy configs
+        exaggerate (tiny per-replica batch vs full param set) and which
+        the DP section of SCALING.md models properly.
+    XLA cost analysis counts a lax.scan body ONCE, so per-leg
+    corrections apply: pp flops x microbatches (the schedule runs F+B
+    once per microbatch) and permute bytes x num_steps; sp permute
+    bytes x ring hops.  Each leg also reports its analytic BALANCE
+    threshold — the knob value at which the axis turns compute-bound on
+    v5e ICI at the sustained rate."""
+    cb = rec["collective_result_bytes"]
+    trip = rec.get("scan_trip_count", 1)
+    axis_kind = {"pp": "collective-permute", "ep": "all-to-all",
+                 "sp": "collective-permute"}[rec["leg"]]
+    # ring factors use the size of the GROUP each collective spans, not
+    # the whole device count: the axis collectives run over their own
+    # mesh axis (experts for the MoE all_to_all; permutes move one hop
+    # regardless), and the gradient all-reduce spans the 'data' axis
+    g_axis = {"pp": rec.get("stages", 1), "ep": rec.get("experts", 1),
+              "sp": rec.get("seq_shards", 1)}[rec["leg"]]
+    dp = max(rec.get("dp", 1), 1)
+    axis_factor = {"collective-permute": 1.0,
+                   "all-to-all": (g_axis - 1) / g_axis}[axis_kind]
+    dp_ring = {"all-reduce": 2.0 * (dp - 1) / dp,
+               "all-gather": (dp - 1) / dp,
+               "reduce-scatter": (dp - 1) / dp,
+               "all-to-all": (dp - 1) / dp,
+               "collective-permute": 1.0}
+    axis_traffic = cb.get(axis_kind, 0) * axis_factor * \
+        (trip if axis_kind == "collective-permute" else 1)
+    other_traffic = sum(v * dp_ring[k] for k, v in cb.items()
+                        if k != axis_kind)
+    balance = effective_flops / V5E_ICI_BW
+    flops = rec["per_chip_flops"]
+    if rec["leg"] == "pp":
+        flops *= rec["microbatches"]
+    elif rec["leg"] == "sp":
+        flops *= trip  # ring body runs once per hop (upper bound incl.
+        #                the out-of-scan qkv/head, over-counted (hops-1)x)
+    t_comp = flops / effective_flops
+    t_axis = axis_traffic / V5E_ICI_BW
+    eff_axis = t_comp / (t_comp + t_axis)
+    if rec["leg"] == "pp":
+        eff_axis *= (1.0 - rec["bubble_fraction"])
+        rec["efficiency_bubble_only"] = round(
+            1.0 - rec["bubble_fraction"], 4)
+    if rec["leg"] == "sp":
+        rec["balance_seq_per_shard"] = int(2 * balance)
+        rec["seq_per_shard"] = rec["seq"] // rec["seq_shards"]
+    if rec["leg"] == "ep":
+        rec["balance_hidden"] = int(2 * balance)
+    rec.update({
+        "axis_traffic_bytes": int(axis_traffic),
+        "dp_grad_traffic_bytes": int(other_traffic),
+        "t_compute_s": round(t_comp, 6),
+        "t_axis_comm_s": round(t_axis, 6),
+        "efficiency_axis": round(eff_axis, 4),
+        "machine_balance_flop_per_byte": int(balance),
+    })
+    return rec
+
+
 def run_child(n, tp, batch_per_chip, depth, image, classes):
     env = dict(os.environ)
     for k in list(env):
@@ -217,7 +524,8 @@ def run_child(n, tp, batch_per_chip, depth, image, classes):
         [sys.executable, os.path.abspath(__file__), "--mesh", str(n),
          "--batch-per-chip", str(batch_per_chip), "--depth", str(depth),
          "--image", str(image), "--classes", str(classes)] +
-        (["--tp"] if tp else []),
+        (["--leg", tp] if isinstance(tp, str) else
+         (["--tp"] if tp else [])),
         env=env, capture_output=True, text=True, timeout=3600, cwd=REPO)
     if proc.returncode != 0:
         raise RuntimeError(proc.stdout + proc.stderr)
@@ -229,6 +537,8 @@ def main():
     p.add_argument("--mesh", type=int, default=None,
                    help="child mode: compile on THIS process's devices")
     p.add_argument("--tp", action="store_true")
+    p.add_argument("--leg", default=None,
+                   help="pp | ep | sp (parallelism-axis legs)")
     p.add_argument("--sweep", default=None, help="e.g. 8,16,64")
     p.add_argument("--batch-per-chip", type=int, default=32)
     p.add_argument("--depth", type=int, default=50)
@@ -241,8 +551,15 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        rec = _compile_step(args.mesh, args.tp, args.batch_per_chip,
-                            args.depth, args.image, args.classes)
+        if args.leg == "pp":
+            rec = _compile_pp(args.mesh)
+        elif args.leg == "ep":
+            rec = _compile_ep(args.mesh)
+        elif args.leg == "sp":
+            rec = _compile_sp(args.mesh)
+        else:
+            rec = _compile_step(args.mesh, args.tp, args.batch_per_chip,
+                                args.depth, args.image, args.classes)
         print(json.dumps(rec))
         return
 
@@ -254,6 +571,14 @@ def main():
                 continue
             rec = analyze(run_child(n, tp, args.batch_per_chip, args.depth,
                                     args.image, args.classes))
+            recs.append(rec)
+            print(json.dumps(rec), flush=True)
+        for leg in ("pp", "ep", "sp"):
+            if n % 4:
+                continue
+            rec = analyze_axis(run_child(n, leg, args.batch_per_chip,
+                                         args.depth, args.image,
+                                         args.classes))
             recs.append(rec)
             print(json.dumps(rec), flush=True)
     with open(args.out, "w") as f:
