@@ -1,0 +1,530 @@
+/*
+ * mxnet_tpu.hpp — a single-header C++ binding over the complete C ABI
+ * (include/mxnet_tpu/c_api.h).
+ *
+ * Parity target: the reference cpp-package (/root/reference/cpp-package,
+ * include/mxnet-cpp) and its core idiom — the GENERIC Operator class:
+ *
+ *     auto fc = Operator("FullyConnected")
+ *                   .SetParam("num_hidden", 64)
+ *                   .SetInput("data", x)
+ *                   .CreateSymbol("fc1");
+ *
+ * No per-op code generation is needed: operators are addressed by name
+ * and validated by the op registry behind the C ABI; the introspection
+ * surface (MXSymbolListAtomicSymbolCreators / GetAtomicSymbolInfo) is
+ * available for binding generators that DO want to emit typed wrappers
+ * (see ListOperators / OperatorInfo below — the proof that a
+ * third-party binding can enumerate the full op surface).
+ *
+ * Exceptions: every failing C call throws MXException carrying
+ * MXGetLastError().  Handles are RAII-owned.
+ *
+ * Build: link against libmxnet_tpu.so —
+ *     g++ -std=c++17 app.cc -I include -I cpp_package/include \
+ *         -L <libdir> -lmxnet_tpu -Wl,-rpath,<libdir>
+ */
+#ifndef MXNET_TPU_CPP_HPP_
+#define MXNET_TPU_CPP_HPP_
+
+#include <mxnet_tpu/c_api.h>
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+class MXException : public std::runtime_error {
+ public:
+  explicit MXException(const std::string &where)
+      : std::runtime_error(where + ": " + MXGetLastError()) {}
+};
+
+inline void Check(int rc, const char *where) {
+  if (rc != 0) throw MXException(where);
+}
+
+/* --------------------------------------------------------- Context */
+struct Context {
+  int dev_type;  // 1 = cpu, 2 = accelerator (TPU)
+  int dev_id;
+  static Context cpu(int id = 0) { return {1, id}; }
+  static Context tpu(int id = 0) { return {2, id}; }
+};
+
+/* --------------------------------------------------------- NDArray */
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(NDArrayHandle h) : h_(wrap(h)) {}
+  NDArray(const std::vector<mx_uint> &shape, Context ctx = Context::cpu(),
+          int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<mx_uint>(shape.size()),
+                            ctx.dev_type, ctx.dev_id, 0, dtype, &h),
+          "NDArrayCreate");
+    h_ = wrap(h);
+  }
+  NDArray(const std::vector<float> &data, const std::vector<mx_uint> &shape,
+          Context ctx = Context::cpu())
+      : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data);
+  }
+
+  NDArrayHandle handle() const { return h_.get(); }
+  bool is_none() const { return !h_; }
+
+  void SyncCopyFromCPU(const std::vector<float> &data) {
+    Check(MXNDArraySyncCopyFromCPU(h_.get(), data.data(), data.size()),
+          "SyncCopyFromCPU");
+  }
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(h_.get(), out.data(), out.size()),
+          "SyncCopyToCPU");
+    return out;
+  }
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *dims = nullptr;
+    Check(MXNDArrayGetShape(h_.get(), &ndim, &dims), "GetShape");
+    return std::vector<mx_uint>(dims, dims + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+  void WaitToRead() const {
+    Check(MXNDArrayWaitToRead(h_.get()), "WaitToRead");
+  }
+  NDArray Copy() const {  // deep copy via the identity op
+    return Invoke("_copy", {*this}, {}).at(0);
+  }
+
+  /* imperative op by NAME — the registry is the source of truth */
+  static std::vector<NDArray> Invoke(
+      const std::string &op, const std::vector<NDArray> &inputs,
+      const std::map<std::string, std::string> &params) {
+    std::vector<NDArrayHandle> in;
+    for (const auto &a : inputs) in.push_back(a.handle());
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXImperativeInvoke(const_cast<char *>(op.c_str()),
+                             static_cast<int>(in.size()), in.data(), &n_out,
+                             &outs, static_cast<int>(keys.size()),
+                             keys.data(), vals.data()),
+          "ImperativeInvoke");
+    std::vector<NDArray> result;
+    for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  /* in-place invoke: results are written INTO the caller's arrays
+   * (the reference's pre-allocated-outputs ABI) */
+  static void InvokeInto(const std::string &op,
+                         const std::vector<NDArray> &inputs,
+                         const std::map<std::string, std::string> &params,
+                         const std::vector<NDArray> &outputs) {
+    std::vector<NDArrayHandle> in, out;
+    for (const auto &a : inputs) in.push_back(a.handle());
+    for (const auto &a : outputs) out.push_back(a.handle());
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = static_cast<int>(out.size());
+    NDArrayHandle *outp = out.data();
+    Check(MXImperativeInvoke(const_cast<char *>(op.c_str()),
+                             static_cast<int>(in.size()), in.data(), &n_out,
+                             &outp, static_cast<int>(keys.size()),
+                             keys.data(), vals.data()),
+          "ImperativeInvoke(in-place)");
+  }
+
+  NDArray operator+(const NDArray &o) const {
+    return Invoke("elemwise_add", {*this, o}, {}).at(0);
+  }
+  NDArray operator*(const NDArray &o) const {
+    return Invoke("elemwise_mul", {*this, o}, {}).at(0);
+  }
+
+  static void Save(const std::string &fname,
+                   const std::map<std::string, NDArray> &arrays) {
+    std::vector<NDArrayHandle> hs;
+    std::vector<const char *> names;
+    for (const auto &kv : arrays) {
+      names.push_back(kv.first.c_str());
+      hs.push_back(kv.second.handle());
+    }
+    Check(MXNDArraySave(fname.c_str(), static_cast<mx_uint>(hs.size()),
+                        hs.data(), names.data()),
+          "NDArraySave");
+  }
+  static std::map<std::string, NDArray> Load(const std::string &fname) {
+    mx_uint n = 0, nn = 0;
+    NDArrayHandle *arrs = nullptr;
+    const char **names = nullptr;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &arrs, &nn, &names),
+          "NDArrayLoad");
+    std::map<std::string, NDArray> out;
+    for (mx_uint i = 0; i < n; ++i)
+      out.emplace(nn == n ? names[i] : std::to_string(i), NDArray(arrs[i]));
+    return out;
+  }
+
+ private:
+  static std::shared_ptr<void> wrap(NDArrayHandle h) {
+    return std::shared_ptr<void>(h, [](void *p) {
+      if (p) MXNDArrayFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* ---------------------------------------------------------- Symbol */
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : h_(wrap(h)) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h), "CreateVariable");
+    return Symbol(h);
+  }
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h), "CreateFromJSON");
+    return Symbol(h);
+  }
+  static Symbol Group(const std::vector<Symbol> &symbols) {
+    std::vector<SymbolHandle> hs;
+    for (const auto &s : symbols) hs.push_back(s.handle());
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateGroup(static_cast<mx_uint>(hs.size()), hs.data(),
+                              &h),
+          "CreateGroup");
+    return Symbol(h);
+  }
+
+  SymbolHandle handle() const { return h_.get(); }
+
+  std::string ToJSON() const {
+    const char *json = nullptr;
+    Check(MXSymbolSaveToJSON(h_.get(), &json), "SaveToJSON");
+    return json;
+  }
+  std::vector<std::string> ListArguments() const {
+    return StrList(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrList(&MXSymbolListAuxiliaryStates);
+  }
+
+  /* infer all argument/output shapes from the named known ones */
+  void InferShape(
+      const std::map<std::string, std::vector<mx_uint>> &known,
+      std::vector<std::vector<mx_uint>> *arg_shapes,
+      std::vector<std::vector<mx_uint>> *out_shapes,
+      std::vector<std::vector<mx_uint>> *aux_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> ind(1, 0), data;
+    for (const auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      ind.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_n, out_n, aux_n;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_d, **out_d, **aux_d;
+    int complete = 0;
+    Check(MXSymbolInferShape(h_.get(),
+                             static_cast<mx_uint>(keys.size()), keys.data(),
+                             ind.data(), data.data(), &in_n, &in_nd, &in_d,
+                             &out_n, &out_nd, &out_d, &aux_n, &aux_nd,
+                             &aux_d, &complete),
+          "InferShape");
+    auto unpack = [](mx_uint n, const mx_uint *nd, const mx_uint **d,
+                     std::vector<std::vector<mx_uint>> *out) {
+      if (!out) return;
+      out->clear();
+      for (mx_uint i = 0; i < n; ++i)
+        out->emplace_back(d[i], d[i] + nd[i]);
+    };
+    unpack(in_n, in_nd, in_d, arg_shapes);
+    unpack(out_n, out_nd, out_d, out_shapes);
+    unpack(aux_n, aux_nd, aux_d, aux_shapes);
+  }
+
+ private:
+  template <typename F>
+  std::vector<std::string> StrList(F fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(fn(h_.get(), &n, &arr), "SymbolList");
+    return std::vector<std::string>(arr, arr + n);
+  }
+  static std::shared_ptr<void> wrap(SymbolHandle h) {
+    return std::shared_ptr<void>(h, [](void *p) {
+      if (p) MXSymbolFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* ------------------------------------------- the generic Operator.
+ * The reference cpp-package's central idea: one class builds ANY
+ * registered operator from (name, string params, inputs). */
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : name_(op_name) {}
+
+  template <typename T>
+  Operator &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return *this;
+  }
+  /* Named input: composed onto the op's declared slot of that name
+   * (order of SetInput calls does not matter). */
+  Operator &SetInput(const std::string &name, const Symbol &sym) {
+    input_names_.push_back(name);
+    inputs_.push_back(sym);
+    return *this;
+  }
+  /* Positional input (reference operator() chaining). Mixing unnamed
+   * and named inputs falls back to positional order for all. */
+  Operator &operator()(const Symbol &sym) { return SetInput("", sym); }
+
+  Symbol CreateSymbol(const std::string &instance_name = "") {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(
+              const_cast<char *>(name_.c_str()),
+              static_cast<mx_uint>(keys.size()), keys.data(), vals.data(),
+              &h),
+          "CreateAtomicSymbol");
+    Symbol owned(h);  // RAII before Compose so a failure cannot leak h
+    std::vector<SymbolHandle> args;
+    for (const auto &s : inputs_) args.push_back(s.handle());
+    bool named = !input_names_.empty();
+    for (const auto &n : input_names_)
+      if (n.empty()) named = false;
+    std::vector<const char *> in_keys;
+    for (const auto &n : input_names_) in_keys.push_back(n.c_str());
+    Check(MXSymbolCompose(h, instance_name.c_str(),
+                          static_cast<mx_uint>(args.size()),
+                          named ? in_keys.data() : nullptr, args.data()),
+          "SymbolCompose");
+    return owned;
+  }
+
+ private:
+  std::string name_;
+  std::map<std::string, std::string> params_;
+  std::vector<std::string> input_names_;
+  std::vector<Symbol> inputs_;
+};
+
+/* ----------------------------- introspection (binding-generator view) */
+struct OperatorInfo {
+  std::string name, description, key_var_num_args, return_type;
+  std::vector<std::string> arg_names, arg_types, arg_descriptions;
+};
+
+inline std::vector<std::string> ListOperators() {
+  mx_uint n = 0;
+  const char **arr = nullptr;
+  Check(MXListAllOpNames(&n, &arr), "ListAllOpNames");
+  return std::vector<std::string>(arr, arr + n);
+}
+
+inline OperatorInfo GetOperatorInfo(const std::string &op_name) {
+  AtomicSymbolCreator creator =
+      const_cast<char *>(op_name.c_str());  // name-addressing convention
+  const char *name, *desc, *keyvar, *rett;
+  mx_uint n_args;
+  const char **anames, **atypes, **adescs;
+  Check(MXSymbolGetAtomicSymbolInfo(creator, &name, &desc, &n_args,
+                                    &anames, &atypes, &adescs, &keyvar,
+                                    &rett),
+        "GetAtomicSymbolInfo");
+  OperatorInfo info;
+  info.name = name;
+  info.description = desc;
+  info.key_var_num_args = keyvar;
+  info.return_type = rett;
+  for (mx_uint i = 0; i < n_args; ++i) {
+    info.arg_names.emplace_back(anames[i]);
+    info.arg_types.emplace_back(atypes[i]);
+    info.arg_descriptions.emplace_back(adescs[i]);
+  }
+  return info;
+}
+
+/* -------------------------------------------------------- Executor */
+class Executor {
+ public:
+  /* SimpleBind: allocate-and-bind with per-name grad requests
+   * ("null"/"write"/"add"); params not in `grad_reqs` default to the
+   * dict semantics (missing -> null). */
+  Executor(const Symbol &sym, Context ctx,
+           const std::map<std::string, std::vector<mx_uint>> &arg_shapes,
+           const std::map<std::string, std::string> &grad_reqs)
+      : sym_(sym) {
+    std::vector<const char *> req_names, req_types;
+    for (const auto &kv : grad_reqs) {
+      req_names.push_back(kv.first.c_str());
+      req_types.push_back(kv.second.c_str());
+    }
+    std::vector<const char *> shape_names;
+    std::vector<mx_uint> shape_data, shape_idx(1, 0);
+    for (const auto &kv : arg_shapes) {
+      shape_names.push_back(kv.first.c_str());
+      shape_data.insert(shape_data.end(), kv.second.begin(),
+                        kv.second.end());
+      shape_idx.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    int shared_len = -1;
+    mx_uint n_in = 0, n_aux = 0;
+    NDArrayHandle *in = nullptr, *grads = nullptr, *aux = nullptr;
+    Check(MXExecutorSimpleBind(
+              sym.handle(), ctx.dev_type, ctx.dev_id, 0, nullptr, nullptr,
+              nullptr, static_cast<mx_uint>(req_names.size()),
+              req_names.data(), req_types.data(),
+              static_cast<mx_uint>(shape_names.size()), shape_names.data(),
+              shape_data.data(), shape_idx.data(), 0, nullptr, nullptr, 0,
+              nullptr, &shared_len, nullptr, nullptr, nullptr, nullptr,
+              &n_in, &in, &grads, &n_aux, &aux, nullptr, &h_),
+          "SimpleBind");
+    try {
+      auto arg_names = sym.ListArguments();
+      for (mx_uint i = 0; i < n_in; ++i) {
+        arg_dict_.emplace(arg_names[i], NDArray(in[i]));
+        if (grads[i]) grad_dict_.emplace(arg_names[i], NDArray(grads[i]));
+      }
+      auto aux_names = sym.ListAuxiliaryStates();
+      for (mx_uint i = 0; i < n_aux; ++i)
+        aux_dict_.emplace(aux_names[i], NDArray(aux[i]));
+    } catch (...) {
+      // a throwing ctor never runs ~Executor — free the handle here
+      MXExecutorFree(h_);
+      throw;
+    }
+  }
+  ~Executor() {
+    if (h_) MXExecutorFree(h_);
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+
+  std::map<std::string, NDArray> &arg_dict() { return arg_dict_; }
+  std::map<std::string, NDArray> &grad_dict() { return grad_dict_; }
+  std::map<std::string, NDArray> &aux_dict() { return aux_dict_; }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_, is_train ? 1 : 0), "Forward");
+  }
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto &g : head_grads) hs.push_back(g.handle());
+    Check(MXExecutorBackward(h_, static_cast<mx_uint>(hs.size()),
+                             hs.data()),
+          "Backward");
+  }
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXExecutorOutputs(h_, &n, &outs), "Outputs");
+    std::vector<NDArray> result;
+    for (mx_uint i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  Symbol sym_;
+  ExecutorHandle h_ = nullptr;
+  std::map<std::string, NDArray> arg_dict_, grad_dict_, aux_dict_;
+};
+
+/* ------------------------------------------------------- Optimizer.
+ * SGD over the registry's fused update op — each update is one
+ * in-place imperative invoke (pre-allocated output = the weight). */
+class SGDOptimizer {
+ public:
+  explicit SGDOptimizer(float lr, float wd = 0.0f) : lr_(lr), wd_(wd) {}
+  void Update(NDArray *weight, const NDArray &grad) {
+    std::map<std::string, std::string> p{
+        {"lr", std::to_string(lr_)}, {"wd", std::to_string(wd_)}};
+    // in-place: the result lands in the weight's own (bound) buffer,
+    // so an executor holding this array sees the update
+    NDArray::InvokeInto("sgd_update", {*weight, grad}, p, {*weight});
+  }
+
+ private:
+  float lr_, wd_;
+};
+
+/* --------------------------------------------------------- KVStore */
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    Check(MXKVStoreCreate(type.c_str(), &h_), "KVStoreCreate");
+  }
+  ~KVStore() {
+    if (h_) MXKVStoreFree(h_);
+  }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+
+  void Init(int key, const NDArray &val) {
+    NDArrayHandle h = val.handle();
+    Check(MXKVStoreInit(h_, 1, &key, &h), "KVStoreInit");
+  }
+  void Push(int key, const NDArray &val, int priority = 0) {
+    NDArrayHandle h = val.handle();
+    Check(MXKVStorePush(h_, 1, &key, &h, priority), "KVStorePush");
+  }
+  void Pull(int key, NDArray *out, int priority = 0) {
+    NDArrayHandle h = out->handle();
+    Check(MXKVStorePull(h_, 1, &key, &h, priority), "KVStorePull");
+  }
+  int Rank() const {
+    int r = 0;
+    Check(MXKVStoreGetRank(h_, &r), "GetRank");
+    return r;
+  }
+  int NumWorkers() const {
+    int n = 0;
+    Check(MXKVStoreGetGroupSize(h_, &n), "GetGroupSize");
+    return n;
+  }
+
+ private:
+  KVStoreHandle h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_HPP_

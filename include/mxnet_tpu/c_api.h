@@ -197,7 +197,10 @@ MXNET_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator_or_name,
                                          SymbolHandle *out);
 MXNET_DLL int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
                                   SymbolHandle *out);
-/* Composes IN PLACE: after this the handle holds the applied symbol. */
+/* Composes IN PLACE: after this the handle holds the applied symbol.
+ * keys==NULL composes positionally; with keys, each arg binds to the
+ * op's declared input slot of that name (call order irrelevant; named
+ * args must fill a prefix of the slots). */
 MXNET_DLL int MXSymbolCompose(SymbolHandle handle, const char *name,
                               mx_uint num_args, const char **keys,
                               SymbolHandle *args);

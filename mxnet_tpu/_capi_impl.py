@@ -134,9 +134,33 @@ def symbol_create(op_name, keys, vals, name):
     return (op_name, dict(zip(keys, vals)), name or None)
 
 
-def symbol_compose(creator, args):
+def symbol_compose(creator, args, keys=None):
+    """Positional composition, or NAMED when `keys` is given: the op
+    registry declares its input slots (Op.inputs), so named args are
+    reordered onto them regardless of call order (reference kwargs
+    composition, nnvm Symbol::Compose)."""
     op_name, attrs, name = creator
-    return _sym._create(op_name, list(args), attrs, name=name)
+    args = list(args)
+    if keys:
+        op = OP_REGISTRY.get(op_name)
+        slots = list(op.inputs) if op is not None else []
+        by_name = dict(zip(keys, args))
+        if len(by_name) != len(args):
+            raise MXNetError("compose: duplicate input names %s" % (keys,))
+        unknown = [k for k in by_name if k not in slots]
+        if unknown:
+            raise MXNetError(
+                "compose: %s has no input(s) %s (inputs: %s)"
+                % (op_name, unknown, slots))
+        args = [by_name[s] for s in slots if s in by_name]
+        # named args must fill a PREFIX of the slots — a gap would
+        # silently shift later inputs
+        expect = [s for s in slots[:len(args)]]
+        missing = [s for s in expect if s not in by_name]
+        if missing:
+            raise MXNetError("compose: missing input(s) %s for %s"
+                             % (missing, op_name))
+    return _sym._create(op_name, args, attrs, name=name)
 
 
 def symbol_list(sym, which):

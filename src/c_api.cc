@@ -485,13 +485,6 @@ int MXSymbolCreateAtomicSymbol(const void *creator_or_name,
 
 int MXSymbolCompose(void *handle, const char *name, unsigned num_args,
                     const char **keys, void **args) {
-  // only positional composition is implemented; silently treating named
-  // args as positional would bind them to the wrong inputs
-  if (keys != nullptr) {
-    set_error("MXSymbolCompose: named (keyword) composition is not "
-              "supported — pass args positionally with keys=NULL");
-    return -1;
-  }
   Gil gil;
   Handle *h = static_cast<Handle *>(handle);
   PyObject *creator = h->obj;
@@ -500,10 +493,16 @@ int MXSymbolCompose(void *handle, const char *name, unsigned num_args,
                                    PyTuple_GetItem(creator, 1),
                                    name ? name : "");
   PyObject *arg_list = handle_list(num_args, args);
-  PyObject *r = (tagged && arg_list)
+  // keys==NULL -> positional; keys given -> NAMED composition, ordered
+  // onto the op's declared input slots python-side
+  PyObject *ks = keys ? str_list(num_args, keys)
+                      : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = (tagged && arg_list && ks)
                     ? impl_call("symbol_compose",
-                                Py_BuildValue("(OO)", tagged, arg_list))
+                                Py_BuildValue("(OOO)", tagged, arg_list,
+                                              ks))
                     : nullptr;
+  Py_XDECREF(ks);
   Py_XDECREF(tagged);
   Py_XDECREF(arg_list);
   if (!r) { set_error_from_python(); return -1; }

@@ -845,3 +845,29 @@ def test_c_api_custom_op_register():
     exe.backward([mx.nd.array(np.array([1.0, 1.0, 2.0], np.float32))])
     np.testing.assert_allclose(np.asarray(exe.grad_dict["x"].asnumpy()),
                                [3.0, 3.0, 6.0])
+
+def test_cpp_package_binding(tmp_path):
+    """The C++ binding (cpp_package/include/mxnet_tpu.hpp) trains an MLP
+    end to end: generic Operator symbol building, SimpleBind,
+    forward/backward, in-place fused-op SGD, KVStore, introspection —
+    the reference cpp-package workflow over this C ABI."""
+    libpath = _lib_path()
+    cxx = shutil.which("g++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    exe = str(tmp_path / "train_mlp")
+    libdir = os.path.dirname(libpath)
+    subprocess.run(
+        [cxx, "-std=c++17",
+         os.path.join(ROOT, "cpp_package", "example", "train_mlp.cpp"),
+         "-I", os.path.join(ROOT, "include"),
+         "-I", os.path.join(ROOT, "cpp_package", "include"),
+         "-L", libdir, "-lmxnet_tpu", "-Wl,-rpath," + libdir, "-o", exe],
+        check=True, capture_output=True)
+    proc = subprocess.run([exe], capture_output=True, text=True,
+                          env=_run_env(), timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CPP_OK" in proc.stdout, proc.stdout
+    ops_line = [l for l in proc.stdout.splitlines()
+                if l.startswith("ops:")][0]
+    assert int(ops_line.split()[1].rstrip(",")) >= 300, ops_line
