@@ -147,6 +147,48 @@ def get_recordio_lib():
     return lib
 
 
+def get_im2rec_lib():
+    """Load (building if needed) the native multithreaded image packer
+    (src/im2rec.cc, reference tools/im2rec.cc analog); None if no
+    toolchain or no libjpeg."""
+    lib = _load("im2rec", ["im2rec.cc", "recordio.cc"],
+                extra=tuple(_jpeg_link_flags()))
+    if lib is None:
+        return None
+    if not getattr(lib, "_im2rec_configured", False):
+        lib.im2rec_pack.restype = ctypes.c_long
+        lib.im2rec_pack.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_long,
+        ]
+        lib._im2rec_configured = True
+    return lib
+
+
+def im2rec_pack(lst_path, image_root, rec_path, idx_path, resize=0,
+                quality=95, nthreads=0):
+    """Pack a .lst into .rec/.idx with the native threaded packer.
+    Returns the number of records written; raises on failure."""
+    lib = get_im2rec_lib()
+    if lib is None:
+        raise RuntimeError("native im2rec unavailable (toolchain/libjpeg)")
+    if nthreads <= 0:
+        nthreads = os.cpu_count() or 1
+    err = ctypes.create_string_buffer(512)
+    n = lib.im2rec_pack(str(lst_path).encode(), str(image_root).encode(),
+                        str(rec_path).encode(), str(idx_path).encode(),
+                        int(resize), int(quality), int(nthreads), err,
+                        len(err))
+    if n < 0:
+        raise IOError("im2rec_pack: %s" % err.value.decode())
+    if err.value:
+        import logging
+
+        logging.warning("im2rec_pack: %s", err.value.decode())
+    return int(n)
+
+
 class NativeRecordReader:
     """Batched native reader over a .rec file."""
 

@@ -1,0 +1,365 @@
+// im2rec.cc — multithreaded image→RecordIO packer.
+//
+// Parity: reference tools/im2rec.cc (the OpenMP C++ packer the reference
+// ships for ImageNet-scale dataset preparation; the python
+// tools/im2rec.py covers correctness, this covers throughput).  Worker
+// threads read + (optionally) decode/resize/re-encode JPEGs in
+// parallel; one writer emits records in LIST ORDER so the .rec/.idx
+// pair is byte-for-byte deterministic regardless of thread count.
+//
+// Record layout matches mxnet_tpu/recordio.py pack(): IRHeader
+// {u32 flag, f32 label, u64 id, u64 id2} little-endian, flag = number
+// of extra labels when multi-label (labels appended as f32s), then the
+// image payload.  The .idx sidecar is "index\toffset" per line.
+//
+// Built by mxnet_tpu/native.py together with recordio.cc (whose
+// rio_open_writer/rio_write provide the dmlc-compatible framing).
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <atomic>
+#include <charconv>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+// from recordio.cc (compiled into the same shared object)
+extern "C" {
+void* rio_open_writer(const char* path);
+long rio_write(void* h, const char* data, long len);
+void rio_close_writer(void* h);
+}
+
+namespace {
+
+struct Entry {
+  uint64_t index = 0;
+  std::vector<float> labels;
+  std::string path;
+};
+
+struct ErrJmp {
+  jpeg_error_mgr mgr;
+  std::jmp_buf jmp;
+};
+
+void on_jpeg_error(j_common_ptr cinfo) {
+  std::longjmp(reinterpret_cast<ErrJmp*>(cinfo->err)->jmp, 1);
+}
+void silent(j_common_ptr, int) {}
+void silent_msg(j_common_ptr) {}
+
+bool is_jpeg(const std::string& bytes) {
+  return bytes.size() > 3 && (unsigned char)bytes[0] == 0xFF &&
+         (unsigned char)bytes[1] == 0xD8;
+}
+
+// decode -> RGB rows; false on any decode error.  This packer keeps
+// its own small decode/encode pair rather than sharing imdecode.cc's:
+// that engine decodes INTO the training layout (DCT scaling, fused
+// crop/resize sampling, thread pool of its own) while packing needs
+// full-fidelity decode + encode — the ~60 shared lines aren't worth
+// coupling the two pipelines' error and scaling semantics.
+bool decode_jpeg(const std::string& bytes, std::vector<unsigned char>* rgb,
+                 int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  ErrJmp err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = on_jpeg_error;
+  err.mgr.emit_message = silent;
+  err.mgr.output_message = silent_msg;
+  if (setjmp(err.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, reinterpret_cast<const unsigned char*>(bytes.data()),
+               bytes.size());
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  rgb->resize(size_t(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = rgb->data() + size_t(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize so the SHORTER side equals `target` (the reference
+// packer's --resize semantics); no-op when already at/below target
+void resize_short(const std::vector<unsigned char>& in, int w, int h,
+                  int target, std::vector<unsigned char>* out, int* ow,
+                  int* oh) {
+  int short_side = w < h ? w : h;
+  if (target <= 0 || short_side <= target) {
+    *out = in;
+    *ow = w;
+    *oh = h;
+    return;
+  }
+  double scale = double(target) / short_side;
+  *ow = int(w * scale + 0.5);
+  *oh = int(h * scale + 0.5);
+  out->resize(size_t(*ow) * *oh * 3);
+  for (int y = 0; y < *oh; ++y) {
+    double sy = (y + 0.5) / scale - 0.5;
+    int y0 = sy < 0 ? 0 : int(sy);
+    int y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    double fy = sy - y0;
+    for (int x = 0; x < *ow; ++x) {
+      double sx = (x + 0.5) / scale - 0.5;
+      int x0 = sx < 0 ? 0 : int(sx);
+      int x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      double fx = sx - x0;
+      for (int c = 0; c < 3; ++c) {
+        double v00 = in[(size_t(y0) * w + x0) * 3 + c];
+        double v01 = in[(size_t(y0) * w + x1) * 3 + c];
+        double v10 = in[(size_t(y1) * w + x0) * 3 + c];
+        double v11 = in[(size_t(y1) * w + x1) * 3 + c];
+        double v = v00 * (1 - fy) * (1 - fx) + v01 * (1 - fy) * fx +
+                   v10 * fy * (1 - fx) + v11 * fy * fx;
+        (*out)[(size_t(y) * *ow + x) * 3 + c] =
+            (unsigned char)(v + 0.5);
+      }
+    }
+  }
+}
+
+bool encode_jpeg(const std::vector<unsigned char>& rgb, int w, int h,
+                 int quality, std::string* out) {
+  jpeg_compress_struct cinfo;
+  ErrJmp err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = on_jpeg_error;
+  // `mem` is reallocated by libjpeg through &mem after setjmp, so the
+  // recovery branch must read the CURRENT value — through a volatile
+  // pointer-to-pointer (mem's storage is addressable, so the load sees
+  // whatever libjpeg last wrote; a plain local could sit in a register)
+  unsigned char* mem = nullptr;
+  unsigned char** volatile memp = &mem;
+  unsigned long buflen = 0;
+  if (setjmp(err.jmp)) {
+    jpeg_destroy_compress(&cinfo);
+    if (*memp) free(*memp);
+    return false;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &mem, &buflen);
+
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  std::vector<unsigned char> row(size_t(w) * 3);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    std::memcpy(row.data(), rgb.data() + size_t(cinfo.next_scanline) * w * 3,
+                row.size());
+    unsigned char* rp = row.data();
+    jpeg_write_scanlines(&cinfo, &rp, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  out->assign(reinterpret_cast<char*>(mem), buflen);
+  jpeg_destroy_compress(&cinfo);
+  free(mem);
+  return true;
+}
+
+void put_u32(std::string* s, uint32_t v) { s->append((char*)&v, 4); }
+void put_f32(std::string* s, float v) { s->append((char*)&v, 4); }
+void put_u64(std::string* s, uint64_t v) { s->append((char*)&v, 8); }
+
+// IRHeader + labels + payload (the recordio.py pack() layout)
+std::string make_record(const Entry& e, const std::string& payload) {
+  std::string rec;
+  rec.reserve(24 + 4 * e.labels.size() + payload.size());
+  if (e.labels.size() == 1) {
+    put_u32(&rec, 0);
+    put_f32(&rec, e.labels[0]);
+  } else {
+    put_u32(&rec, (uint32_t)e.labels.size());
+    put_f32(&rec, 0.0f);
+  }
+  put_u64(&rec, e.index);
+  put_u64(&rec, 0);
+  if (e.labels.size() != 1)
+    for (float l : e.labels) put_f32(&rec, l);
+  rec += payload;
+  return rec;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack lst entries into rec/idx.  resize: shorter-side target (0 = keep
+// bytes verbatim, no decode).  Returns records written, or -1 with a
+// message in err.
+long im2rec_pack(const char* lst_path, const char* image_root,
+                 const char* rec_path, const char* idx_path, int resize,
+                 int quality, int nthreads, char* err, long errcap) {
+  auto fail = [&](const std::string& msg) -> long {
+    if (err && errcap > 0) {
+      std::snprintf(err, errcap, "%s", msg.c_str());
+    }
+    return -1;
+  };
+  std::ifstream lst(lst_path);
+  if (!lst) return fail(std::string("cannot open list ") + lst_path);
+  std::vector<Entry> entries;
+  std::string line;
+  while (std::getline(lst, line)) {
+    if (line.empty()) continue;
+    std::vector<std::string> cols;
+    std::stringstream ss(line);
+    std::string col;
+    while (std::getline(ss, col, '\t')) cols.push_back(col);
+    if (cols.size() < 3) continue;
+    Entry e;
+    e.index = std::strtoull(cols[0].c_str(), nullptr, 10);
+    for (size_t i = 1; i + 1 < cols.size(); ++i) {
+      // std::from_chars: locale-INDEPENDENT ('.' decimal always) — the
+      // python packer's float() likewise ignores LC_NUMERIC, and byte
+      // identity between the two is a tested guarantee
+      float v = 0.0f;
+      const std::string& c = cols[i];
+      std::from_chars(c.data(), c.data() + c.size(), v);
+      e.labels.push_back(v);
+    }
+    e.path = std::string(image_root) + "/" + cols.back();
+    entries.push_back(std::move(e));
+  }
+  if (nthreads < 1) nthreads = 1;
+
+  void* writer = rio_open_writer(rec_path);
+  if (!writer) return fail(std::string("cannot open ") + rec_path);
+  std::FILE* fidx = std::fopen(idx_path, "w");
+  if (!fidx) {
+    rio_close_writer(writer);
+    return fail(std::string("cannot open ") + idx_path);
+  }
+
+  std::atomic<size_t> next_job{0};
+  std::atomic<long> n_nonjpeg{0};
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<size_t, std::string> ready;  // seq -> record (bounded below)
+  std::string first_error;
+  const size_t kMaxPending = size_t(nthreads) * 4;
+
+  auto worker = [&]() {
+    std::vector<unsigned char> rgb, resized;
+    for (;;) {
+      size_t i = next_job.fetch_add(1);
+      if (i >= entries.size()) return;
+      const Entry& e = entries[i];
+      std::string rec, payload;
+      std::ifstream img(e.path, std::ios::binary);
+      if (!img) {
+        std::lock_guard<std::mutex> lk(mu);
+        if (first_error.empty())
+          first_error = "cannot read " + e.path;
+        rec.clear();
+      } else {
+        std::stringstream buf;
+        buf << img.rdbuf();
+        payload = buf.str();
+        if (resize > 0 && is_jpeg(payload)) {
+          int w = 0, h = 0, ow = 0, oh = 0;
+          if (decode_jpeg(payload, &rgb, &w, &h)) {
+            resize_short(rgb, w, h, resize, &resized, &ow, &oh);
+            if (ow != w || oh != h) {  // already small: bytes untouched,
+              std::string enc;         // no lossy re-encode generation
+              if (encode_jpeg(resized, ow, oh, quality, &enc))
+                payload.swap(enc);
+            }
+          }
+          // decode/encode failure: keep the original bytes (the
+          // reference packer likewise passes through what it can't
+          // transcode)
+        } else if (resize > 0) {
+          n_nonjpeg.fetch_add(1);  // passed through at original size
+        }
+        rec = make_record(e, payload);
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait(lk, [&] {
+        return stop.load() || ready.size() < kMaxPending ||
+               (!ready.empty() && ready.begin()->first > i);
+      });
+      if (stop.load()) return;  // writer died: drain out, don't block
+      ready.emplace(i, std::move(rec));
+      cv.notify_all();
+    }
+  };
+
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
+
+  long written = 0;
+  bool ok = true;
+  for (size_t seq = 0; seq < entries.size() && ok; ++seq) {
+    std::string rec;
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait(lk, [&] {
+        return !ready.empty() && ready.begin()->first == seq;
+      });
+      rec = std::move(ready.begin()->second);
+      ready.erase(ready.begin());
+      cv.notify_all();
+    }
+    if (rec.empty()) continue;  // unreadable file: skipped, error noted
+    long pos = rio_write(writer, rec.data(), (long)rec.size());
+    if (pos < 0) {
+      ok = false;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (first_error.empty()) first_error = "record write failed";
+        stop.store(true);  // release workers blocked on the full map
+      }
+      cv.notify_all();
+      break;
+    }
+    std::fprintf(fidx, "%llu\t%ld\n",
+                 (unsigned long long)entries[seq].index, pos);
+    ++written;
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    stop.store(true);  // normal end: wake any worker still waiting
+  }
+  cv.notify_all();
+  for (auto& t : pool) t.join();
+  std::fclose(fidx);
+  rio_close_writer(writer);
+  if (!ok) return fail(first_error);
+  if (first_error.empty() && n_nonjpeg.load() > 0 && err && errcap > 0)
+    std::snprintf(err, errcap,
+                  "%ld non-JPEG image(s) passed through at original size "
+                  "(--resize transcodes JPEG only)", n_nonjpeg.load());
+  if (!first_error.empty() && err && errcap > 0)
+    std::snprintf(err, errcap, "%s", first_error.c_str());  // partial skip
+  return written;
+}
+
+}  // extern "C"

@@ -2,6 +2,8 @@
 the C++ recordio path)."""
 import os
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import numpy as np
 import pytest
 
@@ -163,3 +165,94 @@ def test_multipart_records_roundtrip(tmp_path):
     assert got2 == payloads
     # the two files are byte-identical (same split algorithm)
     assert open(p1, "rb").read() == open(p2, "rb").read()
+
+
+def test_native_im2rec_matches_python_packer(tmp_path):
+    """The C++ multithreaded packer (src/im2rec.cc) produces a
+    byte-identical .rec/.idx to the python packer at any thread count
+    (ordered writer), and its output feeds ImageRecordIter."""
+    PIL = pytest.importorskip("PIL.Image")
+    import subprocess
+    import sys as _sys
+
+    from mxnet_tpu import native
+
+    if native.get_im2rec_lib() is None:
+        pytest.skip("native im2rec unavailable")
+
+    rng = np.random.RandomState(0)
+    root = tmp_path / "imgs"
+    for label in range(2):
+        d = root / ("c%d" % label)
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = rng.randint(0, 255, (20, 24, 3)).astype(np.uint8)
+            PIL.fromarray(arr).save(str(d / ("i%d.jpg" % i)), "JPEG")
+    prefix = str(tmp_path / "ds")
+    subprocess.run([_sys.executable,
+                    os.path.join(ROOT, "tools", "im2rec.py"), prefix,
+                    str(root), "--list"], check=True, capture_output=True)
+
+    # python packer (reference semantics)
+    subprocess.run([_sys.executable,
+                    os.path.join(ROOT, "tools", "im2rec.py"),
+                    prefix, str(root), "--no-native"],
+                   check=True, capture_output=True)
+    py_rec = open(prefix + ".rec", "rb").read()
+    py_idx = open(prefix + ".idx").read()
+
+    # native, 1 thread and 4 threads: both byte-identical to python
+    for nt in (1, 4):
+        n = native.im2rec_pack(prefix + ".lst", str(root),
+                               prefix + ".n.rec", prefix + ".n.idx",
+                               nthreads=nt)
+        assert n == 12
+        assert open(prefix + ".n.rec", "rb").read() == py_rec, \
+            "thread count %d changed bytes" % nt
+        assert open(prefix + ".n.idx").read() == py_idx
+
+    # the iterator consumes the native-packed file
+    import mxnet_tpu as mx
+
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".n.rec",
+                               data_shape=(3, 20, 20), batch_size=4)
+    batches = sum(1 for _ in it)
+    assert batches == 3
+
+
+def test_native_im2rec_resize(tmp_path):
+    """--resize re-encodes through libjpeg with the shorter side scaled
+    to the target (bilinear), leaving smaller images untouched."""
+    PIL = pytest.importorskip("PIL.Image")
+    import io as _io
+    import subprocess
+    import sys as _sys
+
+    from mxnet_tpu import native, recordio
+
+    if native.get_im2rec_lib() is None:
+        pytest.skip("native im2rec unavailable")
+
+    rng = np.random.RandomState(1)
+    root = tmp_path / "imgs"
+    root.mkdir()
+    PIL.fromarray(rng.randint(0, 255, (64, 96, 3)).astype(np.uint8)).save(
+        str(root / "big.jpg"), "JPEG")
+    PIL.fromarray(rng.randint(0, 255, (12, 16, 3)).astype(np.uint8)).save(
+        str(root / "small.jpg"), "JPEG")
+    prefix = str(tmp_path / "rs")
+    subprocess.run([_sys.executable,
+                    os.path.join(ROOT, "tools", "im2rec.py"), prefix,
+                    str(root), "--list"], check=True, capture_output=True)
+    n = native.im2rec_pack(prefix + ".lst", str(root), prefix + ".rec",
+                           prefix + ".idx", resize=32, nthreads=2)
+    assert n == 2
+    rdr = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    sizes = []
+    for key in sorted(rdr.idx):
+        _, payload = recordio.unpack(rdr.read_idx(key))
+        img = PIL.open(_io.BytesIO(payload))
+        sizes.append(img.size)  # (w, h)
+    rdr.close()
+    # big 96x64 -> shorter side 64 scaled to 32 => 48x32; small untouched
+    assert (48, 32) in sizes and (16, 12) in sizes, sizes

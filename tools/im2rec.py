@@ -42,6 +42,20 @@ def make_list(prefix, root, exts=(".jpg", ".jpeg", ".png")):
     print("wrote %s.lst with %d entries (%d classes)" % (prefix, len(entries), len(classes)))
 
 
+def pack_native(prefix, root, quality=95, resize=0, nthreads=0):
+    """Multithreaded C++ packer (src/im2rec.cc, reference tools/im2rec.cc
+    analog).  Output is byte-identical regardless of thread count (the
+    writer emits in list order)."""
+    from mxnet_tpu import native
+
+    n = native.im2rec_pack(prefix + ".lst", root, prefix + ".rec",
+                           prefix + ".idx", resize=resize, quality=quality,
+                           nthreads=nthreads)
+    print("packed %d images into %s.rec (native, %s threads)"
+          % (n, prefix, nthreads or "auto"))
+    return n
+
+
 def pack(prefix, root, quality=95):
     writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     n = 0
@@ -69,13 +83,30 @@ def main():
     parser.add_argument("root")
     parser.add_argument("--list", action="store_true", help="generate the .lst file only")
     parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--resize", type=int, default=0,
+                        help="shorter-side resize target (native packer)")
+    parser.add_argument("--num-thread", type=int, default=0,
+                        help="packer threads (0 = all cores)")
+    parser.add_argument("--no-native", action="store_true",
+                        help="force the single-threaded python packer")
     args = parser.parse_args()
     if args.list:
         make_list(args.prefix, args.root)
-    else:
-        if not os.path.exists(args.prefix + ".lst"):
-            make_list(args.prefix, args.root)
-        pack(args.prefix, args.root, args.quality)
+        return
+    if not os.path.exists(args.prefix + ".lst"):
+        make_list(args.prefix, args.root)
+    use_native = not args.no_native
+    if use_native:
+        try:
+            pack_native(args.prefix, args.root, args.quality, args.resize,
+                        args.num_thread)
+            return
+        except (RuntimeError, IOError) as e:
+            print("native packer unavailable (%s); falling back" % e)
+    if args.resize:
+        print("warning: --resize requires the native packer; packing "
+              "original bytes")
+    pack(args.prefix, args.root, args.quality)
 
 
 if __name__ == "__main__":
