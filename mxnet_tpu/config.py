@@ -70,6 +70,15 @@ REGISTRY = [
            "512 (1826 vs 2487 img/s) — the kernel wins nothing over XLA's "
            "fused reduce and its custom_vjp pins an extra residual. Kept "
            "for experimentation; see README Roofline item 5"),
+    EnvVar("MXNET_TPU_S2D_STEM", int, 0,
+           "EXACT space-to-depth rewrite of 7x7/stride-2/pad-3 stem "
+           "convolutions (C_in<=4): factor-2 fold to an equivalent "
+           "4x4/stride-1 conv on 4x the channels (ops/nn.py "
+           "_maybe_s2d_stem). Numerically exact but measured SLOWER "
+           "end-to-end on ResNet-50 inference (11456 vs 11759 img/s): "
+           "the stem conv sheds 0.9 ms/call but the fold's relayout "
+           "copies add 2.2 ms (README Per-model MFU item 5). Default "
+           "OFF; kept for experimentation"),
     # ---- JAX/XLA passthrough the test/dev flows rely on ----
     EnvVar("JAX_PLATFORMS", str, "", "Force a JAX backend, e.g. 'cpu'"),
     EnvVar("XLA_FLAGS", str, "",

@@ -166,6 +166,75 @@ def _infer_conv(in_shapes, attrs):
     return shapes, [out]
 
 
+def _maybe_s2d_stem(data, weight, kernel, stride, pad, dilate, groups,
+                    layout):
+    """EXACT space-to-depth rewrite of the classic 7x7/stride-2/pad-3
+    stem conv (opt-in: MXNET_TPU_S2D_STEM=1).
+
+    A C_in<=4 stem runs at ~12% MFU on the MXU (round-5 audit,
+    tools/mfu_decompose.py: 3 channels fill 3/128 contraction lanes at
+    224x224).  Factor-2 space-to-depth turns it into an equivalent
+    4x4/stride-1 conv on [H/2, W/2, 4*C_in]: input row 2Y+py folds into
+    channel c*4+py*2+px, and tap ky maps to (KY, py) via
+    py=(ky-3)%2, KY=(ky-3-py)//2+2 — a bijection over the 7 taps, so
+    the rewritten weights reproduce the original conv EXACTLY (the
+    (KY=0, py=0) slice stays zero).  Spatial padding becomes
+    (2,1)x(2,1) on the folded grid.  Returns None when the conv is not
+    that stem (or the flag is off)."""
+    from ..config import get as _cfg_get
+
+    if not _cfg_get("MXNET_TPU_S2D_STEM"):
+        return None
+    if (len(kernel) != 2 or tuple(kernel) != (7, 7)
+            or tuple(stride) != (2, 2) or tuple(pad) != (3, 3)
+            or tuple(dilate) != (1, 1) or groups != 1):
+        return None
+    last = _channel_last(layout)
+    N = data.shape[0]
+    if last:
+        H, W, C = data.shape[1], data.shape[2], data.shape[3]
+    else:
+        C, H, W = data.shape[1], data.shape[2], data.shape[3]
+    if C > 4 or H % 2 or W % 2:
+        return None
+    # tap bijection: ky -> (KY, py)
+    import numpy as _onp
+
+    ks = _onp.arange(7)
+    ps = (ks - 3) % 2
+    Ks = (ks - 3 - ps) // 2 + 2
+    iky, ikx = _onp.meshgrid(ks, ks, indexing="ij")
+    KYa = Ks[iky].reshape(-1)
+    KXa = Ks[ikx].reshape(-1)
+    pypx = (ps[iky] * 2 + ps[ikx]).reshape(-1)           # [49]
+    ch = (_onp.arange(C)[None, :] * 4 + pypx[:, None])   # [49, C]
+    if last:
+        # x: [N,H,W,C] -> [N,Y,X,C*4] with channel c*4 + py*2 + px
+        x2 = data.reshape(N, H // 2, 2, W // 2, 2, C)
+        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, H // 2, W // 2,
+                                                    C * 4)
+        O = weight.shape[3]                               # HWIO
+        taps = weight[iky.reshape(-1), ikx.reshape(-1)]   # [49, C, O]
+        w2 = jnp.zeros((4, 4, C * 4, O), weight.dtype)
+        w2 = w2.at[KYa[:, None], KXa[:, None], ch].set(taps)
+    else:
+        # x: [N,C,H,W] -> [N,C*4,Y,X]
+        x2 = data.reshape(N, C, H // 2, 2, W // 2, 2)
+        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, H // 2,
+                                                    W // 2)
+        O = weight.shape[0]                               # OIHW
+        taps = weight[:, :, iky.reshape(-1), ikx.reshape(-1)]  # [O,C,49]
+        taps = taps.transpose(2, 1, 0)                    # [49, C, O]
+        w2 = jnp.zeros((4, 4, C * 4, O), weight.dtype)
+        w2 = w2.at[KYa[:, None], KXa[:, None], ch].set(taps)
+        w2 = w2.transpose(3, 2, 0, 1)                     # -> OIHW
+    return lax.conv_general_dilated(
+        x2, w2, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=_conv_dn(layout, 2),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.float32
+        else None)
+
+
 @register("Convolution", inputs=("data", "weight", "bias"), infer_shape=_infer_conv,
           aliases=("Convolution_v1",),
           params={"kernel": P.Shape(required=True, low=1, desc="conv kernel (h, w)"),
@@ -204,16 +273,19 @@ def convolution(
     p = _shape(pad) or (0,) * n
     pairs = [(int(x), int(x)) for x in p]
     dn = _conv_dn(layout, n)
-    out = lax.conv_general_dilated(
-        data,
-        weight,
-        window_strides=stride,
-        padding=pairs,
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=int(_lit(num_group)),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None,
-    )
+    out = _maybe_s2d_stem(data, weight, kernel, stride, p, dilate,
+                          int(_lit(num_group)), layout)
+    if out is None:
+        out = lax.conv_general_dilated(
+            data,
+            weight,
+            window_strides=stride,
+            padding=pairs,
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=int(_lit(num_group)),
+            preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None,
+        )
     if bias is not None and not _bool(no_bias):
         if _channel_last(layout):
             out = out + bias  # C is minormost: plain broadcast

@@ -794,3 +794,53 @@ def test_zz_registry_coverage():
     # test_operator.py is the de-facto spec — finish it)
     assert frac >= 1.0, (
         "op test coverage %.1f%% < 100%%; uncovered: %s" % (100 * frac, missing))
+
+
+def test_s2d_stem_rewrite_exact():
+    """MXNET_TPU_S2D_STEM: the space-to-depth stem rewrite reproduces the
+    plain 7x7/s2/p3 conv EXACTLY — forward, data grad, and weight grad,
+    in both layouts (it ships default-OFF for speed: README Per-model
+    MFU item 5 records the measured A/B)."""
+    import os
+
+    import mxnet_tpu as mx
+
+    def run(layout, flag):
+        os.environ["MXNET_TPU_S2D_STEM"] = "1" if flag else "0"
+        rng = np.random.RandomState(0)
+        dshape = (2, 3, 16, 16) if layout == "NCHW" else (2, 16, 16, 3)
+        wshape = (8, 3, 7, 7) if layout == "NCHW" else (7, 7, 3, 8)
+        x = mx.sym.Variable("data")
+        c = mx.sym.Convolution(x, num_filter=8, kernel=(7, 7),
+                               stride=(2, 2), pad=(3, 3), layout=layout,
+                               name="stem")
+        loss = mx.sym.MakeLoss(mx.sym.sum(c * c))
+        gx = mx.nd.zeros(dshape)
+        gw = mx.nd.zeros(wshape)
+        exe = loss.bind(
+            mx.cpu(),
+            {"data": mx.nd.array(rng.randn(*dshape).astype(np.float32)),
+             "stem_weight": mx.nd.array(
+                 (rng.randn(*wshape) * 0.1).astype(np.float32)),
+             "stem_bias": mx.nd.array(np.zeros(8, np.float32))},
+            args_grad={"data": gx, "stem_weight": gw},
+            grad_req={"data": "write", "stem_weight": "write",
+                      "stem_bias": "null"})
+        exe.forward(is_train=True)
+        out = exe.outputs[0].asnumpy().copy()
+        exe.backward()
+        return out, gx.asnumpy().copy(), gw.asnumpy().copy()
+
+    prior = os.environ.get("MXNET_TPU_S2D_STEM")
+    try:
+        for layout in ("NCHW", "NHWC"):
+            o0, gx0, gw0 = run(layout, False)
+            o1, gx1, gw1 = run(layout, True)
+            np.testing.assert_allclose(o1, o0, rtol=2e-5, atol=2e-5)
+            np.testing.assert_allclose(gx1, gx0, rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(gw1, gw0, rtol=2e-4, atol=2e-4)
+    finally:
+        if prior is None:
+            os.environ.pop("MXNET_TPU_S2D_STEM", None)
+        else:
+            os.environ["MXNET_TPU_S2D_STEM"] = prior
