@@ -12,6 +12,7 @@
 #include <cmath>
 #include <cstdio>
 #include <random>
+#include <unistd.h>
 #include <vector>
 
 using namespace mxtpu;
@@ -124,8 +125,28 @@ int main() {
   std::printf("kvstore: rank %d/%d pull [%g %g %g]\n", kv.Rank(),
               kv.NumWorkers(), pulled[0], pulled[1], pulled[2]);
 
+  /* ---- RecordIO round-trip ---- */
+  bool rec_ok = false;
+  {
+    char uri[64];
+    std::snprintf(uri, sizeof(uri), "/tmp/cpp_example.%d.rec",
+                  (int)getpid());  // unique per process; removed below
+    const std::string binary("binary\0data", 11);
+    {
+      RecordWriter w(uri);
+      w.Write("first record");
+      w.Write(binary);
+    }
+    RecordReader r(uri);
+    std::string rec1, rec2, rec3;
+    rec_ok = r.Read(&rec1) && r.Read(&rec2) && !r.Read(&rec3) &&
+             rec1 == "first record" && rec2 == binary;
+    std::printf("recordio: round-trip %s\n", rec_ok ? "ok" : "FAILED");
+    std::remove(uri);
+  }
+
   bool ok = loss < 0.5f * first_loss && correct >= kBatch * 0.9 &&
-            pulled[2] == 3.0f;
+            pulled[2] == 3.0f && rec_ok;
   std::printf(ok ? "CPP_OK\n" : "CPP_FAIL\n");
   return ok ? 0 : 1;
 }

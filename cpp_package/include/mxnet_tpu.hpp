@@ -525,6 +525,111 @@ class KVStore {
   KVStoreHandle h_ = nullptr;
 };
 
+/* -------------------------------------------------------- DataIter */
+class DataIter {
+ public:
+  /* Create a registered iterator by name (MNISTIter, CSVIter,
+   * ImageRecordIter, ImageDetRecordIter); param values are python
+   * literals as strings, e.g. {"data_shape", "(3,32,32)"}. */
+  DataIter(const std::string &name,
+           const std::map<std::string, std::string> &params) {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    Check(MXDataIterCreateIter(const_cast<char *>(name.c_str()),
+                               static_cast<mx_uint>(keys.size()),
+                               keys.data(), vals.data(), &h_),
+          "DataIterCreateIter");
+  }
+  ~DataIter() {
+    if (h_) MXDataIterFree(h_);
+  }
+  DataIter(const DataIter &) = delete;
+  DataIter &operator=(const DataIter &) = delete;
+
+  bool Next() {
+    int has = 0;
+    Check(MXDataIterNext(h_, &has), "DataIterNext");
+    return has != 0;
+  }
+  void Reset() { Check(MXDataIterBeforeFirst(h_), "DataIterBeforeFirst"); }
+  NDArray Data() const {
+    NDArrayHandle out = nullptr;
+    Check(MXDataIterGetData(h_, &out), "DataIterGetData");
+    return NDArray(out);
+  }
+  NDArray Label() const {
+    NDArrayHandle out = nullptr;
+    Check(MXDataIterGetLabel(h_, &out), "DataIterGetLabel");
+    return NDArray(out);
+  }
+  int PadNum() const {
+    int pad = 0;
+    Check(MXDataIterGetPadNum(h_, &pad), "DataIterGetPadNum");
+    return pad;
+  }
+
+ private:
+  DataIterHandle h_ = nullptr;
+};
+
+/* -------------------------------------------------------- RecordIO */
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string &uri) {
+    Check(MXRecordIOWriterCreate(uri.c_str(), &h_), "RecordIOWriterCreate");
+  }
+  ~RecordWriter() {
+    if (h_) MXRecordIOWriterFree(h_);
+  }
+  RecordWriter(const RecordWriter &) = delete;
+  RecordWriter &operator=(const RecordWriter &) = delete;
+
+  void Write(const std::string &record) {
+    Check(MXRecordIOWriterWriteRecord(h_, record.data(), record.size()),
+          "RecordIOWriterWriteRecord");
+  }
+  size_t Tell() const {
+    size_t pos = 0;
+    Check(MXRecordIOWriterTell(h_, &pos), "RecordIOWriterTell");
+    return pos;
+  }
+
+ private:
+  RecordIOHandle h_ = nullptr;
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string &uri) {
+    Check(MXRecordIOReaderCreate(uri.c_str(), &h_), "RecordIOReaderCreate");
+  }
+  ~RecordReader() {
+    if (h_) MXRecordIOReaderFree(h_);
+  }
+  RecordReader(const RecordReader &) = delete;
+  RecordReader &operator=(const RecordReader &) = delete;
+
+  /* false at EOF; otherwise *record holds the payload */
+  bool Read(std::string *record) {
+    const char *buf = nullptr;
+    size_t size = 0;
+    Check(MXRecordIOReaderReadRecord(h_, &buf, &size),
+          "RecordIOReaderReadRecord");
+    if (!buf) return false;
+    record->assign(buf, size);
+    return true;
+  }
+  void Seek(size_t pos) {
+    Check(MXRecordIOReaderSeek(h_, pos), "RecordIOReaderSeek");
+  }
+
+ private:
+  RecordIOHandle h_ = nullptr;
+};
+
 }  // namespace mxtpu
 
 #endif  // MXNET_TPU_CPP_HPP_

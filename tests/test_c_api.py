@@ -257,6 +257,70 @@ def test_c_api_dataiter(tmp_path):
     assert lib.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value
     assert lib.MXDataIterFree(it) == 0
 
+
+
+def _pack_tiny_recset(tmp_path, classes=2, per_class=8, size=16):
+    """Pack a tiny JPEG dataset; returns the .rec prefix."""
+    from PIL import Image
+
+    root = tmp_path / "imgs"
+    for label in range(classes):
+        d = root / ("c%d" % label)
+        d.mkdir(parents=True)
+        arr = np.full((size, size, 3), 60 + label * 120, np.uint8)
+        for i in range(per_class):
+            Image.fromarray(arr).save(str(d / ("i%d.jpg" % i)), "JPEG")
+    prefix = str(tmp_path / "tiny")
+    subprocess.run([sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+                    prefix, str(root)], check=True, capture_output=True)
+    return prefix
+
+
+def test_cpp_dataiter_wrapper(tmp_path):
+    """The C++ DataIter RAII wrapper (cpp_package) drains a packed .rec:
+    compile a small consumer, run it, check the batch count and Reset."""
+    pytest.importorskip("PIL.Image")
+    libpath = _lib_path()
+    cxx = shutil.which("g++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    prefix = _pack_tiny_recset(tmp_path)
+    src = tmp_path / "iter_demo.cpp"
+    src.write_text("""
+#include <mxnet_tpu.hpp>
+#include <cstdio>
+int main(int argc, char** argv) {
+  mxtpu::DataIter it("ImageRecordIter",
+                     {{"path_imgrec", argv[1]},
+                      {"data_shape", "(3,16,16)"},
+                      {"batch_size", "4"}});
+  int batches = 0;
+  while (it.Next()) {
+    auto shape = it.Data().Shape();
+    if (shape.size() != 4 || shape[0] != 4) return 1;
+    ++batches;
+  }
+  it.Reset();
+  if (!it.Next()) return 1;
+  std::printf("CPP_ITER_BATCHES %d\\n", batches);
+  return 0;
+}
+""")
+    exe = str(tmp_path / "iter_demo")
+    libdir = os.path.dirname(libpath)
+    subprocess.run(
+        [cxx, "-std=c++17", str(src),
+         "-I", os.path.join(ROOT, "include"),
+         "-I", os.path.join(ROOT, "cpp_package", "include"),
+         "-L", libdir, "-lmxnet_tpu", "-Wl,-rpath," + libdir, "-o", exe],
+        check=True, capture_output=True)
+    proc = subprocess.run([exe, prefix + ".rec"], capture_output=True,
+                          text=True, env=_run_env(), timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CPP_ITER_BATCHES 4" in proc.stdout, proc.stdout
+
+
+
 def test_c_api_prealloc_invoke_and_positional_infer():
     """Reference-ABI corners: pre-allocated in-place MXImperativeInvoke,
     keys=NULL positional MXSymbolInferShape with ndim-0 unknown slots,
