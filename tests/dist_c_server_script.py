@@ -1,0 +1,41 @@
+"""Server-role driver for the C-API RunServer test: everything through
+the C ABI via ctypes — create the (role-aware) kvstore handle, register
+a C controller callback, and block in MXKVStoreRunServer until the
+workers stop the job.  Received commands are appended to the file named
+by MXTPU_CTRL_LOG so the test can assert delivery."""
+import ctypes
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu import native  # noqa: E402
+
+lib = ctypes.CDLL(native.get_c_api_lib_path())
+lib.MXGetLastError.restype = ctypes.c_char_p
+
+kv = ctypes.c_void_p()
+assert lib.MXKVStoreCreate(b"dist_sync", ctypes.byref(kv)) == 0, \
+    lib.MXGetLastError()
+
+is_server = ctypes.c_int(0)
+assert lib.MXKVStoreIsServerNode(ctypes.byref(is_server)) == 0
+assert is_server.value == 1, "script must run with DMLC_ROLE=server"
+
+log_path = os.environ["MXTPU_CTRL_LOG"]
+CTRL = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                        ctypes.c_void_p)
+
+
+def controller(head, body, _handle):
+    with open(log_path, "a") as f:
+        f.write("%d:%s\n" % (head, (body or b"").decode()))
+
+
+ctrl = CTRL(controller)
+rc = lib.MXKVStoreRunServer(kv, ctrl, None)  # blocks until _STOP
+assert rc == 0, lib.MXGetLastError()
+print("C_SERVER_DONE")
+sys.stdout.flush()

@@ -91,12 +91,13 @@ def test_dist_create_without_cluster_env_raises():
                 os.environ[k] = v
 
 
-def test_worker_crash_and_recovery():
-    """A worker dies without finalize; a replacement rejoins under the old
-    rank (MXTPU_RECOVER_RANK ≙ ps-lite is_recovery), servers retain state,
-    the healthy worker observes dead -> recovered and both barrier."""
+
+def _cluster_scaffold(num_workers, num_servers, extra_env=None):
+    """Shared multi-process harness: free port, DMLC env, role spawner.
+
+    Returns (port, base_env, spawn, procs); callers kill leftover procs
+    in their finally block."""
     import socket
-    import time
 
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -105,18 +106,13 @@ def test_worker_crash_and_recovery():
     base_env = dict(os.environ)
     base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
     base_env.setdefault("JAX_PLATFORMS", "cpu")
-    flag = os.path.join(REPO, ".recover_flag_%d" % port)
     base_env.update({
         "DMLC_PS_ROOT_URI": "127.0.0.1",
         "DMLC_PS_ROOT_PORT": str(port),
-        "DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "1",
-        # fast detection so the test doesn't wait the 60 s default
-        "MXNET_KVSTORE_DEAD_TIMEOUT": "8",
-        "MXTPU_TEST_FLAG_FILE": flag,
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
     })
-    if os.path.exists(flag):
-        os.remove(flag)
-    script = os.path.join(REPO, "tests", "dist_recover_script.py")
+    base_env.update(extra_env or {})
     procs = []
 
     def spawn(role_env, args, extra=None):
@@ -127,6 +123,25 @@ def test_worker_crash_and_recovery():
                              stderr=subprocess.STDOUT, text=True)
         procs.append(p)
         return p
+
+    return port, base_env, spawn, procs
+
+
+def test_worker_crash_and_recovery():
+    """A worker dies without finalize; a replacement rejoins under the old
+    rank (MXTPU_RECOVER_RANK ≙ ps-lite is_recovery), servers retain state,
+    the healthy worker observes dead -> recovered and both barrier."""
+    import time
+
+    # fast detection so the test doesn't wait the 60 s default; the flag
+    # file name needs the port, so patch it in after the scaffold
+    port, base_env, spawn, procs = _cluster_scaffold(
+        2, 1, {"MXNET_KVSTORE_DEAD_TIMEOUT": "8"})
+    flag = os.path.join(REPO, ".recover_flag_%d" % port)
+    base_env["MXTPU_TEST_FLAG_FILE"] = flag
+    if os.path.exists(flag):
+        os.remove(flag)
+    script = os.path.join(REPO, "tests", "dist_recover_script.py")
 
     try:
         sched = spawn("scheduler", [
@@ -378,3 +393,86 @@ def test_server_command_error_does_not_kill_handler():
     srv.command_hook = lambda head, body: seen.append((head, bytes(body)))
     srv._handle_command(7, b"payload")  # non-zero head: hook only
     assert seen == [(7, b"payload")]
+
+
+def test_c_run_server_controller():
+    """MXKVStoreRunServer end to end: a server process driven ENTIRELY
+    through the C ABI (ctypes) registers a C controller, blocks in the
+    server loop, receives a custom command a python worker sends via
+    kvstore._send_command_to_servers, still serves push/pull, and exits
+    cleanly when the worker finalizes."""
+    import socket
+    import time
+
+    import pytest
+
+    from mxnet_tpu import native
+
+    if native.get_c_api_lib_path() is None:
+        pytest.skip("C ABI library unavailable")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env.setdefault("JAX_PLATFORMS", "cpu")
+    ctrl_log = os.path.join(REPO, ".ctrl_log_%d" % port)
+    if os.path.exists(ctrl_log):
+        os.remove(ctrl_log)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "MXTPU_CTRL_LOG": ctrl_log,
+    })
+    procs = []
+
+    def spawn(role, args):
+        env = dict(base_env)
+        env["DMLC_ROLE"] = role
+        p = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        return p
+
+    worker_code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+kv = mx.kv.create("dist_sync")
+kv._send_command_to_servers(7, b"custom-command")
+kv.init("k", mx.nd.ones((2, 2)))
+kv.push("k", mx.nd.ones((2, 2)) * 3)
+out = mx.nd.zeros((2, 2))
+kv.pull("k", out)
+assert np.allclose(out.asnumpy(), 3.0), out.asnumpy()
+kv.close()
+print("WORKER_OK")
+"""
+    try:
+        sched = spawn("scheduler", [
+            sys.executable, "-c",
+            "import sys; from mxnet_tpu.parallel.dist import "
+            "run_scheduler as r; sys.exit(r())"])
+        server = spawn("server", [
+            sys.executable,
+            os.path.join(REPO, "tests", "dist_c_server_script.py")])
+        worker = spawn("worker", [sys.executable, "-c", worker_code])
+        out_w, _ = worker.communicate(timeout=240)
+        assert worker.returncode == 0, out_w
+        assert "WORKER_OK" in out_w, out_w
+        out_s, _ = server.communicate(timeout=120)
+        assert server.returncode == 0, out_s
+        assert "C_SERVER_DONE" in out_s, out_s
+        assert sched.wait(timeout=60) == 0  # clean _FINALIZE deregister
+        with open(ctrl_log) as f:
+            log = f.read()
+        assert "7:custom-command" in log, log
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if os.path.exists(ctrl_log):
+            os.remove(ctrl_log)
