@@ -525,6 +525,92 @@ class KVStore {
   KVStoreHandle h_ = nullptr;
 };
 
+/* -------------------------------------------------------- CachedOp */
+class CachedOp {
+ public:
+  explicit CachedOp(const Symbol &sym) {
+    Check(MXCreateCachedOp(sym.handle(), &h_), "CreateCachedOp");
+  }
+  ~CachedOp() {
+    if (h_) MXFreeCachedOp(h_);
+  }
+  CachedOp(const CachedOp &) = delete;
+  CachedOp &operator=(const CachedOp &) = delete;
+
+  /* inputs in list_arguments order; per-signature executor reuse */
+  std::vector<NDArray> operator()(const std::vector<NDArray> &inputs) {
+    std::vector<NDArrayHandle> in;
+    for (const auto &a : inputs) in.push_back(a.handle());
+    int n_out = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXInvokeCachedOp(h_, static_cast<int>(in.size()), in.data(),
+                           &n_out, &outs),
+          "InvokeCachedOp");
+    std::vector<NDArray> result;
+    for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  CachedOpHandle h_ = nullptr;
+};
+
+/* -------------------------------------------------------- Autograd.
+ * Imperative tape over NDArray::Invoke calls: mark variables, run ops
+ * inside a Recording scope, Backward fills the marked grad arrays. */
+namespace autograd {
+
+class Recording {  // RAII train-mode toggle
+ public:
+  Recording() { Check(MXAutogradSetIsTraining(1, &prev_), "SetIsTraining"); }
+  ~Recording() {
+    int unused = 0;
+    MXAutogradSetIsTraining(prev_, &unused);
+  }
+  Recording(const Recording &) = delete;
+  Recording &operator=(const Recording &) = delete;
+
+ private:
+  int prev_ = 0;
+};
+
+/* grad req: 0 null, 1 write, 3 add */
+inline void MarkVariables(const std::vector<NDArray> &vars,
+                          const std::vector<NDArray> &grads,
+                          mx_uint req = 1) {
+  if (vars.size() != grads.size())
+    throw std::runtime_error(
+        "autograd::MarkVariables: vars/grads size mismatch");
+  std::vector<NDArrayHandle> vh, gh;
+  for (const auto &v : vars) vh.push_back(v.handle());
+  for (const auto &g : grads) gh.push_back(g.handle());
+  std::vector<mx_uint> reqs(vars.size(), req);
+  Check(MXAutogradMarkVariables(static_cast<mx_uint>(vh.size()), vh.data(),
+                                reqs.data(), gh.data()),
+        "MarkVariables");
+}
+
+/* Default-NDArray (is_none) or missing trailing entries in head_grads
+ * mean a ones-gradient for that output (the C ABI's NULL convention) */
+inline void Backward(const std::vector<NDArray> &outputs,
+                     const std::vector<NDArray> &head_grads = {},
+                     bool retain_graph = false) {
+  if (head_grads.size() > outputs.size())
+    throw std::runtime_error(
+        "autograd::Backward: more head_grads than outputs");
+  std::vector<NDArrayHandle> oh, gh;
+  for (const auto &o : outputs) oh.push_back(o.handle());
+  for (const auto &g : head_grads)
+    gh.push_back(g.is_none() ? nullptr : g.handle());
+  gh.resize(oh.size(), nullptr);  // pad: ones-gradient for the rest
+  Check(MXAutogradBackward(static_cast<mx_uint>(oh.size()), oh.data(),
+                           head_grads.empty() ? nullptr : gh.data(),
+                           retain_graph ? 1 : 0),
+        "AutogradBackward");
+}
+
+}  // namespace autograd
+
 /* -------------------------------------------------------- DataIter */
 class DataIter {
  public:

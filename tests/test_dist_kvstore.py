@@ -401,40 +401,17 @@ def test_c_run_server_controller():
     server loop, receives a custom command a python worker sends via
     kvstore._send_command_to_servers, still serves push/pull, and exits
     cleanly when the worker finalizes."""
-    import socket
-    import time
-
     import pytest
 
     from mxnet_tpu import native
 
     if native.get_c_api_lib_path() is None:
         pytest.skip("C ABI library unavailable")
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    base_env = dict(os.environ)
-    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
-    base_env.setdefault("JAX_PLATFORMS", "cpu")
+    port, base_env, spawn, procs = _cluster_scaffold(1, 1)
     ctrl_log = os.path.join(REPO, ".ctrl_log_%d" % port)
+    base_env["MXTPU_CTRL_LOG"] = ctrl_log
     if os.path.exists(ctrl_log):
         os.remove(ctrl_log)
-    base_env.update({
-        "DMLC_PS_ROOT_URI": "127.0.0.1",
-        "DMLC_PS_ROOT_PORT": str(port),
-        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
-        "MXTPU_CTRL_LOG": ctrl_log,
-    })
-    procs = []
-
-    def spawn(role, args):
-        env = dict(base_env)
-        env["DMLC_ROLE"] = role
-        p = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT, text=True)
-        procs.append(p)
-        return p
 
     worker_code = """
 import jax
