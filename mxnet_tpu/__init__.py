@@ -15,8 +15,10 @@ from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_tpus, num_gpus
 from . import ops
+from . import engine
 from . import ndarray
 from . import ndarray as nd
+from .ndarray import waitall
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol, Variable, Group

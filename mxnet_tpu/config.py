@@ -43,6 +43,19 @@ REGISTRY = [
     EnvVar("DMLC_PS_ROOT_PORT", int, 9091, "Scheduler port"),
     EnvVar("DMLC_NUM_WORKER", int, 1, "Worker count"),
     EnvVar("DMLC_NUM_SERVER", int, 1, "Server count"),
+    # ---- dependency engine (engine/) ----
+    EnvVar("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+           "Execution engine backend (engine/): ThreadedEnginePerDevice "
+           "(default; ThreadedEngine accepted) schedules host-side ops "
+           "on a worker pool with read/write-var dependency ordering; "
+           "NaiveEngine executes every push inline for debugging/"
+           "determinism. Unknown values warn and fall back to the "
+           "default (reference src/engine/engine.cc CreateEngine)"),
+    EnvVar("MXNET_CPU_WORKER_NTHREADS", int, 0,
+           "Engine worker threads (engine/threaded.py); 0 = auto, "
+           "min(4, max(2, n_cpus)). The reference defaults to 1; here "
+           "auto keeps >=2 workers so host compute, IO decode, and "
+           "kvstore traffic overlap out of the box"),
     # ---- memory (executor.py) ----
     EnvVar("MXNET_BACKWARD_DO_MIRROR", int, 0,
            "Memory mirroring: recompute cheap activations (BN/ReLU/elemwise) "
@@ -88,15 +101,17 @@ REGISTRY = [
 
 # reference env vars whose role XLA/PJRT absorbed — accepted, ignored,
 # documented (reference docs/how_to/env_var.md)
+# NOTE: MXNET_ENGINE_TYPE and MXNET_CPU_WORKER_NTHREADS graduated from
+# this table to the registry above when the dependency engine (engine/)
+# landed — the host-side scheduler is ours again; XLA keeps only the
+# device-side knobs.
 ABSORBED = {
-    "MXNET_CPU_WORKER_NTHREADS": "XLA thread pools",
     "MXNET_GPU_WORKER_NTHREADS": "PJRT device streams",
     "MXNET_CPU_PRIORITY_NTHREADS": "XLA scheduling",
     "MXNET_EXEC_ENABLE_INPLACE": "XLA buffer assignment",
     "NNVM_EXEC_MATCH_RANGE": "XLA memory planner",
     "MXNET_EXEC_NUM_TEMP": "XLA temp allocation",
     "MXNET_GPU_MEM_POOL_RESERVE": "PJRT allocator",
-    "MXNET_ENGINE_TYPE": "PJRT async dispatch (no engine choice)",
     "MXNET_EXEC_BULK_EXEC_INFERENCE": "whole-graph jit (always bulk)",
     "MXNET_EXEC_BULK_EXEC_TRAIN": "whole-graph jit (always bulk)",
     "MXNET_KVSTORE_REDUCTION_NTHREADS": "XLA collectives",

@@ -1,0 +1,122 @@
+"""mxnet_tpu.engine — the async dependency-tracking execution engine (L1).
+
+Parity: reference `src/engine/` + `include/mxnet/engine.h:75-214`.  The
+engine sits below everything that touches data: NDArray imperative ops,
+kvstore push/pull, and the IO prefetchers all dispatch through
+:func:`push` with declared read/write variable sets, giving RAW/WAR/WAW
+ordering over mutable state plus async overlap of host-side compute,
+decode, and gradient traffic.  Device-side ordering remains XLA's job
+(see docs/engine.md "how ordering maps onto XLA async dispatch") — this
+engine schedules the HOST side the same way the reference's
+ThreadedEngine did.
+
+Two backends, selected by ``MXNET_ENGINE_TYPE``:
+
+  * ``ThreadedEnginePerDevice`` (default; ``ThreadedEngine`` accepted) —
+    N worker threads, N from ``MXNET_CPU_WORKER_NTHREADS``.
+  * ``NaiveEngine`` — synchronous, for debugging/determinism.
+
+Unknown values warn and fall back to the default (reference
+engine/engine.cc:39-51 CreateEngine).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+
+from .naive import NaiveEngine
+from .threaded import ThreadedEngine
+from .var import Var, in_engine_op
+from .threaded_iter import ThreadedIter
+
+__all__ = ["get", "set_engine_type", "push", "new_variable", "wait_for_var",
+           "wait_for_all", "in_engine_op", "Var", "ThreadedIter",
+           "NaiveEngine", "ThreadedEngine"]
+
+_ENGINE = None
+_ENGINE_LOCK = threading.Lock()
+
+_THREADED_NAMES = ("ThreadedEnginePerDevice", "ThreadedEngine")
+
+
+def _default_workers():
+    # reference defaults MXNET_CPU_WORKER_NTHREADS to 1; we default to a
+    # small pool so host compute / IO decode / kvstore traffic overlap
+    # out of the box (the whole point of the engine on TPU hosts)
+    try:
+        ncpu = os.cpu_count() or 2
+    except Exception:
+        ncpu = 2
+    return max(2, min(4, ncpu))
+
+
+def _create(engine_type=None, num_workers=None):
+    from .. import config
+
+    # knob defaults live in the config registry (single source of truth);
+    # this wrapper only adds the warn-instead-of-raise fallbacks
+    engine_type = engine_type or config.get("MXNET_ENGINE_TYPE")
+    if num_workers is None:
+        try:
+            # 0 = auto (the registered default): pick _default_workers();
+            # explicit ints are taken as-is
+            num_workers = config.get("MXNET_CPU_WORKER_NTHREADS")
+        except ValueError:
+            warnings.warn("MXNET_CPU_WORKER_NTHREADS=%r is not an int; "
+                          "using the auto default"
+                          % os.environ.get("MXNET_CPU_WORKER_NTHREADS"))
+            num_workers = 0
+        if num_workers <= 0:
+            num_workers = _default_workers()
+    if engine_type == "NaiveEngine":
+        return NaiveEngine()
+    if engine_type not in _THREADED_NAMES:
+        warnings.warn("MXNET_ENGINE_TYPE=%r is unknown (expected one of "
+                      "NaiveEngine, ThreadedEngine, ThreadedEnginePerDevice); "
+                      "falling back to ThreadedEnginePerDevice" % engine_type)
+    return ThreadedEngine(num_workers=num_workers)
+
+
+def get():
+    """The process-wide engine singleton (reference Engine::Get())."""
+    global _ENGINE
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                _ENGINE = _create()
+    return _ENGINE
+
+
+def set_engine_type(engine_type, num_workers=None):
+    """Swap the engine backend.  Drains the old engine first so no op
+    straddles two schedulers; returns the new engine."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is not None:
+            _ENGINE.wait_for_all()
+            _ENGINE.stop()
+        _ENGINE = _create(engine_type, num_workers)
+        return _ENGINE
+
+
+# ----------------------------------------------------------------------
+# module-level convenience mirroring the reference C API surface
+# ----------------------------------------------------------------------
+
+def new_variable():
+    return get().new_variable()
+
+
+def push(fn, read_vars=(), write_vars=(), priority=0, name=None, wait=False,
+         atomic=True):
+    return get().push(fn, read_vars=read_vars, write_vars=write_vars,
+                      priority=priority, name=name, wait=wait, atomic=atomic)
+
+
+def wait_for_var(var, wait_reads=False):
+    get().wait_for_var(var, wait_reads=wait_reads)
+
+
+def wait_for_all():
+    get().wait_for_all()

@@ -1,0 +1,60 @@
+"""NaiveEngine — synchronous reference backend.
+
+Parity: reference `src/engine/naive_engine.cc`.  Every `push` executes
+the op inline on the calling thread before returning, so program order
+IS execution order: no queues, no races, errors surface at the push
+site.  Select with ``MXNET_ENGINE_TYPE=NaiveEngine`` for debugging and
+determinism; results must be identical to the threaded backend (the
+dependency discipline guarantees it — tests/test_engine.py asserts the
+equivalence on a real model).
+"""
+from __future__ import annotations
+
+import time
+
+from .var import Var, enter_op, exit_op
+
+__all__ = ["NaiveEngine"]
+
+
+class NaiveEngine:
+    """Synchronous engine (reference NaiveEngine, naive_engine.cc:23-88)."""
+
+    kind = "NaiveEngine"
+    num_workers = 0
+
+    def new_variable(self):
+        return Var()
+
+    def push(self, fn, read_vars=(), write_vars=(), priority=0, name=None,
+             wait=False, atomic=True):
+        """Execute inline; by the time push returns every dependency is
+        trivially satisfied, so vars never carry pending state.  `atomic`
+        is accepted for signature parity — under synchronous execution
+        nothing is ever pending, so the distinction is moot."""
+        from .. import profiler
+
+        if atomic:
+            enter_op()
+        t0 = time.time()
+        try:
+            fn()
+        finally:
+            if atomic:
+                exit_op()
+            t1 = time.time()
+            profiler.record_span("engine::" + (name or getattr(fn, "__name__", "op")),
+                                 int(t0 * 1e6), int((t1 - t0) * 1e6), cat="engine")
+        return None
+
+    def help_one(self, timeout=0.02):
+        return False  # synchronous: there is never queued work to help with
+
+    def wait_for_var(self, var, wait_reads=False):
+        pass  # nothing is ever pending
+
+    def wait_for_all(self):
+        pass
+
+    def stop(self):
+        pass

@@ -1,0 +1,294 @@
+"""ThreadedEngine — the asynchronous dependency-tracking scheduler.
+
+Parity: reference `src/engine/threaded_engine_perdevice.cc`.  Ops are
+pushed with declared read/write variable sets and return immediately;
+a pool of N worker threads (``MXNET_CPU_WORKER_NTHREADS``) executes
+them as their dependencies resolve, highest `priority` first (FIFO
+within a priority class).  Errors raised inside an op are captured and
+re-raised at the next synchronization point — `wait_for_var`,
+`wait_for_all`, or any value read — matching the reference's deferred
+error behavior (threaded_engine.cc `OnCompleteStatic` + the var's
+stored exception).
+
+Atomicity invariant: an engine op is the unit of scheduling.  Code
+running *inside* an op (worker context) must only touch state covered
+by the op's declared vars; nested `push` calls from inside an op
+execute inline so the enclosing op stays atomic (the kvstore updater
+path relies on this — see kvstore.py push).
+
+Signaling design: one lock, two condition queues.  Producers notify
+exactly one worker per newly-runnable op (`_work_cv.notify`), and
+completions wake sync-point waiters only when any are registered
+(`_waiters` counter) — `notify_all` on a shared condition per push
+measured ~200 µs/op of GIL thrash at Python speeds; this layout runs
+an order of magnitude cheaper.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+
+from .var import (OpRecord, Var, attach_tokens, dedupe_vars, grant_ready,
+                  release_tokens, enter_op, exit_op, in_engine_op)
+
+__all__ = ["ThreadedEngine"]
+
+
+class ThreadedEngine:
+    """N-worker dependency engine (reference ThreadedEnginePerDevice)."""
+
+    kind = "ThreadedEnginePerDevice"
+
+    def __init__(self, num_workers=2):
+        self.num_workers = max(1, int(num_workers))
+        self._lock = threading.Lock()
+        self._work_cv = threading.Condition(self._lock)   # workers idle here
+        self._done_cv = threading.Condition(self._lock)   # sync points wait here
+        self._ready = []          # heap of runnable OpRecords
+        self._inflight = 0        # pushed, not yet completed
+        self._waiters = 0         # threads blocked in wait_for_var/all
+        self._errors = []         # deferred exceptions, FIFO
+        self._shutdown = False
+        self._workers = []
+        for i in range(self.num_workers):
+            t = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name="mxtpu-engine-worker-%d" % i)
+            t.start()
+            self._workers.append(t)
+
+    # ------------------------------------------------------------------
+    # public engine contract (reference include/mxnet/engine.h:75-214)
+    # ------------------------------------------------------------------
+    def new_variable(self):
+        """Allocate a dependency variable (reference Engine::NewVariable)."""
+        return Var()
+
+    def push(self, fn, read_vars=(), write_vars=(), priority=0, name=None,
+             wait=False, atomic=True):
+        """Schedule `fn` after all pending writers of `read_vars` and all
+        pending accessors of `write_vars` (reference Engine::PushAsync).
+
+        Returns the OpRecord; `wait=True` blocks until completion and
+        re-raises the op's error there (reference Engine::PushSync).
+        `atomic=False` ops keep normal sync semantics inside their body
+        (see OpRecord.atomic) — for ops running arbitrary foreign code.
+        """
+        if in_engine_op():
+            # nested push from inside an atomic op body: run inline so the
+            # enclosing op remains the atomic unit of scheduling
+            fn()
+            return None
+        reads, writes = dedupe_vars(read_vars, write_vars)
+        op = OpRecord(fn, name or getattr(fn, "__name__", "op"), priority,
+                      atomic=atomic)
+        if wait:
+            op.done = threading.Event()
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("engine is stopped")
+            self._inflight += 1
+            attach_tokens(op, reads, writes)
+            if op.pending == 0:
+                heapq.heappush(self._ready, op)
+                self._work_cv.notify()
+            else:
+                n = 0
+                for v in reads:
+                    for r in grant_ready(v):
+                        heapq.heappush(self._ready, r)
+                        n += 1
+                for v in writes:
+                    for r in grant_ready(v):
+                        heapq.heappush(self._ready, r)
+                        n += 1
+                if n:
+                    self._work_cv.notify(n)
+        if wait:
+            op.done.wait()
+            if op.exception is not None:
+                exc = op.exception
+                self._discard_error(exc)
+                raise exc
+        return op
+
+    def wait_for_var(self, var, wait_reads=False):
+        """Block until `var`'s pending writes (and, with `wait_reads`,
+        pending reads) complete; re-raise its deferred error (reference
+        Engine::WaitForVar ≙ NDArray::WaitToRead).
+
+        The waiting thread WORK-STEALS: while its target is pending it
+        executes ready ops itself rather than sleeping through a
+        condition round-trip — the synchronous push-then-read pattern
+        then runs at inline speed instead of paying two GIL handoffs
+        per op, and a blocked consumer can never be starved by busy
+        workers."""
+        if in_engine_op():
+            return  # dependency ordering already guarantees visibility
+        self._wait(lambda: var.pending_writes
+                   or (wait_reads and var.pending_reads))
+        with self._lock:
+            self._raise_var_exception(var)
+
+    def wait_for_all(self):
+        """Drain the whole engine, then re-raise the first deferred error
+        (reference Engine::WaitForAll).  Work-steals like wait_for_var."""
+        if in_engine_op():
+            return
+        self._wait(lambda: self._inflight)
+        with self._lock:
+            if self._errors:
+                exc = self._errors[0]
+                del self._errors[:]
+                raise exc
+
+    def help_one(self, timeout=0.02):
+        """Execute ONE ready op on the calling thread, if any; otherwise
+        wait up to `timeout` for engine activity.  Returns True iff an op
+        ran.  For consumers blocked on op side effects the var system
+        cannot see (e.g. ThreadedIter's hand-off queue): polling this
+        instead of hard-blocking keeps the pool deadlock-free even when
+        engine-backed iterators nest and every worker is inside a
+        consumer."""
+        with self._lock:
+            if self._ready:
+                op = heapq.heappop(self._ready)
+            else:
+                if self._inflight:
+                    self._waiters += 1
+                    try:
+                        self._done_cv.wait(timeout)
+                    finally:
+                        self._waiters -= 1
+                return False
+        self._execute(op)
+        self._complete(op)
+        return True
+
+    def _wait(self, still_pending):
+        """Run ready ops on this thread until `still_pending()` is false,
+        sleeping only when the heap is empty (ops are mid-flight on
+        workers)."""
+        while True:
+            with self._lock:
+                if not still_pending():
+                    return
+                if self._ready:
+                    op = heapq.heappop(self._ready)
+                else:
+                    self._waiters += 1
+                    try:
+                        self._done_cv.wait()
+                    finally:
+                        self._waiters -= 1
+                    continue
+            self._execute(op)
+            self._complete(op)
+
+    def stop(self):
+        """Drain and terminate the worker pool (used when swapping engines)."""
+        with self._lock:
+            self._waiters += 1
+            try:
+                while self._inflight:
+                    self._done_cv.wait()
+            finally:
+                self._waiters -= 1
+            self._shutdown = True
+            self._work_cv.notify_all()
+        for t in self._workers:
+            t.join(timeout=5.0)
+        self._workers = []
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _raise_var_exception(self, var):
+        # caller holds the lock
+        if var.exception is not None:
+            exc = var.exception
+            var.exception = None
+            try:
+                self._errors.remove(exc)
+            except ValueError:
+                pass
+            raise exc
+
+    def _discard_error(self, exc):
+        with self._lock:
+            try:
+                self._errors.remove(exc)
+            except ValueError:
+                pass
+
+    def _worker_loop(self):
+        while True:
+            with self._lock:
+                while not self._ready and not self._shutdown:
+                    self._work_cv.wait()
+                if self._shutdown and not self._ready:
+                    return
+                op = heapq.heappop(self._ready)
+            self._execute(op)
+            self._complete(op, will_take_next=True)
+
+    def _complete(self, op, will_take_next=False):
+        """Post-execution bookkeeping, shared by workers and stealing
+        waiters: poison/clear vars, release tokens, wake whoever needs it.
+        `will_take_next` (workers only): the caller's loop pops the heap
+        unconditionally next, so one wakeup can be elided; a stealing
+        waiter may instead return as soon as its target is free, so every
+        op it made ready must get its own wakeup or it would strand."""
+        with self._lock:
+            if op.exception is not None:
+                for tok in op.tokens:
+                    if tok.is_write:
+                        tok.var.exception = op.exception
+                # identity-dedup: poison propagation re-raises the SAME
+                # exception object in every downstream op; one delivery at
+                # one sync point must clear it everywhere, or a handled
+                # error would re-raise at a later wait_for_all
+                if not any(e is op.exception for e in self._errors):
+                    self._errors.append(op.exception)
+            else:
+                # a successful write supersedes stale poison: the var
+                # now holds a good value again
+                for tok in op.tokens:
+                    if tok.is_write and tok.var.exception is not None:
+                        tok.var.exception = None
+            ready = release_tokens(op)
+            if ready:
+                for r in ready:
+                    heapq.heappush(self._ready, r)
+                n = len(ready) - 1 if will_take_next else len(ready)
+                if n:
+                    self._work_cv.notify(n)
+            self._inflight -= 1
+            if self._waiters:
+                self._done_cv.notify_all()
+        if op.done is not None:
+            op.done.set()
+
+    def _execute(self, op):
+        from .. import profiler
+
+        if op.atomic:
+            enter_op()
+        t0 = time.time()
+        try:
+            # a failed producer poisons its consumers: propagate instead
+            # of computing on garbage (reference threaded_engine.cc
+            # global exception chaining).  Only READ deps poison — a pure
+            # writer replaces the value and clears the var on success.
+            for tok in op.tokens:
+                if not tok.is_write and tok.var.exception is not None:
+                    raise tok.var.exception
+            op.fn()
+        except BaseException as e:  # deferred to the next sync point
+            op.exception = e
+        finally:
+            if op.atomic:
+                exit_op()
+            t1 = time.time()
+            profiler.record_span("engine::" + op.name, int(t0 * 1e6),
+                                 int((t1 - t0) * 1e6), cat="engine")

@@ -1,0 +1,177 @@
+"""Engine variables and dependency tokens.
+
+Parity: reference `src/engine/threaded_engine.h` `ThreadedVar` /
+`VersionedVarBlock` (the per-variable FIFO of pending reader/writer
+blocks, threaded_engine.h:60-170).  A :class:`Var` owns a FIFO queue of
+:class:`Token`s, one per (op, access-kind); the grant rule over that
+queue is exactly the reference's:
+
+  * a READ token is runnable when no WRITE token precedes it;
+  * a WRITE token is runnable only when it is at the head of the queue
+    (all earlier readers and writers have completed and been removed).
+
+This yields RAW (a later reader waits for the pending writer), WAR (a
+later writer waits for pending readers) and WAW (writers are serialized
+in push order) — the dataflow semantics `note_engine.md` builds MXNet
+on.  All queue state is guarded by the owning engine's single lock; at
+Python speeds (the GIL serializes bytecode anyway) a sharded lock buys
+nothing.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+__all__ = ["Var", "Token", "OpRecord", "dedupe_vars", "attach_tokens",
+           "grant_ready", "release_tokens", "enter_op", "exit_op",
+           "in_engine_op"]
+
+_var_ids = itertools.count()
+
+# Worker-context flag, shared by all backends.  Code running inside an
+# engine op reads values through `NDArray._raw()`-style direct access
+# (its declared deps are guaranteed complete) and nested pushes execute
+# inline — both keyed off this thread-local.
+_TLS = threading.local()
+
+
+def enter_op():
+    _TLS.depth = getattr(_TLS, "depth", 0) + 1
+
+
+def exit_op():
+    _TLS.depth = getattr(_TLS, "depth", 1) - 1
+
+
+def in_engine_op():
+    """True when the calling thread is executing inside an engine op."""
+    return getattr(_TLS, "depth", 0) > 0
+
+
+class Var:
+    """One engine variable — the dependency-tracking handle for a chunk
+    of mutable state (reference engine.h:75 `Engine::NewVariable`)."""
+
+    __slots__ = ("vid", "queue", "pending_writes", "pending_reads",
+                 "exception", "__weakref__")
+
+    def __init__(self):
+        self.vid = next(_var_ids)
+        self.queue = []            # FIFO of Tokens (granted ones stay until done)
+        self.pending_writes = 0    # queued + running write tokens
+        self.pending_reads = 0     # queued + running read tokens
+        self.exception = None      # deferred error from the last failed writer
+
+    def __repr__(self):
+        return "<Var %d r%d w%d>" % (self.vid, self.pending_reads, self.pending_writes)
+
+
+class Token:
+    """One op's claim on one Var (reference VersionedVarBlock)."""
+
+    __slots__ = ("op", "var", "is_write", "granted")
+
+    def __init__(self, op, var, is_write):
+        self.op = op
+        self.var = var
+        self.is_write = is_write
+        self.granted = False
+
+
+class OpRecord:
+    """One pushed operation (reference ThreadedOpr, threaded_engine.h:180)."""
+
+    __slots__ = ("fn", "tokens", "pending", "priority", "seq", "name",
+                 "done", "exception", "atomic")
+
+    _seq = itertools.count()
+
+    def __init__(self, fn, name, priority, atomic=True):
+        self.fn = fn
+        self.name = name
+        self.priority = priority
+        # atomic ops run in worker context: value reads bypass the engine
+        # fence (declared deps guarantee freshness) and nested pushes
+        # inline.  Non-atomic ops (ThreadedIter fetches running arbitrary
+        # user iterator code) keep normal sync semantics — their reads
+        # wait (work-stealing keeps that deadlock-free) and their nested
+        # pushes queue.
+        self.atomic = atomic
+        self.seq = next(OpRecord._seq)  # FIFO tiebreak inside a priority class
+        self.tokens = []
+        self.pending = 0               # ungranted tokens; 0 => runnable
+        self.done = None               # Event, allocated only for PushSync
+        self.exception = None
+
+    def __lt__(self, other):           # heapq ordering: high priority first
+        return (-self.priority, self.seq) < (-other.priority, other.seq)
+
+
+def dedupe_vars(read_vars, write_vars):
+    """Normalize dependency sets: writes subsume reads of the same var
+    (a read+write of one var is a single write claim, matching the
+    reference's CHECK against overlapping const/mutable vars), and
+    duplicates collapse to one token."""
+    writes, seen = [], set()
+    for v in write_vars:
+        if id(v) not in seen:
+            seen.add(id(v))
+            writes.append(v)
+    reads = []
+    for v in read_vars:
+        if id(v) not in seen:
+            seen.add(id(v))
+            reads.append(v)
+    return reads, writes
+
+
+def attach_tokens(op, read_vars, write_vars):
+    """Create and enqueue one token per (op, var); returns them ungranted.
+    Caller holds the engine lock."""
+    for v in read_vars:
+        t = Token(op, v, False)
+        op.tokens.append(t)
+        v.queue.append(t)
+        v.pending_reads += 1
+    for v in write_vars:
+        t = Token(op, v, True)
+        op.tokens.append(t)
+        v.queue.append(t)
+        v.pending_writes += 1
+    op.pending = len(op.tokens)
+
+
+def grant_ready(var):
+    """Scan `var`'s queue from the head, granting every runnable token.
+    Returns ops whose pending count hit zero (now dispatchable).
+    Caller holds the engine lock."""
+    ready = []
+    for i, tok in enumerate(var.queue):
+        if tok.is_write:
+            if i == 0 and not tok.granted:
+                tok.granted = True
+                tok.op.pending -= 1
+                if tok.op.pending == 0:
+                    ready.append(tok.op)
+            break                     # nothing behind a write may run
+        if not tok.granted:
+            tok.granted = True
+            tok.op.pending -= 1
+            if tok.op.pending == 0:
+                ready.append(tok.op)
+    return ready
+
+
+def release_tokens(op):
+    """Remove `op`'s tokens from their vars and re-grant each queue.
+    Returns newly runnable ops.  Caller holds the engine lock."""
+    ready = []
+    for tok in op.tokens:
+        var = tok.var
+        var.queue.remove(tok)
+        if tok.is_write:
+            var.pending_writes -= 1
+        else:
+            var.pending_reads -= 1
+        ready.extend(grant_ready(var))
+    return ready

@@ -4,18 +4,19 @@ Parity: reference src/io/iter_image_recordio_2.cc composition chain
 (record parser → decode/augment workers → BatchLoader → Normalize →
 Prefetcher, SURVEY.md §3.3).  The byte-level record scan runs in native
 C++ (src/recordio.cc); decode+augment run in a Python thread pool (PIL/cv2
-release the GIL); a background prefetch thread double-buffers batches ahead
-of the consumer feeding the device.
+release the GIL); batch assembly rides the dependency engine — each
+batch is one engine op on the shared worker pool (engine.ThreadedIter,
+the dmlc threadediter replacement), so prefetch depth is demand-driven
+and `mx.waitall()` fences the IO pipeline too.
 """
 from __future__ import annotations
 
-import queue as _queue
-import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as _np
 
 from .base import MXNetError
+from .engine.threaded_iter import ThreadedIter
 from .io import DataBatch, DataDesc, DataIter
 from .ndarray import array
 from .ops.random_ops import HOST_RNG
@@ -69,10 +70,8 @@ class ImageRecordIterImpl(DataIter):
         if not self._offsets:
             raise MXNetError("no records in shard %d/%d of %s" % (part_index, num_parts, path_imgrec))
         self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
-        self._queue = _queue.Queue(maxsize=prefetch_buffer)
-        self._producer = None
-        self._epoch_order = None
-        self._stop = threading.Event()
+        self._prefetch = max(1, int(prefetch_buffer))
+        self._bg = None
         self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
         self.provide_label = [
             DataDesc(label_name, (batch_size,) if label_width == 1 else (batch_size, label_width))
@@ -166,62 +165,46 @@ class ImageRecordIterImpl(DataIter):
             batch_data[j] = img
         return True
 
-    def _produce(self, order):
-        try:
-            batch_data = _np.empty((self.batch_size,) + self.data_shape, dtype=_np.float32)
-            lshape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
-            batch_label = _np.zeros(lshape, dtype=_np.float32)
-            for start in range(0, len(order), self.batch_size):
-                if self._stop.is_set():
-                    return
-                chunk = order[start:start + self.batch_size]
-                if not self._fill_batch_native(chunk, batch_data, batch_label):
-                    futures = [
-                        self._pool.submit(self._decode_one, self._reader.read_at(off))
-                        for off in chunk
-                    ]
-                    for j, fut in enumerate(futures):
-                        img, label = fut.result()
-                        batch_data[j] = img
-                        batch_label[j] = label
-                n = len(chunk)
-                if n == self.batch_size:
-                    self._queue.put((batch_data.copy(), batch_label.copy()))
-                else:
-                    # last partial batch: pad by wrapping (reference pad semantics)
-                    for j in range(n, self.batch_size):
-                        batch_data[j] = batch_data[j - n]
-                        batch_label[j] = batch_label[j - n]
-                    self._queue.put((batch_data.copy(), batch_label.copy(),
-                                     self.batch_size - n))
-        finally:
-            self._queue.put(None)
+    def _batches(self, order):
+        """Generator yielding (data, label[, pad]) per batch; driven one
+        batch per engine op by the ThreadedIter in reset()."""
+        batch_data = _np.empty((self.batch_size,) + self.data_shape, dtype=_np.float32)
+        lshape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+        batch_label = _np.zeros(lshape, dtype=_np.float32)
+        for start in range(0, len(order), self.batch_size):
+            chunk = order[start:start + self.batch_size]
+            if not self._fill_batch_native(chunk, batch_data, batch_label):
+                futures = [
+                    self._pool.submit(self._decode_one, self._reader.read_at(off))
+                    for off in chunk
+                ]
+                for j, fut in enumerate(futures):
+                    img, label = fut.result()
+                    batch_data[j] = img
+                    batch_label[j] = label
+            n = len(chunk)
+            if n == self.batch_size:
+                yield (batch_data.copy(), batch_label.copy())
+            else:
+                # last partial batch: pad by wrapping (reference pad semantics)
+                for j in range(n, self.batch_size):
+                    batch_data[j] = batch_data[j - n]
+                    batch_label[j] = batch_label[j - n]
+                yield (batch_data.copy(), batch_label.copy(),
+                       self.batch_size - n)
 
     def reset(self):
-        self._stop.set()
-        if self._producer is not None:
-            while self._producer.is_alive():
-                try:
-                    self._queue.get_nowait()
-                except _queue.Empty:
-                    pass
-                self._producer.join(timeout=0.01)
-            while True:
-                try:
-                    self._queue.get_nowait()
-                except _queue.Empty:
-                    break
-        self._stop.clear()
+        if self._bg is not None:
+            self._bg.close()  # drains in-flight fetches before we rewind
         order = list(self._offsets)
         if self.shuffle:
             self._rng.shuffle(order)
-        self._producer = threading.Thread(target=self._produce, args=(order,), daemon=True)
-        self._producer.start()
+        gen = self._batches(order)
+        self._bg = ThreadedIter(lambda: next(gen), max_prefetch=self._prefetch,
+                                name="image_record_iter")
 
     def next(self):
-        item = self._queue.get()
-        if item is None:
-            raise StopIteration
+        item = next(self._bg)
         if len(item) == 3:
             data, label, pad = item
         else:
@@ -230,4 +213,5 @@ class ImageRecordIterImpl(DataIter):
         return DataBatch(data=[array(data)], label=[array(label)], pad=pad, index=None)
 
     def __del__(self):
-        self._stop.set()
+        if getattr(self, "_bg", None) is not None:
+            self._bg.cancel()

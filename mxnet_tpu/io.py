@@ -11,14 +11,13 @@ from __future__ import annotations
 import gzip
 import os
 import struct
-import threading
-import queue as _queue
 from collections import namedtuple
 
 import numpy as _np
 
 from .base import MXNetError
 from . import ndarray as nd
+from .engine.threaded_iter import ThreadedIter
 from .ndarray import NDArray, array
 
 __all__ = [
@@ -256,9 +255,11 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread prefetch over one or more iterators
-    (parity: io.py PrefetchingIter; reference double-buffering
-    src/io/iter_prefetcher.h:96-118)."""
+    """Engine-backed prefetch over one or more iterators (parity: io.py
+    PrefetchingIter; reference double-buffering iter_prefetcher.h:96-118
+    over dmlc threadediter — here each batch fetch is one engine op, so
+    decode overlaps with device compute on the engine's worker pool and
+    `mx.waitall()` fences IO along with everything else)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -270,49 +271,26 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self._queues = None
-        self._started = False
-        self.prefetch_threads = []
+        self._bg_iters = None
         self.current_batch = [None for _ in range(self.n_iter)]
         self._start_prefetch()
 
     def _start_prefetch(self):
-        self._queues = [_queue.Queue(maxsize=2) for _ in range(self.n_iter)]
-        self._started = True
-
-        def prefetch_func(i):
-            while self._started:
-                try:
-                    batch = self.iters[i].next()
-                except StopIteration:
-                    batch = None
-                self._queues[i].put(batch)
-                if batch is None:
-                    break
-
-        self.prefetch_threads = []
-        for i in range(self.n_iter):
-            t = threading.Thread(target=prefetch_func, args=(i,), daemon=True)
-            t.start()
-            self.prefetch_threads.append(t)
+        self._bg_iters = [
+            ThreadedIter(it.next, max_prefetch=2, name="prefetch_%d" % i)
+            for i, it in enumerate(self.iters)
+        ]
 
     def _stop_prefetch(self):
-        """Shut producers down cleanly: a producer may be blocked in put(), so
-        drain while joining, and only discard queues once threads are dead."""
-        self._started = False
-        for t in self.prefetch_threads:
-            while t.is_alive():
-                for q in self._queues:
-                    try:
-                        q.get_nowait()
-                    except _queue.Empty:
-                        pass
-                t.join(timeout=0.01)
-        self._queues = None
-        self.prefetch_threads = []
+        if self._bg_iters is not None:
+            for bg in self._bg_iters:
+                bg.close()
+        self._bg_iters = None
 
     def __del__(self):
-        self._started = False
+        if self._bg_iters is not None:
+            for bg in self._bg_iters:
+                bg.cancel()
 
     @property
     def provide_data(self):
@@ -347,7 +325,12 @@ class PrefetchingIter(DataIter):
         self._start_prefetch()
 
     def iter_next(self):
-        batches = [q.get() for q in self._queues]
+        batches = []
+        for bg in self._bg_iters:
+            try:
+                batches.append(next(bg))
+            except StopIteration:
+                batches.append(None)
         if any(b is None for b in batches):
             return False
         self.current_batch = batches

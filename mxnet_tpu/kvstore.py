@@ -24,6 +24,7 @@ import os
 import pickle
 
 from .base import MXNetError
+from . import engine
 from . import optimizer as opt
 from .ndarray import NDArray, zeros
 
@@ -43,6 +44,11 @@ class KVStore:
     def __init__(self, kv_type="local"):
         self.type = kv_type
         self._store = {}
+        # one engine var per key: push/pull on a key are engine ops
+        # serialized through it (reference KVStoreLocal wraps each merged
+        # buffer's engine var the same way, kvstore_local.h:65-118), so
+        # gradient aggregation overlaps with unrelated host compute
+        self._key_vars = {}
         self._updater = None
         self._optimizer = None
         self._barrier_count = 0
@@ -51,40 +57,75 @@ class KVStore:
     # init/push/pull (parity: kvstore.py init/push/pull;
     # reference KVStoreLocal::Push/Pull kvstore_local.h:65-118)
     # ------------------------------------------------------------------
+    def _key_var(self, k):
+        if k not in self._key_vars:
+            self._key_vars[k] = engine.new_variable()
+        return self._key_vars[k]
+
     def init(self, key, value):
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
+            self._key_var(k)
             if k in self._store:
                 continue  # parity: re-Init of existing key ignored (dist_server.h:147-163)
             self._store[k] = v.copy() if isinstance(v, NDArray) else v
 
     def push(self, key, value, priority=0):
         """Push (aggregate) values.  A list-of-lists aggregates per key across
-        devices — Reduce ≙ fused on-device sum (reference comm.h:216-259)."""
+        devices — Reduce ≙ fused on-device sum (reference comm.h:216-259).
+
+        Each key's aggregate+update is ONE engine op reading the gradient
+        vars and writing the key var, so it overlaps with forward/backward
+        of other layers exactly like the reference's CommCPU reduce
+        (higher `priority` keys are scheduled first — callers pass -index
+        so back-layer gradients, produced first, also update first)."""
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
-            if isinstance(v, (list, tuple)):
-                merged = v[0].copy()
-                for other in v[1:]:
+            if self._updater is not None and k not in self._store \
+                    and k not in self._key_vars:
+                # the updater path needs an init'd weight; fail at the push
+                # site, not as a bare KeyError at a later sync point
+                raise MXNetError("key %s has not been initialized" % str(k))
+            vlist = list(v) if isinstance(v, (list, tuple)) else [v]
+            read_vars = [g._engine_var() for g in vlist if isinstance(g, NDArray)]
+            write_vars = [self._key_var(k)]
+            stored = self._store.get(k)
+            if isinstance(stored, NDArray):
+                write_vars.append(stored._engine_var())
+
+            def _do_push(_k=k, _vlist=vlist):
+                merged = _vlist[0].copy()
+                for other in _vlist[1:]:
                     merged += other
-            else:
-                merged = v.copy()
-            if self._updater is not None:
-                self._updater(k, merged, self._store[k])
-            else:
-                self._store[k] = merged
+                if self._updater is not None:
+                    self._updater(_k, merged, self._store[_k])
+                else:
+                    self._store[_k] = merged
+
+            engine.push(_do_push, read_vars=read_vars, write_vars=write_vars,
+                        priority=priority, name="kvstore_push:%s" % k)
 
     def pull(self, key, out=None, priority=0):
         keys, outs = _ctype_key_value(key, out)
         for k, o in zip(keys, outs):
-            if k not in self._store:
+            if k not in self._store and k not in self._key_vars:
+                # never init'd OR pushed: fail eagerly.  A key touched by a
+                # queued push is legitimate — the key-var dependency orders
+                # this pull after that push materializes the entry.
                 raise MXNetError("key %s has not been initialized" % str(k))
-            src = self._store[k]
-            if isinstance(o, (list, tuple)):
-                for oo in o:
+            olist = list(o) if isinstance(o, (list, tuple)) else [o]
+            write_vars = [oo._engine_var() for oo in olist]
+
+            def _do_pull(_k=k, _olist=olist):
+                if _k not in self._store:
+                    raise MXNetError("key %s has not been initialized" % str(_k))
+                src = self._store[_k]
+                for oo in _olist:
                     oo[:] = src
-            else:
-                o[:] = src
+
+            engine.push(_do_pull, read_vars=[self._key_var(k)],
+                        write_vars=write_vars, priority=priority,
+                        name="kvstore_pull:%s" % k)
 
     # ------------------------------------------------------------------
     # optimizer plumbing (parity: kvstore.py set_optimizer/_set_updater)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .context import Context, cpu, current_context
 from .ops.registry import OP_REGISTRY, get_op
+from . import engine
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
            "concatenate", "moveaxis", "load", "loads", "save", "waitall",
@@ -62,15 +63,18 @@ class NDArray:
     """Multi-dimensional array on a device (parity: python/mxnet/ndarray.py NDArray)."""
 
     # _fresh_grad backs MXNDArray{Set,Get}GradState (set lazily; unset
-    # slot reads as 0 through the C API)
+    # slot reads as 0 through the C API).  _var is the engine dependency
+    # variable for this chunk (reference NDArray::var(), ndarray.h:350),
+    # created lazily on first engine dispatch.
     __slots__ = ("_data", "_ctx", "_parent", "_index", "writable",
-                 "_fresh_grad")
+                 "_fresh_grad", "_var")
 
     def __init__(self, data, ctx=None, _parent=None, _index=None):
         self._parent = _parent
         self._index = _index
         self._ctx = ctx if ctx is not None else current_context()
         self._data = data
+        self._var = None
         self.writable = True
 
     # ------------------------------------------------------------------
@@ -78,15 +82,71 @@ class NDArray:
     # ------------------------------------------------------------------
     @property
     def data(self):
-        """The underlying jax.Array (lazy slice of parent for views)."""
+        """The underlying jax.Array (lazy slice of parent for views).
+
+        This is a READ sync point: if engine ops are pending on this
+        chunk's variable the read blocks until the writers complete (and
+        re-raises their deferred error — reference WaitToRead semantics).
+        Inside an engine op the wait is skipped: the op's declared deps
+        already guarantee the value is final."""
         if self._parent is not None:
             return self._parent.data[self._index]
+        var = self._var
+        if var is not None and (var.pending_writes or var.exception is not None) \
+                and not engine.in_engine_op():
+            engine.get().wait_for_var(var)
+        if self._data is None and var is not None:
+            # the producing engine op failed and its deferred error was
+            # already delivered at an earlier sync point; a clear error
+            # beats an AttributeError on a None payload downstream
+            raise MXNetError(
+                "NDArray value is unavailable: the engine op that was to "
+                "produce it failed (its error was raised at an earlier "
+                "sync point)")
+        return self._data
+
+    def _raw(self):
+        """Payload WITHOUT engine sync — only valid inside an engine op
+        whose declared read/write vars cover this array."""
+        if self._parent is not None:
+            return self._parent._raw()[self._index]
+        return self._data
+
+    def _engine_var(self):
+        """This chunk's dependency variable (reference NDArray::var();
+        views share their parent's var, as reference views share the
+        Chunk)."""
+        if self._parent is not None:
+            return self._parent._engine_var()
+        if self._var is None:
+            self._var = engine.Var()
+        return self._var
+
+    def _full_overwrite_base(self):
+        """Current payload for a whole-array overwrite, or None when there
+        is none to preserve (the producing op failed): inside an engine op
+        the raw payload is authoritative; outside, pending writers are
+        awaited first so a not-yet-delivered producer error still raises
+        here rather than being silently papered over."""
+        if self._parent is not None:
+            return self.data
+        if engine.in_engine_op():
+            return self._raw()
+        var = self._var
+        if var is not None and (var.pending_writes or var.exception is not None):
+            return self.data  # waits; re-raises an undelivered deferred error
         return self._data
 
     def _set_data(self, value):
         if self._parent is not None:
             self._parent._set_data(self._parent.data.at[self._index].set(value))
         else:
+            var = self._var
+            if var is not None and (var.pending_writes or var.pending_reads) \
+                    and not engine.in_engine_op():
+                # in-place assignment is a WRITE on the chunk var: wait out
+                # pending readers (WAR) and writers (WAW) before swapping
+                engine.get().wait_for_var(var, wait_reads=True)
             self._data = value
 
     # ------------------------------------------------------------------
@@ -168,17 +228,31 @@ class NDArray:
     def wait_to_read(self):
         """Block until this array's value is computed (reference WaitToRead).
 
-        On tunneled/relay device platforms (axon) `block_until_ready` can
-        return before execution finishes; there a 1-element host transfer is
-        the reliable fence.  Healthy local platforms keep the transfer-free
-        fence."""
+        Two fences compose: the engine's `wait_for_var` drains pending
+        host-side ops on this chunk's variable, then the device fence
+        covers XLA's own async dispatch.  On tunneled/relay device
+        platforms (axon) `block_until_ready` can return before execution
+        finishes; there a 1-element host transfer is the reliable fence.
+        Healthy local platforms keep the transfer-free fence."""
+        self._sync(wait_reads=False)
+
+    def wait_to_write(self):
+        """Block until pending readers AND writers finish (reference
+        WaitToWrite): after this, an in-place mutation cannot race a
+        queued engine op."""
+        self._sync(wait_reads=True)
+
+    def _sync(self, wait_reads):
+        base = self
+        while base._parent is not None:
+            base = base._parent
+        if base._var is not None:
+            engine.get().wait_for_var(base._var, wait_reads=wait_reads)
         d = self.data
         if hasattr(d, "block_until_ready"):
             d.block_until_ready()
         if _needs_scalar_fence() and d.size:
             jax.device_get(d.ravel()[0])
-
-    wait_to_write = wait_to_read
 
     # ------------------------------------------------------------------
     # conversion / copies
@@ -221,16 +295,30 @@ class NDArray:
         return NDArray(None, self._ctx, _parent=self, _index=key)
 
     def __setitem__(self, key, value):
-        val = _as_jax(value, dtype=self.dtype)
         # NOTE: builtins.slice — the registry populates a module-level `slice`
         # op function in this namespace, which would shadow the builtin here.
         if isinstance(key, builtins.slice) and key == builtins.slice(None):
-            base = self.data
+            base = self._full_overwrite_base()
+            if base is None:
+                # revival of a failed array (its producer op errored and the
+                # deferred error was already delivered): a full overwrite
+                # needs no prior value — this is how e.g. kv.pull restores
+                # a poisoned weight, and how the engine's
+                # write-clears-poison rule stays reachable
+                newval = _as_jax(value)
+                if getattr(newval, "ndim", 0) == 0:
+                    raise MXNetError(
+                        "cannot restore a failed NDArray from a scalar: its "
+                        "shape was never materialized; assign a full array")
+                self._set_data(newval)
+                return
+            val = _as_jax(value, dtype=base.dtype)
             self._set_data(jnp.broadcast_to(val, base.shape).astype(base.dtype))
-        else:
-            if isinstance(key, NDArray):
-                key = key.data.astype(jnp.int32)
-            self._set_data(self.data.at[key].set(val))
+            return
+        val = _as_jax(value, dtype=self.dtype)
+        if isinstance(key, NDArray):
+            key = key.data.astype(jnp.int32)
+        self._set_data(self.data.at[key].set(val))
 
     def slice(self, start, stop):
         return self[start:stop]
@@ -246,10 +334,8 @@ class NDArray:
         if isinstance(other, _np.ndarray) and other.ndim == 0:
             other = float(other)
         if isinstance(other, (NDArray, jax.Array, _np.ndarray)):
-            lhs, rhs = self.data, _as_jax(other)
-            if reverse:
-                lhs, rhs = rhs, lhs
-            out = NDArray(get_op(op_name).fn(lhs, rhs), self._ctx)
+            args = (other, self) if reverse else (self, other)
+            out = _engine_invoke(get_op(op_name), args, {}, self._ctx)
             if _RECORD_HOOK is not None:
                 fn = get_op(op_name).fn
                 if isinstance(other, NDArray):
@@ -264,7 +350,8 @@ class NDArray:
                         _RECORD_HOOK(lambda x, _c=const, _f=fn: _f(x, _c),
                                      [self], [out])
             return out
-        out = NDArray(get_op(scalar_name).fn(self.data, scalar=float(other)), self._ctx)
+        out = _engine_invoke(get_op(scalar_name), (self,),
+                             {"scalar": float(other)}, self._ctx)
         if _RECORD_HOOK is not None:
             _RECORD_HOOK(lambda x, _f=get_op(scalar_name).fn, _s=float(other):
                          _f(x, scalar=_s), [self], [out])
@@ -368,6 +455,7 @@ class NDArray:
     def __setstate__(self, state):
         self._parent = None
         self._index = None
+        self._var = None
         self._ctx = Context(*state["ctx"])
         self._data = jnp.asarray(state["data"])
         self.writable = True
@@ -492,10 +580,14 @@ def _needs_scalar_fence():
 
 
 def waitall():
-    """Best-effort global fence (reference Engine::WaitForAll).
+    """Global fence (reference Engine::WaitForAll).
 
-    JAX has no global work queue to drain; we fence a fresh computation,
-    which on an in-order device stream completes after all prior work."""
+    Drains the dependency engine (all pushed NDArray/kvstore/io ops),
+    re-raising the first deferred engine error, then fences the device:
+    JAX has no global work queue to drain, so we fence a fresh
+    computation, which on an in-order device stream completes after all
+    prior work."""
+    engine.get().wait_for_all()
     x = jnp.zeros(()) + 0
     x.block_until_ready()
     if _needs_scalar_fence():
@@ -693,12 +785,72 @@ def _load_container_format(f):
 # ----------------------------------------------------------------------
 
 
+def _tracer_free(args):
+    """False when any operand is (backed by) a live jax Tracer: a
+    CustomOp / torch-bridge forward may run imperative ops INSIDE an
+    active jax transformation, and deferring those to a worker thread
+    would leak the tracer out of its trace
+    (jax.errors.UnexpectedTracerError) — they must execute eagerly on
+    the tracing thread."""
+    for a in args:
+        if isinstance(a, NDArray):
+            base = a
+            while base._parent is not None:
+                base = base._parent
+            if isinstance(base._data, jax.core.Tracer):
+                return False
+        elif isinstance(a, jax.core.Tracer):
+            return False
+    return True
+
+
+def _engine_invoke(op, args, kwargs, ctx, priority=0):
+    """Dispatch one single-output op through the dependency engine
+    (reference Engine::PushAsync from MXImperativeInvoke,
+    c_api_ndarray.cc:248-430): returns the output handle immediately;
+    the value materializes on an engine worker once all input writers
+    have completed.  Reads on the result synchronize via its chunk var.
+    Tracer operands fall back to eager inline execution."""
+    if not _tracer_free(args):
+        return NDArray(op.fn(*[_as_jax(a) for a in args], **kwargs), ctx)
+    # non-NDArray operands are snapshotted NOW: a numpy scratch buffer the
+    # caller mutates after this call has no engine var, so only an eager
+    # copy keeps the op's inputs at their call-site values.  copy=True is
+    # load-bearing: jnp.asarray on CPU may zero-copy ALIAS numpy memory,
+    # which is no snapshot at all (jax.Arrays are immutable, so they pass
+    # through untouched)
+    args = tuple(
+        a if isinstance(a, NDArray)
+        else jnp.array(a, copy=True) if isinstance(a, _np.ndarray)
+        else _as_jax(a)
+        for a in args)
+    out = NDArray(None, ctx)
+    eng = engine.get()
+    read_vars = [a._engine_var() for a in args if isinstance(a, NDArray)]
+
+    def _run(_op=op, _args=args, _kw=kwargs, _out=out):
+        jax_args = [a._raw() if isinstance(a, NDArray) else a for a in _args]
+        _out._data = _op.fn(*jax_args, **_kw)
+
+    eng.push(_run, read_vars=read_vars, write_vars=(out._engine_var(),),
+             priority=priority, name=op.name)
+    return out
+
+
+def _engine_dispatchable(op, args):
+    """Ops the engine path covers: single fixed output, no aux-state
+    mutation, no host RNG (draw order must follow program order), no
+    mesh/is_train plumbing, and no variadic list arguments."""
+    return (op.num_outputs == 1 and op.num_aux_out == 0
+            and not op.need_rng and not op.need_mesh and not op.need_is_train
+            and not any(isinstance(a, (list, tuple)) for a in args))
+
+
 def _make_nd_function(op):
     def fn(*args, **kwargs):
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)  # accepted for symbol-compat call sites
         ctx = kwargs.pop("ctx", None)
-        jax_args = [_as_jax(a) for a in args]
         res_ctx = None
         for a in args:
             if isinstance(a, NDArray):
@@ -709,15 +861,18 @@ def _make_nd_function(op):
             from .ops.params import validate_attrs
 
             validate_attrs(op, kwargs)
-        result = op.fn(*jax_args, **kwargs)
-        n_main = op.num_outputs(kwargs) if callable(op.num_outputs) else op.num_outputs
-        if isinstance(result, tuple):
-            main = result[: len(result) - op.num_aux_out] if op.num_aux_out else result
-            boxed = tuple(NDArray(r, res_ctx) for r in main)
-            if len(boxed) == 1:
-                boxed = boxed[0]
+        if _engine_dispatchable(op, args):
+            boxed = _engine_invoke(op, args, kwargs, res_ctx)
         else:
-            boxed = NDArray(result, res_ctx)
+            jax_args = [_as_jax(a) for a in args]
+            result = op.fn(*jax_args, **kwargs)
+            if isinstance(result, tuple):
+                main = result[: len(result) - op.num_aux_out] if op.num_aux_out else result
+                boxed = tuple(NDArray(r, res_ctx) for r in main)
+                if len(boxed) == 1:
+                    boxed = boxed[0]
+            else:
+                boxed = NDArray(result, res_ctx)
         if _RECORD_HOOK is not None:
             nd_ins = [a for a in args if isinstance(a, NDArray)]
             nd_outs = list(boxed) if isinstance(boxed, tuple) else [boxed]

@@ -313,8 +313,16 @@ def _nms_iou(boxes):
     return jnp.where(union <= 0.0, 0.0, inter / union)
 
 
+from .params import Int as _ParamInt  # noqa: E402  (placed by MultiBoxDetection)
+
+
 @register("_contrib_MultiBoxDetection", inputs=("cls_prob", "loc_pred", "anchor"),
-          infer_shape=_infer_mbdet)
+          infer_shape=_infer_mbdet,
+          # declared so the check runs EAGERLY at the call site (engine
+          # dispatch defers fn bodies; attr validation must not defer)
+          params={"background_id": _ParamInt(
+              default=0, low=0, high=0,
+              desc="only background_id=0 is supported")})
 def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
                        background_id=0, nms_threshold=0.5, force_suppress=False,
                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):

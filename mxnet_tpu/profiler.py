@@ -103,10 +103,15 @@ def _join_xla_trace(trace_dir):
             })
 
 
-def record_span(name, start_us, dur_us, cat="operator", tid=0):
-    """Record one span; called by executors when profiling is on."""
+def record_span(name, start_us, dur_us, cat="operator", tid=None):
+    """Record one span; called by executors and engine workers when
+    profiling is on.  `tid` defaults to the REAL calling thread id so
+    engine worker lanes render as separate rows in chrome://tracing
+    (reference SetOprStart/SetOprEnd record per-thread ProfileStat)."""
     if not _STATE["running"]:
         return
+    if tid is None:
+        tid = threading.get_ident()
     with _LOCK:
         _EVENTS.append({"name": name, "cat": cat, "ph": "X", "ts": start_us,
                         "dur": dur_us, "pid": 0, "tid": tid})

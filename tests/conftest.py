@@ -20,6 +20,40 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--engine-type", default=None,
+        help="Run the suite under this MXNET_ENGINE_TYPE (NaiveEngine / "
+             "ThreadedEnginePerDevice); equivalent to setting the env var.")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running stress tests, excluded from tier-1")
+    engine_type = config.getoption("--engine-type")
+    if engine_type:
+        # before any test imports mxnet_tpu, so the lazy engine singleton
+        # picks it up; plain `MXNET_ENGINE_TYPE=... pytest` works too
+        os.environ["MXNET_ENGINE_TYPE"] = engine_type
+
+
+def pytest_report_header(config):
+    return "MXNET_ENGINE_TYPE=%s" % os.environ.get(
+        "MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice (default)")
+
+
+@pytest.fixture(autouse=True)
+def _engine_barrier():
+    """Drain the dependency engine after each test so async ops cannot
+    bleed across tests — and so a deferred engine error is attributed to
+    the test that produced it, not a random later one."""
+    yield
+    import sys as _sys
+
+    if "mxnet_tpu" in _sys.modules:
+        _sys.modules["mxnet_tpu"].engine.wait_for_all()
+
+
 @pytest.fixture(autouse=True)
 def _fresh_name_manager():
     """Reset auto-naming counters per test so tests that reference generated

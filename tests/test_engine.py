@@ -1,0 +1,452 @@
+"""Dependency-engine tests (reference tests/python/unittest/test_engine.py
++ the threaded-engine stress patterns of tests/cpp/engine/threaded_engine_test.cc).
+
+Runs under both backends: `MXNET_ENGINE_TYPE=NaiveEngine pytest tests/`
+(or `--engine-type NaiveEngine`) must pass everything here that does not
+explicitly construct a ThreadedEngine.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, profiler
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.fixture
+def threaded_engine():
+    """A ThreadedEngine with enough workers to exercise real parallelism,
+    restored to the session's configured backend afterwards."""
+    prev = engine.get().kind
+    eng = engine.set_engine_type("ThreadedEnginePerDevice", num_workers=4)
+    yield eng
+    engine.set_engine_type(prev)
+
+
+# ----------------------------------------------------------------------
+# ordering semantics
+# ----------------------------------------------------------------------
+
+def test_raw_war_waw_ordering(threaded_engine):
+    """Writers are serialized (WAW), each reader sees exactly the writes
+    pushed before it (RAW), and a later writer waits for earlier readers
+    (WAR) — so the read log is exactly 1..N despite 4 workers."""
+    eng = threaded_engine
+    n = 200
+    v = eng.new_variable()
+    val = [0]
+    log = []
+    for i in range(n):
+        def w(i=i):
+            if i % 17 == 0:
+                time.sleep(0.001)  # jitter to provoke reordering bugs
+            val[0] += 1
+
+        eng.push(w, write_vars=[v], name="w%d" % i)
+
+        def r():
+            log.append(val[0])
+
+        eng.push(r, read_vars=[v], name="r%d" % i)
+    eng.wait_for_all()
+    assert val[0] == n
+    assert log == list(range(1, n + 1))
+
+
+def test_independent_chains_run_and_converge(threaded_engine):
+    """Disjoint write chains share nothing and may run in any interleaving;
+    each chain's own WAW order must still hold."""
+    eng = threaded_engine
+    chains = 8
+    per = 50
+    vs = [eng.new_variable() for _ in range(chains)]
+    vals = [[0] for _ in range(chains)]
+    for step in range(per):
+        for c in range(chains):
+            def w(c=c, step=step):
+                assert vals[c][0] == step  # strict WAW order within the chain
+                vals[c][0] = step + 1
+
+            eng.push(w, write_vars=[vs[c]])
+    eng.wait_for_all()
+    assert [v[0] for v in vals] == [per] * chains
+
+
+def test_engine_ordering_through_ndarray():
+    """The NDArray imperative path rides the same var discipline: parallel
+    reads off one array, then a RAW reduction chain."""
+    x = mx.nd.ones((8, 8))
+    ys = [x * float(i) for i in range(1, 21)]  # 20 parallel readers of x
+    total = ys[0]
+    for y in ys[1:]:
+        total = total + y  # RAW chain
+    assert total.asnumpy()[0, 0] == float(sum(range(1, 21)))
+    x[:] = 3.0  # WAR: must wait for all readers
+    assert x.asnumpy()[0, 0] == 3.0
+
+
+def test_priority_prefers_urgent_ops(threaded_engine):
+    """Among simultaneously-ready ops, higher priority dispatches first
+    (reference PushAsync priority hint)."""
+    eng = threaded_engine
+    start_gate, end_gate = threading.Event(), threading.Event()
+    order = []
+    # park all but one worker for the whole test, and the last worker
+    # until pushing is done — the survivor then drains the heap serially,
+    # so completion order == dispatch order == priority order
+    for _ in range(eng.num_workers - 1):
+        eng.push(lambda: end_gate.wait(10), write_vars=[eng.new_variable()])
+    eng.push(lambda: start_gate.wait(10), write_vars=[eng.new_variable()])
+    for i in range(10):
+        eng.push(lambda i=i: order.append(("lo", i)), priority=0,
+                 write_vars=[eng.new_variable()])
+    for i in range(10):
+        eng.push(lambda i=i: order.append(("hi", i)), priority=10,
+                 write_vars=[eng.new_variable()])
+    start_gate.set()
+    while len(order) < 20:
+        time.sleep(0.005)
+    end_gate.set()
+    eng.wait_for_all()
+    seq = [kind for kind, _ in order]
+    assert seq == ["hi"] * 10 + ["lo"] * 10, order
+    # FIFO within each priority class
+    assert [i for k, i in order if k == "hi"] == list(range(10))
+
+
+# ----------------------------------------------------------------------
+# deferred errors
+# ----------------------------------------------------------------------
+
+def test_deferred_exception_reraised_at_wait_for_var():
+    eng = engine.get()
+    v = eng.new_variable()
+
+    def boom():
+        raise ValueError("engine boom")
+
+    # NaiveEngine raises at push (inline exec); ThreadedEngine defers to
+    # the sync point — both surface inside this block
+    with pytest.raises(ValueError, match="engine boom"):
+        eng.push(boom, write_vars=[v], name="boom")
+        eng.wait_for_var(v)
+    eng.wait_for_all()  # error was consumed at the var sync, not re-raised
+
+
+def test_deferred_exception_through_ndarray_read():
+    with pytest.raises(TypeError):
+        y = mx.nd.dot(mx.nd.ones((2, 2)), mx.nd.ones((3, 3)))
+        y.asnumpy()
+    mx.waitall()
+
+
+def test_failed_producer_poisons_consumer(threaded_engine):
+    """An op consuming a failed op's output propagates the original error
+    instead of computing on garbage."""
+    eng = threaded_engine
+    v1, v2 = eng.new_variable(), eng.new_variable()
+
+    def boom():
+        raise RuntimeError("producer failed")
+
+    eng.push(boom, write_vars=[v1])
+    eng.push(lambda: None, read_vars=[v1], write_vars=[v2])
+    with pytest.raises(RuntimeError, match="producer failed"):
+        eng.wait_for_var(v2)
+    # one failure = one delivery: the propagated copies are deduped, so a
+    # later global barrier does not re-raise a handled error...
+    eng.wait_for_all()
+    # ...but v1's own poison still delivers at v1's OWN sync point
+    with pytest.raises(RuntimeError, match="producer failed"):
+        eng.wait_for_var(v1)
+
+
+# ----------------------------------------------------------------------
+# backend equivalence + sync API
+# ----------------------------------------------------------------------
+
+def test_waitall_exported_and_fences():
+    assert mx.waitall is mx.nd.waitall
+    a = mx.nd.ones((16, 16))
+    for _ in range(5):
+        a += 1
+    mx.waitall()
+    assert a.asnumpy()[0, 0] == 6
+
+
+def test_naive_and_threaded_engines_agree_on_model():
+    """Same small MLP fit (test_module fixtures) under both backends gives
+    identical parameters — the dependency discipline makes the threaded
+    schedule equivalent to the naive serial one."""
+    from test_module import _mlp, _toy_data
+
+    X, y = _toy_data(n=128)
+    params = {}
+    prev = engine.get().kind
+    try:
+        for kind in ("NaiveEngine", "ThreadedEnginePerDevice"):
+            engine.set_engine_type(kind, num_workers=4)
+            mx.random.seed(11)
+            train = mx.io.NDArrayIter(X, y, batch_size=32)
+            mod = mx.mod.Module(_mlp(), context=mx.cpu())
+            # a real KVStore handle (not the string, which single-device
+            # fit short-circuits to None) so gradient aggregation rides
+            # engine ops in both backends
+            mod.fit(train, optimizer="sgd", kvstore=mx.kv.create("local"),
+                    optimizer_params={"learning_rate": 0.05}, num_epoch=2,
+                    initializer=mx.init.Xavier(), force_init=True)
+            arg, _ = mod.get_params()
+            params[kind] = {k: v.asnumpy() for k, v in arg.items()}
+    finally:
+        engine.set_engine_type(prev)
+    for k in params["NaiveEngine"]:
+        assert_almost_equal(params["NaiveEngine"][k],
+                            params["ThreadedEnginePerDevice"][k],
+                            rtol=1e-6, atol=1e-7)
+
+
+def test_unknown_engine_type_warns_and_falls_back():
+    prev = engine.get().kind
+    try:
+        with pytest.warns(UserWarning, match="MXNET_ENGINE_TYPE"):
+            eng = engine.set_engine_type("TurboEngine9000")
+        assert eng.kind == "ThreadedEnginePerDevice"
+    finally:
+        engine.set_engine_type(prev)
+
+
+# ----------------------------------------------------------------------
+# load-bearing dispatch: ndarray / kvstore / io all go through push
+# ----------------------------------------------------------------------
+
+def test_paths_dispatch_through_engine_push(monkeypatch):
+    eng = engine.get()
+    names = []
+    orig_push = eng.push
+
+    def spy(fn, **kwargs):
+        names.append(kwargs.get("name"))
+        return orig_push(fn, **kwargs)
+
+    monkeypatch.setattr(eng, "push", spy)
+
+    (mx.nd.ones((2, 2)) + 1.0).asnumpy()                      # ndarray path
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 2)))
+    kv.push(3, [mx.nd.ones((2, 2)), mx.nd.ones((2, 2))])      # kvstore path
+    out = mx.nd.zeros((2, 2))
+    kv.pull(3, out=out)
+    assert out.asnumpy()[0, 0] == 2.0
+    it = mx.io.NDArrayIter(np.zeros((8, 2), "f"), np.zeros(8, "f"),
+                           batch_size=4)
+    pf = mx.io.PrefetchingIter(it)                            # io path
+    assert pf.next() is not None
+    pf._stop_prefetch()
+    mx.waitall()
+
+    assert "_plus_scalar" in names
+    assert any(str(n).startswith("kvstore_push") for n in names)
+    assert any(str(n).startswith("kvstore_pull") for n in names)
+    assert any(str(n).startswith("prefetch") for n in names)
+
+
+def test_numpy_operands_snapshot_at_call_site(threaded_engine):
+    """A numpy scratch buffer mutated after the op call must not change
+    the op's result — raw operands have no engine var, so they are
+    copied eagerly at dispatch."""
+    eng = threaded_engine
+    gate = threading.Event()
+    for _ in range(eng.num_workers):  # park workers: the add stays queued
+        eng.push(lambda: gate.wait(10), write_vars=[eng.new_variable()])
+    a = mx.nd.ones((4,))
+    buf = np.full((4,), 10.0, dtype=np.float32)
+    c = a + buf
+    buf[:] = 999.0
+    gate.set()
+    assert list(c.asnumpy()) == [11.0] * 4
+
+
+def test_prefetch_op_syncs_on_undeclared_arrays(threaded_engine):
+    """A ThreadedIter fetch op runs arbitrary iterator code; NDArray reads
+    inside it must observe pending engine writes (non-atomic op semantics),
+    even when the producer is queued BEHIND the fetch in priority order."""
+    from mxnet_tpu.engine.threaded_iter import ThreadedIter
+
+    eng = threaded_engine
+    gate = threading.Event()
+    for _ in range(eng.num_workers):
+        eng.push(lambda: gate.wait(10), write_vars=[eng.new_variable()])
+    scale = mx.nd.ones((1,)) * 5.0          # queued, priority 0
+    vals = iter([1.0, 2.0])
+
+    def next_fn():
+        return float(next(vals)) * float(scale.asnumpy()[0])
+
+    it = ThreadedIter(next_fn, max_prefetch=1, priority=10)  # runs first
+    gate.set()
+    assert next(it) == 5.0
+    assert next(it) == 10.0
+    with pytest.raises(StopIteration):
+        next(it)
+    it.close()
+
+
+def test_nested_threaded_iters_single_worker():
+    """Engine-backed iterators nest without deadlock even on a 1-worker
+    pool: a consumer with an empty hand-off queue helps the engine run
+    ready ops instead of pinning the worker in a blind blocking get."""
+    from mxnet_tpu.engine.threaded_iter import ThreadedIter
+
+    prev = engine.get().kind
+    try:
+        engine.set_engine_type("ThreadedEnginePerDevice", num_workers=1)
+        inner_src = iter(range(30))
+        inner = ThreadedIter(lambda: next(inner_src), max_prefetch=2,
+                             name="inner")
+        outer = ThreadedIter(lambda: next(inner), max_prefetch=2,
+                             name="outer")
+        assert list(outer) == list(range(30))
+        outer.close()
+        inner.close()
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_failed_array_revivable_by_overwrite():
+    """After a deferred producer error is delivered, a full overwrite
+    (kv.pull or x[:] = ...) restores the array — the engine's
+    successful-write-clears-poison rule must be reachable."""
+    from mxnet_tpu.base import MXNetError
+
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((2, 2)) * 4.0)
+    x = None
+    with pytest.raises(Exception):
+        x = mx.nd.dot(mx.nd.ones((2, 2)), mx.nd.ones((3, 3)))  # shape mismatch
+        x.asnumpy()  # threaded: deferred error delivered here
+    if x is None:
+        return  # NaiveEngine raised at the op call: no failed state to revive
+    with pytest.raises(MXNetError, match="unavailable"):
+        x.asnumpy()  # value never materialized: clear error, not NoneType
+    with pytest.raises(MXNetError, match="scalar"):
+        x[:] = 0.0  # scalar revival would silently lose the shape
+    kv.pull("w", out=x)  # full-array overwrite revives it
+    assert (x.asnumpy() == 4.0).all()
+    mx.waitall()
+
+
+def test_kvstore_pull_sees_queued_push(threaded_engine):
+    """pull() after an uninit'd push must order behind the queued push op
+    (the key var carries the dependency), not fail the eager key check —
+    while a never-touched key still fails eagerly."""
+    from mxnet_tpu.base import MXNetError
+
+    eng = threaded_engine
+    kv = mx.kv.create("local")
+    gate = threading.Event()
+    for _ in range(eng.num_workers):  # park workers: push stays queued
+        eng.push(lambda: gate.wait(10), write_vars=[eng.new_variable()])
+    kv.push(7, mx.nd.ones((2, 2)) * 3.0)  # no init: the op creates the entry
+    out = mx.nd.zeros((2, 2))
+    kv.pull(7, out=out)
+    with pytest.raises(MXNetError, match="not been initialized"):
+        kv.pull(99, out=mx.nd.zeros((2, 2)))
+    gate.set()
+    assert out.asnumpy()[0, 0] == 3.0
+
+
+def test_kvstore_aggregation_matches_eager():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4, 4)))
+    grads = [mx.nd.ones((4, 4)) * float(i) for i in range(1, 4)]
+    kv.push("w", grads)  # no updater: store <- sum(grads)
+    out = mx.nd.zeros((4, 4))
+    kv.pull("w", out=out)
+    assert_almost_equal(out.asnumpy(), np.full((4, 4), 6.0))
+
+
+# ----------------------------------------------------------------------
+# profiler integration
+# ----------------------------------------------------------------------
+
+def test_engine_spans_carry_distinct_worker_tids(threaded_engine, tmp_path):
+    """A profiled small training loop produces engine-op spans on >= 2
+    distinct worker tids (the reference's SetOprStart/SetOprEnd view)."""
+    from test_module import _mlp, _toy_data
+
+    eng = threaded_engine
+    fname = str(tmp_path / "engine_profile.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+
+    # a pair of ops that provably occupy two different workers
+    flag = threading.Event()
+    eng.push(lambda: flag.wait(5), write_vars=[eng.new_variable()],
+             name="lane_probe_wait")
+    eng.push(lambda: flag.set(), write_vars=[eng.new_variable()],
+             name="lane_probe_set")
+
+    X, y = _toy_data(n=64)
+    train = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(mx.io.PrefetchingIter(train), optimizer="sgd",
+            kvstore=mx.kv.create("local"),
+            optimizer_params={"learning_rate": 0.05}, num_epoch=2)
+    mx.waitall()
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    spans = [e for e in events if e.get("cat") == "engine"]
+    assert len(spans) >= 4, "no engine-op spans recorded"
+    assert all(e["name"].startswith("engine::") for e in spans)
+    tids = {e["tid"] for e in spans}
+    assert len(tids) >= 2, "engine spans all on one worker lane: %s" % tids
+    # the real training path shows up, not just the probes
+    assert any("kvstore" in e["name"] for e in spans), \
+        sorted({e["name"] for e in spans})
+
+
+# ----------------------------------------------------------------------
+# stress (slow tier)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_threaded_engine_high_fanout_stress(threaded_engine):
+    """Random read/write sets over a small var pool, high fan-out: the
+    engine schedule must be indistinguishable from sequential program
+    order (that's the serializability guarantee note_engine.md builds on)."""
+    eng = threaded_engine
+    rng = np.random.RandomState(0)
+    nvars, nops = 8, 3000
+    vs = [eng.new_variable() for _ in range(nvars)]
+    state = [0] * nvars          # engine-run state
+    expected = [0] * nvars       # sequential simulation
+    for j in range(nops):
+        nr = int(rng.randint(0, 3))
+        nw = int(rng.randint(1, 3))
+        reads = list(rng.choice(nvars, size=nr, replace=False))
+        writes = list(rng.choice(nvars, size=nw, replace=False))
+        sleepy = bool(rng.rand() < 0.002)
+
+        def op(reads=tuple(reads), writes=tuple(writes), j=j, sleepy=sleepy):
+            if sleepy:
+                time.sleep(0.001)
+            acc = sum(state[r] for r in reads)
+            for w in writes:
+                state[w] = (state[w] * 31 + acc + j) % 1000003
+
+        eng.push(op, read_vars=[vs[r] for r in reads],
+                 write_vars=[vs[w] for w in writes],
+                 priority=int(rng.randint(0, 3)))
+        # sequential reference
+        acc = sum(expected[r] for r in reads)
+        for w in writes:
+            expected[w] = (expected[w] * 31 + acc + j) % 1000003
+    eng.wait_for_all()
+    assert state == expected
