@@ -37,20 +37,49 @@ REGISTRY = [
     EnvVar("MXNET_KVSTORE_PULL_TIMEOUT", float, 60.0,
            "Version-gated pull wait limit; servers reply with an error "
            "instead of serving stale values"),
+    EnvVar("MXNET_KVSTORE_REGISTER_TIMEOUT", float, 600.0,
+           "Scheduler wait limit for all roles to register at startup; "
+           "a role that dies before registering fails the job instead "
+           "of hanging it (parallel/dist.py Scheduler)"),
     # ---- topology (set by tools/launch.py, reference dmlc tracker) ----
     EnvVar("DMLC_ROLE", str, "worker", "Node role: worker/server/scheduler"),
     EnvVar("DMLC_PS_ROOT_URI", str, "127.0.0.1", "Scheduler host"),
     EnvVar("DMLC_PS_ROOT_PORT", int, 9091, "Scheduler port"),
     EnvVar("DMLC_NUM_WORKER", int, 1, "Worker count"),
     EnvVar("DMLC_NUM_SERVER", int, 1, "Server count"),
+    EnvVar("DMLC_WORKER_ID", int, 0,
+           "This worker's rank, assigned by the tracker (launch.py); "
+           "multihost.initialize falls back to it for the process id"),
+    EnvVar("MXTPU_DIST_URI", str, "",
+           "Non-empty enables the dist kvstore backends without the full "
+           "DMLC_* launcher environment (kvstore.create dist_* gate)"),
+    EnvVar("MXTPU_RECOVER_RANK", int, -1,
+           "Rejoin a running dist_async job under this previous rank "
+           "after a worker death (parallel/dist.py elastic recovery); "
+           "-1 = fresh start"),
+    EnvVar("MXTPU_COORDINATOR", str, "",
+           "host:port of the jax.distributed coordinator for multi-host "
+           "meshes (parallel/multihost.py); defaults to "
+           "DMLC_PS_ROOT_URI:port+1 when a tracker env is present"),
+    EnvVar("MXTPU_PROCESS_ID", int, 0,
+           "This host's process index in the multi-host mesh "
+           "(parallel/multihost.py; falls back to DMLC_WORKER_ID)"),
     # ---- dependency engine (engine/) ----
     EnvVar("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
            "Execution engine backend (engine/): ThreadedEnginePerDevice "
            "(default; ThreadedEngine accepted) schedules host-side ops "
            "on a worker pool with read/write-var dependency ordering; "
            "NaiveEngine executes every push inline for debugging/"
-           "determinism. Unknown values warn and fall back to the "
-           "default (reference src/engine/engine.cc CreateEngine)"),
+           "determinism; SanitizerEngine is the threaded backend plus "
+           "runtime detection of chunk accesses an op did not declare "
+           "(engine/sanitizer.py; docs/engine.md). Unknown values warn "
+           "listing the valid names and fall back to the default "
+           "(reference src/engine/engine.cc CreateEngine)"),
+    EnvVar("MXNET_SANITIZER_STRICT", int, 0,
+           "With MXNET_ENGINE_TYPE=SanitizerEngine: 1 turns undeclared-"
+           "access reports into deferred RaceErrors raised at the next "
+           "sync point (wait_for_var/waitall/value read) instead of "
+           "warnings-only"),
     EnvVar("MXNET_CPU_WORKER_NTHREADS", int, 0,
            "Engine worker threads (engine/threaded.py); 0 = auto, "
            "min(4, max(2, n_cpus)). The reference defaults to 1; here "

@@ -10,14 +10,17 @@ decode, and gradient traffic.  Device-side ordering remains XLA's job
 engine schedules the HOST side the same way the reference's
 ThreadedEngine did.
 
-Two backends, selected by ``MXNET_ENGINE_TYPE``:
+Three backends, selected by ``MXNET_ENGINE_TYPE``:
 
   * ``ThreadedEnginePerDevice`` (default; ``ThreadedEngine`` accepted) —
     N worker threads, N from ``MXNET_CPU_WORKER_NTHREADS``.
   * ``NaiveEngine`` — synchronous, for debugging/determinism.
+  * ``SanitizerEngine`` — the threaded backend plus runtime detection of
+    chunk accesses an op performs but did not declare (sanitizer.py;
+    static counterpart: ``python -m tools.analysis``).
 
-Unknown values warn and fall back to the default (reference
-engine/engine.cc:39-51 CreateEngine).
+Unknown values warn (listing the valid names) and fall back to the
+default (reference engine/engine.cc:39-51 CreateEngine).
 """
 from __future__ import annotations
 
@@ -27,17 +30,23 @@ import warnings
 
 from .naive import NaiveEngine
 from .threaded import ThreadedEngine
-from .var import Var, in_engine_op
+from .sanitizer import SanitizerEngine
+from .var import Var, in_engine_op, note_access, set_access_hook
 from .threaded_iter import ThreadedIter
 
 __all__ = ["get", "set_engine_type", "push", "new_variable", "wait_for_var",
-           "wait_for_all", "in_engine_op", "Var", "ThreadedIter",
-           "NaiveEngine", "ThreadedEngine"]
+           "wait_for_all", "in_engine_op", "note_access", "set_access_hook",
+           "Var", "ThreadedIter", "NaiveEngine", "ThreadedEngine",
+           "SanitizerEngine"]
 
 _ENGINE = None
 _ENGINE_LOCK = threading.Lock()
 
 _THREADED_NAMES = ("ThreadedEnginePerDevice", "ThreadedEngine")
+
+# every accepted MXNET_ENGINE_TYPE value, for the unknown-value warning
+VALID_ENGINE_TYPES = ("NaiveEngine", "ThreadedEngine",
+                      "ThreadedEnginePerDevice", "SanitizerEngine")
 
 
 def _default_workers():
@@ -71,10 +80,12 @@ def _create(engine_type=None, num_workers=None):
             num_workers = _default_workers()
     if engine_type == "NaiveEngine":
         return NaiveEngine()
+    if engine_type == "SanitizerEngine":
+        return SanitizerEngine(num_workers=num_workers)
     if engine_type not in _THREADED_NAMES:
-        warnings.warn("MXNET_ENGINE_TYPE=%r is unknown (expected one of "
-                      "NaiveEngine, ThreadedEngine, ThreadedEnginePerDevice); "
-                      "falling back to ThreadedEnginePerDevice" % engine_type)
+        warnings.warn("MXNET_ENGINE_TYPE=%r is unknown (expected one of %s); "
+                      "falling back to ThreadedEnginePerDevice"
+                      % (engine_type, ", ".join(VALID_ENGINE_TYPES)))
     return ThreadedEngine(num_workers=num_workers)
 
 

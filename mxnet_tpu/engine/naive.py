@@ -34,17 +34,20 @@ class NaiveEngine:
         nothing is ever pending, so the distinction is moot."""
         from .. import profiler
 
+        prof = profiler.spans_active()  # skip timing/formatting when off
         if atomic:
             enter_op()
-        t0 = time.time()
+        t0 = time.time() if prof else 0.0
         try:
             fn()
         finally:
             if atomic:
                 exit_op()
-            t1 = time.time()
-            profiler.record_span("engine::" + (name or getattr(fn, "__name__", "op")),
-                                 int(t0 * 1e6), int((t1 - t0) * 1e6), cat="engine")
+            if prof:
+                t1 = time.time()
+                profiler.record_span(
+                    "engine::" + (name or getattr(fn, "__name__", "op")),
+                    int(t0 * 1e6), int((t1 - t0) * 1e6), cat="engine")
         return None
 
     def help_one(self, timeout=0.02):

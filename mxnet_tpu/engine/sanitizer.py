@@ -1,0 +1,237 @@
+"""SanitizerEngine — the runtime undeclared-dependency (race) detector.
+
+The dynamic half of the scheduling-contract tooling (the static half is
+mxlint, ``python -m tools.analysis``; see docs/engine.md "Verifying
+scheduling contracts").  The engine's correctness rests on call sites
+declaring the right ``read_vars``/``write_vars``; an access an op
+performs but did not declare is invisible to the scheduler and races
+with every concurrent op — exactly the bug class the reference's
+NaiveEngine debug mode (and ThreadSanitizer's happens-before checking)
+existed to flush out.
+
+Select with ``MXNET_ENGINE_TYPE=SanitizerEngine`` (or
+``pytest --engine-type SanitizerEngine``).  It *is* a
+ThreadedEnginePerDevice — same workers, same ordering, same results —
+plus instrumentation:
+
+  * every push records its declared var sets, the push-site stack, and
+    a var-id watermark;
+  * chunk accesses (``NDArray._raw``/``.data``/``_set_data``) report to
+    a per-thread op record via ``var.note_access``; each observed write
+    bumps the Var's version counter;
+  * an access to a var that (a) existed before the push and (b) is in
+    neither declared set is a :class:`Violation`, reported with the op
+    name, the push-site stack, and the access site.
+
+Vars created *after* the push (``vid > watermark``) are op-local —
+nothing else can hold them, so they are exempt; this is what keeps
+nested inline pushes (``a + b`` inside an op allocates its output var
+on the spot) quiet.  ``atomic=False`` ops run arbitrary foreign code
+under normal sync semantics by design and are not sanitized.
+
+Violations warn (:class:`RaceWarning`) and accumulate on
+``engine.violations``; with ``MXNET_SANITIZER_STRICT=1`` they also
+become deferred :class:`RaceError`s raised at the next sync point,
+matching the engine's normal error delivery.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+import warnings
+
+from . import var as _varmod
+from .threaded import ThreadedEngine
+
+__all__ = ["SanitizerEngine", "RaceWarning", "RaceError", "Violation"]
+
+_TLS = threading.local()  # .stack: list of _SanRecord, one per nested op
+
+
+def _stack():
+    s = getattr(_TLS, "stack", None)
+    if s is None:
+        s = _TLS.stack = []
+    return s
+
+
+class RaceWarning(UserWarning):
+    """An op touched a chunk var it did not declare."""
+
+
+class RaceError(RuntimeError):
+    """Strict-mode violation, delivered at the next sync point."""
+
+
+def _trim_stack(frames):
+    """Drop engine-internal and NDArray-accessor frames so the report
+    leads with the user code that performed the access."""
+    out = []
+    for f in frames:
+        fn = f.filename.replace("\\", "/")
+        if "/mxnet_tpu/engine/" in fn:
+            continue
+        if fn.endswith("/mxnet_tpu/ndarray.py") and f.name in (
+                "_set_data", "_raw", "data", "_full_overwrite_base"):
+            continue
+        out.append(f)
+    return out or list(frames)
+
+
+def _fmt_frames(frames, limit=8):
+    return "".join(traceback.format_list(list(frames)[-limit:]))
+
+
+class Violation:
+    """One undeclared chunk access, with both sides of the story."""
+
+    __slots__ = ("op_name", "kind", "vid", "version", "push_stack",
+                 "access_site", "declared")
+
+    def __init__(self, op_name, kind, vid, version, push_stack, access_site,
+                 declared):
+        self.op_name = op_name
+        self.kind = kind                  # 'read' | 'write'
+        self.vid = vid
+        self.version = version            # var write-version at access time
+        self.push_stack = push_stack      # traceback.FrameSummary list
+        self.access_site = access_site    # traceback.FrameSummary list
+        self.declared = declared          # human summary of declared sets
+
+    def report(self):
+        return (
+            "SanitizerEngine: undeclared %s of Var %d (version %d) inside "
+            "engine op `%s` — the access is invisible to the scheduler "
+            "and races with every concurrent op on that var.\n"
+            "  declared at push time: %s\n"
+            "  access site:\n%s"
+            "  pushed from:\n%s"
+            % (self.kind, self.vid, self.version, self.op_name,
+               self.declared, _fmt_frames(self.access_site, 4),
+               _fmt_frames(self.push_stack)))
+
+    __str__ = report
+
+    def __repr__(self):
+        return "<Violation %s Var %d in %r>" % (self.kind, self.vid,
+                                                self.op_name)
+
+
+class _SanRecord:
+    """Per-op sanitizer state, pushed onto the worker's TLS stack for
+    the duration of the op body."""
+
+    __slots__ = ("engine", "name", "reads", "writes", "watermark",
+                 "push_stack", "seen")
+
+    def __init__(self, engine, name, read_vars, write_vars):
+        self.engine = engine
+        self.name = name
+        self.reads = frozenset(id(v) for v in read_vars)
+        self.writes = frozenset(id(v) for v in write_vars)
+        # consume (not peek) a vid: strictly greater vids are post-push
+        self.watermark = _varmod.next_vid()
+        self.push_stack = _trim_stack(traceback.extract_stack()[:-2])
+        self.seen = set()  # (vid, kind) already reported for this op
+
+    def declared_summary(self):
+        return ("read_vars=%d var(s), write_vars=%d var(s)"
+                % (len(self.reads), len(self.writes)))
+
+
+class SanitizerEngine(ThreadedEngine):
+    """ThreadedEnginePerDevice + undeclared-access detection."""
+
+    kind = "SanitizerEngine"
+
+    def __init__(self, num_workers=2, strict=None):
+        super().__init__(num_workers=num_workers)
+        if strict is None:
+            from .. import config
+
+            try:
+                strict = bool(config.get("MXNET_SANITIZER_STRICT"))
+            except Exception:
+                strict = False
+        self.strict = strict
+        self.violations = []
+        self._vio_lock = threading.Lock()
+        _varmod.set_access_hook(self._on_access)
+
+    def stop(self):
+        _varmod.set_access_hook(None)
+        super().stop()
+
+    # ------------------------------------------------------------------
+    def push(self, fn, read_vars=(), write_vars=(), priority=0, name=None,
+             wait=False, atomic=True):
+        """PushAsync + contract recording.  The callback is wrapped so
+        the op's declared sets ride the worker's TLS while it runs;
+        nested inline pushes stack their own records, so their accesses
+        are judged against their OWN declarations."""
+        if not atomic:
+            # foreign-code ops (ThreadedIter fetches) sync through the
+            # normal engine fences — nothing to check
+            return super().push(fn, read_vars=read_vars,
+                                write_vars=write_vars, priority=priority,
+                                name=name, wait=wait, atomic=atomic)
+        name = name or getattr(fn, "__name__", "op")
+        rec = _SanRecord(self, name, read_vars, write_vars)
+
+        def _sanitized(_fn=fn, _rec=rec):
+            s = _stack()
+            s.append(_rec)
+            try:
+                _fn()
+            finally:
+                s.pop()
+
+        return super().push(_sanitized, read_vars=read_vars,
+                            write_vars=write_vars, priority=priority,
+                            name=name, wait=wait, atomic=atomic)
+
+    # ------------------------------------------------------------------
+    def _on_access(self, v, is_write):
+        """var.note_access hook: judge one chunk access against the
+        innermost op's declared sets (runs on the accessing thread)."""
+        s = getattr(_TLS, "stack", None)
+        if not s:
+            return  # main-thread access outside any sanitized op
+        rec = s[-1]
+        if rec.engine is not self:
+            return  # record from a previous engine instance
+        if v.vid > rec.watermark:
+            return  # created after the push: op-local, unshared
+        if is_write:
+            v.version += 1
+            ok = id(v) in rec.writes
+        else:
+            ok = id(v) in rec.reads or id(v) in rec.writes
+        if ok:
+            return
+        kind = "write" if is_write else "read"
+        if (v.vid, kind) in rec.seen:
+            return  # one report per (op, var, kind)
+        rec.seen.add((v.vid, kind))
+        vio = Violation(rec.name, kind, v.vid, v.version,
+                        rec.push_stack,
+                        _trim_stack(traceback.extract_stack()[:-2]),
+                        rec.declared_summary())
+        with self._vio_lock:
+            self.violations.append(vio)
+        warnings.warn(vio.report(), RaceWarning, stacklevel=2)
+        if self.strict:
+            # deliver like any engine error: poison the accessed var so
+            # wait_for_var / value reads on it raise, and queue for
+            # wait_for_all — whichever sync point comes first wins (the
+            # var delivery de-queues the same exception object)
+            err = RaceError(vio.report())
+            with self._lock:
+                v.exception = err
+                self._errors.append(err)
+
+    # ------------------------------------------------------------------
+    def race_report(self):
+        """All violations so far, formatted; empty string when clean."""
+        with self._vio_lock:
+            return "\n".join(v.report() for v in self.violations)

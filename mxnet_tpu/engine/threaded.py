@@ -272,9 +272,10 @@ class ThreadedEngine:
     def _execute(self, op):
         from .. import profiler
 
+        prof = profiler.spans_active()  # skip timing/formatting when off
         if op.atomic:
             enter_op()
-        t0 = time.time()
+        t0 = time.time() if prof else 0.0
         try:
             # a failed producer poisons its consumers: propagate instead
             # of computing on garbage (reference threaded_engine.cc
@@ -289,6 +290,7 @@ class ThreadedEngine:
         finally:
             if op.atomic:
                 exit_op()
-            t1 = time.time()
-            profiler.record_span("engine::" + op.name, int(t0 * 1e6),
-                                 int((t1 - t0) * 1e6), cat="engine")
+            if prof:
+                t1 = time.time()
+                profiler.record_span("engine::" + op.name, int(t0 * 1e6),
+                                     int((t1 - t0) * 1e6), cat="engine")

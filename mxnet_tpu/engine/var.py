@@ -24,9 +24,41 @@ import threading
 
 __all__ = ["Var", "Token", "OpRecord", "dedupe_vars", "attach_tokens",
            "grant_ready", "release_tokens", "enter_op", "exit_op",
-           "in_engine_op"]
+           "in_engine_op", "note_access", "set_access_hook", "next_vid"]
 
 _var_ids = itertools.count()
+
+
+def next_vid():
+    """Consume and return the next var id WITHOUT creating a Var — the
+    SanitizerEngine's push-time watermark: any Var whose vid exceeds it
+    was created after the push and is op-local (unshared, cannot race)."""
+    return next(_var_ids)
+
+
+# ----------------------------------------------------------------------
+# chunk-access instrumentation (the SanitizerEngine's eyes)
+# ----------------------------------------------------------------------
+# When installed, `hook(var, is_write)` observes every instrumented
+# chunk access (NDArray._raw/.data/_set_data call note_access).  None
+# (the default) keeps the fast path at one global load + compare.
+_ACCESS_HOOK = None
+
+
+def set_access_hook(hook):
+    """Install `hook(var, is_write)` on every instrumented chunk access
+    (MXNET_ENGINE_TYPE=SanitizerEngine); None uninstalls."""
+    global _ACCESS_HOOK
+    _ACCESS_HOOK = hook
+
+
+def note_access(var, is_write):
+    """Report one chunk access to the sanitizer hook, if installed.
+    Called from the NDArray payload accessors; must stay O(1) no-op
+    when no sanitizer is active."""
+    hook = _ACCESS_HOOK
+    if hook is not None and var is not None:
+        hook(var, is_write)
 
 # Worker-context flag, shared by all backends.  Code running inside an
 # engine op reads values through `NDArray._raw()`-style direct access
@@ -53,7 +85,7 @@ class Var:
     of mutable state (reference engine.h:75 `Engine::NewVariable`)."""
 
     __slots__ = ("vid", "queue", "pending_writes", "pending_reads",
-                 "exception", "__weakref__")
+                 "exception", "version", "__weakref__")
 
     def __init__(self):
         self.vid = next(_var_ids)
@@ -61,6 +93,7 @@ class Var:
         self.pending_writes = 0    # queued + running write tokens
         self.pending_reads = 0     # queued + running read tokens
         self.exception = None      # deferred error from the last failed writer
+        self.version = 0           # write counter (bumped by the sanitizer)
 
     def __repr__(self):
         return "<Var %d r%d w%d>" % (self.vid, self.pending_reads, self.pending_writes)

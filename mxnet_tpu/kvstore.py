@@ -62,13 +62,24 @@ class KVStore:
             self._key_vars[k] = engine.new_variable()
         return self._key_vars[k]
 
+    def _bind_entry(self, k, arr):
+        """A stored entry's chunk var IS the key var (reference: the
+        merged buffer's var is what Push/Pull declare, kvstore_local.h) —
+        so every engine-visible access to the stored array, including
+        the SanitizerEngine's contract check, resolves to the var the
+        push/pull ops actually declared."""
+        if isinstance(arr, NDArray):
+            arr._var = self._key_vars[k]
+        return arr
+
     def init(self, key, value):
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
             self._key_var(k)
             if k in self._store:
                 continue  # parity: re-Init of existing key ignored (dist_server.h:147-163)
-            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+            self._store[k] = self._bind_entry(
+                k, v.copy() if isinstance(v, NDArray) else v)
 
     def push(self, key, value, priority=0):
         """Push (aggregate) values.  A list-of-lists aggregates per key across
@@ -92,6 +103,15 @@ class KVStore:
             stored = self._store.get(k)
             if isinstance(stored, NDArray):
                 write_vars.append(stored._engine_var())
+            if self._updater is not None:
+                # declare the optimizer state (momentum/variance...) the
+                # updater will mutate: it lives as long as the key, so an
+                # undeclared touch would race a concurrent pull/push of
+                # the same key on another engine (sanitizer-verified)
+                state = getattr(self._updater, "states", {}).get(k)
+                if state is not None:
+                    write_vars.extend(leaf._engine_var()
+                                      for leaf in opt._state_leaves(state))
 
             def _do_push(_k=k, _vlist=vlist):
                 merged = _vlist[0].copy()
@@ -100,7 +120,8 @@ class KVStore:
                 if self._updater is not None:
                     self._updater(_k, merged, self._store[_k])
                 else:
-                    self._store[_k] = merged
+                    # mxlint: disable=E001 -- the entry write is serialized by the key var (declared in write_vars); _bind_entry makes the stored chunk's var the key var itself
+                    self._store[_k] = self._bind_entry(_k, merged)
 
             engine.push(_do_push, read_vars=read_vars, write_vars=write_vars,
                         priority=priority, name="kvstore_push:%s" % k)

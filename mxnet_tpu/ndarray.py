@@ -103,13 +103,16 @@ class NDArray:
                 "NDArray value is unavailable: the engine op that was to "
                 "produce it failed (its error was raised at an earlier "
                 "sync point)")
+        engine.note_access(var, False)  # SanitizerEngine contract check
         return self._data
 
     def _raw(self):
         """Payload WITHOUT engine sync — only valid inside an engine op
-        whose declared read/write vars cover this array."""
+        whose declared read/write vars cover this array (the
+        SanitizerEngine verifies exactly that via note_access)."""
         if self._parent is not None:
             return self._parent._raw()[self._index]
+        engine.note_access(self._var, False)
         return self._data
 
     def _engine_var(self):
@@ -147,6 +150,7 @@ class NDArray:
                 # in-place assignment is a WRITE on the chunk var: wait out
                 # pending readers (WAR) and writers (WAW) before swapping
                 engine.get().wait_for_var(var, wait_reads=True)
+            engine.note_access(var, True)  # SanitizerEngine contract check
             self._data = value
 
     # ------------------------------------------------------------------
@@ -341,8 +345,13 @@ class NDArray:
                 if isinstance(other, NDArray):
                     ins = [other, self] if reverse else [self, other]
                     _RECORD_HOOK(fn, ins, [out])
-                else:  # raw jax operand captured as a constant
-                    const = _as_jax(other)
+                else:
+                    # raw operand captured as a replay constant — numpy
+                    # is snapshotted: jnp.asarray on CPU may zero-copy
+                    # ALIAS the caller's buffer (see _engine_invoke), and
+                    # the replay must see call-site values
+                    const = jnp.array(other, copy=True) \
+                        if isinstance(other, _np.ndarray) else _as_jax(other)
                     if reverse:
                         _RECORD_HOOK(lambda x, _c=const, _f=fn: _f(_c, x),
                                      [self], [out])
@@ -830,7 +839,7 @@ def _engine_invoke(op, args, kwargs, ctx, priority=0):
 
     def _run(_op=op, _args=args, _kw=kwargs, _out=out):
         jax_args = [a._raw() if isinstance(a, NDArray) else a for a in _args]
-        _out._data = _op.fn(*jax_args, **_kw)
+        _out._set_data(_op.fn(*jax_args, **_kw))
 
     eng.push(_run, read_vars=read_vars, write_vars=(out._engine_var(),),
              priority=priority, name=op.name)
@@ -877,8 +886,14 @@ def _make_nd_function(op):
             nd_ins = [a for a in args if isinstance(a, NDArray)]
             nd_outs = list(boxed) if isinstance(boxed, tuple) else [boxed]
             # non-NDArray args are captured as constants in the replay fn
-            spec = [None if isinstance(a, NDArray) else _as_jax(a) for a in args]
+            # (numpy snapshotted — jnp.asarray may alias the caller's
+            # buffer, and the replay must see call-site values)
+            spec = [None if isinstance(a, NDArray)
+                    else jnp.array(a, copy=True) if isinstance(a, _np.ndarray)
+                    else _as_jax(a)
+                    for a in args]
 
+            # mxlint: disable=W101 -- deliberate def-time snapshot: the replay closure must see the kwargs as they were at record time; the default is never mutated
             def _replay(*xs, _f=op.fn, _kw=dict(kwargs), _spec=spec):
                 it = iter(xs)
                 vals = [next(it) if s is None else s for s in _spec]

@@ -103,6 +103,13 @@ def _join_xla_trace(trace_dir):
             })
 
 
+def spans_active():
+    """Cheap hot-path check: is span recording on?  Callers (the engine
+    worker loop) skip timestamping and span-name formatting entirely
+    when profiling is off."""
+    return _STATE["running"]
+
+
 def record_span(name, start_us, dur_us, cat="operator", tid=None):
     """Record one span; called by executors and engine workers when
     profiling is on.  `tid` defaults to the REAL calling thread id so

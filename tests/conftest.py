@@ -24,7 +24,8 @@ def pytest_addoption(parser):
     parser.addoption(
         "--engine-type", default=None,
         help="Run the suite under this MXNET_ENGINE_TYPE (NaiveEngine / "
-             "ThreadedEnginePerDevice); equivalent to setting the env var.")
+             "ThreadedEnginePerDevice / SanitizerEngine); equivalent to "
+             "setting the env var.")
 
 
 def pytest_configure(config):

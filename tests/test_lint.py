@@ -1,0 +1,277 @@
+"""mxlint (tools/analysis) — the static scheduling-contract gate.
+
+Tier-1 on purpose: `test_repo_is_lint_clean` runs the full check suite
+over mxnet_tpu/ exactly like `python -m tools.analysis mxnet_tpu`, so a
+PR that introduces an undeclared engine dependency (E001), a sync call
+inside an op (E002), a leaked Var (E003), or an undocumented env knob
+(W103) fails CI here.  The rest unit-tests each check against synthetic
+sources so the framework itself cannot silently rot.
+"""
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.analysis import run_paths  # noqa: E402
+
+
+def _lint_src(tmp_path, src, name="snippet.py", config_src=None):
+    """Lint one synthetic file; a minimal mxnet_tpu/config.py can be
+    provided so W103 has a registry to resolve against."""
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "config.py").write_text(config_src or "REGISTRY = []\n")
+    p = pkg / name
+    p.write_text(src)
+    return run_paths([str(p)])
+
+
+def _ids(findings):
+    return [f.check_id for f in findings]
+
+
+# ----------------------------------------------------------------------
+# the repo gate
+# ----------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """`python -m tools.analysis mxnet_tpu` must exit 0: every finding
+    fixed or allowlisted with a justification (docs/engine.md)."""
+    findings, suppressed, errors = run_paths([os.path.join(ROOT, "mxnet_tpu")])
+    assert not errors, errors
+    assert not findings, "\n".join(str(f) for f in findings)
+    # the allowlist is in use and every entry carries its justification
+    for f in suppressed:
+        assert "[allowlisted:" in f.message
+
+
+def test_cli_runs_and_is_clean():
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "mxnet_tpu"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ----------------------------------------------------------------------
+# E001 — undeclared dependencies
+# ----------------------------------------------------------------------
+
+E001_UNDECLARED = """
+def schedule(eng, a, b, out):
+    def cb():
+        out._set_data(a._raw() + b._raw())
+    eng.push(cb, read_vars=[a._engine_var()], write_vars=[out._engine_var()])
+"""
+
+
+def test_e001_flags_undeclared_closure_read(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E001_UNDECLARED)
+    assert _ids(findings) == ["E001"]
+    assert "`b`" in findings[0].message
+
+
+E001_DECLARED = """
+def schedule(eng, arrs, out):
+    read_vars = [g._engine_var() for g in arrs]
+
+    def cb(_arrs=arrs, _out=out):
+        acc = _arrs[0]._raw()
+        for g in _arrs[1:]:
+            acc = acc + g._raw()
+        _out._set_data(acc)
+    eng.push(cb, read_vars=read_vars, write_vars=[out._engine_var()])
+"""
+
+
+def test_e001_follows_default_bindings_and_loops(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E001_DECLARED)
+    assert findings == []
+
+
+E001_LIST_BUILD = """
+def schedule(eng, k, stored, grads, key_var):
+    ws = [key_var]
+    ws.append(stored._engine_var())
+
+    def cb(_stored=stored, _grads=grads):
+        _stored._set_data(_grads[0]._raw())
+    eng.push(cb, read_vars=[g._engine_var() for g in grads], write_vars=ws)
+"""
+
+
+def test_e001_follows_imperative_list_construction(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E001_LIST_BUILD)
+    assert findings == []
+
+
+E001_SELF_STORE = """
+class KV:
+    def push(self, eng, k, merged, key_var):
+        def cb(_k=k, _merged=merged):
+            self._store[_k] = _merged
+        eng.push(cb, read_vars=[merged._engine_var()], write_vars=[key_var])
+"""
+
+
+def test_e001_flags_shared_container_write(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E001_SELF_STORE)
+    assert _ids(findings) == ["E001"]
+    assert "self._store" in findings[0].message
+
+
+E001_NON_ATOMIC = """
+def schedule(eng, a, v):
+    def cb():
+        return a.asnumpy()
+    eng.push(cb, write_vars=[v], atomic=False)
+"""
+
+
+def test_e001_e002_exempt_non_atomic_ops(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E001_NON_ATOMIC)
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# E002 — sync calls inside atomic callbacks
+# ----------------------------------------------------------------------
+
+E002_SYNC = """
+def schedule(eng, a, v):
+    def cb():
+        a.wait_to_read()
+        x = a.asnumpy()
+        y = a.data + 1
+    eng.push(cb, read_vars=[a._engine_var()], write_vars=[v])
+"""
+
+
+def test_e002_flags_sync_calls_and_data_reads(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_SYNC)
+    got = _ids(findings)
+    assert got.count("E002") == 3, findings
+    assert any("`.data`" in f.message for f in findings)
+
+
+def test_missing_path_is_an_error_not_a_clean_pass(tmp_path):
+    findings, _, errors = run_paths([str(tmp_path / "no_such_dir")])
+    assert findings == []
+    assert len(errors) == 1 and "does not exist" in errors[0][1]
+
+
+# ----------------------------------------------------------------------
+# E003 — leaked Vars
+# ----------------------------------------------------------------------
+
+E003_LEAKS = """
+def leak_discard(eng):
+    eng.new_variable()
+
+def leak_unused(eng):
+    v = eng.new_variable()
+    return 3
+
+def fine(eng):
+    v = eng.new_variable()
+    eng.push(lambda: None, write_vars=[v])
+
+def fine_closure(eng):
+    v = eng.new_variable()
+
+    def cb():
+        return None
+    eng.push(cb, write_vars=[v])
+"""
+
+
+def test_e003_flags_leaked_vars_only(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E003_LEAKS)
+    assert _ids(findings) == ["E003", "E003"]
+    assert findings[0].line < findings[1].line <= 7
+
+
+# ----------------------------------------------------------------------
+# W1xx — general checks
+# ----------------------------------------------------------------------
+
+W_GENERAL = """
+def f(x=[]):
+    try:
+        return x
+    except:
+        pass
+"""
+
+
+def test_w101_and_w102(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, W_GENERAL)
+    assert sorted(_ids(findings)) == ["W101", "W102"]
+
+
+W103_CONFIG = """
+EnvVar = None
+REGISTRY = [EnvVar("MXNET_DOCUMENTED", str, "", "doc'd")]
+ABSORBED = {"MXNET_ABSORBED": "xla"}
+"""
+
+W103_READS = """
+import os
+a = os.environ.get("MXNET_DOCUMENTED", "")
+b = os.environ.get("MXNET_ABSORBED")
+c = os.environ["MXTPU_SECRET_KNOB"]
+d = os.environ.get("HOME")  # not a framework var: out of scope
+"""
+
+
+def test_w103_flags_only_undocumented_framework_vars(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, W103_READS, config_src=W103_CONFIG)
+    assert _ids(findings) == ["W103"]
+    assert "MXTPU_SECRET_KNOB" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# allowlist semantics
+# ----------------------------------------------------------------------
+
+ALLOW_TRAILING = """
+def f(x={}):  # mxlint: disable=W101 -- read-only sentinel, never mutated
+    return x
+"""
+
+ALLOW_STANDALONE = """
+# mxlint: disable=W101 -- read-only sentinel, never mutated
+def f(x={}):
+    return x
+"""
+
+ALLOW_NO_REASON = """
+def f(x={}):  # mxlint: disable=W101
+    return x
+"""
+
+
+def test_allowlist_with_justification_suppresses(tmp_path):
+    for src in (ALLOW_TRAILING, ALLOW_STANDALONE):
+        findings, suppressed, _ = _lint_src(tmp_path, src)
+        assert findings == []
+        assert _ids(suppressed) == ["W101"]
+        assert "never mutated" in suppressed[0].message
+
+
+def test_allowlist_without_justification_is_inert_and_reported(tmp_path):
+    findings, suppressed, _ = _lint_src(tmp_path, ALLOW_NO_REASON)
+    assert sorted(_ids(findings)) == ["L001", "W101"]
+    assert suppressed == []
+
+
+def test_file_level_allowlist(tmp_path):
+    src = ("# mxlint: disable-file=W102 -- exercising file-wide suppression\n"
+           "try:\n    pass\nexcept:\n    pass\n"
+           "try:\n    pass\nexcept:\n    pass\n")
+    findings, suppressed, _ = _lint_src(tmp_path, src)
+    assert findings == []
+    assert _ids(suppressed) == ["W102", "W102"]

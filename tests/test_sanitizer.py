@@ -1,0 +1,216 @@
+"""SanitizerEngine — the runtime scheduling-contract detector
+(mxnet_tpu/engine/sanitizer.py; static counterpart: tools/analysis).
+
+The seeded regression: an op performing a write it did not declare is
+*silent* under ThreadedEnginePerDevice (detection off — the schedule
+happily races), and is caught by SanitizerEngine with the push-site
+stack in the report.  Plus: clean paths stay clean (ndarray, kvstore
+incl. optimizer state, prefetch IO), strict mode raises at sync
+points, and a slow sweep re-runs the test_engine ordering suite under
+``--engine-type SanitizerEngine``.
+"""
+import os
+import subprocess
+import sys
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+from mxnet_tpu.engine.sanitizer import RaceError, RaceWarning, SanitizerEngine
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _push_undeclared_write(eng):
+    """The seeded contract violation: `sneaky` writes x's chunk but
+    declares only a decoy var — the scheduler cannot order the write
+    against any concurrent op on x."""
+    x = mx.nd.ones((2, 2))
+    x._engine_var()              # chunk var exists BEFORE the push
+    decoy = eng.new_variable()
+
+    def sneaky():
+        x._set_data(jnp.zeros((2, 2)))
+
+    eng.push(sneaky, write_vars=[decoy], name="sneaky_write")
+    eng.wait_for_all()
+    return x
+
+
+def test_undeclared_write_caught_only_by_sanitizer():
+    prev = engine.get().kind
+    try:
+        # detection off: ThreadedEnginePerDevice runs the same op with no
+        # report of any kind — the race is silent (that is the bug class)
+        eng = engine.set_engine_type("ThreadedEnginePerDevice", num_workers=2)
+        x = _push_undeclared_write(eng)
+        assert (x.asnumpy() == 0).all()
+        assert not getattr(eng, "violations", [])
+
+        eng = engine.set_engine_type("SanitizerEngine", num_workers=2)
+        with pytest.warns(RaceWarning, match="sneaky_write"):
+            _push_undeclared_write(eng)
+        assert len(eng.violations) == 1
+        v = eng.violations[0]
+        assert v.kind == "write" and v.op_name == "sneaky_write"
+        report = eng.race_report()
+        assert "undeclared write" in report
+        # the push-site stack points back at this file's push call
+        assert "test_sanitizer.py" in report and "pushed from" in report
+        # the access site (inside the op body) is reported too
+        assert "sneaky" in report
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_undeclared_read_caught():
+    prev = engine.get().kind
+    try:
+        eng = engine.set_engine_type("SanitizerEngine", num_workers=2)
+        y = mx.nd.ones((2, 2))
+        y._engine_var()
+        out = []
+        v = eng.new_variable()
+        with pytest.warns(RaceWarning, match="undeclared read"):
+            eng.push(lambda: out.append(y._raw()), write_vars=[v],
+                     name="sneaky_read")
+            eng.wait_for_all()
+        assert eng.violations[0].kind == "read"
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_clean_paths_produce_no_violations():
+    """The framework's own call sites declare everything they touch:
+    imperative ndarray chains, kvstore push/pull with a stateful
+    optimizer (momentum vars declared on the second push), prefetch IO."""
+    prev = engine.get().kind
+    try:
+        eng = engine.set_engine_type("SanitizerEngine", num_workers=4)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RaceWarning)  # any report fails
+            a = mx.nd.ones((8, 8))
+            b = sum((a * float(i) for i in range(1, 6)), mx.nd.zeros((8, 8)))
+            assert b.asnumpy()[0, 0] == 15.0
+            a[:] = 2.0
+
+            kv = mx.kv.create("local")
+            kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                                 momentum=0.9))
+            kv.init("w", mx.nd.ones((4, 4)))
+            for _ in range(3):  # >1: exercises declared optimizer state
+                kv.push("w", [mx.nd.ones((4, 4)), mx.nd.ones((4, 4))])
+            out = mx.nd.zeros((4, 4))
+            kv.pull("w", out=out)
+            out.asnumpy()
+
+            it = mx.io.NDArrayIter(np.zeros((16, 2), "f"), np.zeros(16, "f"),
+                                   batch_size=4)
+            pf = mx.io.PrefetchingIter(it)
+            assert pf.next() is not None
+            pf._stop_prefetch()
+            mx.waitall()
+        assert eng.violations == []
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_strict_mode_raises_at_sync_point(monkeypatch):
+    monkeypatch.setenv("MXNET_SANITIZER_STRICT", "1")
+    prev = engine.get().kind
+    try:
+        eng = engine.set_engine_type("SanitizerEngine", num_workers=2)
+        assert eng.strict
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RaceWarning)
+            with pytest.raises(RaceError, match="sneaky_write"):
+                _push_undeclared_write(eng)  # delivered at wait_for_all
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_strict_mode_raises_at_value_read(monkeypatch):
+    """The racily-written var itself is poisoned: a value read on it is
+    a sync point and must deliver the RaceError, not just waitall."""
+    monkeypatch.setenv("MXNET_SANITIZER_STRICT", "1")
+    prev = engine.get().kind
+    try:
+        eng = engine.set_engine_type("SanitizerEngine", num_workers=2)
+        x = mx.nd.ones((2, 2))
+        x._engine_var()
+        decoy = eng.new_variable()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RaceWarning)
+            eng.push(lambda: x._set_data(jnp.zeros((2, 2))),
+                     write_vars=[decoy], name="sneaky_write")
+            eng.wait_for_var(decoy)      # op done; decoy itself is clean
+            with pytest.raises(RaceError, match="sneaky_write"):
+                x.asnumpy()              # value-read sync point delivers
+        eng.wait_for_all()               # delivery consumed the error
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_op_local_vars_are_exempt():
+    """Vars created after the push (nested inline ops allocating their
+    outputs) are op-local and must not be reported."""
+    prev = engine.get().kind
+    try:
+        eng = engine.set_engine_type("SanitizerEngine", num_workers=2)
+        src = mx.nd.ones((4,))
+        v = eng.new_variable()
+
+        def body():
+            tmp = src * 2.0 + 1.0   # nested inline ops, fresh out vars
+            tmp._set_data(tmp._raw() * 1.0)
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RaceWarning)
+            eng.push(body, read_vars=[src._engine_var()], write_vars=[v],
+                     name="local_alloc")
+            eng.wait_for_all()
+        assert eng.violations == []
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_unknown_engine_warning_lists_all_backends():
+    prev = engine.get().kind
+    try:
+        with pytest.warns(UserWarning, match="SanitizerEngine"):
+            eng = engine.set_engine_type("NoSuchEngine")
+        assert eng.kind == "ThreadedEnginePerDevice"
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_sanitizer_selectable_via_env(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "SanitizerEngine")
+    prev = engine.get().kind
+    try:
+        eng = engine.set_engine_type(None)  # re-read from config
+        assert eng.kind == "SanitizerEngine"
+        assert isinstance(eng, SanitizerEngine)
+    finally:
+        monkeypatch.delenv("MXNET_ENGINE_TYPE")
+        engine.set_engine_type(prev)
+
+
+@pytest.mark.slow
+def test_engine_ordering_suite_under_sanitizer():
+    """The sweep: test_engine.py ordering/kvstore tests must pass with
+    the sanitizer as the session backend — same schedule, plus checks."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_engine.py", "-q",
+         "-m", "not slow",
+         "-k", "ordering or chains or waitall or kvstore or priority",
+         "--engine-type", "SanitizerEngine",
+         "-p", "no:cacheprovider"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    assert "passed" in r.stdout

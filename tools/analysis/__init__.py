@@ -1,0 +1,12 @@
+"""mxlint — static dependency-contract lint for mxnet_tpu.
+
+Run as ``python -m tools.analysis [paths...]``; see __main__.py for the
+CLI, core.py for the framework, engine_checks.py / general_checks.py
+for the checks, and docs/engine.md "Verifying scheduling contracts"
+for the user-facing story (including the runtime counterpart,
+``MXNET_ENGINE_TYPE=SanitizerEngine``).
+"""
+from .core import Finding, all_checks, register, run_paths
+from . import engine_checks, general_checks  # noqa: F401  (register checks)
+
+__all__ = ["Finding", "all_checks", "register", "run_paths"]

@@ -1,0 +1,74 @@
+"""mxlint CLI — ``python -m tools.analysis [paths...]``.
+
+Exit status: 0 clean (or everything allowlisted), 1 findings, 2 usage
+or parse errors.  ``--show-suppressed`` prints allowlisted findings
+with their justifications (the audit view referenced in
+docs/engine.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import all_checks, run_paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="mxlint: engine dependency-contract lint (E0xx) + "
+                    "hygiene checks (W1xx). See docs/engine.md.")
+    ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
+                    help="files or directories (default: mxnet_tpu)")
+    ap.add_argument("--select", action="append", default=[],
+                    metavar="ID", help="only run checks with this id prefix "
+                    "(repeatable, e.g. --select E)")
+    ap.add_argument("--ignore", action="append", default=[],
+                    metavar="ID", help="skip checks with this id prefix")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print allowlisted findings + justifications")
+    ap.add_argument("--list-checks", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for cls in all_checks():
+            print("%-5s %s" % ("/".join(getattr(cls, "ids", (cls.id,))),
+                               cls.title))
+        print("%-5s %s" % ("L001", "mxlint disable comments require a "
+                           "`-- justification`"))
+        return 0
+
+    findings, suppressed, errors = run_paths(
+        args.paths, select=args.select or None, ignore=args.ignore)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars_of(f) for f in findings],
+            "suppressed": [vars_of(f) for f in suppressed],
+            "errors": [{"path": p, "message": m} for p, m in errors],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        if args.show_suppressed:
+            for f in suppressed:
+                print("suppressed: %s" % f)
+        for p, m in errors:
+            print("ERROR %s: %s" % (p, m), file=sys.stderr)
+        summary = "%d finding(s), %d suppressed, %d error(s)" % (
+            len(findings), len(suppressed), len(errors))
+        print(("" if not (findings or suppressed or errors) else "-- ") + summary)
+    if errors:
+        return 2
+    return 1 if findings else 0
+
+
+def vars_of(f):
+    return {"check": f.check_id, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

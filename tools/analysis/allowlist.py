@@ -1,0 +1,83 @@
+"""mxlint inline allowlist.
+
+A finding is suppressed by a justification-bearing comment — the
+justification is MANDATORY, because the allowlist doubles as the
+documentation of why each scheduling-contract exception is safe
+(docs/engine.md "Verifying scheduling contracts"):
+
+    engine.push(fn, ...)  # mxlint: disable=E001 -- guarded by the key var
+
+    # mxlint: disable=E002 -- sync is intended here; workers steal work
+    engine.push(other_fn, ...)
+
+    # mxlint: disable-file=W103 -- env surface documented in launch.py
+
+A trailing comment suppresses its own line; a standalone comment
+suppresses the next line; ``disable-file`` suppresses the check for the
+whole file.  A disable with no ``-- justification`` is inert and is
+itself reported (L001), so the lint gate cannot be muted silently.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["Allowlist", "parse_allowlist"]
+
+_DISABLE_RE = re.compile(
+    r"#\s*mxlint:\s*disable(?P<filewide>-file)?\s*=\s*"
+    r"(?P<ids>[A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)"
+    r"(?:\s+--\s*(?P<why>\S.*?))?\s*$")
+
+
+class Allowlist:
+    """Per-file suppression map: (check_id, line) -> justification."""
+
+    def __init__(self):
+        self._by_line = {}   # (check_id, line) -> justification
+        self._by_file = {}   # check_id -> justification
+
+    def add_line(self, check_id, line, why):
+        self._by_line[(check_id, line)] = why
+
+    def add_file(self, check_id, why):
+        self._by_file[check_id] = why
+
+    def justification(self, check_id, line):
+        """The justification suppressing (check_id, line), or None."""
+        why = self._by_line.get((check_id, line))
+        if why is not None:
+            return why
+        return self._by_file.get(check_id)
+
+
+def parse_allowlist(path, text):
+    """Scan `text` for disable comments; returns (Allowlist, bad) where
+    `bad` are L001 findings for justification-less disables."""
+    from .core import Finding  # local import: core imports this module
+
+    allow = Allowlist()
+    bad = []
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        m = _DISABLE_RE.search(line)
+        if not m:
+            continue
+        ids = [s.strip() for s in m.group("ids").split(",")]
+        why = m.group("why")
+        if not why:
+            bad.append(Finding(
+                "L001", path, lineno, line.index("#"),
+                "mxlint disable comment without a justification — write "
+                "`# mxlint: disable=%s -- <why this is safe>`; the "
+                "disable is ignored until then" % ",".join(ids)))
+            continue
+        stripped = line.split("#", 1)[0].strip()
+        for cid in ids:
+            if m.group("filewide"):
+                allow.add_file(cid, why)
+            elif stripped:
+                # trailing comment: suppresses its own line
+                allow.add_line(cid, lineno, why)
+            else:
+                # standalone comment: suppresses the following line
+                allow.add_line(cid, lineno + 1, why)
+    return allow, bad

@@ -1,0 +1,201 @@
+"""mxlint core — the pluggable AST lint framework.
+
+The static half of the engine-correctness tooling (the runtime half is
+``MXNET_ENGINE_TYPE=SanitizerEngine``, mxnet_tpu/engine/sanitizer.py).
+PR 1's dependency engine is only as correct as its call sites' declared
+``read_vars``/``write_vars``; an undeclared dependency is a silent data
+race.  mxlint walks the AST of every file and machine-checks those
+scheduling contracts (checks E0xx, tools/analysis/engine_checks.py)
+plus a few general hygiene rules (W1xx, general_checks.py).
+
+Framework shape:
+
+  * a check is a class with ``id``, ``title`` and ``run(ctx)`` yielding
+    :class:`Finding`s; ``@register`` adds it to the global registry;
+  * :class:`FileContext` hands every check the parsed tree, the raw
+    source, and a child->parent node map (stdlib ``ast`` has no parent
+    links; scope questions need them);
+  * :func:`run_paths` is the one entry point: walk, parse, check,
+    apply the inline allowlist (allowlist.py), return surviving
+    findings — the CLI (__main__.py) and CI (tests/test_lint.py) both
+    call it.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .allowlist import parse_allowlist
+
+__all__ = ["Finding", "FileContext", "register", "all_checks", "run_paths",
+           "iter_py_files"]
+
+CHECKS = []
+
+# directories never worth linting (build output, vendored binaries)
+_SKIP_DIRS = {"__pycache__", "_native", ".git", "build", "dist"}
+
+
+class Finding:
+    """One lint finding, pointing at path:line:col."""
+
+    __slots__ = ("check_id", "path", "line", "col", "message")
+
+    def __init__(self, check_id, path, line, col, message):
+        self.check_id = check_id
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.check_id)
+
+    def __repr__(self):
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col,
+                                    self.check_id, self.message)
+
+    __str__ = __repr__
+
+
+def register(cls):
+    """Class decorator adding a check to the registry (instantiated once
+    per run, so a check may cache cross-file state like the documented
+    env-var table)."""
+    CHECKS.append(cls)
+    return cls
+
+
+def all_checks():
+    return list(CHECKS)
+
+
+class FileContext:
+    """Everything a check needs about one file."""
+
+    def __init__(self, path, text, tree, repo_root):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree
+        self.repo_root = repo_root
+        self._parents = None
+
+    @property
+    def parents(self):
+        """child node -> parent node map, built lazily once per file."""
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def parent_chain(self, node):
+        """Ancestors of `node`, innermost first."""
+        out = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def enclosing_functions(self, node):
+        """FunctionDef/AsyncFunctionDef/Lambda ancestors, innermost first."""
+        return [n for n in self.parent_chain(node)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))]
+
+    def enclosing_class(self, node):
+        for n in self.parent_chain(node):
+            if isinstance(n, ast.ClassDef):
+                return n
+        return None
+
+
+def iter_py_files(paths):
+    """Expand files/directories to a sorted list of .py files."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return out
+
+
+def _find_repo_root(path):
+    """Walk up until a directory containing mxnet_tpu/config.py (the
+    documented-env-var source of truth); fall back to the path's dir."""
+    cur = os.path.abspath(path if os.path.isdir(path) else os.path.dirname(path))
+    while True:
+        if os.path.exists(os.path.join(cur, "mxnet_tpu", "config.py")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return os.path.abspath(os.path.dirname(path) or ".")
+        cur = nxt
+
+
+def run_paths(paths, select=None, ignore=None):
+    """Lint `paths`; returns (findings, suppressed, errors).
+
+    `select`/`ignore` are iterables of check-id prefixes ("E001", "W").
+    `findings` survive the inline allowlist; `suppressed` carry their
+    allowlist justification appended to the message; `errors` are
+    (path, message) pairs for files that would not parse.
+    """
+    select = tuple(select) if select else None
+    ignore = tuple(ignore) if ignore else ()
+    checks = [cls() for cls in CHECKS]
+    findings, suppressed, errors = [], [], []
+    # a missing path is an error, never a silent all-clear: the exit-0
+    # CI gate must not pass because a typo'd/cwd-relative path linted
+    # zero files
+    for p in paths:
+        if not os.path.exists(p):
+            errors.append((p, "path does not exist (nothing was linted)"))
+    for path in iter_py_files(paths):
+        try:
+            with open(path, "rb") as f:
+                text = f.read().decode("utf-8")
+            tree = ast.parse(text, filename=path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append((path, str(e)))
+            continue
+        ctx = FileContext(path, text, tree, _find_repo_root(path))
+        allow, bad = parse_allowlist(path, text)
+        raw = list(bad)  # malformed disables are findings themselves
+        for check in checks:
+            cids = getattr(check, "ids", (check.id,))
+            if select and not any(c.startswith(s) for c in cids for s in select):
+                continue
+            if all(any(c.startswith(s) for s in ignore) for c in cids):
+                continue
+            try:
+                raw.extend(check.run(ctx))
+            except Exception as e:  # a crashing check must not hide others
+                errors.append((path, "check %s crashed: %r" % (check.id, e)))
+        # per-finding filter: a multi-id check (E001+E002) may have run
+        # for only one of its ids
+        if select:
+            raw = [f for f in raw if f.check_id == "L001"
+                   or any(f.check_id.startswith(s) for s in select)]
+        if ignore:
+            raw = [f for f in raw
+                   if not any(f.check_id.startswith(s) for s in ignore)]
+        for f in raw:
+            why = allow.justification(f.check_id, f.line)
+            if why is not None:
+                f.message += "  [allowlisted: %s]" % why
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    suppressed.sort(key=Finding.sort_key)
+    return findings, suppressed, errors

@@ -1,0 +1,411 @@
+"""mxlint engine-aware checks — static scheduling-contract analysis.
+
+The dependency engine (mxnet_tpu/engine/) orders ops by their declared
+``read_vars``/``write_vars``; whatever a pushed callback actually
+touches beyond those sets is invisible to the scheduler and races with
+every concurrent op.  These checks reconstruct, per push site, the
+names a callback closes over and the payload accesses it performs, and
+compare against the declared sets:
+
+  * **E001** — a pushed callback touches NDArray/chunk state whose name
+    never appears in the declared ``read_vars``/``write_vars``
+    expressions (including writes into ``self.<attr>[...]`` shared
+    containers, which no chunk var can cover syntactically).
+  * **E002** — a blocking sync call (``wait_to_read``, ``asnumpy``,
+    ``waitall``, ``.data``, ...) inside an *atomic* pushed callback: on
+    a worker it is at best a silent no-op (``in_engine_op`` skips the
+    fence) and at worst a pool deadlock; inside an op, declared deps
+    guarantee freshness — read via ``_raw()`` instead.
+  * **E003** — an engine ``Var`` created but never bound to a chunk or
+    op lifecycle: its token queue can never drain (a leak), and state
+    "guarded" by it is guarded by nothing.
+
+Pushes with ``atomic=False`` (ThreadedIter fetches running arbitrary
+foreign iterator code) keep normal sync semantics by design and are
+exempt from E001/E002.
+
+This is a heuristic, names-level dataflow — it follows default-argument
+bindings (``def cb(_x=x)``), loop/comprehension bindings (``for g in
+vlist``) and list construction (``ws = [...]; ws.append(v._engine_var())``),
+which covers the idioms the engine call sites actually use.  Anything
+it cannot resolve it stays silent about: mxlint's contract is no false
+certainty — the runtime SanitizerEngine covers the dynamic remainder.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, register
+
+__all__ = ["EnginePushContracts", "EngineVarLifecycle"]
+
+# payload READ accessors on an NDArray-like object
+_READ_CALL_ATTRS = {"_raw", "asnumpy", "asscalar", "wait_to_read"}
+# payload WRITE accessors
+_WRITE_CALL_ATTRS = {"_set_data", "wait_to_write"}
+# calls that block on engine/device progress — never valid in an atomic op
+_SYNC_CALL_ATTRS = {"wait_to_read", "wait_to_write", "wait_for_var",
+                    "wait_for_all", "asnumpy", "asscalar", "waitall"}
+_SYNC_CALL_NAMES = {"waitall"}
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _base_name(node):
+    """Innermost Name of an attribute/subscript/call chain, e.g.
+    `a._raw()` -> 'a', `self._store[k]` -> 'self', `(x+y).data` -> None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _iter_push_sites(ctx):
+    """Yield (call, kwargs) for every engine-push call site: a `.push(...)`
+    passing read_vars= or write_vars= (the engine contract signature)."""
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "push"):
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            if "read_vars" in kw or "write_vars" in kw:
+                yield node, kw
+
+
+def _is_non_atomic(kw):
+    a = kw.get("atomic")
+    return isinstance(a, ast.Constant) and a.value is False
+
+
+def _resolve_callback(ctx, call):
+    """The AST of the function object passed as the callback, or None
+    when it is not resolvable in this file (e.g. a bare parameter)."""
+    if not call.args:
+        return None
+    cb = call.args[0]
+    if isinstance(cb, ast.Lambda):
+        return cb
+    if isinstance(cb, ast.Name):
+        scopes = ctx.enclosing_functions(call) + [ctx.tree]
+        for scope in scopes:
+            for n in ast.walk(scope):
+                if isinstance(n, ast.FunctionDef) and n.name == cb.id:
+                    return n
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Lambda):
+                    if any(isinstance(t, ast.Name) and t.id == cb.id
+                           for t in n.targets):
+                        return n.value
+        return None
+    if (isinstance(cb, ast.Attribute) and isinstance(cb.value, ast.Name)
+            and cb.value.id == "self"):
+        cls = ctx.enclosing_class(call)
+        if cls is not None:
+            for n in cls.body:
+                if isinstance(n, ast.FunctionDef) and n.name == cb.attr:
+                    return n
+    return None
+
+
+def _declared_names(ctx, call, kw):
+    """Names syntactically tied to the declared var sets: every Name in
+    the read_vars/write_vars expressions, plus — when the expression is
+    a bare variable — the Names in whatever built that variable in the
+    enclosing function (assignments, `.append/.extend` mutations)."""
+    names = set()
+    encl = ctx.enclosing_functions(call)
+    scope = encl[0] if encl else ctx.tree
+    for key in ("read_vars", "write_vars"):
+        expr = kw.get(key)
+        if expr is None:
+            continue
+        names |= _names_in(expr)
+        if not isinstance(expr, ast.Name):
+            continue
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign):
+                if any(isinstance(t, ast.Name) and t.id == expr.id
+                       for t in n.targets):
+                    names |= _names_in(n.value)
+            elif (isinstance(n, ast.AugAssign)
+                  and isinstance(n.target, ast.Name)
+                  and n.target.id == expr.id):
+                names |= _names_in(n.value)
+            elif (isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr in ("append", "extend", "insert", "add")
+                  and isinstance(n.func.value, ast.Name)
+                  and n.func.value.id == expr.id):
+                for a in n.args:
+                    names |= _names_in(a)
+    return names
+
+
+def _scope_bound_names(scopes):
+    """Names bound anywhere in the enclosing function scopes — the
+    universe a callback can close over (module globals excluded: numpy,
+    helper functions etc. are not chunk state)."""
+    bound = set()
+    for fn in scopes:
+        a = fn.args
+        for arg in (a.args + a.kwonlyargs + getattr(a, "posonlyargs", [])):
+            bound.add(arg.arg)
+        for arg in (a.vararg, a.kwarg):
+            if arg is not None:
+                bound.add(arg.arg)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+            elif isinstance(n, (ast.For, ast.comprehension)):
+                tgt = n.target
+                bound |= _names_in(tgt)
+    return bound
+
+
+class _CallbackScope:
+    """Name bindings inside one callback: which names are its own locals,
+    and which alias an outer name (default-arg binding `_x=x`, iteration
+    `for g in _vlist`)."""
+
+    def __init__(self, cb):
+        self.aliases = {}
+        self.locals = set()
+        a = cb.args
+        pos = a.args + getattr(a, "posonlyargs", [])
+        for arg in pos + a.kwonlyargs:
+            self.locals.add(arg.arg)
+        for arg in (a.vararg, a.kwarg):
+            if arg is not None:
+                self.locals.add(arg.arg)
+        defaults = a.defaults
+        if defaults:
+            for arg, default in zip(a.args[len(a.args) - len(defaults):],
+                                    defaults):
+                if isinstance(default, ast.Name):
+                    self.aliases[arg.arg] = default.id
+        body = cb.body if isinstance(cb.body, list) else [cb.body]
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, (ast.For, ast.comprehension)):
+                    src = _base_name(n.iter)
+                    for t in _names_in(n.target):
+                        if src is not None:
+                            self.aliases.setdefault(t, src)
+                        else:
+                            self.locals.add(t)
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        for name in _names_in(t):
+                            self.locals.add(name)
+
+    def source_of(self, name):
+        """Follow aliases to the outermost source name."""
+        seen = set()
+        while name in self.aliases and name not in seen:
+            seen.add(name)
+            name = self.aliases[name]
+        return name
+
+    def is_local(self, name):
+        return name in self.locals and name not in self.aliases
+
+
+def _payload_accesses(cb):
+    """Yield (node, base_name, kind, what) for every NDArray-payload
+    access in the callback body; kind is 'read'/'write', `what` is the
+    human-readable access text."""
+    body = cb.body if isinstance(cb.body, list) else [cb.body]
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                base = _base_name(n.func.value)
+                if n.func.attr in _READ_CALL_ATTRS:
+                    yield n, base, "read", ".%s()" % n.func.attr
+                elif n.func.attr in _WRITE_CALL_ATTRS:
+                    yield n, base, "write", ".%s()" % n.func.attr
+            elif isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+                if n.attr == "data":
+                    yield n, _base_name(n.value), "read", ".data"
+            elif isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Store):
+                if n.attr in ("_data", "data"):
+                    yield n, _base_name(n.value), "write", ".%s = ..." % n.attr
+            elif isinstance(n, ast.Subscript) and isinstance(n.ctx, ast.Store):
+                yield n, _base_name(n.value), "write", "[...] = ..."
+            elif isinstance(n, ast.AugAssign):
+                tgt = n.target
+                if isinstance(tgt, ast.Name):
+                    yield n, tgt.id, "write", "%s %s= ..." % (
+                        tgt.id, type(n.op).__name__)
+                elif isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                    yield n, _base_name(tgt.value), "write", "augmented store"
+
+
+def _self_attr_of(node):
+    """For an access node whose base is `self`, the attribute name
+    actually touched (`self._store[k] = ...` -> '_store'), or None."""
+    cur = node
+    if isinstance(cur, ast.AugAssign):
+        cur = cur.target
+    while isinstance(cur, (ast.Subscript, ast.Call)):
+        cur = cur.func if isinstance(cur, ast.Call) else cur.value
+    if (isinstance(cur, ast.Attribute) and isinstance(cur.value, ast.Name)
+            and cur.value.id == "self"):
+        return cur.attr
+    return None
+
+
+@register
+class EnginePushContracts:
+    """E001 + E002: per push site, callback accesses vs declared vars."""
+
+    id = "E001"  # primary id; E002 findings carry their own id
+    ids = ("E001", "E002")
+    title = "engine.push callbacks must declare every chunk they touch"
+
+    def run(self, ctx):
+        for call, kw in _iter_push_sites(ctx):
+            if _is_non_atomic(kw):
+                continue  # non-atomic ops keep normal sync semantics
+            cb = _resolve_callback(ctx, call)
+            if cb is None:
+                continue  # not resolvable here: the sanitizer's job
+            declared = _declared_names(ctx, call, kw)
+            scopes = ctx.enclosing_functions(call)
+            closable = _scope_bound_names(scopes)
+            scope = _CallbackScope(cb)
+            seen = set()
+            for node, base, kind, what in _payload_accesses(cb):
+                if base is None:
+                    continue
+                if base == "self":
+                    # a write through self.<attr>[...] mutates shared
+                    # container state no declared chunk var can name
+                    attr = _self_attr_of(node)
+                    if kind == "write" and attr is not None:
+                        key = ("self", attr, node.lineno)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield Finding(
+                            "E001", ctx.path, node.lineno, node.col_offset,
+                            "pushed callback writes shared container "
+                            "`self.%s` (%s): no declared var covers an "
+                            "attribute store — serialize it through an "
+                            "engine var or allowlist with the guarding "
+                            "invariant" % (attr, what))
+                    continue
+                src = scope.source_of(base)
+                if scope.is_local(src) or src in declared:
+                    continue
+                if src not in closable:
+                    continue  # module-level name (np, helper fn, ...)
+                key = (src, kind, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    "E001", ctx.path, node.lineno, node.col_offset,
+                    "pushed callback %ss `%s` (%s) but `%s` appears in "
+                    "neither read_vars nor write_vars of the push at "
+                    "line %d — an undeclared dependency the engine "
+                    "cannot order (silent data race)"
+                    % (kind, base, what, src, call.lineno))
+            # E002: blocking sync points inside the atomic callback —
+            # sync calls, and `.data` reads (a sync accessor; inside an
+            # op the idiom is `_raw()`)
+            body = cb.body if isinstance(cb.body, list) else [cb.body]
+            called = set()  # Attribute nodes consumed as call targets
+            for stmt in body:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call) and isinstance(n.func,
+                                                              ast.Attribute):
+                        called.add(id(n.func))
+            for stmt in body:
+                for n in ast.walk(stmt):
+                    name = None
+                    if isinstance(n, ast.Call):
+                        fn = n.func
+                        if isinstance(fn, ast.Attribute) \
+                                and fn.attr in _SYNC_CALL_ATTRS:
+                            name = fn.attr
+                        elif isinstance(fn, ast.Name) \
+                                and fn.id in _SYNC_CALL_NAMES:
+                            name = fn.id
+                    elif (isinstance(n, ast.Attribute)
+                          and isinstance(n.ctx, ast.Load)
+                          and n.attr == "data" and id(n) not in called
+                          and _base_name(n.value) not in (None, "self")):
+                        name = ".data"
+                    if name is None:
+                        continue
+                    yield Finding(
+                        "E002", ctx.path, n.lineno, n.col_offset,
+                        "blocking sync point `%s` inside an atomic pushed "
+                        "callback (push at line %d): on an engine worker "
+                        "this is a no-op at best (in_engine_op skips the "
+                        "fence) and a pool deadlock at worst — declare "
+                        "the dependency and read via `_raw()`, or push "
+                        "with atomic=False" % (name, call.lineno))
+
+
+@register
+class EngineVarLifecycle:
+    """E003: Vars created but never bound to a chunk/op lifecycle."""
+
+    id = "E003"
+    title = "engine Vars must be bound to a chunk or op lifecycle"
+
+    @staticmethod
+    def _is_var_ctor(node):
+        if not isinstance(node, ast.Call):
+            return False
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr in ("new_variable", "Var")
+        if isinstance(fn, ast.Name):
+            return fn.id == "Var"
+        return False
+
+    @staticmethod
+    def _scope_nodes(scope):
+        """Nodes owned directly by `scope` — nested function bodies are
+        excluded (they are their own scope and get their own pass)."""
+        out = []
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def run(self, ctx):
+        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for scope in scopes:
+            own = self._scope_nodes(scope)
+            # loads counted over the FULL subtree: a var used only by a
+            # nested closure (a pushed callback) is still used
+            loads = {}
+            for n in ast.walk(scope):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    loads.setdefault(n.id, 0)
+                    loads[n.id] += 1
+            for n in own:
+                if isinstance(n, ast.Expr) and self._is_var_ctor(n.value):
+                    yield Finding(
+                        "E003", ctx.path, n.lineno, n.col_offset,
+                        "engine Var created and immediately discarded: its "
+                        "token queue can never drain and nothing is "
+                        "guarded by it (leaked dependency token)")
+                elif isinstance(n, ast.Assign) and self._is_var_ctor(n.value):
+                    targets = [t for t in n.targets if isinstance(t, ast.Name)]
+                    for t in targets:
+                        if loads.get(t.id, 0) == 0:
+                            yield Finding(
+                                "E003", ctx.path, n.lineno, n.col_offset,
+                                "engine Var bound to `%s` but never used: "
+                                "not attached to any chunk, push, or wait "
+                                "— a leaked token queue" % t.id)

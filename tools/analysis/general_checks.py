@@ -1,0 +1,156 @@
+"""mxlint general checks — hygiene rules that ride along with the
+engine-contract checks (same framework, same allowlist, same CI gate).
+
+  * **W101** — mutable default argument (``def f(x=[])``): the default
+    is created once and shared across calls.
+  * **W102** — bare ``except:``: swallows KeyboardInterrupt/SystemExit
+    and, in engine callbacks, the deferred-error machinery's
+    BaseExceptions.
+  * **W103** — an ``os.environ`` read of a framework variable
+    (``MXNET_*`` / ``MXTPU_*`` / ``DMLC_*``) that is not declared in
+    the config registry (mxnet_tpu/config.py) and therefore missing
+    from the generated docs/how_to/env_var.md.  The registry is the
+    documented runtime surface — undeclared knobs are invisible to
+    users and to `tools/gen_env_doc.py`.
+
+W103 reads the registry by PARSING config.py (no mxnet_tpu import: the
+linter must run in seconds on a bare checkout, and importing the
+package pulls in jax).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, register
+
+__all__ = ["MutableDefaultArgs", "BareExcept", "UndocumentedEnvVar"]
+
+_FRAMEWORK_VAR = re.compile(r"^(MXNET_|MXTPU_|DMLC_)[A-Z0-9_]+$")
+
+
+@register
+class MutableDefaultArgs:
+    id = "W101"
+    title = "mutable default arguments are shared across calls"
+
+    @staticmethod
+    def _is_mutable(node):
+        if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            return node.func.id in ("list", "dict", "set")
+        return False
+
+    def run(self, ctx):
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            a = n.args
+            pos = getattr(a, "posonlyargs", []) + a.args
+            pairs = list(zip(pos[len(pos) - len(a.defaults):], a.defaults))
+            pairs += [(arg, d) for arg, d in zip(a.kwonlyargs, a.kw_defaults)
+                      if d is not None]
+            for arg, default in pairs:
+                if self._is_mutable(default):
+                    yield Finding(
+                        "W101", ctx.path, default.lineno, default.col_offset,
+                        "mutable default for `%s` in `%s()`: evaluated once "
+                        "at def time and shared across calls — default to "
+                        "None and allocate inside" % (arg.arg, n.name))
+
+
+@register
+class BareExcept:
+    id = "W102"
+    title = "bare except swallows BaseException"
+
+    def run(self, ctx):
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.ExceptHandler) and n.type is None:
+                yield Finding(
+                    "W102", ctx.path, n.lineno, n.col_offset,
+                    "bare `except:` catches KeyboardInterrupt/SystemExit "
+                    "(and the engine's deferred BaseExceptions) — name the "
+                    "exception class, or use `except Exception:`")
+
+
+@register
+class UndocumentedEnvVar:
+    id = "W103"
+    title = "framework env vars must be declared in the config registry"
+
+    def __init__(self):
+        self._documented = {}  # repo_root -> frozenset of names
+
+    @staticmethod
+    def _registry_names(repo_root):
+        """Declared env-var names, parsed from mxnet_tpu/config.py:
+        EnvVar("NAME", ...) first arguments plus ABSORBED dict keys."""
+        cfg = os.path.join(repo_root, "mxnet_tpu", "config.py")
+        names = set()
+        try:
+            with open(cfg, "rb") as f:
+                tree = ast.parse(f.read().decode("utf-8"), filename=cfg)
+        except (OSError, SyntaxError):
+            return frozenset()
+        for n in ast.walk(tree):
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "EnvVar" and n.args
+                    and isinstance(n.args[0], ast.Constant)
+                    and isinstance(n.args[0].value, str)):
+                names.add(n.args[0].value)
+            elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Dict):
+                if any(isinstance(t, ast.Name) and t.id == "ABSORBED"
+                       for t in n.targets):
+                    for k in n.value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            names.add(k.value)
+        return frozenset(names)
+
+    def _documented_for(self, repo_root):
+        if repo_root not in self._documented:
+            self._documented[repo_root] = self._registry_names(repo_root)
+        return self._documented[repo_root]
+
+    @staticmethod
+    def _env_read_name(node):
+        """The string literal read from os.environ, or None.  Matches
+        `os.environ.get("X", ...)`, `environ.get("X")`, `os.environ["X"]`,
+        and `os.getenv("X")`."""
+        def _is_environ(v):
+            if isinstance(v, ast.Attribute) and v.attr == "environ":
+                return True
+            return isinstance(v, ast.Name) and v.id == "environ"
+
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == "get"
+                    and _is_environ(fn.value)) or \
+               (isinstance(fn, ast.Attribute) and fn.attr == "getenv") or \
+               (isinstance(fn, ast.Name) and fn.id == "getenv"):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    return node.args[0].value
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            if _is_environ(node.value):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    return sl.value
+        return None
+
+    def run(self, ctx):
+        documented = self._documented_for(ctx.repo_root)
+        for n in ast.walk(ctx.tree):
+            name = self._env_read_name(n)
+            if name is None or not _FRAMEWORK_VAR.match(name):
+                continue
+            if name in documented:
+                continue
+            yield Finding(
+                "W103", ctx.path, n.lineno, n.col_offset,
+                "env var `%s` is read here but not declared in "
+                "mxnet_tpu/config.py (REGISTRY or ABSORBED), so it is "
+                "missing from docs/how_to/env_var.md — declare it and "
+                "regenerate via tools/gen_env_doc.py" % name)
