@@ -2,7 +2,7 @@
 """Headline benchmark: ResNet-50 ImageNet-shape training throughput, 1 chip.
 
 Measures the FULL training step through the public API — Module.forward_
-backward + update (one fused XLA dispatch: fwd+bwd+SGD with donated
+backward + update (fused XLA dispatch: fwd+bwd+SGD with donated
 buffers) — matching how the reference's 181.53 img/s baseline was measured
 (train_imagenet.py full steps on 1x P100, reference docs/how_to/perf.md:
 181-190).
@@ -14,30 +14,89 @@ convs internally — see README "Roofline" for the full layout A/B and
 profile).  BatchNorm uses the one-pass fp32-accumulated E[x]/E[x^2] stats
 (ops/nn.py batch_norm), worth ~17% step time on this model.
 
-Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "img/s", "vs_baseline": N}
-plus an `mfu` field: XLA-counted step FLOPs / step time / 197 TFLOP/s
-(v5e bf16 peak, MAC=2 convention both sides).
+Dispatch amortization (docs/perf.md): with --steps-per-dispatch K > 1
+(or MXTPU_STEPS_PER_DISPATCH), each dispatch is ONE jitted lax.scan
+executing K full fwd+bwd+update steps, with input blocks double-buffered
+to the device by a background engine op (io.DeviceStagedIter) — the
+~11 ms per-chained-dispatch tunnel overhead is paid once per K steps.
+The JSON line reports `dispatches` (= ceil(steps/K)) and
+`steps_per_dispatch` either way.
+
+`--smoke` runs a tiny model on CPU (JAX_PLATFORMS=cpu) through the REAL
+K-step path end-to-end — fit -> DeviceStagedIter -> fused_update_block —
+with the profiler on, and reports the h2d_stage / fused_dispatch lanes;
+tests/test_bench_smoke.py pins it so this harness cannot silently rot.
 
 Methodology note: on the tunneled TPU platform `block_until_ready` can
 return early and each CHAINED dispatch carries ~11 ms tunnel overhead, so
-the timed loop runs 30 steps (amortizing the fixed costs) and is fenced
-once by a ONE-element weight transfer.
+the timed loop runs several steps per fence (amortizing the fixed costs)
+and is fenced by a ONE-element weight transfer.
 """
+import argparse
 import json
+import os
 import time
-
-import numpy as np
 
 BASELINE_IMG_S = 181.53  # 1x P100, reference docs/how_to/perf.md:181-190
 V5E_PEAK_FLOPS = 197e12  # bf16, MAC=2 convention
-BATCH = 512
-STEPS = 30
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny model on CPU through the real K-step path; "
+                        "prints a JSON line with dispatch/lane checks")
+    p.add_argument("--steps-per-dispatch", type=int, default=None,
+                   help="fused block size K (default: "
+                        "MXTPU_STEPS_PER_DISPATCH, i.e. 1)")
+    p.add_argument("--batch", type=int, default=512)
+    p.add_argument("--steps", type=int, default=30,
+                   help="total timed steps (with K>1: rounded up to 3 "
+                        "fenced chunks of whole K-blocks)")
+    return p.parse_args()
+
+
+def _resolve_k(args):
+    if args.steps_per_dispatch is not None:
+        return max(1, args.steps_per_dispatch)
+    from mxnet_tpu import config  # registered default, single source
+
+    return max(1, config.get("MXTPU_STEPS_PER_DISPATCH"))
+
+
+def _endless_iter(mx, rng, batch, shape, classes, nbatches=4):
+    """Endless in-memory iterator cycling over `nbatches` synthetic
+    batches (ResizeIter rewinds the source on exhaustion), so ONE
+    staging pipeline can stream the whole timed run and the H2D of
+    block N+1 genuinely overlaps block N's compute."""
+    import numpy as np
+
+    n = batch * nbatches
+    X = rng.randn(n, *shape).astype("float32")
+    y = rng.randint(0, classes, n).astype("float32")
+    return mx.io.ResizeIter(mx.io.NDArrayIter(X, y, batch_size=batch),
+                            size=1 << 30)
+
+
+def _fence(mod, name):
+    import numpy as np
+
+    x = mod._exec_group.execs[0].arg_dict[name].data
+    np.asarray(x[(0,) * x.ndim])  # 1-element transfer = real sync
 
 
 def main():
+    args = parse_args()
+    if args.smoke:
+        return smoke(args)
+
+    import numpy as np
+
     import mxnet_tpu as mx
     from mxnet_tpu.models.resnet import resnet
+
+    BATCH = args.batch
+    K = _resolve_k(args)
 
     mx.random.seed(0)
     net = resnet(50, layout="NHWC")
@@ -48,64 +107,175 @@ def main():
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
     rng = np.random.RandomState(0)
-    batch = mx.io.DataBatch(
-        data=[mx.nd.array(rng.randn(BATCH, 224, 224, 3).astype("float32"))],
-        label=[mx.nd.array(rng.randint(0, 1000, BATCH).astype("float32"))],
-    )
+    exe = mod._exec_group.execs[0]
 
-    def fence():
-        x = mod._exec_group.execs[0].arg_dict["fc1_weight"].data
-        np.asarray(x[(0,) * x.ndim])  # 1-element transfer = real sync
-
-    for _ in range(4):  # compile + settle
-        mod.forward_backward(batch)
-        mod.update()
-    fence()
-
-    # 3 fenced chunks -> mean + spread, so the headline number carries a
-    # variance estimate (perf.md-style methodology, not a single sample)
-    chunk = STEPS // 3
-    rates = []
-    for _ in range(3):
-        t0 = time.time()
-        for _ in range(chunk):
+    if K > 1:
+        # K-step fused block path: --steps rounded up to whole K-blocks
+        # and 3 equal fenced chunks; ONE DeviceStagedIter stays alive
+        # across the whole timed run so staging overlaps compute like it
+        # does in training (a fresh pipeline per chunk would serialize
+        # the first H2D into every chunk)
+        blocks_per_chunk = max(1, -(-args.steps // K // 3))
+        it = _endless_iter(mx, rng, BATCH, (224, 224, 3), 1000)
+        staged = mx.io.DeviceStagedIter(it, steps_per_dispatch=K,
+                                        place_fn=exe.place_block_input)
+        rates, steps_done = [], 0
+        try:
+            block = next(staged)  # compile + settle
+            mod.forward_backward(block)
+            mod.update()
+            _fence(mod, "fc1_weight")
+            d0 = exe._train_dispatches
+            for _ in range(3):
+                t0 = time.time()
+                n = 0
+                for _ in range(blocks_per_chunk):
+                    block = next(staged)
+                    mod.forward_backward(block)
+                    mod.update()
+                    n += block.count
+                _fence(mod, "fc1_weight")
+                rates.append(BATCH * n / (time.time() - t0))
+                steps_done += n
+        finally:
+            staged.close()
+        dispatches = exe._train_dispatches - d0
+        img_s = float(np.mean(rates))
+        spread = float(np.std(rates))
+        dt = BATCH / img_s
+        mfu = None  # cost_analysis over the scan executable is not wired yet
+    else:
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.randn(BATCH, 224, 224, 3).astype("float32"))],
+            label=[mx.nd.array(rng.randint(0, 1000, BATCH).astype("float32"))],
+        )
+        for _ in range(4):  # compile + settle
             mod.forward_backward(batch)
             mod.update()
-        fence()
-        rates.append(BATCH * chunk / (time.time() - t0))
-    img_s = float(np.mean(rates))
-    spread = float(np.std(rates))
-    dt = BATCH / img_s
+        _fence(mod, "fc1_weight")
 
-    # XLA-counted FLOPs of the fused step (fwd+bwd+update) for the MFU claim
-    mfu = None
-    try:
-        ex = mod._exec_group.execs[0]
-        args = ex._place(ex._gather_args())
-        diff_names, diff_idx, nondiff_idx = ex._fused_static
-        dv = tuple(args[i] for i in diff_idx)
-        ndv = tuple(args[i] for i in nondiff_idx)
-        from mxnet_tpu.optimizer import _state_leaves
+        # 3 fenced chunks -> mean + spread, so the headline number carries a
+        # variance estimate (perf.md-style methodology, not a single sample)
+        chunk = max(1, args.steps // 3)
+        rates = []
+        d0 = exe._train_dispatches
+        for _ in range(3):
+            t0 = time.time()
+            for _ in range(chunk):
+                mod.forward_backward(batch)
+                mod.update()
+            _fence(mod, "fc1_weight")
+            rates.append(BATCH * chunk / (time.time() - t0))
+        dispatches = exe._train_dispatches - d0
+        steps_done = 3 * chunk
+        img_s = float(np.mean(rates))
+        spread = float(np.std(rates))
+        dt = BATCH / img_s
 
-        st = tuple(tuple(l.data for l in _state_leaves(
-            ex._fused_updater.states[ex._fused_index_of_name[n]]))
-            for n in diff_names)
-        sc = np.zeros((len(diff_names), 3), np.float32)
-        comp = ex._jit_step[0].lower(dv, ndv, ex._gather_aux(), st,
-                                     np.uint32(0), sc).compile()
-        ca = comp.cost_analysis()
-        ca = ca[0] if isinstance(ca, list) else ca
-        mfu = round(float(ca.get("flops", 0.0)) / dt / V5E_PEAK_FLOPS, 4)
-    except Exception:
-        pass
+        # XLA-counted FLOPs of the fused step (fwd+bwd+update) for the MFU claim
+        mfu = None
+        try:
+            ex = mod._exec_group.execs[0]
+            args_v = ex._place(ex._gather_args())
+            diff_names, diff_idx, nondiff_idx = ex._fused_static
+            dv = tuple(args_v[i] for i in diff_idx)
+            ndv = tuple(args_v[i] for i in nondiff_idx)
+            from mxnet_tpu.optimizer import _state_leaves
+
+            st = tuple(tuple(l.data for l in _state_leaves(
+                ex._fused_updater.states[ex._fused_index_of_name[n]]))
+                for n in diff_names)
+            sc = np.zeros((len(diff_names), 3), np.float32)
+            comp = ex._jit_step[0].lower(dv, ndv, ex._gather_aux(), st,
+                                         np.uint32(0), sc).compile()
+            ca = comp.cost_analysis()
+            ca = ca[0] if isinstance(ca, list) else ca
+            mfu = round(float(ca.get("flops", 0.0)) / dt / V5E_PEAK_FLOPS, 4)
+        except Exception:
+            pass
 
     print(json.dumps({
-        "metric": "ResNet-50 full train step img/s/chip (bf16+fp32 master, batch 512, NHWC, fwd+bwd+SGD)",
+        "metric": "ResNet-50 full train step img/s/chip (bf16+fp32 master, "
+                  "batch %d, NHWC, fwd+bwd+SGD)" % BATCH,
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "mfu": mfu,
         "stdev": round(spread, 2),
+        "steps_per_dispatch": K,
+        "steps": steps_done,
+        "dispatches": dispatches,
+    }))
+
+
+def smoke(args):
+    """Tiny-model CPU run of the REAL K-step path end-to-end: fit ->
+    DeviceStagedIter (background h2d_stage engine op) ->
+    Executor.fused_update_block (lax.scan dispatch).  Prints ONE JSON
+    line with the dispatch count (= ceil(steps/K)) and the profiler-lane
+    evidence that staging ran asynchronously."""
+    # must win over any site TPU default BEFORE jax is first imported
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    K = args.steps_per_dispatch or 4
+    BATCH = 16
+    NBATCH = 24  # 6 blocks at K=4: enough for staging to run ahead
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    X = rng.randn(BATCH * NBATCH, 32).astype("float32")
+    y = rng.randint(0, 4, BATCH * NBATCH).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    fname = os.path.join(tempfile.mkdtemp(), "smoke_profile.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            steps_per_dispatch=K)
+    mx.waitall()
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    h2d = [e for e in events if e["name"] == "h2d_stage"]
+    fused = [e for e in events if e["name"].startswith("fused_dispatch(")]
+
+    def overlaps(a, b):
+        return a["ts"] < b["ts"] + b["dur"] and b["ts"] < a["ts"] + a["dur"]
+
+    h2d_overlap = any(overlaps(a, b) for a in h2d for b in fused)
+    fused_tids = {e["tid"] for e in fused}
+    # staging ops run on engine workers (record_span keeps real thread
+    # ids), so an h2d span off the dispatching thread proves the H2D ran
+    # asynchronously even when the tiny CPU spans are too short to overlap
+    h2d_async = any(e["tid"] not in fused_tids for e in h2d)
+
+    exe = mod._exec_group.execs[0]
+    print(json.dumps({
+        "metric": "bench smoke (K-step fused dispatch + async staging, CPU)",
+        "steps": NBATCH,
+        "steps_per_dispatch": K,
+        "dispatches": exe._train_dispatches,
+        "expected_dispatches": -(-NBATCH // K),
+        "h2d_stage_spans": len(h2d),
+        "fused_dispatch_spans": len(fused),
+        "h2d_overlap": bool(h2d_overlap),
+        "h2d_async": bool(h2d_async),
     }))
 
 

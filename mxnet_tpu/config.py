@@ -85,6 +85,20 @@ REGISTRY = [
            "min(4, max(2, n_cpus)). The reference defaults to 1; here "
            "auto keeps >=2 workers so host compute, IO decode, and "
            "kvstore traffic overlap out of the box"),
+    # ---- training dispatch / input staging (executor.py, io.py) ----
+    EnvVar("MXTPU_STEPS_PER_DISPATCH", int, 1,
+           "Fused training block size K: Module.fit runs K full "
+           "fwd+bwd+update steps per XLA dispatch — one jitted lax.scan "
+           "carrying (params, optimizer state, aux) with donated buffers "
+           "— so fixed per-dispatch cost (~11 ms on tunneled TPUs, "
+           "bench.py) is paid once per K steps.  1 = one dispatch per "
+           "step (the pre-block behavior); see docs/perf.md"),
+    EnvVar("MXTPU_STAGE_BUFFERS", int, 2,
+           "io.DeviceStagedIter lookahead: how many stacked K-step input "
+           "blocks are host-decoded and jax.device_put ahead of compute "
+           "by a background engine op (2 = classic double buffering, "
+           "reference src/io/iter_prefetcher.h); raise only if H2D "
+           "stalls show between fused_dispatch spans in the profile"),
     # ---- memory (executor.py) ----
     EnvVar("MXNET_BACKWARD_DO_MIRROR", int, 0,
            "Memory mirroring: recompute cheap activations (BN/ReLU/elemwise) "

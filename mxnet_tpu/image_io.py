@@ -193,7 +193,22 @@ class ImageRecordIterImpl(DataIter):
                 yield (batch_data.copy(), batch_label.copy(),
                        self.batch_size - n)
 
+    def close(self):
+        """Final teardown: drain the engine-backed fetch chain and JOIN
+        the decode pool's worker threads (reset() cycles reuse the pool;
+        without close() each iterator instance leaks its pool threads
+        for the process lifetime).  Idempotent; the iterator is not
+        usable afterwards."""
+        if self._bg is not None:
+            self._bg.close()
+            self._bg = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
     def reset(self):
+        if self._pool is None:
+            raise MXNetError("ImageRecordIter is closed")
         if self._bg is not None:
             self._bg.close()  # drains in-flight fetches before we rewind
         order = list(self._offsets)

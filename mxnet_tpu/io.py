@@ -22,8 +22,8 @@ from .ndarray import NDArray, array
 
 __all__ = [
     "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-    "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
-    "ImageDetRecordIter",
+    "PrefetchingIter", "DeviceStagedIter", "StagedBlock", "MNISTIter",
+    "CSVIter", "ImageRecordIter", "ImageDetRecordIter",
 ]
 
 
@@ -282,10 +282,26 @@ class PrefetchingIter(DataIter):
         ]
 
     def _stop_prefetch(self):
+        """Stop background fetching and DRAIN it: after this returns no
+        engine op is still calling into the wrapped iterators, so the
+        caller may safely reset or destroy them.  Idempotent — reset()
+        cycles and repeated close() calls must not double-release (or
+        leak one fetch pipeline per epoch)."""
         if self._bg_iters is not None:
             for bg in self._bg_iters:
                 bg.close()
         self._bg_iters = None
+
+    def close(self):
+        """Final teardown: drain this iterator's prefetch ops AND close the
+        wrapped iterators (joining any worker threads they own, e.g.
+        ImageRecordIter's decode pool).  Idempotent; the iterator is not
+        usable afterwards (unlike reset(), which restarts prefetch)."""
+        self._stop_prefetch()
+        for it in self.iters:
+            inner_close = getattr(it, "close", None)
+            if callable(inner_close):
+                inner_close()
 
     def __del__(self):
         if self._bg_iters is not None:
@@ -359,6 +375,165 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch[0].pad
+
+
+class StagedBlock:
+    """K training batches stacked on a new leading axis, resident on
+    device: the unit of work of the K-step fused dispatch
+    (Executor.fused_update_block).
+
+    * ``data`` / ``label`` — lists of (K, ...) device arrays aligned with
+      ``provide_data`` / ``provide_label``;
+    * ``label_host`` — per-step numpy labels ([[arr, ...] per step]) kept
+      on the host so update_metric never reads the device block back;
+    * ``count`` — number of real steps K (the last block of an epoch may
+      be short);
+    * ``pad`` — pad rows of the FINAL step (earlier steps are full).
+    """
+
+    __slots__ = ("data", "label", "label_host", "count", "pad")
+
+    def __init__(self, data, label, label_host, count, pad=0):
+        self.data = data
+        self.label = label
+        self.label_host = label_host
+        self.count = count
+        self.pad = pad
+
+
+class DeviceStagedIter(DataIter):
+    """Async device staging: groups K batches from `data_iter` into one
+    stacked StagedBlock and `jax.device_put`s it from a BACKGROUND engine
+    op, so the host decode + H2D of block N+1 overlap block N's device
+    compute — the tf.data prefetch-to-device recipe layered on the
+    reference's double-buffered PrefetcherIter (src/io/iter_prefetcher.h).
+
+    The fetch rides engine.ThreadedIter (one engine op per block on the
+    shared worker pool, its iterator var declared as the op's write set,
+    so SanitizerEngine sees a fully-declared pipeline and `mx.waitall()`
+    fences staging along with everything else).  ``MXTPU_STAGE_BUFFERS``
+    blocks are kept in flight (default 2 = classic double buffering).
+    Each staging op records an ``h2d_stage`` profiler span, so overlap
+    with the ``fused_dispatch(K)`` lane is visible in the trace.
+
+    `place_fn(name, stacked_array)` does the actual device placement —
+    Module.fit passes Executor.place_block_input so blocks land with the
+    executor's input sharding; without it blocks stay host-side and the
+    executor places them at dispatch (no overlap, same results).
+    """
+
+    def __init__(self, data_iter, steps_per_dispatch=None, place_fn=None,
+                 buffers=None):
+        super().__init__()
+        from . import config
+
+        self._inner = data_iter
+        k = (steps_per_dispatch if steps_per_dispatch is not None
+             else config.get("MXTPU_STEPS_PER_DISPATCH"))
+        self._k = max(1, int(k))
+        self._place_fn = place_fn
+        self._buffers = max(1, int(buffers if buffers is not None
+                                   else config.get("MXTPU_STAGE_BUFFERS")))
+        self.batch_size = getattr(data_iter, "batch_size", 0)
+        self._bg = None
+        self._start()
+
+    @property
+    def steps_per_dispatch(self):
+        return self._k
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def _start(self):
+        self._bg = ThreadedIter(self._fetch_block, max_prefetch=self._buffers,
+                                name="h2d_stage")
+
+    def _names(self, descs):
+        return [d.name if isinstance(d, DataDesc) else d[0] for d in descs]
+
+    def _fetch_block(self):
+        """One staging op: pull up to K batches, stack host-side, device-
+        put.  Runs on an engine worker while the consumer's previous
+        block computes on device; the whole decode+stack+H2D is recorded
+        as one `h2d_stage` profiler span."""
+        import time as _time
+
+        from . import profiler
+
+        t0 = _time.time()
+        batches = []
+        while len(batches) < self._k:
+            try:
+                batches.append(self._inner.next())
+            except StopIteration:
+                break
+        if not batches:
+            raise StopIteration
+        block = self._assemble(batches)
+        if profiler.spans_active():
+            t1 = _time.time()
+            profiler.record_span("h2d_stage", int(t0 * 1e6),
+                                 int((t1 - t0) * 1e6), cat="io")
+        return block
+
+    def _assemble(self, batches):
+        def host(a):
+            return a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+
+        def stack_put(names, rows):
+            out = []
+            for i, name in enumerate(names):
+                arr = _np.stack([host(b[i]) for b in rows])
+                out.append(self._place_fn(name, arr)
+                           if self._place_fn is not None else arr)
+            return out
+
+        data_names = self._names(self.provide_data)
+        data = stack_put(data_names, [b.data for b in batches])
+        label, label_host = [], None
+        if batches[0].label:
+            label_names = self._names(self.provide_label)
+            label = stack_put(label_names, [b.label for b in batches])
+            label_host = [[host(a) for a in b.label] for b in batches]
+        return StagedBlock(data, label, label_host, len(batches),
+                           pad=batches[-1].pad or 0)
+
+    def next(self):
+        if self._bg is None:
+            raise MXNetError("DeviceStagedIter is closed (reset() restarts "
+                             "a live iterator; a closed one is done)")
+        return next(self._bg)
+
+    def iter_next(self):
+        raise NotImplementedError("DeviceStagedIter yields StagedBlocks; "
+                                  "iterate with next()")
+
+    def reset(self):
+        """Drain in-flight staging ops, rewind the source, restart the
+        lookahead.  Idempotent per cycle — no staging pipeline survives
+        from the previous epoch."""
+        self.close()
+        self._inner.reset()
+        self._start()
+
+    def close(self):
+        """Stop staging and drain outstanding ops (after this returns the
+        source iterator is no longer being read, so the owner may reset
+        or destroy it).  Idempotent.  Does NOT close the source — the
+        training loop owns its lifetime."""
+        if self._bg is not None:
+            self._bg.close()
+        self._bg = None
+
+    def __del__(self):
+        if getattr(self, "_bg", None) is not None:
+            self._bg.cancel()
 
 
 class MNISTIter(NDArrayIter):

@@ -190,6 +190,15 @@ class DataParallelExecutorGroup:
         assert self.for_training, "re-bind with for_training=True to run backward"
         self.execs[0].backward(out_grads)
 
+    def stage_block(self, block):
+        """Stage a StagedBlock (stacked K-step inputs, io.DeviceStagedIter)
+        on the executor; the next update() runs the whole block as ONE
+        K-step fused dispatch (Executor.fused_update_block)."""
+        named = dict(zip(self.data_names, block.data))
+        if self.label_names and block.label:
+            named.update(zip(self.label_names, block.label))
+        self.execs[0].stage_block(named, block.count)
+
     def get_outputs(self, merge_multi_context=True):
         outs = self.execs[0].outputs
         if merge_multi_context:
@@ -204,7 +213,19 @@ class DataParallelExecutorGroup:
         return [[g] for g in grads]
 
     def update_metric(self, eval_metric, labels):
-        preds = self.execs[0].outputs
+        """Feed outputs to the metric.  After a K-step block dispatch the
+        outputs are stacked (K, ...) and `labels` is the block's per-step
+        label list: the stacked arrays are read back ONCE (one D2H
+        transfer per dispatch instead of one per step) and the metric
+        consumes the block step by step on the host."""
+        exe = self.execs[0]
+        k = getattr(exe, "_last_block_count", 0)
+        if k:
+            preds = [_np.asarray(o.data) for o in exe.outputs]
+            for s in range(k):
+                eval_metric.update(list(labels[s]), [p[s] for p in preds])
+            return
+        preds = exe.outputs
         eval_metric.update(labels, preds)
 
     @property

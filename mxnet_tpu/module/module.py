@@ -308,9 +308,64 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec_group.forward(data_batch, is_train)
 
+    def forward_backward(self, data_batch):
+        """One fused training step — or, given a StagedBlock, a K-step
+        block: the stacked batches are staged on the executor and the
+        whole fwd+bwd+update×K runs as ONE dispatch at update()."""
+        from ..io import StagedBlock
+
+        if isinstance(data_batch, StagedBlock):
+            assert self._block_ready(), (
+                "K-step block dispatch needs the fused updater armed "
+                "(init_optimizer with a fused-capable optimizer, no "
+                "kvstore-side update)")
+            self._exec_group.stage_block(data_batch)
+            return
+        super().forward_backward(data_batch)
+
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
         self._exec_group.backward(out_grads=out_grads)
+
+    def _block_ready(self):
+        """The K-step fused block path needs the single-dispatch fused
+        updater armed (fused-capable optimizer, updater-side update,
+        plain 'write' grad_req, no monitor)."""
+        return (self.binded and self.optimizer_initialized
+                and self._exec_group is not None
+                and getattr(self._exec_group.execs[0], "_fused_updater",
+                            None) is not None)
+
+    def _run_epoch_block(self, train_data, epoch, eval_metric,
+                         batch_end_callback, k):
+        """Blocked epoch body: K steps per dispatch, inputs double-
+        buffered to the device by a background engine op, metrics
+        consumed once per dispatch from the stacked outputs."""
+        from ..io import DeviceStagedIter
+        from .base_module import _fire
+
+        exe = self._exec_group.execs[0]
+        staged = DeviceStagedIter(train_data, steps_per_dispatch=k,
+                                  place_fn=exe.place_block_input)
+        nbatch = 0
+        try:
+            for block in staged:
+                self.forward_backward(block)
+                self.update()
+                if block.label_host is not None:
+                    self.update_metric(eval_metric, block.label_host)
+                nbatch += block.count
+                if batch_end_callback is not None:
+                    # one callback per dispatch (nbatch = last step index):
+                    # per-step callbacks would force per-step host sync,
+                    # defeating the amortization
+                    _fire(batch_end_callback,
+                          BatchEndParam(epoch=epoch, nbatch=nbatch - 1,
+                                        eval_metric=eval_metric,
+                                        locals=locals()))
+        finally:
+            staged.close()  # the epoch owns train_data; fit resets it
+        return nbatch
 
     def _maybe_install_fused_update(self):
         """Arm the single-dispatch fwd+bwd+update step when safe:
@@ -337,6 +392,9 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
         exe = self._exec_group.execs[0]
+        if getattr(exe, "_pending_fused_block", False):
+            exe.fused_update_block()
+            return
         if getattr(exe, "_pending_fused", False):
             if getattr(exe, "_fused_updater", None) is not None:
                 exe.fused_update()

@@ -1,0 +1,172 @@
+"""Input-pipeline lifecycle hygiene: PrefetchingIter / DeviceStagedIter
+reset() cycles must not leak a fetch pipeline (or thread) per epoch, and
+close() must drain, join, and be idempotent."""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter, DeviceStagedIter, \
+    NDArrayIter, PrefetchingIter
+
+
+def _nd_iter(n=64, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return NDArrayIter(rng.rand(n, 4).astype("f4"),
+                       rng.randint(0, 3, n).astype("f4"), batch_size=batch)
+
+
+class _ClosableIter(DataIter):
+    """Source iterator that records close() propagation."""
+
+    def __init__(self, inner):
+        super().__init__(inner.batch_size)
+        self.inner = inner
+        self.closed = 0
+
+    @property
+    def provide_data(self):
+        return self.inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self.inner.provide_label
+
+    def reset(self):
+        self.inner.reset()
+
+    def next(self):
+        return self.inner.next()
+
+    def close(self):
+        self.closed += 1
+
+
+def test_prefetching_iter_resets_do_not_leak_threads():
+    """The regression pin: 3 reset() cycles (3 'epochs') leave the live
+    thread count flat — the engine worker pool is fixed-size and each
+    epoch's fetch chain is drained, not abandoned."""
+    it = PrefetchingIter(_nd_iter())
+    for b in it:  # warm-up epoch spins up whatever lazily starts
+        pass
+    mx.waitall()
+    before = threading.active_count()
+    for _ in range(3):
+        it.reset()
+        n = sum(1 for _ in it)
+        assert n == 8
+    mx.waitall()
+    assert threading.active_count() <= before, (
+        "reset() cycles leaked threads: %d -> %d"
+        % (before, threading.active_count()))
+    it.close()
+
+
+def test_prefetching_iter_close_is_idempotent_and_propagates():
+    inner = _ClosableIter(_nd_iter())
+    it = PrefetchingIter(inner)
+    next(it)
+    it.close()
+    it.close()  # second close must be a no-op, not a crash/double-release
+    assert inner.closed == 2  # propagated each time (inner close idempotent too)
+    assert it._bg_iters is None
+
+
+def test_prefetching_iter_stop_prefetch_idempotent():
+    it = PrefetchingIter(_nd_iter())
+    it._stop_prefetch()
+    it._stop_prefetch()
+    assert it._bg_iters is None
+    it.reset()  # restartable after stop
+    assert sum(1 for _ in it) == 8
+    it.close()
+
+
+def test_device_staged_iter_blocks_and_reset():
+    """Staged blocks carry stacked (K, batch, ...) arrays, the tail block
+    is short, and reset() cycles replay the epoch without leaking."""
+    it = DeviceStagedIter(_nd_iter(n=48, batch=8), steps_per_dispatch=4)
+    before = None
+    for cycle in range(3):
+        counts = []
+        b0 = next(it)
+        assert np.asarray(b0.data[0]).shape == (4, 8, 4)
+        assert np.asarray(b0.label[0]).shape == (4, 8)
+        assert len(b0.label_host) == 4 and b0.label_host[0][0].shape == (8,)
+        counts.append(b0.count)
+        counts.extend(b.count for b in it)
+        assert counts == [4, 2]  # 6 steps at K=4 -> 4 + tail 2
+        with pytest.raises(StopIteration):
+            next(it)
+        mx.waitall()
+        if before is None:
+            before = threading.active_count()
+        else:
+            assert threading.active_count() <= before
+        it.reset()
+    it.close()
+    it.close()  # idempotent
+
+
+def test_device_staged_iter_close_leaves_source_usable():
+    """close() drains staging but does NOT close the source — the
+    training loop owns the source's lifetime across epochs."""
+    src = _nd_iter(n=32, batch=8)
+    staged = DeviceStagedIter(src, steps_per_dispatch=2)
+    next(staged)
+    staged.close()
+    assert staged._bg is None
+    with pytest.raises(mx.base.MXNetError, match="closed"):
+        next(staged)
+    src.reset()
+    assert sum(1 for _ in src) == 4
+
+
+def test_device_staged_iter_propagates_source_errors():
+    class Boom(DataIter):
+        batch_size = 2
+        provide_data = [DataDesc("data", (2, 3))]
+        provide_label = [DataDesc("softmax_label", (2,))]
+
+        def next(self):
+            raise RuntimeError("decode exploded")
+
+    it = DeviceStagedIter(Boom(), steps_per_dispatch=2)
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        next(it)
+    it.close()
+
+
+def test_image_record_iter_close_joins_decode_pool(tmp_path):
+    """ImageRecordIter.close() shuts the decode pool down (joining its
+    worker threads) and is idempotent; reset() after close errors
+    instead of resurrecting a half-torn iterator."""
+    PIL = pytest.importorskip("PIL.Image")
+    import os
+    import subprocess
+    import sys
+
+    root = str(tmp_path / "imgs")
+    os.makedirs(root + "/class0", exist_ok=True)
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        arr = rng.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+        PIL.fromarray(arr).save(root + "/class0/img%d.jpg" % i, "JPEG")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prefix = str(tmp_path / "pack")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+         prefix, root], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 16, 16), batch_size=4,
+                               preprocess_threads=2,
+                               force_python_decode=True)
+    next(it)  # force the python decode pool to actually spin up threads
+    it.close()
+    assert it._pool is None and it._bg is None
+    it.close()  # idempotent
+    with pytest.raises(mx.base.MXNetError):
+        it.reset()

@@ -123,6 +123,39 @@ def test_e001_flags_shared_container_write(tmp_path):
     assert "self._store" in findings[0].message
 
 
+E001_STAGING_UNDECLARED = """
+def stage_blocks(eng, source, staged, slot_var):
+    def fetch():
+        block = source._raw()
+        staged._set_data(block)
+    eng.push(fetch, read_vars=[source._engine_var()], write_vars=[slot_var])
+"""
+
+
+def test_e001_flags_undeclared_staging_buffer_write(tmp_path):
+    """A staging-style callback (background H2D double buffering, the
+    io.DeviceStagedIter shape) that writes its staging buffer without
+    declaring it: the scheduler can't order the write against the
+    consumer's read of the same buffer."""
+    findings, _, _ = _lint_src(tmp_path, E001_STAGING_UNDECLARED)
+    assert _ids(findings) == ["E001"]
+    assert "`staged`" in findings[0].message
+
+
+E001_STAGING_DECLARED = """
+def stage_blocks(eng, source, staged, slot_var):
+    def fetch(_src=source, _dst=staged):
+        _dst._set_data(_src._raw())
+    eng.push(fetch, read_vars=[source._engine_var()],
+             write_vars=[slot_var, staged._engine_var()])
+"""
+
+
+def test_e001_staging_callback_with_declared_buffer_is_clean(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E001_STAGING_DECLARED)
+    assert findings == []
+
+
 E001_NON_ATOMIC = """
 def schedule(eng, a, v):
     def cb():
