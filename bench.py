@@ -108,6 +108,16 @@ def main():
                        optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
     rng = np.random.RandomState(0)
     exe = mod._exec_group.execs[0]
+    # dispatch accounting comes from the telemetry registry (the public
+    # counter surface).  MXTPU_TELEMETRY=0 is respected — a user timing
+    # the instrumentation's own overhead gets a registry-free run, and
+    # dispatch counts fall back to the executor's internal attribute.
+    from mxnet_tpu import telemetry
+
+    def _dispatches():
+        if telemetry.enabled():
+            return telemetry.counter_value("executor.train_dispatches")
+        return exe._train_dispatches
 
     if K > 1:
         # K-step fused block path: --steps rounded up to whole K-blocks
@@ -125,7 +135,7 @@ def main():
             mod.forward_backward(block)
             mod.update()
             _fence(mod, "fc1_weight")
-            d0 = exe._train_dispatches
+            d0 = _dispatches()
             for _ in range(3):
                 t0 = time.time()
                 n = 0
@@ -139,7 +149,7 @@ def main():
                 steps_done += n
         finally:
             staged.close()
-        dispatches = exe._train_dispatches - d0
+        dispatches = _dispatches() - d0
         img_s = float(np.mean(rates))
         spread = float(np.std(rates))
         dt = BATCH / img_s
@@ -158,7 +168,7 @@ def main():
         # variance estimate (perf.md-style methodology, not a single sample)
         chunk = max(1, args.steps // 3)
         rates = []
-        d0 = exe._train_dispatches
+        d0 = _dispatches()
         for _ in range(3):
             t0 = time.time()
             for _ in range(chunk):
@@ -166,7 +176,7 @@ def main():
                 mod.update()
             _fence(mod, "fc1_weight")
             rates.append(BATCH * chunk / (time.time() - t0))
-        dispatches = exe._train_dispatches - d0
+        dispatches = _dispatches() - d0
         steps_done = 3 * chunk
         img_s = float(np.mean(rates))
         spread = float(np.std(rates))
@@ -222,7 +232,14 @@ def smoke(args):
     import numpy as np
 
     import mxnet_tpu as mx
-    from mxnet_tpu import profiler
+    from mxnet_tpu import profiler, telemetry
+
+    # --smoke IS the telemetry acceptance harness: it force-enables the
+    # registry (overriding MXTPU_TELEMETRY=0) because its job is to
+    # assert the instrumentation works; use the headline bench for
+    # telemetry-free timing
+    telemetry.set_enabled(True)
+    telemetry.reset()
 
     K = args.steps_per_dispatch or 4
     BATCH = 16
@@ -265,6 +282,18 @@ def smoke(args):
     # asynchronously even when the tiny CPU spans are too short to overlap
     h2d_async = any(e["tid"] not in fused_tids for e in h2d)
 
+    # telemetry snapshot asserts: the registry saw the run — dispatches
+    # counted, input bytes staged to device, and the staging pipeline's
+    # buffer occupancy observed at least once (docs/observability.md)
+    snap = telemetry.snapshot()
+    tel_dispatches = snap["counters"].get("executor.train_dispatches", 0)
+    tel_h2d = snap["counters"].get("executor.h2d_bytes", 0)
+    stage_seen = "io.buffer.h2d_stage" in snap["gauges"]
+    assert tel_dispatches == -(-NBATCH // K), snap["counters"]
+    assert tel_h2d > 0, snap["counters"]
+    assert stage_seen, snap["gauges"]
+    assert snap["histograms"]["module.step_seconds"]["count"] == tel_dispatches
+
     exe = mod._exec_group.execs[0]
     print(json.dumps({
         "metric": "bench smoke (K-step fused dispatch + async staging, CPU)",
@@ -276,6 +305,10 @@ def smoke(args):
         "fused_dispatch_spans": len(fused),
         "h2d_overlap": bool(h2d_overlap),
         "h2d_async": bool(h2d_async),
+        "telemetry_dispatches": tel_dispatches,
+        "telemetry_h2d_bytes": tel_h2d,
+        "telemetry_stage_occupancy_seen": stage_seen,
+        "telemetry_mfu": snap["gauges"].get("module.mfu"),
     }))
 
 

@@ -49,6 +49,7 @@ from . import monitor
 from . import monitor as mon
 from .monitor import Monitor
 from . import profiler
+from . import telemetry
 from . import module
 from . import module as mod
 from .module import Module

@@ -48,7 +48,14 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Samples/sec logging (parity: callback.py Speedometer:103-123)."""
+    """Samples/sec logging (parity: callback.py Speedometer:103-123).
+
+    Reads the telemetry registry (mxnet_tpu/telemetry.py) rather than
+    private executor counters: each report line carries the registry's
+    step count, dispatch count, and MFU gauge, and — when
+    ``MXTPU_TELEMETRY_FILE`` is set — flushes one JSONL telemetry record
+    per report, giving intra-epoch resolution between fit()'s per-epoch
+    records (``tools/parse_log.py --telemetry`` renders them)."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
@@ -56,6 +63,28 @@ class Speedometer:
         self.init = False
         self.tic = 0
         self.last_count = 0
+
+    def _telemetry_suffix(self):
+        """'\tMFU=… dispatches=…' from the registry ('' when disabled).
+        Point reads (counter_value/gauge_value), not a full snapshot —
+        this runs every report interval and must not deep-copy the
+        whole registry under its lock."""
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return ""
+        parts = []
+        mfu = telemetry.gauge_value("module.mfu")
+        if mfu is not None:
+            parts.append("MFU=%.4f" % mfu)
+        dispatches = telemetry.counter_value("executor.train_dispatches", None)
+        if dispatches is not None:
+            parts.append("dispatches=%d" % dispatches)
+        steps = telemetry.counter_value("module.steps", None)
+        if steps is not None:
+            parts.append("steps=%d" % steps)
+        telemetry.flush()
+        return ("\t" + " ".join(parts)) if parts else ""
 
     def __call__(self, param):
         count = param.nbatch
@@ -65,16 +94,18 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                extra = self._telemetry_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     param.eval_metric.reset()
                     for name, value in name_value:
                         logging.info(
-                            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
-                            param.epoch, count, speed, name, value,
+                            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f%s",
+                            param.epoch, count, speed, name, value, extra,
                         )
                 else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec", param.epoch, count, speed)
+                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                                 param.epoch, count, speed, extra)
                 self.tic = time.time()
         else:
             self.init = True

@@ -99,6 +99,23 @@ REGISTRY = [
            "by a background engine op (2 = classic double buffering, "
            "reference src/io/iter_prefetcher.h); raise only if H2D "
            "stalls show between fused_dispatch spans in the profile"),
+    # ---- telemetry (telemetry.py; docs/observability.md) ----
+    EnvVar("MXTPU_TELEMETRY", int, 1,
+           "Metrics registry (telemetry.py): counters/gauges/histograms "
+           "across engine, io, executor, kvstore, and module layers, "
+           "read via telemetry.snapshot() and reported by bench.py and "
+           "callback.Speedometer.  0 disables recording entirely — every "
+           "instrumentation site fast-paths out behind "
+           "telemetry.enabled() (mxlint E004 enforces the guard)"),
+    EnvVar("MXTPU_TELEMETRY_FILE", str, "",
+           "Non-empty: telemetry.flush() appends one JSONL record of "
+           "the registry (monotonic flush_seq + step stamps) here — "
+           "fit() flushes per epoch, Speedometer per report interval; "
+           "render with `python tools/parse_log.py --telemetry FILE`"),
+    EnvVar("MXTPU_PEAK_FLOPS", float, 0.0,
+           "Hardware peak FLOP/s for the telemetry MFU gauge "
+           "(module.mfu); <=0 or unset = the shared TPU v5e constant "
+           "(tools/tpu_constants.py, 197e12 bf16 MAC=2)"),
     # ---- memory (executor.py) ----
     EnvVar("MXNET_BACKWARD_DO_MIRROR", int, 0,
            "Memory mirroring: recompute cheap activations (BN/ReLU/elemwise) "

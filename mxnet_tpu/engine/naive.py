@@ -32,22 +32,28 @@ class NaiveEngine:
         trivially satisfied, so vars never carry pending state.  `atomic`
         is accepted for signature parity — under synchronous execution
         nothing is ever pending, so the distinction is moot."""
-        from .. import profiler
+        from .. import profiler, telemetry
 
         prof = profiler.spans_active()  # skip timing/formatting when off
+        tel = telemetry.enabled()
+        timed = prof or tel
         if atomic:
             enter_op()
-        t0 = time.time() if prof else 0.0
+        t0 = time.time() if timed else 0.0
         try:
             fn()
         finally:
             if atomic:
                 exit_op()
-            if prof:
+            if timed:
                 t1 = time.time()
-                profiler.record_span(
-                    "engine::" + (name or getattr(fn, "__name__", "op")),
-                    int(t0 * 1e6), int((t1 - t0) * 1e6), cat="engine")
+                if prof:
+                    profiler.record_span(
+                        "engine::" + (name or getattr(fn, "__name__", "op")),
+                        int(t0 * 1e6), int((t1 - t0) * 1e6), cat="engine")
+                if tel:
+                    telemetry.inc("engine.ops_completed")
+                    telemetry.observe("engine.op_seconds", t1 - t0)
         return None
 
     def help_one(self, timeout=0.02):

@@ -104,6 +104,14 @@ class ThreadedEngine:
                         n += 1
                 if n:
                     self._work_cv.notify(n)
+        from .. import telemetry
+
+        if telemetry.enabled():
+            # per-backend scheduler health: ops pushed-not-done and the
+            # runnable backlog (both also render as counter lanes when
+            # the profiler is on — see telemetry.set_gauge)
+            telemetry.set_gauge("engine.pending_ops", self._inflight)
+            telemetry.set_gauge("engine.queue_depth", len(self._ready))
         if wait:
             op.done.wait()
             if op.exception is not None:
@@ -266,16 +274,26 @@ class ThreadedEngine:
             self._inflight -= 1
             if self._waiters:
                 self._done_cv.notify_all()
+        from .. import telemetry
+
+        if telemetry.enabled():
+            telemetry.inc("engine.ops_completed")
+            if op.exception is not None:
+                telemetry.inc("engine.deferred_errors")
+            telemetry.set_gauge("engine.pending_ops", self._inflight)
+            telemetry.set_gauge("engine.queue_depth", len(self._ready))
         if op.done is not None:
             op.done.set()
 
     def _execute(self, op):
-        from .. import profiler
+        from .. import profiler, telemetry
 
         prof = profiler.spans_active()  # skip timing/formatting when off
+        tel = telemetry.enabled()
+        timed = prof or tel
         if op.atomic:
             enter_op()
-        t0 = time.time() if prof else 0.0
+        t0 = time.time() if timed else 0.0
         try:
             # a failed producer poisons its consumers: propagate instead
             # of computing on garbage (reference threaded_engine.cc
@@ -290,7 +308,12 @@ class ThreadedEngine:
         finally:
             if op.atomic:
                 exit_op()
-            if prof:
+            if timed:
                 t1 = time.time()
-                profiler.record_span("engine::" + op.name, int(t0 * 1e6),
-                                     int((t1 - t0) * 1e6), cat="engine")
+                if prof:
+                    profiler.record_span("engine::" + op.name, int(t0 * 1e6),
+                                         int((t1 - t0) * 1e6), cat="engine")
+                if tel:
+                    # worker busy time: how much of the pool is doing
+                    # real work vs idling on the condition variable
+                    telemetry.observe("engine.op_seconds", t1 - t0)

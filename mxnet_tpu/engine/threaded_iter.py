@@ -79,8 +79,13 @@ class ThreadedIter:
         return self
 
     def __next__(self):
-        from . import get as _get_engine
+        import time as _time
 
+        from . import get as _get_engine
+        from .. import telemetry
+
+        tel = telemetry.enabled()
+        t0 = _time.time() if tel else 0.0
         # never hard-block: when the queue is empty, help the engine run
         # ready ops instead — the consumer may itself be inside an engine
         # op (nested engine-backed iterators, e.g. PrefetchingIter over
@@ -97,6 +102,13 @@ class ThreadedIter:
                         break
                     except _queue.Empty:
                         continue
+        if tel:
+            # how long the consumer stalled waiting for this pipeline
+            # (≈0 when lookahead keeps up) and how full its buffer ran
+            telemetry.observe("io.consumer_wait_seconds",
+                              _time.time() - t0)
+            telemetry.set_gauge("io.buffer.%s" % self._name,
+                                self._queue.qsize())
         if err is not None:
             self._queue.put((_END, None))  # subsequent next() stops cleanly
             raise err

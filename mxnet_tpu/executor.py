@@ -508,7 +508,17 @@ class Executor:
         for name, value in kwargs.items():
             if name not in self.arg_dict:
                 raise MXNetError("Unknown argument %s" % name)
-            v = value.data if isinstance(value, NDArray) else jnp.asarray(value)
+            if isinstance(value, NDArray):
+                v = value.data
+            else:
+                # raw numpy/list input converts (and transfers) here;
+                # NDArray inputs paid their H2D at creation (nd.array).
+                # Bytes counted AFTER conversion so list inputs (no
+                # .nbytes) are measured exactly.
+                host = not isinstance(value, jax.Array)
+                v = jnp.asarray(value)
+                if host:
+                    self._note_bytes("executor.h2d_bytes", v.nbytes)
             if tuple(v.shape) != tuple(self.arg_dict[name].shape):
                 raise MXNetError(
                     "Shape mismatch for argument %s: bound %s, got %s (use reshape())"
@@ -529,21 +539,84 @@ class Executor:
             self._compute_forward(False)
         return self.outputs if not is_train else None
 
+    # ------------------------------------------------------------------
+    # telemetry helpers (each early-returns when the registry is off,
+    # so hot paths pay one predicted branch — the enabled() contract)
+    # ------------------------------------------------------------------
+    def _note_compile_cache(self, hit):
+        """One executable-cache lookup: a miss means an XLA (re)compile —
+        steady-state training must show hits only (a miss churn here is
+        the bucketing-rebind / shape-instability smell)."""
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return
+        telemetry.inc("executor.compile_cache_hits" if hit
+                      else "executor.compile_cache_misses")
+
+    def _note_dispatch(self, kind, elapsed):
+        """One training dispatch: wall latency split by dispatch shape
+        (`step` = single fused fwd+bwd(+update), `block` = K-step scan)."""
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return
+        telemetry.inc("executor.train_dispatches")
+        telemetry.observe("executor.dispatch_seconds.%s" % kind, elapsed)
+
+    def _note_bytes(self, name, nbytes):
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return
+        telemetry.inc(name, int(nbytes))
+
+    def flops_per_step(self, is_train=True):
+        """Analytic FLOPs of one step of the bound symbol (fwd traced via
+        jax.make_jaxpr — pure tracing, no device work; training steps
+        count fwd+bwd as 3x forward, the standard accounting).  Cached;
+        0.0 when the trace fails.  telemetry's per-step MFU gauge is
+        this over measured step time and tools/tpu_constants.py peak."""
+        cache = getattr(self, "_flops_cache", None)
+        if cache is None:
+            cache = self._flops_cache = {}
+        if is_train not in cache:
+            from . import telemetry
+
+            try:
+                import numpy as _np
+
+                # the UNJITTED forward closure: tracing it must not seed
+                # _jit_fwd, or the first real forward would be counted
+                # as a compile-cache hit while XLA still compiles it
+                jaxpr = jax.make_jaxpr(self._build_fwd(is_train))(
+                    self._gather_args(), self._gather_aux(), _np.uint32(0))
+                fwd = telemetry.flops_of_jaxpr(jaxpr)
+                cache[is_train] = fwd * (3.0 if is_train else 1.0)
+            except Exception:
+                cache[is_train] = 0.0
+        return cache[is_train]
+
+    def _build_fwd(self, is_train):
+        """The raw (unjitted) forward closure — jitted+cached by _fwd_fn;
+        flops_per_step traces it directly."""
+        entries, order = self._entries, self._order
+        an, xn = self._arg_names, self._aux_names
+        boundary = self._boundary()
+        cast = self._cast()
+
+        mesh = self._mesh
+
+        def f(arg_vals, aux_vals, seed):
+            rng = jax.random.key(seed)
+            return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train,
+                              rng, boundary=boundary, cast=cast, mesh=mesh)
+
+        return f
+
     def _fwd_fn(self, is_train):
         if is_train not in self._jit_fwd:
-            entries, order = self._entries, self._order
-            an, xn = self._arg_names, self._aux_names
-            boundary = self._boundary()
-            cast = self._cast()
-
-            mesh = self._mesh
-
-            def f(arg_vals, aux_vals, seed):
-                rng = jax.random.key(seed)
-                return _run_graph(entries, order, an, xn, arg_vals, aux_vals, is_train,
-                                  rng, boundary=boundary, cast=cast, mesh=mesh)
-
-            self._jit_fwd[is_train] = jax.jit(f)
+            self._jit_fwd[is_train] = jax.jit(self._build_fwd(is_train))
         return self._jit_fwd[is_train]
 
     def _next_seed(self):
@@ -559,6 +632,7 @@ class Executor:
         from . import profiler
 
         compiled = is_train in self._jit_fwd
+        self._note_compile_cache(compiled)
         fn = self._fwd_fn(is_train)
         args = self._place(self._gather_args())
         import numpy as _np
@@ -724,6 +798,8 @@ class Executor:
         scalars = schedule_prefix(
             opt, [self._fused_index_of_name[n] for n in diff_names], 1)[0]
         sig = tuple((n, tuple(l.shape for l in leaves_by_name[n])) for n in diff_names)
+        self._note_compile_cache(self._jit_step is not None
+                                 and self._jit_step[1] == sig)
         if self._jit_step is None or self._jit_step[1] != sig:
             core = self._grad_core(diff_idx, nondiff_idx)
 
@@ -745,13 +821,23 @@ class Executor:
         diff_vals = tuple(all_vals[i] for i in diff_idx)
         nondiff_vals = tuple(all_vals[i] for i in nondiff_idx)
         state_tuples = tuple(tuple(l.data for l in leaves_by_name[n]) for n in diff_names)
-        from . import profiler
+        import time as _time
 
+        from . import profiler, telemetry
+
+        tel = telemetry.enabled()
+        if tel:
+            self._note_bytes("executor.donated_bytes",
+                             sum(v.nbytes for v in diff_vals)
+                             + sum(l.nbytes for st in state_tuples for l in st))
+        t0 = _time.time() if tel else 0.0
         with profiler.span("fused_step(fwd+bwd+update)", cat="executor"):
             outs, aux_upd, new_params, new_states = fn(
                 diff_vals, nondiff_vals, self._gather_aux(), state_tuples,
                 _np.uint32(self._step_seed), scalars,
             )
+        if tel:
+            self._note_dispatch("step", _time.time() - t0)
         self._train_dispatches += 1
         self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
         if not self._aux_applied:
@@ -792,6 +878,11 @@ class Executor:
         this from a background engine op so the transfer overlaps device
         compute.  Idempotent: re-putting an already-placed block is a
         no-op, so the dispatch path can call it again safely."""
+        if not isinstance(arr, jax.Array):
+            # count H2D bytes only for HOST arrays: the dispatch path
+            # re-places already-staged device blocks (the idempotent
+            # no-op), which must not double the byte counter
+            self._note_bytes("executor.h2d_bytes", arr.nbytes)
         sh = self.block_input_sharding()
         if sh is None:
             return jax.device_put(arr, self._first_ctx.jax_device())
@@ -841,6 +932,7 @@ class Executor:
         sig = tuple((n, tuple(l.shape for l in leaves_by_name[n]))
                     for n in diff_names)
         key = (k, tuple(an[i] for i in stream_idx), sig)
+        self._note_compile_cache(key in self._jit_block)
         if key not in self._jit_block:
             core = self._grad_core(diff_idx, nondiff_idx)
             stream_pos = {i: p for p, i in enumerate(stream_idx)}
@@ -881,12 +973,22 @@ class Executor:
                             for i in stream_idx)
         state_tuples = tuple(tuple(l.data for l in leaves_by_name[n])
                              for n in diff_names)
-        from . import profiler
+        import time as _time
 
+        from . import profiler, telemetry
+
+        tel = telemetry.enabled()
+        if tel:
+            self._note_bytes("executor.donated_bytes",
+                             sum(v.nbytes for v in diff_vals)
+                             + sum(l.nbytes for st in state_tuples for l in st))
+        t0 = _time.time() if tel else 0.0
         with profiler.span("fused_dispatch(K=%d)" % k, cat="executor"):
             outs, aux_upd, new_params, new_states = fn(
                 diff_vals, static_vals, self._gather_aux(), state_tuples,
                 stream_vals, seeds, scalars)
+        if tel:
+            self._note_dispatch("block", _time.time() - t0)
         self._train_dispatches += 1
         self._last_block_count = k
         # outputs arrive stacked (K, ...): ONE per-dispatch host readback
@@ -916,6 +1018,7 @@ class Executor:
             return
         has_heads = out_grads is not None
         key = (True, has_heads)
+        self._note_compile_cache(key in self._jit_bwd)
         if key not in self._jit_bwd:
             an = self._arg_names
             diff_idx = [an.index(n) for n in diff_names]
@@ -937,12 +1040,17 @@ class Executor:
                 out_grads = [out_grads]
             heads = tuple(g.data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads)
         import numpy as _np
+        import time as _time
 
-        from . import profiler
+        from . import profiler, telemetry
 
+        tel = telemetry.enabled()
+        t0 = _time.time() if tel else 0.0
         with profiler.span("forward_backward", cat="executor"):
             outs, aux_upd, grads = fn(diff_vals, nondiff_vals, self._gather_aux(),
                                       _np.uint32(self._step_seed), heads)
+        if tel:
+            self._note_dispatch("step", _time.time() - t0)
         self._train_dispatches += 1
         self._outputs_cache = [NDArray(o, self._first_ctx) for o in outs]
         if not self._aux_applied:

@@ -464,7 +464,7 @@ class DeviceStagedIter(DataIter):
         as one `h2d_stage` profiler span."""
         import time as _time
 
-        from . import profiler
+        from . import profiler, telemetry
 
         t0 = _time.time()
         batches = []
@@ -480,16 +480,38 @@ class DeviceStagedIter(DataIter):
             t1 = _time.time()
             profiler.record_span("h2d_stage", int(t0 * 1e6),
                                  int((t1 - t0) * 1e6), cat="io")
+        if telemetry.enabled():
+            telemetry.observe("io.h2d_stage_seconds", _time.time() - t0)
+            telemetry.inc("io.blocks_staged")
         return block
 
     def _assemble(self, batches):
+        from . import telemetry
+
         def host(a):
-            return a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+            if isinstance(a, NDArray):
+                # a device-resident batch (e.g. NDArrayIter output) is
+                # read BACK to host before stacking — a real D2H leg of
+                # the staging path, counted so the transfer books
+                # balance (numpy-producing iterators skip it)
+                out = a.asnumpy()
+                if telemetry.enabled():
+                    telemetry.inc("executor.d2h_bytes", int(out.nbytes))
+                return out
+            return _np.asarray(a)
 
         def stack_put(names, rows):
             out = []
             for i, name in enumerate(names):
                 arr = _np.stack([host(b[i]) for b in rows])
+                if telemetry.enabled():
+                    telemetry.inc("io.stage_bytes", int(arr.nbytes))
+                    # size DISTRIBUTION too: one stacked input's bytes —
+                    # whether blocks are big enough to amortize the
+                    # per-transfer overhead is a bucket question
+                    telemetry.observe("io.stage_block_bytes",
+                                      int(arr.nbytes),
+                                      buckets=telemetry.BYTE_BUCKETS)
                 out.append(self._place_fn(name, arr)
                            if self._place_fn is not None else arr)
             return out

@@ -23,9 +23,12 @@ from __future__ import annotations
 import os
 import pickle
 
+import time
+
 from .base import MXNetError
 from . import engine
 from . import optimizer as opt
+from . import telemetry
 from .ndarray import NDArray, zeros
 
 __all__ = ["KVStore", "create"]
@@ -114,6 +117,8 @@ class KVStore:
                                       for leaf in opt._state_leaves(state))
 
             def _do_push(_k=k, _vlist=vlist):
+                tel = telemetry.enabled()
+                t0 = time.time() if tel else 0.0
                 merged = _vlist[0].copy()
                 for other in _vlist[1:]:
                     merged += other
@@ -122,6 +127,12 @@ class KVStore:
                 else:
                     # mxlint: disable=E001 -- the entry write is serialized by the key var (declared in write_vars); _bind_entry makes the stored chunk's var the key var itself
                     self._store[_k] = self._bind_entry(_k, merged)
+                if tel:
+                    telemetry.inc("kvstore.push_count")
+                    telemetry.inc("kvstore.push_bytes",
+                                  int(merged._raw().nbytes))
+                    telemetry.observe("kvstore.push_seconds",
+                                      time.time() - t0)
 
             engine.push(_do_push, read_vars=read_vars, write_vars=write_vars,
                         priority=priority, name="kvstore_push:%s" % k)
@@ -138,11 +149,20 @@ class KVStore:
             write_vars = [oo._engine_var() for oo in olist]
 
             def _do_pull(_k=k, _olist=olist):
+                tel = telemetry.enabled()
+                t0 = time.time() if tel else 0.0
                 if _k not in self._store:
                     raise MXNetError("key %s has not been initialized" % str(_k))
                 src = self._store[_k]
                 for oo in _olist:
                     oo[:] = src
+                if tel:
+                    telemetry.inc("kvstore.pull_count")
+                    telemetry.inc("kvstore.pull_bytes",
+                                  int(src._raw().nbytes) * len(_olist)
+                                  if isinstance(src, NDArray) else 0)
+                    telemetry.observe("kvstore.pull_seconds",
+                                      time.time() - t0)
 
             engine.push(_do_pull, read_vars=[self._key_var(k)],
                         write_vars=write_vars, priority=priority,

@@ -218,14 +218,22 @@ class DataParallelExecutorGroup:
         label list: the stacked arrays are read back ONCE (one D2H
         transfer per dispatch instead of one per step) and the metric
         consumes the block step by step on the host."""
+        from .. import telemetry
+
         exe = self.execs[0]
         k = getattr(exe, "_last_block_count", 0)
         if k:
             preds = [_np.asarray(o.data) for o in exe.outputs]
+            if telemetry.enabled():
+                telemetry.inc("executor.d2h_bytes",
+                              sum(int(p.nbytes) for p in preds))
             for s in range(k):
                 eval_metric.update(list(labels[s]), [p[s] for p in preds])
             return
         preds = exe.outputs
+        if telemetry.enabled():
+            telemetry.inc("executor.d2h_bytes",
+                          sum(int(p.data.nbytes) for p in preds))
         eval_metric.update(labels, preds)
 
     @property

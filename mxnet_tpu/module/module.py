@@ -341,6 +341,9 @@ class Module(BaseModule):
         """Blocked epoch body: K steps per dispatch, inputs double-
         buffered to the device by a background engine op, metrics
         consumed once per dispatch from the stacked outputs."""
+        import time as _time
+
+        from .. import telemetry
         from ..io import DeviceStagedIter
         from .base_module import _fire
 
@@ -348,12 +351,20 @@ class Module(BaseModule):
         staged = DeviceStagedIter(train_data, steps_per_dispatch=k,
                                   place_fn=exe.place_block_input)
         nbatch = 0
+        tel = telemetry.enabled()
         try:
             for block in staged:
+                t0 = _time.perf_counter() if tel else 0.0
                 self.forward_backward(block)
                 self.update()
                 if block.label_host is not None:
                     self.update_metric(eval_metric, block.label_host)
+                if tel:
+                    # one observation per DISPATCH (covering K steps):
+                    # the histogram count is the dispatch count and the
+                    # MFU gauge normalizes by block.count steps
+                    self._observe_steps(_time.perf_counter() - t0,
+                                        block.count)
                 nbatch += block.count
                 if batch_end_callback is not None:
                     # one callback per dispatch (nbatch = last step index):

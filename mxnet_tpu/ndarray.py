@@ -515,11 +515,20 @@ def array(source_array, ctx=None, dtype=None):
     ctx = ctx or current_context()
     if isinstance(source_array, NDArray):
         source_array = source_array.data
+    host_source = not isinstance(source_array, jax.Array)
     if dtype is None and not isinstance(source_array, (_np.ndarray, jax.Array)):
         dtype = "float32"  # parity: python lists default to float32
     arr = jnp.asarray(source_array, dtype=jnp.dtype(dtype) if dtype else None)
     if arr.dtype == jnp.float64:
         arr = arr.astype(jnp.float32)
+    if host_source:
+        # the real host->device transfer point of the imperative API
+        # (batch iterators, init, user numpy): telemetry counts H2D
+        # bytes HERE, where the copy happens, not at forward()
+        from . import telemetry
+
+        if telemetry.enabled():
+            telemetry.inc("executor.h2d_bytes", int(arr.nbytes))
     return NDArray(arr, ctx)
 
 

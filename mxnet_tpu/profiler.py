@@ -12,9 +12,16 @@ import json
 import threading
 import time
 
-__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile", "record_span"]
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "record_span", "record_counter"]
 
 import os as _os
+
+# chrome-trace pids: host-side spans/counters vs the joined XLA device
+# trace — named via process_name metadata at dump time so traces show
+# "host" / "device (XLA)" lanes instead of bare 0/1
+PID_HOST = 0
+PID_DEVICE = 1
 
 _STATE = {
     # MXNET_PROFILER_MODE honored at import (reference env_var.md:101-108)
@@ -25,6 +32,9 @@ _STATE = {
 _EVENTS = []
 _LOCK = threading.Lock()
 _JAX_TRACE_DIR = None
+# tid -> human thread name, harvested as spans are recorded; dumped as
+# thread_name metadata so engine-worker lanes are labeled in the UI
+_TID_NAMES = {}
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -98,7 +108,7 @@ def _join_xla_trace(trace_dir):
         for op, r in sorted(rows.items(), key=lambda kv: -kv[1]["dur"]):
             _EVENTS.append({
                 "name": op, "cat": "xla_op", "ph": "X", "ts": r["ts"],
-                "dur": r["dur"], "pid": 1, "tid": 0,
+                "dur": r["dur"], "pid": PID_DEVICE, "tid": 0,
                 "args": {"calls": r["count"]},
             })
 
@@ -117,11 +127,44 @@ def record_span(name, start_us, dur_us, cat="operator", tid=None):
     (reference SetOprStart/SetOprEnd record per-thread ProfileStat)."""
     if not _STATE["running"]:
         return
-    if tid is None:
+    own_thread = tid is None
+    if own_thread:
         tid = threading.get_ident()
     with _LOCK:
+        if own_thread and tid not in _TID_NAMES:
+            _TID_NAMES[tid] = threading.current_thread().name
         _EVENTS.append({"name": name, "cat": cat, "ph": "X", "ts": start_us,
-                        "dur": dur_us, "pid": 0, "tid": tid})
+                        "dur": dur_us, "pid": PID_HOST, "tid": tid})
+
+
+# per-series floor between counter samples: engine gauges update on
+# EVERY op push/complete — unthrottled they would dwarf the span lanes
+# (4+ events per engine op); 1 ms keeps lanes step-chart-smooth while
+# bounding trace growth
+_COUNTER_MIN_INTERVAL_US = 1000
+_COUNTER_LAST_TS = {}
+
+
+def record_counter(name, value, ts_us=None):
+    """Append one chrome counter sample (``"ph": "C"``): `name` becomes
+    a counter LANE in the dumped trace, rendered as a step chart next
+    to the span lanes.  telemetry.set_gauge calls this for every gauge
+    while profiling is on, so queue depth / buffer occupancy / MFU are
+    visible against the dispatch timeline.  Samples landing within
+    _COUNTER_MIN_INTERVAL_US of the previous one for the same series
+    are dropped (the gauge itself keeps the latest value regardless)."""
+    if not _STATE["running"]:
+        return
+    if ts_us is None:
+        ts_us = int(time.time() * 1e6)
+    with _LOCK:
+        last = _COUNTER_LAST_TS.get(name)
+        if last is not None and ts_us - last < _COUNTER_MIN_INTERVAL_US:
+            return
+        _COUNTER_LAST_TS[name] = ts_us
+        _EVENTS.append({"name": name, "cat": "telemetry", "ph": "C",
+                        "ts": ts_us, "pid": PID_HOST, "tid": 0,
+                        "args": {"value": float(value)}})
 
 
 class span:
@@ -141,11 +184,34 @@ class span:
             record_span(self.name, int(self.t0 * 1e6), int((t1 - self.t0) * 1e6), self.cat)
 
 
+def _metadata_events():
+    """Chrome ``"ph": "M"`` rows naming the trace's processes/threads:
+    pid 0 = host-side spans and counter lanes, pid 1 = the joined XLA
+    device trace, plus one thread_name row per host thread that
+    recorded spans (engine workers carry their real thread names)."""
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": PID_HOST, "tid": 0,
+         "args": {"name": "host"}},
+        {"name": "process_sort_index", "ph": "M", "pid": PID_HOST, "tid": 0,
+         "args": {"sort_index": 0}},
+        {"name": "process_name", "ph": "M", "pid": PID_DEVICE, "tid": 0,
+         "args": {"name": "device (XLA)"}},
+        {"name": "process_sort_index", "ph": "M", "pid": PID_DEVICE, "tid": 0,
+         "args": {"sort_index": 1}},
+    ]
+    for tid, tname in sorted(_TID_NAMES.items()):
+        meta.append({"name": "thread_name", "ph": "M", "pid": PID_HOST,
+                     "tid": tid, "args": {"name": tname}})
+    return meta
+
+
 def dump_profile():
     """Write chrome-tracing JSON (parity: reference Profiler::DumpProfile
-    src/engine/profiler.cc:134-190)."""
+    src/engine/profiler.cc:134-190): process/thread naming metadata,
+    span lanes, and the telemetry counter lanes."""
     with _LOCK:
-        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        payload = {"traceEvents": _metadata_events() + list(_EVENTS),
+                   "displayTimeUnit": "ms"}
         with open(_STATE["filename"], "w") as f:
             json.dump(payload, f)
         _EVENTS.clear()

@@ -1,0 +1,334 @@
+"""Telemetry — the framework-wide metrics registry.
+
+The quantitative counterpart of the profiler's span lanes: where
+profiler.py answers "when did this op run", telemetry answers "how much
+— ops, bytes, seconds, occupancy — per component, per step".  The
+reference brackets every engine op with SetOprStart/SetOprEnd
+(reference src/engine/profiler.cc) and aggregates per-op rows in
+Profiler::DumpProfile; this module generalizes those rows to counters,
+gauges, and fixed-bucket histograms wired through every layer: engine
+queue depth and worker busy time, io buffer occupancy and consumer
+wait, executor dispatch latency / compile-cache traffic / H2D-D2H
+bytes, kvstore push/pull, and per-step MFU at the module level.
+
+Three sinks:
+
+  * :func:`snapshot` — nested plain-dict view for tests and bench;
+  * a JSONL writer (:func:`flush`, path from ``MXTPU_TELEMETRY_FILE``)
+    emitting one record per flush with monotonic step stamps, which
+    ``tools/parse_log.py --telemetry`` renders as a table;
+  * chrome-trace counter lanes: every :func:`set_gauge` while the
+    profiler is running appends a ``"ph": "C"`` event, so queue depth
+    and MFU render as counter lanes alongside the span lanes in
+    ``profiler.dump_profile()`` output.
+
+Cost discipline (the profiler's ``spans_active()`` contract): every
+recording helper returns immediately when disabled, and HOT paths must
+additionally guard the call itself behind :func:`enabled` so no
+timestamping, formatting, or argument construction happens when
+telemetry is off — mxlint check E004 enforces exactly that.  Telemetry
+is ON by default (``MXTPU_TELEMETRY=0`` disables); unlike profiling it
+is cheap enough to leave on, and the always-on registry is what
+bench.py, Speedometer, and later robustness PRs report through.
+"""
+from __future__ import annotations
+
+import json
+import os as _os
+import threading
+import time
+
+__all__ = [
+    "enabled", "set_enabled", "inc", "set_gauge", "observe",
+    "counter_value", "gauge_value", "snapshot", "reset", "flush",
+    "peak_flops", "flops_of_jaxpr", "TIME_BUCKETS", "BYTE_BUCKETS",
+]
+
+# fixed bucket boundaries (seconds): half-decade exponential ladder from
+# 10 us to 100 s — wide enough for one engine op and a whole K-block
+TIME_BUCKETS = (1e-5, 3.16e-5, 1e-4, 3.16e-4, 1e-3, 3.16e-3, 1e-2,
+                3.16e-2, 1e-1, 3.16e-1, 1.0, 3.16, 10.0, 31.6, 100.0)
+# fixed bucket boundaries (bytes): decades from 1 KiB to 10 GiB
+BYTE_BUCKETS = (2.0 ** 10, 2.0 ** 13, 2.0 ** 16, 2.0 ** 20, 2.0 ** 23,
+                2.0 ** 26, 2.0 ** 30, 10.0 * 2.0 ** 30)
+
+_ENABLED = _os.environ.get("MXTPU_TELEMETRY", "1") not in ("0", "")
+_LOCK = threading.Lock()
+_COUNTERS = {}
+_GAUGES = {}
+_HISTOGRAMS = {}
+_FLUSH_SEQ = 0
+
+
+def enabled():
+    """Cheap hot-path check: is the registry recording?  Callers on hot
+    paths (engine worker loop, per-step training code) must skip metric
+    construction entirely when this is False — the profiler
+    ``spans_active()`` discipline, enforced by mxlint E004."""
+    return _ENABLED
+
+
+def set_enabled(flag):
+    """Turn recording on/off; returns the previous state (so tests can
+    restore).  ``MXTPU_TELEMETRY=0`` sets the import-time default."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(flag)
+    return prev
+
+
+class _Histogram:
+    """Fixed-boundary histogram: PER-BUCKET (non-cumulative) counts
+    keyed Prometheus-style (``le_<bound>`` … ``le_inf``, in boundary
+    order) plus count/sum/min/max.  Unlike real Prometheus ``le``
+    buckets the counts do NOT accumulate — ``sum(buckets) == count``
+    (tools/parse_log.py's quantile math relies on this)."""
+
+    __slots__ = ("boundaries", "bucket_counts", "count", "sum", "min", "max")
+
+    def __init__(self, boundaries):
+        self.boundaries = tuple(boundaries)
+        self.bucket_counts = [0] * (len(self.boundaries) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, value):
+        value = float(value)
+        i = 0
+        for b in self.boundaries:
+            if value <= b:
+                break
+            i += 1
+        self.bucket_counts[i] += 1
+        self.count += 1
+        self.sum += value
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
+
+    def as_dict(self):
+        return {
+            "count": self.count, "sum": self.sum,
+            "min": self.min, "max": self.max,
+            "buckets": {
+                ("le_%g" % b): c
+                for b, c in zip(self.boundaries, self.bucket_counts)
+            } | {"le_inf": self.bucket_counts[-1]},
+        }
+
+
+def inc(name, n=1):
+    """Increment counter `name` by `n` (monotonic; floats allowed for
+    byte totals)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def set_gauge(name, value):
+    """Set gauge `name`; while the profiler is running the sample is
+    also appended to the trace as a chrome counter event, so every
+    gauge doubles as a counter lane in the dumped profile."""
+    if not _ENABLED:
+        return
+    value = float(value)
+    with _LOCK:
+        _GAUGES[name] = value
+    from . import profiler
+
+    if profiler.spans_active():
+        profiler.record_counter(name, value)
+
+
+def observe(name, value, buckets=TIME_BUCKETS):
+    """Record `value` into histogram `name` (created on first use with
+    the given fixed `buckets`; later calls reuse the existing
+    boundaries)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        h = _HISTOGRAMS.get(name)
+        if h is None:
+            h = _HISTOGRAMS[name] = _Histogram(buckets)
+        h.observe(value)
+
+
+def counter_value(name, default=0):
+    with _LOCK:
+        return _COUNTERS.get(name, default)
+
+
+def gauge_value(name, default=None):
+    with _LOCK:
+        return _GAUGES.get(name, default)
+
+
+def snapshot():
+    """Nested plain-dict view of the whole registry — the test/bench
+    sink.  Stable schema: top-level ``counters`` / ``gauges`` /
+    ``histograms``; histogram values carry count/sum/min/max/buckets."""
+    with _LOCK:
+        return {
+            "counters": dict(_COUNTERS),
+            "gauges": dict(_GAUGES),
+            "histograms": {k: h.as_dict() for k, h in _HISTOGRAMS.items()},
+        }
+
+
+def reset():
+    """Clear every metric (tests; a long-lived server would flush+reset
+    per reporting window)."""
+    global _FLUSH_SEQ
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTOGRAMS.clear()
+        _FLUSH_SEQ = 0
+
+
+def flush(path=None, extra=None):
+    """Append ONE JSONL record of the current registry state to `path`
+    (default ``MXTPU_TELEMETRY_FILE``; no-op when neither is set).
+
+    Each record carries a monotonic flush sequence number, a monotonic
+    clock stamp, and the global training-step counter
+    (``module.steps``), so downstream tooling can order and diff
+    records without trusting wall clocks.  ``tools/parse_log.py
+    --telemetry`` reads this format back.  Returns the record dict (or
+    None when no sink is configured)."""
+    global _FLUSH_SEQ
+    if not _ENABLED:
+        return None
+    path = path or _os.environ.get("MXTPU_TELEMETRY_FILE", "")
+    if not path:
+        return None
+    with _LOCK:
+        _FLUSH_SEQ += 1
+        record = {
+            "flush_seq": _FLUSH_SEQ,
+            "monotonic_s": time.monotonic(),
+            "step": _COUNTERS.get("module.steps", 0),
+            "counters": dict(_COUNTERS),
+            "gauges": dict(_GAUGES),
+            "histograms": {k: h.as_dict() for k, h in _HISTOGRAMS.items()},
+        }
+        if extra:
+            record.update(extra)
+        # write under the lock: concurrent flushes (epoch-end + a user
+        # reporter thread) must not interleave partial lines or land
+        # flush_seq N+1 before N in the file
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    return record
+
+
+# ----------------------------------------------------------------------
+# MFU support: hardware peak + an analytic FLOP counter over jaxprs
+# ----------------------------------------------------------------------
+
+def peak_flops():
+    """Accelerator peak FLOP/s for MFU math — ``MXTPU_PEAK_FLOPS`` when
+    set to a positive number, else the shared v5e constant
+    (tools/tpu_constants.py, the same source the bench table and
+    scaling model use).  A malformed override is warned about ONCE and
+    ignored — a typo'd env var must not kill the training loop from a
+    telemetry call."""
+    raw = _os.environ.get("MXTPU_PEAK_FLOPS", "")
+    if raw:
+        try:
+            val = float(raw)
+            if val > 0:
+                return val
+        except ValueError:
+            if raw not in _BAD_PEAK_WARNED:
+                _BAD_PEAK_WARNED.add(raw)
+                import warnings
+
+                warnings.warn("MXTPU_PEAK_FLOPS=%r is not a number; using "
+                              "the v5e default for the MFU gauge" % raw)
+    global _DEFAULT_PEAK
+    if _DEFAULT_PEAK is None:
+        # resolved once: a FAILED import is not cached by sys.modules,
+        # and this runs per training dispatch via the MFU gauge
+        try:
+            from tools.tpu_constants import V5E_PEAK_FLOPS
+
+            _DEFAULT_PEAK = float(V5E_PEAK_FLOPS)
+        except ImportError:  # installed without the tools/ tree
+            _DEFAULT_PEAK = 197e12
+    return _DEFAULT_PEAK
+
+
+_DEFAULT_PEAK = None
+_BAD_PEAK_WARNED = set()
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _dot_flops(eqn):
+    """2 * batch * M * N * K from the operand shapes and the contraction
+    spec (MAC=2 convention, matching tools/tpu_constants.py)."""
+    (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    batch = _prod(lhs[d] for d in lb)
+    contract = _prod(lhs[d] for d in lc)
+    lhs_free = _prod(lhs[d] for d in range(len(lhs)) if d not in set(lc) | set(lb))
+    rhs_free = _prod(rhs[d] for d in range(len(rhs)) if d not in set(rc) | set(_rb))
+    return 2.0 * batch * contract * lhs_free * rhs_free
+
+
+def _conv_flops(eqn):
+    """2 * |output| * kernel_spatial * in_channels_per_group."""
+    out = eqn.outvars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    dn = eqn.params["dimension_numbers"]
+    rhs_spec = dn.rhs_spec  # (out_ch, in_ch/group, *spatial)
+    kernel_spatial = _prod(rhs[d] for d in rhs_spec[2:])
+    in_per_group = rhs[rhs_spec[1]]
+    return 2.0 * _prod(out) * kernel_spatial * in_per_group
+
+
+def flops_of_jaxpr(jaxpr):
+    """Analytic FLOP count of a (closed or open) jaxpr: MXU work only
+    (dot_general + conv_general_dilated — the terms that dominate MFU;
+    elementwise ops are bandwidth-bound and excluded by convention,
+    same as XLA's cost analysis headline number).  Recurses into call
+    primitives; a scan body is multiplied by its trip count, cond
+    branches contribute their max.  Pure tracing arithmetic — never
+    runs device code."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0.0
+    for eqn in inner.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += _dot_flops(eqn)
+        elif name == "conv_general_dilated":
+            total += _conv_flops(eqn)
+        elif name == "cond":
+            branches = eqn.params.get("branches", ())
+            if branches:
+                total += max(flops_of_jaxpr(b) for b in branches)
+        else:
+            mult = eqn.params.get("length", 1) if name == "scan" else 1
+            for v in eqn.params.values():
+                total += mult * _flops_of_param(v)
+    return total
+
+
+def _flops_of_param(v):
+    """FLOPs of any jaxpr(s) hiding in one eqn param value."""
+    if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+        return flops_of_jaxpr(v)
+    if isinstance(v, (tuple, list)):
+        return sum(_flops_of_param(x) for x in v)
+    return 0.0

@@ -33,6 +33,12 @@ def test_bench_smoke_runs_k_step_path():
     # wall-clock-overlapping a fused dispatch (both hold on real runs;
     # either alone proves the H2D was not inline with dispatch)
     assert out["h2d_async"] or out["h2d_overlap"], out
+    # the telemetry registry saw the same run (bench asserts the
+    # snapshot itself; these pins keep the reported fields honest)
+    assert out["telemetry_dispatches"] == 6
+    assert out["telemetry_h2d_bytes"] > 0
+    assert out["telemetry_stage_occupancy_seen"] is True
+    assert 0 < out["telemetry_mfu"] <= 1
 
 
 @pytest.mark.slow

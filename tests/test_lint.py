@@ -197,6 +197,114 @@ def test_missing_path_is_an_error_not_a_clean_pass(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# E004 — telemetry/profiler recording must be behind the fast path
+# ----------------------------------------------------------------------
+
+E004_UNGUARDED = """
+import time
+from . import profiler, telemetry
+
+def hot_loop(ops):
+    for op in ops:
+        t0 = time.time()
+        op()
+        telemetry.observe("engine.op_seconds", time.time() - t0)
+        profiler.record_span("op", int(t0 * 1e6), 1)
+"""
+
+
+def test_e004_flags_unguarded_recording_calls(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_UNGUARDED)
+    assert _ids(findings) == ["E004", "E004"]
+    assert "telemetry.observe" in findings[0].message
+    assert "profiler.record_span" in findings[1].message
+
+
+E004_IF_GUARDED = """
+import time
+from . import profiler, telemetry
+
+def hot_loop(ops):
+    for op in ops:
+        t0 = time.time()
+        op()
+        if telemetry.enabled():
+            telemetry.observe("engine.op_seconds", time.time() - t0)
+        if profiler.spans_active():
+            profiler.record_span("op", int(t0 * 1e6), 1)
+"""
+
+E004_VAR_GUARDED = """
+import time
+from . import profiler, telemetry
+
+def hot_loop(ops):
+    prof = profiler.spans_active()
+    tel = telemetry.enabled()
+    timed = prof or tel
+    for op in ops:
+        t0 = time.time() if timed else 0.0
+        op()
+        if timed:
+            t1 = time.time()
+            if prof:
+                profiler.record_span("op", int(t0 * 1e6), int(t1 - t0))
+            if tel:
+                telemetry.observe("engine.op_seconds", t1 - t0)
+"""
+
+E004_EARLY_RETURN = """
+from . import telemetry
+
+def note_dispatch(kind, elapsed):
+    if not telemetry.enabled():
+        return
+    telemetry.inc("executor.train_dispatches")
+    telemetry.observe("executor.dispatch_seconds." + kind, elapsed)
+"""
+
+
+def test_e004_accepts_the_three_guard_shapes(tmp_path):
+    for src in (E004_IF_GUARDED, E004_VAR_GUARDED, E004_EARLY_RETURN):
+        findings, _, _ = _lint_src(tmp_path, src)
+        assert findings == [], findings
+
+
+E004_WRONG_GUARD = """
+from . import telemetry
+
+def hot(flag):
+    if flag:  # not the fast path: arbitrary condition
+        telemetry.inc("c")
+"""
+
+E004_INVERTED_GUARD = """
+from . import telemetry
+
+def hot():
+    if telemetry.enabled():
+        return  # inverted: the call below runs exactly when DISABLED
+    telemetry.inc("c")
+"""
+
+E004_NESTED_GUARD = """
+from . import telemetry
+
+def hot(x):
+    if x:
+        if not telemetry.enabled():
+            return
+    telemetry.inc("c")  # unguarded when x is falsy
+"""
+
+
+def test_e004_arbitrary_condition_is_not_a_guard(tmp_path):
+    for src in (E004_WRONG_GUARD, E004_INVERTED_GUARD, E004_NESTED_GUARD):
+        findings, _, _ = _lint_src(tmp_path, src)
+        assert _ids(findings) == ["E004"], (src, findings)
+
+
+# ----------------------------------------------------------------------
 # E003 — leaked Vars
 # ----------------------------------------------------------------------
 

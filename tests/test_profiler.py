@@ -44,10 +44,23 @@ def test_profile_training_path(tmp_path):
     # the fused single-dispatch step and the eval forward both show up
     assert any("fused_step" in n for n in names), names
     assert any("forward" in n for n in names), names
-    # spans have sane timing fields
-    for e in events:
-        assert e["ph"] == "X" and e["dur"] >= 0
+    # spans have sane timing fields (metadata "M" and telemetry counter
+    # "C" rows ride alongside the span lanes)
+    spans = [e for e in events if e["ph"] == "X"]
+    assert spans
+    for e in spans:
+        assert e["dur"] >= 0
     assert os.path.exists(fname)
+    # pid naming metadata: chrome shows "host" / "device (XLA)" lanes
+    # instead of bare pids 0/1, and span-recording threads are labeled
+    meta = {(e["name"], e["pid"]): e["args"] for e in events
+            if e["ph"] == "M"}
+    assert meta[("process_name", 0)]["name"] == "host"
+    assert meta[("process_name", 1)]["name"] == "device (XLA)"
+    span_tids = {e["tid"] for e in spans if e["pid"] == 0}
+    named_tids = {e["tid"] for e in events
+                  if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert span_tids & named_tids
 
 
 def test_xla_mode_emits_per_op_rows(tmp_path):

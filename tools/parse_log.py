@@ -8,10 +8,17 @@ Reads fit() output lines:
     Epoch[3] Time cost=12.2
     Epoch[3] Validation-accuracy=0.95
 and prints one row per epoch: epoch, train metric, valid metric, time.
+
+With ``--telemetry`` the input is a telemetry JSONL file instead
+(mxnet_tpu/telemetry.py flush records, one JSON object per line — the
+``MXTPU_TELEMETRY_FILE`` sink): one row per flush with the step stamp,
+step-time percentiles from the histogram, MFU, dispatch and
+compile-cache counters.  See docs/observability.md.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import re
 import sys
 
@@ -33,14 +40,102 @@ def parse(lines, metric="accuracy"):
     return [(e,) + tuple(v) for e, v in sorted(rows.items())]
 
 
+def _hist_quantile(hist, q):
+    """Approximate quantile from a telemetry fixed-bucket histogram
+    record (upper bucket boundary containing the q-th observation)."""
+    count = hist.get("count", 0)
+    if not count:
+        return None
+    target = q * count
+    seen = 0
+    for key, c in hist.get("buckets", {}).items():
+        # keys are "le_<bound>" / "le_inf" in boundary order (dicts
+        # preserve insertion order end-to-end through json)
+        seen += c
+        if seen >= target:
+            if key == "le_inf":
+                return hist.get("max")
+            return float(key[3:])
+    return hist.get("max")
+
+
+def parse_telemetry(lines):
+    """Telemetry JSONL (telemetry.flush records) -> one summary row per
+    record: [{flush_seq, step, epoch?, step_p50, step_max, mfu,
+    dispatches, cache_hits, cache_misses, io_wait_p50, h2d_bytes}]."""
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            # a truncated tail (killed run) or a line mid-append must
+            # not hide the valid records before it
+            print("warning: skipping malformed telemetry line",
+                  file=sys.stderr)
+            continue
+        hist = rec.get("histograms", {})
+        step_h = hist.get("module.step_seconds", {})
+        io_h = hist.get("io.consumer_wait_seconds", {})
+        counters = rec.get("counters", {})
+        gauges = rec.get("gauges", {})
+        rows.append({
+            "flush_seq": rec.get("flush_seq"),
+            "step": rec.get("step"),
+            "epoch": rec.get("epoch"),
+            "step_p50": _hist_quantile(step_h, 0.5),
+            "step_max": step_h.get("max"),
+            "mfu": gauges.get("module.mfu"),
+            "dispatches": counters.get("executor.train_dispatches"),
+            "cache_hits": counters.get("executor.compile_cache_hits"),
+            "cache_misses": counters.get("executor.compile_cache_misses"),
+            "io_wait_p50": _hist_quantile(io_h, 0.5),
+            "h2d_bytes": counters.get("executor.h2d_bytes"),
+        })
+    return rows
+
+
+_TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
+                   "mfu", "dispatches", "cache_hits", "cache_misses",
+                   "io_wait_p50", "h2d_bytes"]
+
+
+def _print_telemetry(rows, fmt):
+    def cell(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return "%.6g" % v
+        return str(v)
+
+    if fmt == "markdown":
+        print("| " + " | ".join(_TELEMETRY_COLS) + " |")
+        print("|" + " --- |" * len(_TELEMETRY_COLS))
+    for r in rows:
+        cells = [cell(r[c]) for c in _TELEMETRY_COLS]
+        if fmt == "markdown":
+            print("| " + " | ".join(cells) + " |")
+        else:
+            print(*cells)
+
+
 def main():
     parser = argparse.ArgumentParser(description="parse training logs")
     parser.add_argument("logfile", nargs="?", help="log file (default stdin)")
     parser.add_argument("--format", choices=["markdown", "none"],
                         default="markdown")
     parser.add_argument("--metric", type=str, default="accuracy")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="input is a telemetry JSONL file "
+                             "(MXTPU_TELEMETRY_FILE sink) instead of a "
+                             "fit() text log")
     args = parser.parse_args()
     lines = open(args.logfile).readlines() if args.logfile else sys.stdin.readlines()
+    if args.telemetry:
+        _print_telemetry(parse_telemetry(lines), args.format)
+        return
     rows = parse(lines, metric=args.metric)
     if args.format == "markdown":
         print("| epoch | train-%s | valid-%s | time |" % (args.metric, args.metric))
