@@ -46,6 +46,13 @@ def parse_args():
     p.add_argument("--smoke", action="store_true",
                    help="tiny model on CPU through the real K-step path; "
                         "prints a JSON line with dispatch/lane checks")
+    p.add_argument("--imperative", action="store_true",
+                   help="imperative microbench: a --chain-ops-long "
+                        "elementwise NDArray chain, lazy fusion vs "
+                        "MXTPU_LAZY=0 eager — reports ops/s, dispatch "
+                        "counts, and fusion-cache hit rate")
+    p.add_argument("--chain-ops", type=int, default=64,
+                   help="ops per imperative chain (default 64)")
     p.add_argument("--steps-per-dispatch", type=int, default=None,
                    help="fused block size K (default: "
                         "MXTPU_STEPS_PER_DISPATCH, i.e. 1)")
@@ -89,6 +96,8 @@ def main():
     args = parse_args()
     if args.smoke:
         return smoke(args)
+    if args.imperative:
+        return imperative(args)
 
     import numpy as np
 
@@ -215,6 +224,86 @@ def main():
         "steps_per_dispatch": K,
         "steps": steps_done,
         "dispatches": dispatches,
+    }))
+
+
+def imperative(args):
+    """Imperative dispatch microbench (docs/perf.md "Lazy imperative
+    fusion"): run a `--chain-ops`-long elementwise NDArray chain twice
+    under MXTPU_LAZY=0 eager (one engine op + one un-jitted XLA dispatch
+    per primitive) and twice under lazy fusion (the whole chain deferred
+    and flushed as ONE jitted call), reporting ops/s, per-iteration XLA
+    dispatch counts from the telemetry registry, and the fusion-cache
+    hit rate — the second lazy iteration must hit the cache compiled by
+    the first.  Prints ONE JSON line in the headline bench's shape;
+    tests/test_bench_smoke.py pins it."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import lazy, telemetry
+
+    # like --smoke, this harness asserts its own instrumentation: the
+    # registry is the dispatch counter, so it must be on
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    lazy.reset_cache()
+
+    chain_ops = max(2, args.chain_ops // 2 * 2)  # whole mul+add pairs
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(256, 256).astype("float32"))
+    a = mx.nd.array(rng.rand(256, 256).astype("float32") + 0.5)
+    b = mx.nd.array(rng.randn(256, 256).astype("float32"))
+
+    def chain():
+        y = x
+        for _ in range(chain_ops // 2):
+            y = y * a
+            y = y + b
+        return y
+
+    def timed(iters):
+        d0 = telemetry.counter_value("ndarray.imperative_dispatches")
+        t0 = time.time()
+        for _ in range(iters):
+            chain().wait_to_read()
+        dt = time.time() - t0
+        d = telemetry.counter_value("ndarray.imperative_dispatches") - d0
+        return dt, d / iters
+
+    iters = 4
+    prev = lazy.set_enabled(False)
+    try:
+        chain().wait_to_read()  # settle per-primitive compile caches
+        t_eager, eager_dispatches = timed(iters)
+
+        lazy.set_enabled(True)
+        chain().wait_to_read()  # compile the fused executable
+        h0 = telemetry.counter_value("lazy.fusion_cache_hits")
+        m0 = telemetry.counter_value("lazy.fusion_cache_misses")
+        t_lazy, lazy_dispatches = timed(iters)
+        hits = telemetry.counter_value("lazy.fusion_cache_hits") - h0
+        misses = telemetry.counter_value("lazy.fusion_cache_misses") - m0
+    finally:
+        lazy.set_enabled(prev)
+
+    snap = telemetry.snapshot()
+    chain_h = snap["histograms"].get("lazy.chain_length", {})
+    print(json.dumps({
+        "metric": "imperative %d-op elementwise chain ops/s "
+                  "(lazy fusion, 256x256 f32)" % chain_ops,
+        "value": round(chain_ops * iters / t_lazy, 1),
+        "unit": "ops/s",
+        "eager_ops_s": round(chain_ops * iters / t_eager, 1),
+        "speedup": round(t_eager / t_lazy, 3),
+        "chain_ops": chain_ops,
+        "dispatches_lazy": lazy_dispatches,
+        "dispatches_eager": eager_dispatches,
+        "fusion_cache_hit_rate": round(hits / (hits + misses), 3)
+        if (hits + misses) else None,
+        "flushes": {k.split(".")[-1]: v for k, v in snap["counters"].items()
+                    if k.startswith("lazy.flushes.")},
+        "mean_chain_len": round(chain_h["sum"] / chain_h["count"], 2)
+        if chain_h.get("count") else None,
     }))
 
 

@@ -18,6 +18,7 @@ from . import ops
 from . import engine
 from . import ndarray
 from . import ndarray as nd
+from . import lazy
 from .ndarray import waitall
 from . import symbol
 from . import symbol as sym

@@ -99,6 +99,22 @@ REGISTRY = [
            "by a background engine op (2 = classic double buffering, "
            "reference src/io/iter_prefetcher.h); raise only if H2D "
            "stalls show between fused_dispatch spans in the profile"),
+    # ---- lazy imperative evaluation (lazy.py; docs/perf.md) ----
+    EnvVar("MXTPU_LAZY", int, 1,
+           "Lazy imperative evaluation (lazy.py): NDArray ops defer "
+           "into a per-context pending graph and each chain runs as "
+           "ONE jitted XLA dispatch at the next sync point "
+           "(.data/asnumpy/wait_to_read/waitall, mutation, autograd "
+           "recording, or the MXTPU_LAZY_MAX_OPS cap), behind a "
+           "structural fusion cache with scalar-family float attrs "
+           "lifted to traced operands.  1 = on (default); 0 = eager "
+           "per-op engine dispatch (the pre-lazy behavior); see "
+           "docs/perf.md"),
+    EnvVar("MXTPU_LAZY_MAX_OPS", int, 64,
+           "Cap on a pending lazy chain: recording the Nth op flushes "
+           "the graph even without a sync point, bounding host memory "
+           "held by deferred operands and compile time of the fused "
+           "program (lazy.py)"),
     # ---- telemetry (telemetry.py; docs/observability.md) ----
     EnvVar("MXTPU_TELEMETRY", int, 1,
            "Metrics registry (telemetry.py): counters/gauges/histograms "

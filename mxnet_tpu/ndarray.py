@@ -59,15 +59,28 @@ def _as_jax(value, dtype=None):
     return jnp.asarray(value, dtype=dtype)
 
 
+def _snapshot(value):
+    """Freeze a raw (non-NDArray) operand to an immutable jax.Array at
+    its call-site value — THE snapshot rule for every deferred use of a
+    caller-owned buffer (engine dispatch args/kwargs, autograd replay
+    constants, lazy-chain inputs).  copy=True is load-bearing: plain
+    jnp.asarray on CPU may zero-copy ALIAS numpy memory, which is no
+    snapshot at all."""
+    return jnp.array(value, copy=True) if isinstance(value, _np.ndarray) \
+        else _as_jax(value)
+
+
 class NDArray:
     """Multi-dimensional array on a device (parity: python/mxnet/ndarray.py NDArray)."""
 
     # _fresh_grad backs MXNDArray{Set,Get}GradState (set lazily; unset
     # slot reads as 0 through the C API).  _var is the engine dependency
     # variable for this chunk (reference NDArray::var(), ndarray.h:350),
-    # created lazily on first engine dispatch.
+    # created lazily on first engine dispatch.  _lazy is the pending
+    # deferred-op node producing this chunk under lazy imperative
+    # evaluation (lazy.py), or None once materialized/flushed.
     __slots__ = ("_data", "_ctx", "_parent", "_index", "writable",
-                 "_fresh_grad", "_var")
+                 "_fresh_grad", "_var", "_lazy")
 
     def __init__(self, data, ctx=None, _parent=None, _index=None):
         self._parent = _parent
@@ -75,6 +88,7 @@ class NDArray:
         self._ctx = ctx if ctx is not None else current_context()
         self._data = data
         self._var = None
+        self._lazy = None
         self.writable = True
 
     # ------------------------------------------------------------------
@@ -91,6 +105,10 @@ class NDArray:
         already guarantee the value is final."""
         if self._parent is not None:
             return self._parent.data[self._index]
+        if self._lazy is not None:
+            # lazy sync point: push the pending fused chain through the
+            # engine; the wait below then blocks on its write token
+            lazy.materialize(self)
         var = self._var
         if var is not None and (var.pending_writes or var.exception is not None) \
                 and not engine.in_engine_op():
@@ -118,9 +136,13 @@ class NDArray:
     def _engine_var(self):
         """This chunk's dependency variable (reference NDArray::var();
         views share their parent's var, as reference views share the
-        Chunk)."""
+        Chunk).  Requesting the var is how a chunk enters the
+        engine-visible world, so any pending fused chain touching it is
+        flushed first — its tokens must exist before a foreign op's
+        tokens order against them."""
         if self._parent is not None:
             return self._parent._engine_var()
+        lazy.flush_for_array(self)
         if self._var is None:
             self._var = engine.Var()
         return self._var
@@ -133,6 +155,8 @@ class NDArray:
         here rather than being silently papered over."""
         if self._parent is not None:
             return self.data
+        if self._lazy is not None:
+            return self.data  # lazy sync point: flush + wait
         if engine.in_engine_op():
             return self._raw()
         var = self._var
@@ -144,6 +168,13 @@ class NDArray:
         if self._parent is not None:
             self._parent._set_data(self._parent.data.at[self._index].set(value))
         else:
+            if not engine.in_engine_op():
+                # mutation sync point: pending fused chains reading (or
+                # producing) this chunk must be pushed first so their
+                # read tokens order BEFORE this write (lazy analog of
+                # the WAR wait below); inside an engine op the flush
+                # already happened at push time (_engine_var)
+                lazy.flush_for_array(self)
             var = self._var
             if var is not None and (var.pending_writes or var.pending_reads) \
                     and not engine.in_engine_op():
@@ -156,20 +187,39 @@ class NDArray:
     # ------------------------------------------------------------------
     # basic properties
     # ------------------------------------------------------------------
+    def _meta_aval(self):
+        """Abstract shape/dtype of a pending lazy value, or None —
+        metadata reads must not flush a fused chain (lazy.aval_for)."""
+        if self._parent is None and self._lazy is not None:
+            return lazy.aval_for(self)
+        return None
+
     @property
     def shape(self):
+        aval = self._meta_aval()
+        if aval is not None:
+            return tuple(aval.shape)
         return tuple(self.data.shape)
 
     @property
     def size(self):
+        aval = self._meta_aval()
+        if aval is not None:
+            return int(_np.prod(aval.shape)) if aval.shape else 1
         return int(self.data.size)
 
     @property
     def ndim(self):
+        aval = self._meta_aval()
+        if aval is not None:
+            return len(aval.shape)
         return self.data.ndim
 
     @property
     def dtype(self):
+        aval = self._meta_aval()
+        if aval is not None:
+            return _np.dtype(aval.dtype)
         return _np.dtype(self.data.dtype)
 
     @property
@@ -250,6 +300,9 @@ class NDArray:
         base = self
         while base._parent is not None:
             base = base._parent
+        # lazy sync point (wait_to_read/wait_to_write): push the pending
+        # chain producing or reading this chunk before fencing its var
+        lazy.flush_for_array(base)
         if base._var is not None:
             engine.get().wait_for_var(base._var, wait_reads=wait_reads)
         d = self.data
@@ -346,12 +399,9 @@ class NDArray:
                     ins = [other, self] if reverse else [self, other]
                     _RECORD_HOOK(fn, ins, [out])
                 else:
-                    # raw operand captured as a replay constant — numpy
-                    # is snapshotted: jnp.asarray on CPU may zero-copy
-                    # ALIAS the caller's buffer (see _engine_invoke), and
-                    # the replay must see call-site values
-                    const = jnp.array(other, copy=True) \
-                        if isinstance(other, _np.ndarray) else _as_jax(other)
+                    # raw operand captured as a replay constant — the
+                    # replay must see call-site values
+                    const = _snapshot(other)
                     if reverse:
                         _RECORD_HOOK(lambda x, _c=const, _f=fn: _f(_c, x),
                                      [self], [out])
@@ -465,6 +515,7 @@ class NDArray:
         self._parent = None
         self._index = None
         self._var = None
+        self._lazy = None
         self._ctx = Context(*state["ctx"])
         self._data = jnp.asarray(state["data"])
         self.writable = True
@@ -496,6 +547,11 @@ class NDArray:
 
     def argmax(self, axis=None):
         return NDArray(jnp.argmax(self.data, axis=axis).astype(jnp.float32), self._ctx)
+
+
+# lazy imperative evaluation (deferred-op fusion) — imported AFTER the
+# NDArray class: lazy.py imports NDArray back from this module
+from . import lazy  # noqa: E402
 
 
 # ----------------------------------------------------------------------
@@ -605,6 +661,7 @@ def waitall():
     JAX has no global work queue to drain, so we fence a fresh
     computation, which on an in-order device stream completes after all
     prior work."""
+    lazy.flush_all("sync")
     engine.get().wait_for_all()
     x = jnp.zeros(()) + 0
     x.block_until_ready()
@@ -828,25 +885,44 @@ def _engine_invoke(op, args, kwargs, ctx, priority=0):
     c_api_ndarray.cc:248-430): returns the output handle immediately;
     the value materializes on an engine worker once all input writers
     have completed.  Reads on the result synchronize via its chunk var.
-    Tracer operands fall back to eager inline execution."""
+    Tracer operands fall back to eager inline execution.
+
+    Under lazy imperative evaluation (lazy.py; MXTPU_LAZY, on by
+    default) the op is not executed at all: it joins the context's
+    pending expression graph and the whole chain later runs as ONE
+    jitted dispatch.  Deferral is skipped inside engine ops (the chain
+    would escape the op's declared var footprint) and while the
+    autograd tape records (the tape must observe program order)."""
     if not _tracer_free(args):
         return NDArray(op.fn(*[_as_jax(a) for a in args], **kwargs), ctx)
-    # non-NDArray operands are snapshotted NOW: a numpy scratch buffer the
-    # caller mutates after this call has no engine var, so only an eager
-    # copy keeps the op's inputs at their call-site values.  copy=True is
-    # load-bearing: jnp.asarray on CPU may zero-copy ALIAS numpy memory,
-    # which is no snapshot at all (jax.Arrays are immutable, so they pass
-    # through untouched)
-    args = tuple(
-        a if isinstance(a, NDArray)
-        else jnp.array(a, copy=True) if isinstance(a, _np.ndarray)
-        else _as_jax(a)
-        for a in args)
+    # non-NDArray operands — positional AND keyword — are snapshotted
+    # NOW: a numpy scratch buffer the caller mutates after this call has
+    # no engine var, so only an eager copy (_snapshot) keeps the op's
+    # inputs at their call-site values (jax.Arrays are immutable, so
+    # they pass through untouched)
+    args = tuple(a if isinstance(a, NDArray) else _snapshot(a)
+                 for a in args)
+    if kwargs and any(isinstance(v, _np.ndarray) for v in kwargs.values()):
+        kwargs = {
+            k: _snapshot(v) if isinstance(v, _np.ndarray) else v
+            for k, v in kwargs.items()}
+    if _RECORD_HOOK is not None:
+        # autograd boundary: recorded ops must observe program order
+        # against any pending fused chain, and are never deferred
+        lazy.flush_all("sync")
+    elif lazy.enabled() and not engine.in_engine_op():
+        out = lazy.record(op, args, kwargs, ctx)
+        if out is not None:
+            return out
     out = NDArray(None, ctx)
     eng = engine.get()
     read_vars = [a._engine_var() for a in args if isinstance(a, NDArray)]
 
     def _run(_op=op, _args=args, _kw=kwargs, _out=out):
+        from . import telemetry
+
+        if telemetry.enabled():
+            telemetry.inc("ndarray.imperative_dispatches")
         jax_args = [a._raw() if isinstance(a, NDArray) else a for a in _args]
         _out._set_data(_op.fn(*jax_args, **_kw))
 
@@ -894,12 +970,9 @@ def _make_nd_function(op):
         if _RECORD_HOOK is not None:
             nd_ins = [a for a in args if isinstance(a, NDArray)]
             nd_outs = list(boxed) if isinstance(boxed, tuple) else [boxed]
-            # non-NDArray args are captured as constants in the replay fn
-            # (numpy snapshotted — jnp.asarray may alias the caller's
-            # buffer, and the replay must see call-site values)
-            spec = [None if isinstance(a, NDArray)
-                    else jnp.array(a, copy=True) if isinstance(a, _np.ndarray)
-                    else _as_jax(a)
+            # non-NDArray args are captured as constants in the replay
+            # fn (snapshotted — the replay must see call-site values)
+            spec = [None if isinstance(a, NDArray) else _snapshot(a)
                     for a in args]
 
             # mxlint: disable=W101 -- deliberate def-time snapshot: the replay closure must see the kwargs as they were at record time; the default is never mutated

@@ -49,6 +49,7 @@ class Op:
         "need_mesh",
         "input_axes",
         "variadic",
+        "lift_floats",
         "doc",
         "params",
     )
@@ -68,6 +69,7 @@ class Op:
         need_mesh=False,
         input_axes=None,
         variadic=False,
+        lift_floats=False,
         doc="",
         params=None,
     ):
@@ -89,6 +91,13 @@ class Op:
         # mesh carries it (executor picks this up; the EP memory scaling)
         self.input_axes = dict(input_axes or {})
         self.variadic = variadic
+        # lift_floats: this op's kernel tolerates float attrs arriving as
+        # jit TRACERS (it never calls float()/int() on them), so lazy
+        # fusion (lazy.py) may lift them to traced operands and share one
+        # compiled executable across scalar values.  Ops left at False
+        # get float attrs embedded statically — still fused, but each
+        # value keys its own program.
+        self.lift_floats = lift_floats
         self.doc = doc
         # declarative parameter specs (dmlc::Parameter analog, ops/params.py)
         self.params = params

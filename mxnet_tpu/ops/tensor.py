@@ -136,9 +136,19 @@ for _n, _f in [
 # scalar variants (reference src/operator/tensor/elemwise_binary_scalar_op*)
 
 
+def _scalarv(v):
+    """Scalar attr coercion that admits a traced operand: under lazy
+    fusion (lazy.py) the scalar arrives as a jit tracer — a lifted
+    operand shared across scalar values — and float() would force
+    concretization (UnexpectedTracerError)."""
+    if isinstance(v, jax.Array):
+        return v
+    return float(_lit(v))
+
+
 def _reg_scalar(name, fn, aliases=()):
-    register(name, inputs=("data",), aliases=aliases)(
-        (lambda f: lambda data, scalar=1.0, **kw: f(data, float(_lit(scalar))))(fn)
+    register(name, inputs=("data",), aliases=aliases, lift_floats=True)(
+        (lambda f: lambda data, scalar=1.0, **kw: f(data, _scalarv(scalar)))(fn)
     )
 
 
@@ -231,10 +241,10 @@ def clip(data, a_min=None, a_max=None, **kw):
     return jnp.clip(data, _lit(a_min), _lit(a_max))
 
 
-@register("smooth_l1")
+@register("smooth_l1", lift_floats=True)
 def smooth_l1(data, scalar=1.0, **kw):
     """Smooth L1 (reference src/operator/tensor/elemwise_unary_op.cc smooth_l1)."""
-    sigma2 = float(_lit(scalar)) ** 2
+    sigma2 = _scalarv(scalar) ** 2
     adata = jnp.abs(data)
     return jnp.where(adata < 1.0 / sigma2, 0.5 * sigma2 * data * data, adata - 0.5 / sigma2)
 

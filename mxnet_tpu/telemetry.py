@@ -42,6 +42,7 @@ __all__ = [
     "enabled", "set_enabled", "inc", "set_gauge", "observe",
     "counter_value", "gauge_value", "snapshot", "reset", "flush",
     "peak_flops", "flops_of_jaxpr", "TIME_BUCKETS", "BYTE_BUCKETS",
+    "COUNT_BUCKETS",
 ]
 
 # fixed bucket boundaries (seconds): half-decade exponential ladder from
@@ -51,6 +52,10 @@ TIME_BUCKETS = (1e-5, 3.16e-5, 1e-4, 3.16e-4, 1e-3, 3.16e-3, 1e-2,
 # fixed bucket boundaries (bytes): decades from 1 KiB to 10 GiB
 BYTE_BUCKETS = (2.0 ** 10, 2.0 ** 13, 2.0 ** 16, 2.0 ** 20, 2.0 ** 23,
                 2.0 ** 26, 2.0 ** 30, 10.0 * 2.0 ** 30)
+# fixed bucket boundaries (counts): powers of two from 1 to 1024 — sized
+# for small integer distributions like lazy fused-chain lengths
+COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0)
 
 _ENABLED = _os.environ.get("MXTPU_TELEMETRY", "1") not in ("0", "")
 _LOCK = threading.Lock()

@@ -42,6 +42,28 @@ def test_bench_smoke_runs_k_step_path():
 
 
 @pytest.mark.slow
+def test_bench_imperative_fuses_the_chain():
+    """bench.py --imperative: the acceptance pin for lazy imperative
+    fusion (docs/perf.md) — the 64-op chain executes in ≤ 4 XLA
+    dispatches per iteration under lazy mode vs 64 eager, and the
+    second lazy iteration hits the fusion cache."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXTPU_LAZY", None)
+    env.pop("MXTPU_LAZY_MAX_OPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--imperative"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["chain_ops"] == 64
+    assert out["dispatches_eager"] == 64  # one dispatch per primitive
+    assert out["dispatches_lazy"] <= 4    # the whole chain fused
+    assert out["fusion_cache_hit_rate"] > 0
+    assert out["mean_chain_len"] and out["mean_chain_len"] > 8
+    assert out["value"] > 0 and out["unit"] == "ops/s"
+
+
+@pytest.mark.slow
 def test_bench_smoke_honors_k_flag():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(

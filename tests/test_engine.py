@@ -248,7 +248,11 @@ def test_paths_dispatch_through_engine_push(monkeypatch):
     pf._stop_prefetch()
     mx.waitall()
 
-    assert "_plus_scalar" in names
+    # under lazy imperative evaluation (the default) the ndarray op
+    # arrives as a fused lazy_flush(n) engine op; with MXTPU_LAZY=0 it
+    # keeps its own op name
+    assert any(str(n).startswith("lazy_flush(") for n in names) \
+        or "_plus_scalar" in names
     assert any(str(n).startswith("kvstore_push") for n in names)
     assert any(str(n).startswith("kvstore_pull") for n in names)
     assert any(str(n).startswith("prefetch") for n in names)

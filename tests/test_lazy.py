@@ -1,0 +1,583 @@
+"""Lazy imperative evaluation (mxnet_tpu/lazy.py) — deferred NDArray op
+chains fused into single jitted XLA dispatches.
+
+Pins the tentpole contracts: a chain of imperative ops executes as ONE
+engine dispatch (vs one per primitive eager); every engine-dispatchable
+registry op computes the same value and dtype lazy as with MXTPU_LAZY=0;
+sync points (reads, mutation/view write-through, `_engine_var`
+visibility, waitall, autograd recording, the MXTPU_LAZY_MAX_OPS cap)
+flush in program order; the SanitizerEngine sees a clean declared-access
+run; and the fusion cache is structural — two scalar values share one
+compiled executable (scalar lift), telemetry-verified.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, lazy, profiler, telemetry
+from mxnet_tpu.contrib import autograd as ag
+from mxnet_tpu.ndarray import NDArray, _engine_dispatchable
+from mxnet_tpu.ops.registry import OP_REGISTRY
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+@pytest.fixture(autouse=True)
+def _lazy_state():
+    """Each test runs with lazy ON, a fresh telemetry registry, and no
+    pending graphs or cap override bleeding across tests."""
+    prev_enabled = lazy.set_enabled(True)
+    prev_tel = telemetry.set_enabled(True)
+    telemetry.reset()
+    yield
+    lazy.flush_all("sync")
+    engine.wait_for_all()
+    lazy.set_enabled(prev_enabled)
+    telemetry.set_enabled(prev_tel)
+    telemetry.reset()
+
+
+def _dispatches():
+    return telemetry.counter_value("ndarray.imperative_dispatches")
+
+
+# ----------------------------------------------------------------------
+# the tentpole: defer + fuse into one dispatch
+# ----------------------------------------------------------------------
+
+def test_chain_runs_as_one_dispatch():
+    x = mx.nd.array(np.arange(8, dtype=np.float32))
+    d0 = _dispatches()
+    y = x
+    for _ in range(10):
+        y = y * 2.0
+        y = y - 1.0
+    assert lazy.pending_ops() == 20
+    assert _dispatches() == d0  # nothing ran yet
+    got = y.asnumpy()
+    assert lazy.pending_ops() == 0
+    assert _dispatches() == d0 + 1  # the WHOLE chain was one dispatch
+    ref = np.arange(8, dtype=np.float32)
+    for _ in range(10):
+        ref = ref * 2.0 - 1.0
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    snap = telemetry.snapshot()["counters"]
+    assert snap["lazy.ops_deferred"] >= 20
+    assert snap["lazy.flushes.sync"] >= 1
+
+
+def test_eager_mode_dispatches_per_op():
+    prev = lazy.set_enabled(False)
+    try:
+        x = mx.nd.array(np.ones(4, np.float32))
+        d0 = _dispatches()
+        y = ((x + 1.0) * 3.0) - 2.0
+        y.wait_to_read()
+        assert _dispatches() == d0 + 3  # one engine dispatch per primitive
+        assert lazy.pending_ops() == 0
+    finally:
+        lazy.set_enabled(prev)
+
+
+def test_disabled_by_env_at_import(tmp_path):
+    """MXTPU_LAZY=0 is the escape hatch: the import-time default leaves
+    every op on the eager per-primitive engine path."""
+    src = (
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import lazy\n"
+        "assert not lazy.enabled()\n"
+        "x = mx.nd.array(np.ones(4, np.float32))\n"
+        "y = x * 2.0 + 1.0\n"
+        "assert lazy.pending_ops() == 0\n"
+        "np.testing.assert_allclose(y.asnumpy(), 3.0)\n"
+        "print('OK')\n")
+    env = dict(os.environ, MXTPU_LAZY="0", JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                       text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0 and "OK" in r.stdout, r.stdout + r.stderr
+
+
+# ----------------------------------------------------------------------
+# registry-wide parity sweep (lazy == eager for every dispatchable op)
+# ----------------------------------------------------------------------
+
+def _sweep_ops():
+    """Unique engine-dispatchable ops under their canonical name."""
+    seen = set()
+    for name, op in sorted(OP_REGISTRY.items()):
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        if _engine_dispatchable(op, ()):
+            yield op
+
+
+def test_registry_parity_sweep():
+    """Every engine-dispatchable op that runs with generic inputs under
+    MXTPU_LAZY=0 produces an allclose, dtype-equal result under lazy
+    fusion.  Ops needing mandatory attrs/special shapes raise identically
+    in both modes and are skipped (they never reach the lazy path in a
+    state the eager path accepts either)."""
+    rng = np.random.RandomState(7)
+    # (4, 4) values in (0.1, 0.9): inside the domain of log/arcsin/
+    # arctanh/rsqrt, square so dot-likes accept twin operands
+    base = (rng.rand(4, 4).astype(np.float32) * 0.8 + 0.1)
+    compared, skipped = [], []
+    for op in _sweep_ops():
+        fn = getattr(mx.nd, op.name, None)
+        if fn is None:
+            continue
+        args = [mx.nd.array(base + 0.01 * i)
+                for i in range(max(1, len(op.inputs)))]
+        prev = lazy.set_enabled(False)
+        try:
+            want = fn(*args)
+            want_np = want.asnumpy()
+        except Exception:
+            skipped.append(op.name)
+            continue
+        finally:
+            lazy.set_enabled(prev)
+        got = fn(*args)
+        got_np = got.asnumpy()
+        assert got_np.dtype == want_np.dtype, (
+            "dtype drift under lazy fusion for %s: %s vs %s"
+            % (op.name, got_np.dtype, want_np.dtype))
+        np.testing.assert_allclose(
+            got_np, want_np, rtol=1e-5, atol=1e-6,
+            err_msg="lazy/eager value mismatch for op %s" % op.name)
+        compared.append(op.name)
+    # the sweep must actually cover the registry, not skip its way green
+    assert len(compared) >= 60, (
+        "parity sweep compared only %d ops (skipped %d: %s)"
+        % (len(compared), len(skipped), skipped[:20]))
+
+
+# ----------------------------------------------------------------------
+# sync points flush in program order
+# ----------------------------------------------------------------------
+
+def test_mutation_flushes_pending_readers_first():
+    """A chain reading x must flush BEFORE a later in-place write to x:
+    the fused op's read tokens order before the write, so the chain sees
+    the pre-mutation value (program order)."""
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    y = x * 10.0  # pending, reads x
+    assert lazy.pending_ops() == 1
+    x[:] = np.full((2, 3), 5.0, np.float32)  # mutation sync point
+    np.testing.assert_allclose(y.asnumpy(), 10.0)  # pre-mutation value
+    np.testing.assert_allclose(x.asnumpy(), 5.0)
+
+
+def test_view_write_through_flushes_pending_readers_first():
+    """Same contract when the mutation arrives through a view's
+    write-through scatter (v[:] = ... on a row view of x)."""
+    x = mx.nd.array(np.zeros((3, 4), np.float32))
+    y = x + 7.0  # pending, reads x
+    v = x[1]
+    v[:] = np.full((4,), 9.0, np.float32)  # scatter into x through the view
+    np.testing.assert_allclose(y.asnumpy(), 7.0)  # chain saw zeros
+    want = np.zeros((3, 4), np.float32)
+    want[1] = 9.0
+    np.testing.assert_allclose(x.asnumpy(), want)
+
+
+def test_write_to_pending_output_materializes_it_first():
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = x * 2.0  # pending
+    y[0] = np.zeros((3,), np.float32)  # write into the chain's output
+    want = np.arange(6, dtype=np.float32).reshape(2, 3) * 2.0
+    want[0] = 0.0
+    np.testing.assert_allclose(y.asnumpy(), want)
+
+
+def test_engine_var_request_flushes():
+    """A chunk entering the engine-visible world (an eager push declares
+    it via _engine_var — the kvstore/io pattern) flushes the chain that
+    produces it, so the foreign op's tokens order against real work."""
+    x = mx.nd.array(np.ones(4, np.float32))
+    y = x + 2.0
+    assert lazy.pending_ops() == 1
+    out = {}
+
+    def probe():
+        out["val"] = np.asarray(y._raw())
+
+    engine.push(probe, read_vars=[y._engine_var()], name="probe")
+    assert lazy.pending_ops() == 0  # _engine_var was a sync point
+    engine.wait_for_all()
+    np.testing.assert_allclose(out["val"], 3.0)
+
+
+def test_waitall_flushes_everything():
+    x = mx.nd.array(np.ones(3, np.float32))
+    ys = [x * float(i) for i in range(1, 4)]
+    assert lazy.pending_ops() == 3
+    mx.waitall()
+    assert lazy.pending_ops() == 0
+    for i, y in enumerate(ys, start=1):
+        np.testing.assert_allclose(y.asnumpy(), float(i))
+
+
+def test_cap_flush():
+    """Recording the MXTPU_LAZY_MAX_OPS-th op flushes without a sync
+    point, bounding chain length (telemetry reason `cap`)."""
+    prev = lazy.set_max_ops(4)
+    try:
+        x = mx.nd.array(np.ones(2, np.float32))
+        y = x
+        for _ in range(10):
+            y = y + 1.0
+        assert lazy.pending_ops() < 4
+        np.testing.assert_allclose(y.asnumpy(), 11.0)
+        snap = telemetry.snapshot()["counters"]
+        assert snap.get("lazy.flushes.cap", 0) >= 2
+    finally:
+        lazy.set_max_ops(prev)
+
+
+def test_view_of_pending_output_as_operand():
+    """A view over a pending chunk cannot be node-wired (its index slice
+    must apply to the materialized value), so recording an op on it
+    flushes the producing graph first — WITHOUT corrupting the pending
+    accounting or re-binding into the detached graph (the continuation
+    chain lands in a fresh live graph and flushes normally)."""
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    y = x * 2.0           # pending
+    v = y[1]              # view over the pending chunk (no sync)
+    z = v + 100.0         # must flush y's graph, then defer on the view
+    np.testing.assert_allclose(
+        z.asnumpy(), np.arange(4, 8, dtype=np.float32) * 2.0 + 100.0)
+    np.testing.assert_allclose(
+        y.asnumpy(), np.arange(8, dtype=np.float32).reshape(2, 4) * 2.0)
+    assert lazy.pending_ops() == 0
+    # the accounting survived the nested flush: a fresh chain still
+    # defers and flushes exactly once
+    d0 = _dispatches()
+    w = (x + 1.0) * 3.0
+    assert lazy.pending_ops() == 2
+    w.wait_to_read()
+    assert lazy.pending_ops() == 0
+    assert _dispatches() == d0 + 1
+
+
+def test_cross_context_shared_input_no_double_flush():
+    """flush_all over two pending graphs sharing an external input: the
+    first graph's flush declares the shared input's var, which flushes
+    the second graph mid-iteration (guard_ids).  The stale snapshot
+    entry must then be a no-op — each chain runs exactly ONCE."""
+    x = mx.nd.array(np.full((2, 2), 2.0, np.float32))      # shared input
+    a = x * 3.0                                            # graph on cpu(0)
+    other = mx.nd.array(np.ones((2, 2), np.float32), ctx=mx.cpu(1))
+    b = other + x                                          # graph on cpu(1)
+    assert lazy.pending_ops() == 2
+    f0 = telemetry.counter_value("lazy.flushes.sync")
+    mx.waitall()
+    assert lazy.pending_ops() == 0
+    assert telemetry.counter_value("lazy.flushes.sync") - f0 == 2
+    np.testing.assert_allclose(a.asnumpy(), 6.0)
+    np.testing.assert_allclose(b.asnumpy(), 3.0)
+    # the chain-length histogram agrees: two 1-op flushes, no replay
+    h = telemetry.snapshot()["histograms"].get("lazy.chain_length", {})
+    assert h.get("count") == 2 and h.get("sum") == 2.0
+
+
+def test_metadata_reads_do_not_flush():
+    """.shape/.dtype/.size/len()/repr() on a pending array are answered
+    from eval_shape over the chain prefix — only PAYLOAD reads flush."""
+    x = mx.nd.array(np.ones((3, 5), np.float32))
+    y = (x * 2.0) + 1.0
+    d0 = _dispatches()
+    assert y.shape == (3, 5)
+    assert y.dtype == np.float32
+    assert y.size == 15 and y.ndim == 2 and len(y) == 3
+    assert "3x5" in repr(y)
+    assert lazy.pending_ops() == 2  # still pending
+    assert _dispatches() == d0     # nothing ran
+    np.testing.assert_allclose(y.asnumpy(), 3.0)
+    assert _dispatches() == d0 + 1
+
+
+def test_chain_error_surfaces_original_message_chain_granular():
+    """A genuine user error in a fused chain surfaces the op's own
+    eager-path message at the sync point; attribution is CHAIN-granular
+    (the documented bulk-exec semantics): sibling outputs of the failed
+    chain share the poison."""
+    x = mx.nd.array(np.ones((4, 4), np.float32))
+    bad = mx.nd.array(np.ones((3, 5), np.float32))
+    y1 = x + 1.0
+    y2 = x + bad  # same pending graph; broadcast error at execution
+    with pytest.raises(Exception) as ei:
+        y2.asnumpy()
+    assert "incompatible shapes" in str(ei.value) \
+        or "broadcast" in str(ei.value).lower(), ei.value
+    # chain-granular poison: y1 rode the same flush op
+    with pytest.raises(Exception):
+        y1.asnumpy()
+    # the poison does not leak past the chain: fresh work is clean
+    np.testing.assert_allclose((x + 2.0).asnumpy(), 3.0)
+
+
+def test_np_float64_scalar_lifts_and_shares_executable():
+    """np.float64 kwargs (float subclass) lift exactly like builtin
+    floats: two values -> ONE program, second flush is a cache hit."""
+    lazy.reset_cache()
+    x = mx.nd.array(np.ones((3, 3), np.float32))
+    m0 = telemetry.counter_value("lazy.fusion_cache_misses")
+    h0 = telemetry.counter_value("lazy.fusion_cache_hits")
+    r1 = mx.nd._plus_scalar(x, scalar=np.float64(0.5)).asnumpy()
+    progs1, _ = lazy.cache_stats()
+    r2 = mx.nd._plus_scalar(x, scalar=np.float64(1.5)).asnumpy()
+    progs2, _ = lazy.cache_stats()
+    np.testing.assert_allclose(r1, 1.5)
+    np.testing.assert_allclose(r2, 2.5)
+    assert progs2 == progs1
+    assert telemetry.counter_value("lazy.fusion_cache_misses") - m0 == 1
+    assert telemetry.counter_value("lazy.fusion_cache_hits") - h0 == 1
+
+
+def test_np_float32_scalar_lifts_and_defers():
+    """np.float32 (not a float subclass) lifts like any np.floating for
+    a lift_floats op: the call DEFERS (not bypassed to eager, which
+    would chop the chain) and shares the executable with builtin-float
+    spellings."""
+    lazy.reset_cache()
+    x = mx.nd.array(np.ones((3, 3), np.float32))
+    b0 = telemetry.counter_value("lazy.ops_bypassed")
+    m0 = telemetry.counter_value("lazy.fusion_cache_misses")
+    h0 = telemetry.counter_value("lazy.fusion_cache_hits")
+    r1 = mx.nd._plus_scalar(x, scalar=np.float32(0.5)).asnumpy()
+    r2 = mx.nd._plus_scalar(x, scalar=0.25).asnumpy()
+    np.testing.assert_allclose(r1, 1.5)
+    np.testing.assert_allclose(r2, 1.25)
+    assert telemetry.counter_value("lazy.ops_bypassed") - b0 == 0
+    assert telemetry.counter_value("lazy.fusion_cache_misses") - m0 == 1
+    assert telemetry.counter_value("lazy.fusion_cache_hits") - h0 == 1
+
+
+def test_non_lift_float_attr_embeds_statically_and_fuses():
+    """An op whose kernel concretizes its float attr (LeakyReLU slope —
+    no lift_floats) must NOT get a tracer: the value embeds in the
+    program fingerprint, the chain runs fused with zero fallback
+    downgrades, identical calls hit the cache, and each distinct value
+    keys its own program."""
+    lazy.reset_cache()
+    xv = np.linspace(-1.0, 1.0, 8).astype(np.float32)
+    x = mx.nd.array(xv)
+    f0 = telemetry.counter_value("lazy.flushes.fallback")
+    m0 = telemetry.counter_value("lazy.fusion_cache_misses")
+    h0 = telemetry.counter_value("lazy.fusion_cache_hits")
+    expect = np.where(xv * 2.0 > 0, xv * 2.0, 0.25 * xv * 2.0)
+    r1 = mx.nd.LeakyReLU(x * 2.0, slope=0.25).asnumpy()  # 2-op chain
+    np.testing.assert_allclose(r1, expect, rtol=1e-6)
+    r2 = mx.nd.LeakyReLU(x * 2.0, slope=0.25).asnumpy()  # identical -> hit
+    np.testing.assert_allclose(r2, expect, rtol=1e-6)
+    r3 = mx.nd.LeakyReLU(x * 2.0, slope=0.5).asnumpy()   # new value -> new program
+    np.testing.assert_allclose(
+        r3, np.where(xv * 2.0 > 0, xv * 2.0, 0.5 * xv * 2.0), rtol=1e-6)
+    assert telemetry.counter_value("lazy.flushes.fallback") - f0 == 0
+    assert telemetry.counter_value("lazy.fusion_cache_misses") - m0 == 2
+    assert telemetry.counter_value("lazy.fusion_cache_hits") - h0 == 1
+
+
+# ----------------------------------------------------------------------
+# autograd-tape interaction
+# ----------------------------------------------------------------------
+
+def test_autograd_tape_sees_program_order():
+    """While the tape records, ops are NOT deferred (the tape must
+    observe program order), a chain pending from before the section is
+    flushed at the boundary, and gradients match the eager mode."""
+    def run():
+        x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+        pre = x * 2.0  # pending chain crossing into the record section
+        gx = mx.nd.zeros((3,))
+        ag.mark_variables([x], [gx])
+        with ag.train_section():
+            y = x * x + 2.0 * x
+            assert lazy.pending_ops() == 0  # recording defers nothing
+            z = mx.nd.sum(y)
+        ag.backward([z])
+        return gx.asnumpy(), pre.asnumpy()
+
+    g_lazy, pre_lazy = run()
+    prev = lazy.set_enabled(False)
+    try:
+        g_eager, pre_eager = run()
+    finally:
+        lazy.set_enabled(prev)
+    np.testing.assert_allclose(g_lazy, g_eager, rtol=1e-6)
+    np.testing.assert_allclose(pre_lazy, pre_eager, rtol=1e-6)
+    np.testing.assert_allclose(g_lazy, 2 * np.array([1, 2, 3.0]) + 2,
+                               rtol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# engine-contract cleanliness (SanitizerEngine)
+# ----------------------------------------------------------------------
+
+def test_sanitizer_clean_under_lazy():
+    """The fused flush op declares the union of the chain's read/write
+    vars, so the SanitizerEngine's declared-access contract holds: a
+    lazy run with external inputs, chained nodes, and a mutation sync
+    reports ZERO violations."""
+    prev = engine.get().kind
+    eng = engine.set_engine_type("SanitizerEngine", num_workers=2)
+    try:
+        x = mx.nd.array(np.ones((2, 2), np.float32))
+        w = mx.nd.array(np.full((2, 2), 3.0, np.float32))
+        y = (x + w) * 2.0
+        z = y - 1.0
+        np.testing.assert_allclose(z.asnumpy(), 7.0)
+        x[:] = np.zeros((2, 2), np.float32)  # mutation sync on an input
+        np.testing.assert_allclose((x + z).asnumpy(), 7.0)
+        mx.waitall()
+        assert not eng.violations, eng.race_report()
+    finally:
+        engine.set_engine_type(prev)
+
+
+# ----------------------------------------------------------------------
+# fusion cache: structural keys + scalar lift
+# ----------------------------------------------------------------------
+
+def test_scalar_lift_shares_one_executable():
+    """`x + 0.1` and `x + 0.2` share one compiled program: float attrs
+    are lifted to traced operands, so the second flush is a structural
+    cache HIT (telemetry-verified) and the program count grows by 1."""
+    lazy.reset_cache()
+    x = mx.nd.array(np.ones((3, 3), np.float32))
+    h0 = telemetry.counter_value("lazy.fusion_cache_hits")
+    m0 = telemetry.counter_value("lazy.fusion_cache_misses")
+    np.testing.assert_allclose((x + 0.125).asnumpy(), 1.125)
+    progs1, _ = lazy.cache_stats()
+    np.testing.assert_allclose((x + 0.25).asnumpy(), 1.25)
+    progs2, _ = lazy.cache_stats()
+    assert progs2 == progs1  # 1 compile covered BOTH scalar values
+    assert telemetry.counter_value("lazy.fusion_cache_misses") - m0 == 1
+    assert telemetry.counter_value("lazy.fusion_cache_hits") - h0 == 1
+
+
+def test_second_identical_chain_hits_cache():
+    lazy.reset_cache()
+
+    def chain():
+        x = mx.nd.array(np.ones(4, np.float32))
+        return ((x * 2.0) + 3.0).asnumpy()
+
+    m0 = telemetry.counter_value("lazy.fusion_cache_misses")
+    h0 = telemetry.counter_value("lazy.fusion_cache_hits")
+    chain()
+    chain()
+    assert telemetry.counter_value("lazy.fusion_cache_misses") - m0 == 1
+    assert telemetry.counter_value("lazy.fusion_cache_hits") - h0 == 1
+
+
+def test_fused_trace_failure_falls_back_to_eager(monkeypatch):
+    """A program whose fused trace fails downgrades to per-op eager
+    execution inside the same engine op — the value still comes out, and
+    telemetry records the downgrade."""
+    from mxnet_tpu.ops.registry import Op
+
+    calls = {"n": 0}
+
+    def touchy(data, **kw):
+        import jax
+        import jax.numpy as jnp
+
+        calls["n"] += 1
+        if isinstance(data, jax.core.Tracer):
+            raise RuntimeError("refuses to trace")
+        return jnp.asarray(data) + 1.0
+
+    op = Op("_test_touchy", touchy)
+    monkeypatch.setitem(OP_REGISTRY, "_test_touchy", op)
+    lazy.reset_cache()
+    x = mx.nd.array(np.zeros(3, np.float32))
+    out = lazy.record(op, (x,), {}, x.ctx)
+    assert out is not None
+    f0 = telemetry.counter_value("lazy.flushes.fallback")
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    assert telemetry.counter_value("lazy.flushes.fallback") - f0 == 1
+    # the replay path stays visible: a SECOND chain over the same
+    # downgraded (program, signature) counts another fallback flush
+    x2 = mx.nd.array(np.zeros(3, np.float32))
+    out2 = lazy.record(op, (x2,), {}, x2.ctx)
+    np.testing.assert_allclose(out2.asnumpy(), 1.0)
+    assert telemetry.counter_value("lazy.flushes.fallback") - f0 == 2
+
+
+def test_trace_failure_downgrade_is_signature_scoped():
+    """A user error carried by ONE input signature (a broadcast shape
+    mismatch) downgrades only that (program, signature) pair — the same
+    program structure over well-shaped inputs still runs fused, with
+    normal hit/miss accounting and no fallback."""
+    lazy.reset_cache()
+    bad_l = mx.nd.array(np.ones(3, np.float32))
+    bad_r = mx.nd.array(np.ones(4, np.float32))
+    f0 = telemetry.counter_value("lazy.flushes.fallback")
+    with pytest.raises(Exception):
+        (bad_l + bad_r).asnumpy()  # fused trace fails; eager replay re-raises
+    assert telemetry.counter_value("lazy.flushes.fallback") - f0 == 1
+    m0 = telemetry.counter_value("lazy.fusion_cache_misses")
+    a = mx.nd.array(np.ones(5, np.float32))
+    b = mx.nd.array(np.full(5, 2.0, np.float32))
+    np.testing.assert_allclose((a + b).asnumpy(), 3.0)
+    assert telemetry.counter_value("lazy.flushes.fallback") - f0 == 1
+    # the well-shaped signature went through the fused path (a miss —
+    # new signature — not a silent eager replay)
+    assert telemetry.counter_value("lazy.fusion_cache_misses") - m0 == 1
+
+
+# ----------------------------------------------------------------------
+# observability: profiler lane + parse_log columns
+# ----------------------------------------------------------------------
+
+def test_profiler_shows_lazy_flush_span(tmp_path):
+    path = str(tmp_path / "profile.json")
+    profiler.profiler_set_config(filename=path)
+    profiler.profiler_set_state("run")
+    try:
+        x = mx.nd.array(np.ones(4, np.float32))
+        ((x + 1.0) * 2.0).asnumpy()
+        mx.waitall()
+    finally:
+        profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    spans = [e for e in events
+             if e.get("name", "").startswith("lazy_flush(")]
+    assert spans, "no lazy_flush(n) span in the dumped trace"
+
+
+def test_parse_log_renders_lazy_columns(tmp_path):
+    from tools.parse_log import parse_telemetry
+
+    rec = {
+        "flush_seq": 1, "step": 4,
+        "counters": {"lazy.flushes.sync": 3, "lazy.flushes.cap": 1,
+                     "lazy.flushes.fallback": 1,
+                     "lazy.fusion_cache_hits": 3,
+                     "lazy.fusion_cache_misses": 1},
+        "gauges": {},
+        "histograms": {"lazy.chain_length": {"count": 4, "sum": 40.0}},
+    }
+    pre_lazy = {"flush_seq": 2, "step": 8, "counters": {}, "gauges": {},
+                "histograms": {}}
+    rows = parse_telemetry([json.dumps(rec), json.dumps(pre_lazy)])
+    assert rows[0]["lazy_flushes"] == 4  # fallback marks a downgrade, not a flush
+    assert rows[0]["chain_mean"] == pytest.approx(10.0)
+    assert rows[0]["fusion_hit_pct"] == pytest.approx(75.0)
+    # a pre-lazy log renders '-' (None), not zeros
+    assert rows[1]["lazy_flushes"] is None
+    assert rows[1]["chain_mean"] is None
+    assert rows[1]["fusion_hit_pct"] is None

@@ -305,6 +305,84 @@ def test_e004_arbitrary_condition_is_not_a_guard(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# E005 — registered op kernels must not sync on operands (lazy fusion)
+# ----------------------------------------------------------------------
+
+def _lint_ops_src(tmp_path, src, name="snippet.py"):
+    """Like _lint_src but under mxnet_tpu/ops/, where E005 applies."""
+    pkg = tmp_path / "mxnet_tpu"
+    ops = pkg / "ops"
+    ops.mkdir(parents=True, exist_ok=True)
+    (pkg / "config.py").write_text("REGISTRY = []\n")
+    p = ops / name
+    p.write_text(src)
+    return run_paths([str(p)])
+
+
+E005_DECORATED = """
+from .registry import register
+
+@register("bad_op", inputs=("data",))
+def bad_op(data, **kw):
+    host = data.asnumpy()
+    return host + data.data
+"""
+
+E005_DIRECT_LAMBDA = """
+from .registry import register
+
+register("bad_scalar")(lambda data, scalar=1.0, **kw: data.wait_to_read())
+"""
+
+E005_FACTORY_LAMBDA = """
+from .registry import register
+
+def _reg_scalar(name, fn):
+    register(name, inputs=("data",))(
+        (lambda f: lambda data, scalar=1.0, **kw: f(data.data, scalar))(fn)
+    )
+"""
+
+E005_CLEAN = """
+import jax.numpy as jnp
+from .registry import register
+
+@register("good_op", inputs=("data",))
+def good_op(data, scalar=1.0, **kw):
+    return jnp.abs(data) * scalar
+
+def helper(nd):
+    # not a registered op: host access is fine here
+    return nd.asnumpy()
+"""
+
+
+def test_e005_flags_sync_in_registered_ops(tmp_path):
+    findings, _, _ = _lint_ops_src(tmp_path, E005_DECORATED)
+    got = _ids(findings)
+    assert got.count("E005") == 2, findings  # .asnumpy() AND .data
+    assert any("`.asnumpy()`" in f.message for f in findings)
+    assert any("`.data`" in f.message for f in findings)
+    assert any("`bad_op`" in f.message for f in findings)
+
+
+def test_e005_covers_direct_and_factory_registration(tmp_path):
+    findings, _, _ = _lint_ops_src(tmp_path, E005_DIRECT_LAMBDA)
+    assert _ids(findings) == ["E005"]
+    assert "wait_to_read" in findings[0].message
+    findings, _, _ = _lint_ops_src(tmp_path, E005_FACTORY_LAMBDA)
+    assert _ids(findings) == ["E005"]
+
+
+def test_e005_clean_kernel_and_non_ops_file(tmp_path):
+    findings, _, _ = _lint_ops_src(tmp_path, E005_CLEAN)
+    assert findings == []
+    # the same sync-y source OUTSIDE mxnet_tpu/ops/ is out of scope
+    findings, _, _ = _lint_src(tmp_path, E005_DECORATED)
+    assert "E005" not in _ids(findings)
+
+
+# ----------------------------------------------------------------------
 # E003 — leaked Vars
 # ----------------------------------------------------------------------
 

@@ -7,6 +7,6 @@ for the user-facing story (including the runtime counterpart,
 ``MXNET_ENGINE_TYPE=SanitizerEngine``).
 """
 from .core import Finding, all_checks, register, run_paths
-from . import engine_checks, general_checks, telemetry_checks  # noqa: F401  (register checks)
+from . import engine_checks, general_checks, lazy_checks, telemetry_checks  # noqa: F401  (register checks)
 
 __all__ = ["Finding", "all_checks", "register", "run_paths"]

@@ -1,0 +1,115 @@
+"""mxlint lazy-fusion checks — registered op kernels must stay sync-free.
+
+Functions registered into the op registry (``mxnet_tpu/ops/``) are pure
+JAX kernels: they consume and produce ``jax.Array``s and must trace
+under ``jax.jit``.  Under lazy imperative evaluation (mxnet_tpu/lazy.py)
+whole chains of them run inside ONE fused jitted dispatch — a kernel
+that reaches back into NDArray sync machinery breaks that twice over:
+
+  * **E005** — a registered op function calls ``.data`` / ``.asnumpy()``
+    / ``.asscalar()`` / ``.wait_to_read()`` / ``.wait_to_write()`` on an
+    operand.  At best it forces a premature flush inside a fused region
+    (the chain splits and the fusion win evaporates); under an active
+    trace it concretizes a tracer and raises.  Kernels read operands as
+    plain jax values — if host data is genuinely needed, the op does
+    not belong in the registry.
+
+Registration sites recognized: the ``@register("name", ...)`` decorator
+form and the direct ``register("name", ...)(fn_or_lambda)`` call form
+(the ``_reg_*`` helper idiom in ops/tensor.py).  The check only runs on
+files under ``mxnet_tpu/ops/`` — elsewhere ``.data`` is the legitimate
+NDArray payload accessor.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, register
+
+__all__ = ["SyncCallInRegisteredOp"]
+
+# NDArray sync entry points that must not appear inside an op kernel
+_SYNC_ATTRS = {"asnumpy", "asscalar", "wait_to_read", "wait_to_write"}
+
+
+def _is_ops_file(ctx):
+    rel = os.path.relpath(ctx.path, ctx.repo_root).replace(os.sep, "/")
+    return "/ops/" in "/" + rel
+
+
+def _register_name(fn):
+    """The callable name of a register(...) call: `register` or
+    `registry.register`."""
+    if isinstance(fn, ast.Name):
+        return fn.id == "register"
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "register"
+    return False
+
+
+def _registered_functions(ctx):
+    """Yield (callable AST node, registered-name-or-None) for every op
+    registration site in the file."""
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.FunctionDef):
+            for dec in n.decorator_list:
+                if isinstance(dec, ast.Call) and _register_name(dec.func):
+                    yield n, n.name
+        elif isinstance(n, ast.Call):
+            # register("name", ...)(fn) — direct-call form
+            f = n.func
+            if (isinstance(f, ast.Call) and _register_name(f.func)
+                    and n.args):
+                target = n.args[0]
+                opname = None
+                if f.args and isinstance(f.args[0], ast.Constant):
+                    opname = f.args[0].value
+                if isinstance(target, ast.Lambda):
+                    yield target, opname
+                elif isinstance(target, ast.Call):
+                    # immediately-applied factory: (lambda f: lambda ...)(fn)
+                    # — walk into any lambda it builds
+                    for sub in ast.walk(target):
+                        if isinstance(sub, ast.Lambda):
+                            yield sub, opname
+
+
+def _sync_accesses(fn_node):
+    body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _SYNC_ATTRS:
+                yield n, ".%s()" % n.func.attr
+            elif isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                    and n.attr == "data":
+                yield n, ".data"
+
+
+@register
+class SyncCallInRegisteredOp:
+    """E005: registered op kernels must not sync on their operands."""
+
+    id = "E005"
+    title = ("functions registered in mxnet_tpu/ops/ must not call "
+             ".data/.asnumpy()/wait_to_read() on operands")
+
+    def run(self, ctx):
+        if not _is_ops_file(ctx):
+            return
+        seen = set()
+        for fn_node, opname in _registered_functions(ctx):
+            for access, what in _sync_accesses(fn_node):
+                key = (access.lineno, access.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    "E005", ctx.path, access.lineno, access.col_offset,
+                    "registered op %s syncs on an operand via `%s`: op "
+                    "kernels are pure jax functions — under lazy fusion "
+                    "this forces a premature flush inside a fused region "
+                    "(and concretizes a tracer under jit).  Read the "
+                    "operand as a plain jax value instead"
+                    % ("`%s`" % opname if opname else "function", what))

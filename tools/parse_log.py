@@ -13,7 +13,9 @@ With ``--telemetry`` the input is a telemetry JSONL file instead
 (mxnet_tpu/telemetry.py flush records, one JSON object per line — the
 ``MXTPU_TELEMETRY_FILE`` sink): one row per flush with the step stamp,
 step-time percentiles from the histogram, MFU, dispatch and
-compile-cache counters.  See docs/observability.md.
+compile-cache counters, plus the lazy-fusion columns (flush count,
+mean fused-chain length, fusion-cache hit %) when the run recorded
+the ``lazy`` namespace.  See docs/observability.md.
 """
 from __future__ import annotations
 
@@ -81,6 +83,19 @@ def parse_telemetry(lines):
         io_h = hist.get("io.consumer_wait_seconds", {})
         counters = rec.get("counters", {})
         gauges = rec.get("gauges", {})
+        # lazy-fusion columns (mxnet_tpu/lazy.py): None-out when the run
+        # recorded no lazy namespace at all, so pre-lazy logs render '-'
+        has_lazy = any(k.startswith("lazy.") for k in counters)
+        lazy_flushes = sum(v for k, v in counters.items()
+                           if k.startswith("lazy.flushes.")
+                           and k != "lazy.flushes.fallback")
+        chain_h = hist.get("lazy.chain_length", {})
+        chain_mean = (chain_h["sum"] / chain_h["count"]
+                      if chain_h.get("count") else None)
+        f_hits = counters.get("lazy.fusion_cache_hits", 0)
+        f_misses = counters.get("lazy.fusion_cache_misses", 0)
+        fusion_hit_pct = (100.0 * f_hits / (f_hits + f_misses)
+                          if (f_hits + f_misses) else None)
         rows.append({
             "flush_seq": rec.get("flush_seq"),
             "step": rec.get("step"),
@@ -93,13 +108,17 @@ def parse_telemetry(lines):
             "cache_misses": counters.get("executor.compile_cache_misses"),
             "io_wait_p50": _hist_quantile(io_h, 0.5),
             "h2d_bytes": counters.get("executor.h2d_bytes"),
+            "lazy_flushes": lazy_flushes if has_lazy else None,
+            "chain_mean": chain_mean,
+            "fusion_hit_pct": fusion_hit_pct,
         })
     return rows
 
 
 _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "mfu", "dispatches", "cache_hits", "cache_misses",
-                   "io_wait_p50", "h2d_bytes"]
+                   "io_wait_p50", "h2d_bytes", "lazy_flushes", "chain_mean",
+                   "fusion_hit_pct"]
 
 
 def _print_telemetry(rows, fmt):
