@@ -51,12 +51,21 @@ def parse_args():
                         "elementwise NDArray chain, lazy fusion vs "
                         "MXTPU_LAZY=0 eager — reports ops/s, dispatch "
                         "counts, and fusion-cache hit rate")
+    p.add_argument("--ab", choices=sorted(AB_SINKS),
+                   help="matched A/B of one attributed MFU sink "
+                        "(docs/perf.md 'MFU sinks'): runs the before/"
+                        "after pair back-to-back IN ONE PROCESS and "
+                        "emits a single JSON row with both sides, "
+                        "stdev, and the delta.  With --smoke: tiny "
+                        "models on CPU (tests/test_bench_smoke.py)")
     p.add_argument("--chain-ops", type=int, default=64,
                    help="ops per imperative chain (default 64)")
     p.add_argument("--steps-per-dispatch", type=int, default=None,
                    help="fused block size K (default: "
                         "MXTPU_STEPS_PER_DISPATCH, i.e. 1)")
-    p.add_argument("--batch", type=int, default=512)
+    p.add_argument("--batch", type=int, default=None,
+                   help="batch size (default: 512 headline; per-sink "
+                        "defaults under --ab)")
     p.add_argument("--steps", type=int, default=30,
                    help="total timed steps (with K>1: rounded up to 3 "
                         "fenced chunks of whole K-blocks)")
@@ -94,6 +103,8 @@ def _fence(mod, name):
 
 def main():
     args = parse_args()
+    if args.ab:
+        return ab(args)
     if args.smoke:
         return smoke(args)
     if args.imperative:
@@ -104,7 +115,7 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu.models.resnet import resnet
 
-    BATCH = args.batch
+    BATCH = args.batch or 512
     K = _resolve_k(args)
 
     mx.random.seed(0)
@@ -224,6 +235,252 @@ def main():
         "steps_per_dispatch": K,
         "steps": steps_done,
         "dispatches": dispatches,
+    }))
+
+
+# ----------------------------------------------------------------------
+# --ab: matched back-to-back A/B of one attributed MFU sink.  Both sides
+# run IN ONE PROCESS (same host state, same tunnel window — the README
+# Roofline methodology for deltas smaller than the run-to-run spread),
+# each as warmup + 3 fenced chunks so the row carries its own stdev.
+# Roofline entries are reproducible with exactly one command:
+#     python bench.py --ab s2d_stem        (v5e)
+#     python bench.py --ab frozen_bn --smoke   (CPU, tiny — the CI pin)
+# ----------------------------------------------------------------------
+
+
+def _tiny_bn_net(mx, layout="NCHW"):
+    """--smoke model for the conv sinks: a stride-2 odd-input stem conv
+    (exercises the s2d parity pad) + BN + a 3x3 body conv, so every
+    toggled code path (fold, bf16 wgrad, frozen BN) is actually on the
+    traced graph."""
+    ax = -1 if layout.endswith("C") else 1
+    d = mx.sym.Variable("data")
+    c = mx.sym.Convolution(d, num_filter=16, kernel=(3, 3), stride=(2, 2),
+                           no_bias=True, layout=layout, name="stem_conv")
+    b = mx.sym.BatchNorm(c, fix_gamma=False, axis=ax, name="stem_bn")
+    a = mx.sym.Activation(b, act_type="relu")
+    c2 = mx.sym.Convolution(a, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                            no_bias=True, layout=layout, name="body_conv")
+    b2 = mx.sym.BatchNorm(c2, fix_gamma=False, axis=ax, name="body_bn")
+    a2 = mx.sym.Activation(b2, act_type="relu")
+    f = mx.sym.FullyConnected(a2, num_hidden=8, name="fc1")
+    return mx.sym.SoftmaxOutput(f, name="softmax")
+
+
+def _train_rates(mod, batch_obj, batch_size, steps):
+    """Warmup (compile + settle) then 3 fenced chunks; returns img-or-
+    sample/s per chunk."""
+    for _ in range(2):
+        mod.forward_backward(batch_obj)
+        mod.update()
+    _fence(mod, "fc1_weight")
+    chunk = max(1, steps // 3)
+    rates = []
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(chunk):
+            mod.forward_backward(batch_obj)
+            mod.update()
+        _fence(mod, "fc1_weight")
+        rates.append(batch_size * chunk / (time.time() - t0))
+    return rates
+
+
+def _conv_ab_side(args, smoke, env_name, flag, frozen=False):
+    """One side of a conv-model A/B: build a FRESH Module (fresh jit
+    caches — config flags are read at trace time) under `env_name`=flag
+    and measure the full fwd+bwd+SGD step."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    prev = os.environ.get(env_name) if env_name else None
+    if env_name:
+        os.environ[env_name] = "1" if flag else "0"
+    try:
+        mx.random.seed(0)
+        if smoke:
+            net = _tiny_bn_net(mx)
+            shape, batch, classes, steps = (3, 17, 17), 16, 8, 9
+            ctx, dtype = mx.cpu(), None
+        elif frozen or env_name is None:
+            # frozen-BN targets the ResNet-50 headline config
+            from mxnet_tpu.models.resnet import resnet
+
+            net = resnet(50, layout="NHWC")
+            shape, batch = (224, 224, 3), args.batch or 512
+            classes, steps = 1000, args.steps
+            ctx, dtype = mx.tpu(), "bfloat16"
+        else:
+            # stem/wgrad sinks target Inception-v3 (the attribution rows)
+            from mxnet_tpu.models.inception_v3 import get_inception_v3
+
+            net = get_inception_v3(layout="NHWC")
+            shape, batch = (299, 299, 3), args.batch or 128
+            classes, steps = 1000, args.steps
+            ctx, dtype = mx.tpu(), "bfloat16"
+        fixed = None
+        if frozen and flag:
+            from mxnet_tpu.symbol import (batchnorm_param_names,
+                                          freeze_batchnorm)
+
+            fixed = batchnorm_param_names(net)
+            net = freeze_batchnorm(net)
+        mod = mx.mod.Module(net, context=ctx, compute_dtype=dtype,
+                            fixed_param_names=fixed)
+        mod.bind(data_shapes=[("data", (batch,) + shape)],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+        rng = np.random.RandomState(0)
+        b = mx.io.DataBatch(
+            data=[mx.nd.array(rng.randn(batch, *shape).astype("float32"))],
+            label=[mx.nd.array(rng.randint(0, classes, batch)
+                               .astype("float32"))])
+        return _train_rates(mod, b, batch, steps)
+    finally:
+        if env_name:
+            if prev is None:
+                os.environ.pop(env_name, None)
+            else:
+                os.environ[env_name] = prev
+
+
+def _lstm_ab_side(args, smoke, packed):
+    """One side of the bucketed-LSTM A/B: a full BucketingModule training
+    epoch over BucketSentenceIter, batch_growth off vs on.  tokens/s
+    counts every (padded) sequence slot — identical work per epoch on
+    both sides, only the batch packing differs."""
+    import random as _random
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import rnn
+
+    if smoke:
+        # short buckets keep the unrolled-graph compiles (the dominant
+        # CPU cost) cheap; the packing mechanics are identical
+        V, H, E, B, layers = 50, 32, 16, 8, 1
+        buckets, n_sent = [4, 8], 128
+        ctx = mx.cpu()
+    else:
+        # BASELINE config 3 shape: 2x200 LSTM, batch 32 (bptt via buckets)
+        V, H, E, B, layers = 10000, 200, 200, 32, 2
+        buckets, n_sent = [10, 20, 30, 35], 4096
+        ctx = mx.tpu()
+    _random.seed(0)
+    np.random.seed(0)
+    rng = np.random.RandomState(0)
+    sents = []
+    for _ in range(n_sent):
+        n = rng.randint(3, max(buckets) + 1)
+        sents.append([int(v) for v in rng.randint(2, V, n)])
+    it = rnn.BucketSentenceIter(sents, B, buckets=list(buckets),
+                                invalid_label=0, batch_growth=packed)
+    cell = rnn.FusedRNNCell(H, num_layers=layers, mode="lstm",
+                            prefix="lstm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                                 name="embed")
+        output, _ = cell.unroll(seq_len, inputs=embed, layout="NTC",
+                                merge_outputs=True)
+        pred = mx.sym.Reshape(output, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen=sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=ctx)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier(factor_type="in", magnitude=2.34))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    def epoch():
+        it.reset()
+        tokens = 0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            tokens += batch.data[0].size
+        return tokens
+
+    epoch()  # compile every bucket + settle
+    rates = []
+    for _ in range(3):
+        t0 = time.time()
+        tokens = epoch()
+        rates.append(tokens / (time.time() - t0))
+    return rates
+
+
+AB_SINKS = {
+    "s2d_stem": {
+        "unit": "img/s",
+        "desc": "Inception-v3 train step, MXNET_TPU_S2D_STEM 0 vs 1 "
+                "(space-to-depth fold of the 299^2 3x3/s2 stem)",
+        "side": lambda args, smoke, flag: _conv_ab_side(
+            args, smoke, "MXNET_TPU_S2D_STEM", flag),
+    },
+    "bf16_wgrad": {
+        "unit": "img/s",
+        "desc": "Inception-v3 train step, MXTPU_BF16_WGRAD 0 vs 1 "
+                "(bf16-accumulated small-kernel weight grads)",
+        "side": lambda args, smoke, flag: _conv_ab_side(
+            args, smoke, "MXTPU_BF16_WGRAD", flag),
+    },
+    "lstm_pack": {
+        "unit": "tokens/s",
+        "desc": "bucketed LSTM epoch, BucketSentenceIter batch_growth "
+                "off vs on (short buckets trade length for batch rows)",
+        "side": lambda args, smoke, flag: _lstm_ab_side(args, smoke, flag),
+    },
+    "frozen_bn": {
+        "unit": "img/s",
+        "desc": "ResNet-50 train step, trainable BN vs "
+                "fit(frozen_bn=True) (use_global_stats + fixed "
+                "gamma/beta)",
+        "side": lambda args, smoke, flag: _conv_ab_side(
+            args, smoke, None, flag, frozen=True),
+    },
+}
+
+
+def ab(args):
+    """Run one sink's matched A/B (see AB_SINKS) and print ONE JSON row."""
+    if args.smoke:
+        # like smoke(): must win over any site TPU default BEFORE jax
+        # is first imported
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    sink = AB_SINKS[args.ab]
+    a_rates = sink["side"](args, args.smoke, False)
+    b_rates = sink["side"](args, args.smoke, True)
+    a, b = float(np.mean(a_rates)), float(np.mean(b_rates))
+    desc = ("tiny-model CPU smoke of: " + sink["desc"] if args.smoke
+            else sink["desc"])
+    print(json.dumps({
+        "metric": "A/B %s: %s" % (args.ab, desc),
+        "sink": args.ab,
+        "unit": sink["unit"],
+        "a": {"value": round(a, 2),
+              "stdev": round(float(np.std(a_rates)), 2)},
+        "b": {"value": round(b, 2),
+              "stdev": round(float(np.std(b_rates)), 2)},
+        "delta_pct": round((b - a) / a * 100.0, 2),
+        "smoke": bool(args.smoke),
     }))
 
 

@@ -160,14 +160,36 @@ REGISTRY = [
            "fused reduce and its custom_vjp pins an extra residual. Kept "
            "for experimentation; see README Roofline item 5"),
     EnvVar("MXNET_TPU_S2D_STEM", int, 0,
-           "EXACT space-to-depth rewrite of 7x7/stride-2/pad-3 stem "
-           "convolutions (C_in<=4): factor-2 fold to an equivalent "
-           "4x4/stride-1 conv on 4x the channels (ops/nn.py "
-           "_maybe_s2d_stem). Numerically exact but measured SLOWER "
-           "end-to-end on ResNet-50 inference (11456 vs 11759 img/s): "
-           "the stem conv sheds 0.9 ms/call but the fold's relayout "
-           "copies add 2.2 ms (README Per-model MFU item 5). Default "
-           "OFF; kept for experimentation"),
+           "EXACT space-to-depth rewrite of 2-D stride-2 stem "
+           "convolutions (C_in<=4, any kernel/pad, odd sizes "
+           "zero-padded): factor-2 fold to an equivalent stride-1 conv "
+           "on 4x the channels (ops/nn.py space_to_depth_stem). "
+           "Model-dependent: measured SLOWER on ResNet-50's 224^2 7x7 "
+           "stem (11456 vs 11759 img/s inference — the fold's relayout "
+           "copies outweigh the MXU fill, README Per-model MFU item 5) "
+           "but FASTER on Inception-v3's 3x-larger 299^2 3x3 stem "
+           "(README Roofline item 8; A/B via `bench.py --ab s2d_stem`). "
+           "Default OFF"),
+    EnvVar("MXTPU_BF16_WGRAD", int, 0,
+           "bf16-accumulated WEIGHT gradients for small-kernel (max dim "
+           "<=7) convolutions (ops/nn.py _conv_call custom-vjp): the "
+           "weight-grad conv runs with bf16 operands and "
+           "preferred_element_type=bf16, cast to the fp32 master dtype "
+           "after — keeps the fast bf16 grad kernels reachable instead "
+           "of the f32-output kernels that cost Inception-v3 27% of "
+           "device time (README Roofline item 8; A/B via `bench.py "
+           "--ab bf16_wgrad`). Activation gradients keep exact f32 "
+           "accumulation. Changes gradient numerics (tolerance-pinned "
+           "in tests/test_mfu_sinks.py); default OFF"),
+    EnvVar("MXTPU_FROZEN_BN", int, 0,
+           "Default for Module.fit(frozen_bn=): 1 freezes every "
+           "BatchNorm for fine-tuning — use_global_stats forced on "
+           "(running stats carried, never recomputed) and BN "
+           "gamma/beta excluded from the optimizer update "
+           "(symbol.freeze_batchnorm; +17.9% measured on ResNet-50 "
+           "training, README Roofline items 6/8; A/B via `bench.py "
+           "--ab frozen_bn`). A fine-tuning SEMANTICS mode, not a "
+           "free perf knob: stats must already be trained. Default OFF"),
     # ---- JAX/XLA passthrough the test/dev flows rely on ----
     EnvVar("JAX_PLATFORMS", str, "", "Force a JAX backend, e.g. 'cpu'"),
     EnvVar("XLA_FLAGS", str, "",

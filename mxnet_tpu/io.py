@@ -53,6 +53,19 @@ class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
         return [DataDesc(x[0], x[1]) for x in shapes]
 
 
+def desc_shape(desc):
+    """Shape of a bind-style shape spec: DataDesc or plain (name, shape)."""
+    return tuple(desc.shape) if hasattr(desc, "shape") else tuple(desc[1])
+
+
+def redesc(desc, shape):
+    """A DataDesc like `desc` (DataDesc or (name, shape) tuple) with a
+    new shape — dtype/layout carried over when present."""
+    if hasattr(desc, "shape"):
+        return DataDesc(desc.name, shape, desc.dtype, desc.layout)
+    return DataDesc(desc[0], shape)
+
+
 class DataBatch:
     """One batch: data/label NDArray lists + pad/index (parity: io.py DataBatch)."""
 

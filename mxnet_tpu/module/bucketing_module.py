@@ -33,6 +33,7 @@ class BucketingModule(BaseModule):
         self._fixed_param_names = fixed_param_names
         self._state_names = state_names
         self._buckets = {}
+        self._default_module = None
         self._curr_module = None
         self._curr_bucket_key = None
         self._params_dirty = False
@@ -40,6 +41,7 @@ class BucketingModule(BaseModule):
     def _reset_bind(self):
         self.binded = False
         self._buckets = {}
+        self._default_module = None
         self._curr_module = None
         self._curr_bucket_key = None
 
@@ -137,12 +139,35 @@ class BucketingModule(BaseModule):
                     force_rebind=False, shared_module=None, grad_req=grad_req)
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
-        self._buckets[self._default_bucket_key] = module
+        self._default_module = module
+        self._buckets[(self._default_bucket_key,
+                       self._shape_sig(data_shapes))] = module
+
+    @staticmethod
+    def _shape_sig(data_shapes):
+        """Hashable shape signature of a provide_data list (DataDesc or
+        plain (name, shape) tuples)."""
+        from ..io import desc_shape
+
+        return tuple(desc_shape(d) for d in data_shapes)
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
-        """Switch to (bind if new) a bucket (parity: bucketing_module.py switch_bucket)."""
+        """Switch to (bind if new) a bucket (parity: bucketing_module.py
+        switch_bucket).
+
+        Modules are keyed by (bucket_key, batch shapes): a bucket that
+        emits more than one batch shape — BucketSentenceIter
+        batch_growth's plain-batch-size tail batches — gets one module
+        per shape, each compiled ONCE and reused across epochs.
+        (Reshaping a single per-bucket module instead would rebind and
+        recompile the executor every time the shape flips: reference
+        MXNet reshapes cheaply out of its shared memory pool, XLA
+        recompiles.)  Device-side parameters stay shared through the
+        default bucket's executor, exactly like ordinary bucket
+        switching."""
         assert self.binded, "call bind before switching bucket"
-        if bucket_key not in self._buckets:
+        key = (bucket_key, self._shape_sig(data_shapes))
+        if key not in self._buckets:
             symbol, data_names, label_names = self._call_sym_gen(bucket_key)
             module = Module(symbol, data_names, label_names, logger=self.logger,
                             context=self._context, work_load_list=self._work_load_list,
@@ -150,9 +175,9 @@ class BucketingModule(BaseModule):
                             state_names=self._state_names)
             module.bind(data_shapes, label_shapes, self._curr_module.for_training,
                         self._curr_module.inputs_need_grad, force_rebind=False,
-                        shared_module=self._buckets[self._default_bucket_key])
-            self._buckets[bucket_key] = module
-        self._curr_module = self._buckets[bucket_key]
+                        shared_module=self._default_module)
+            self._buckets[key] = module
+        self._curr_module = self._buckets[key]
         self._curr_bucket_key = bucket_key
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",

@@ -149,6 +149,14 @@ class DataParallelExecutorGroup:
                 grad_req[name] = self.grad_req_spec if isinstance(self.grad_req_spec, str) else (
                     self.grad_req_spec.get(name, "write")
                 )
+        if reshape and getattr(self, "execs", None):
+            # in-place executor reshape (Module.reshape / the forward
+            # auto-reshape path): Executor.reshape shares the parameter
+            # arrays and re-installs the fused single-dispatch updater —
+            # a fresh simple_bind here would silently disarm fusion and
+            # recompile from scratch
+            self.execs = [self.execs[0].reshape(**shape_kwargs)]
+            return
         shared_exec = shared_group.execs[0] if shared_group is not None else None
         exe = Executor.simple_bind(
             self.symbol, self.contexts[0], grad_req=grad_req, mesh=self.mesh,

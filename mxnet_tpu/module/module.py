@@ -188,6 +188,12 @@ class Module(BaseModule):
              grad_req="write"):
         """Bind executors (parity: module.py bind:333)."""
         if force_rebind:
+            if self.binded and self.params_initialized and self._params_dirty:
+                # pull trained values off the device before discarding the
+                # executor (same hazard reshape guards): the rebind below
+                # seeds the fresh executor from the HOST params, which go
+                # stale whenever update() ran outside fit's epoch sync
+                self._sync_params_from_devices()
             self._reset_bind()
         if self.binded:
             self.logger.warning("Already binded, ignoring bind()")
@@ -231,12 +237,65 @@ class Module(BaseModule):
             }
         if shared_module is not None and shared_module.optimizer_initialized:
             self.borrow_optimizer(shared_module)
+        if (self.optimizer_initialized and self._updater is not None
+                and not self._update_on_kvstore):
+            # binding with a live optimizer — a force_rebind on a trained
+            # Module (init_optimizer early-returns, e.g. fit(frozen_bn=
+            # True, force_rebind=True)) or a bucket module that just
+            # borrowed the shared updater above — must arm the fused
+            # single-dispatch update on the fresh executor; otherwise
+            # update() silently falls back to the multi-dispatch
+            # _update_params path (arming is name-keyed and idempotent)
+            self._maybe_install_fused_update()
 
     def _reset_bind(self):
         self.binded = False
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+
+    def _apply_frozen_bn(self, force_rebind=False):
+        """Swap in the frozen-BN symbol and pin its gamma/beta params
+        (the Module half of fit(frozen_bn=True); symbol.freeze_batchnorm
+        is the graph half).  Idempotent: a second frozen fit reuses the
+        transform; fit(frozen_bn=False) reverses it via
+        _unapply_frozen_bn — the mode is per-fit, not a one-way latch."""
+        from ..symbol import batchnorm_param_names, freeze_batchnorm
+
+        if getattr(self, "_bn_frozen", False):
+            return
+        if self.binded and not force_rebind:
+            raise MXNetError(
+                "fit(frozen_bn=True) on an already-bound Module: the "
+                "executor was compiled with trainable BN — pass "
+                "force_rebind=True (host-side param values carry over)")
+        self._pre_freeze_symbol = self._symbol
+        bn_params = batchnorm_param_names(self._symbol)
+        self._symbol = freeze_batchnorm(self._symbol)
+        self._frozen_bn_params = [n for n in bn_params
+                                  if n not in self._fixed_param_names]
+        self._fixed_param_names.extend(self._frozen_bn_params)
+        self._bn_frozen = True
+
+    def _unapply_frozen_bn(self, force_rebind=False):
+        """Reverse _apply_frozen_bn: restore the trainable-BN symbol and
+        un-pin the BN params, so fit(frozen_bn=False) after a frozen fit
+        really resumes normal training instead of silently keeping BN
+        frozen.  No-op on a Module that was never frozen (the normal fit
+        path calls this unconditionally)."""
+        if not getattr(self, "_bn_frozen", False):
+            return
+        if self.binded and not force_rebind:
+            raise MXNetError(
+                "fit(frozen_bn=False) on a Module frozen by an earlier "
+                "fit(frozen_bn=True): the executor was compiled with "
+                "frozen BN — pass force_rebind=True (host-side param "
+                "values carry over)")
+        self._symbol = self._pre_freeze_symbol
+        for n in self._frozen_bn_params:
+            self._fixed_param_names.remove(n)
+        self._frozen_bn_params = []
+        self._bn_frozen = False
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),), force_init=False):
@@ -306,6 +365,32 @@ class Module(BaseModule):
     # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        # reference parity (module.py forward:600): a batch whose shapes
+        # differ from the bound ones reshapes the executor instead of
+        # erroring.  (Bucketed flows rarely get here — BucketingModule
+        # keys a module per (bucket_key, batch shape) — this is for plain
+        # Modules fed variable shapes, e.g. a last partial batch.)  The
+        # reshape rides Executor.reshape (executor_group bind_exec
+        # reshape=True), which shares the parameter arrays and keeps the
+        # fused updater armed.
+        from ..io import desc_shape, redesc
+
+        curr_shapes = [desc_shape(d) for d in self._data_shapes]
+        new_shapes = [tuple(x.shape) for x in data_batch.data]
+        if curr_shapes != new_shapes:
+            if getattr(data_batch, "provide_data", None):
+                new_dshape = data_batch.provide_data
+            else:
+                new_dshape = [redesc(d, x) for d, x
+                              in zip(self._data_shapes, new_shapes)]
+            if getattr(data_batch, "provide_label", None):
+                new_lshape = data_batch.provide_label
+            elif self._label_shapes and data_batch.label:
+                new_lshape = [redesc(d, tuple(x.shape)) for d, x
+                              in zip(self._label_shapes, data_batch.label)]
+            else:
+                new_lshape = None
+            self.reshape(new_dshape, new_lshape)
         self._exec_group.forward(data_batch, is_train)
 
     def forward_backward(self, data_batch):
@@ -383,11 +468,19 @@ class Module(BaseModule):
         fused-capable optimizer, no kvstore round-trip, plain 'write'
         grad_req, no input grads (those need materialized grad_dict)."""
         exe = self._exec_group.execs[0]
+        # fixed params (fixed_param_names, e.g. frozen-BN gamma/beta) ride
+        # the fused dispatch as non-donated static args — grad_req 'null'
+        # for THOSE must not disarm the single-dispatch path; 'null' from
+        # any other source (and 'add'/'add'-like reqs) still does
+        fixed = set(self._fixed_param_names)
+        reqs = {n: exe._grad_req.get(n) for n in self._param_names}
         if (
             self._optimizer.fused_supported
             and self._kvstore is None
             and not self.inputs_need_grad
-            and all(exe._grad_req.get(n) == "write" for n in self._param_names)
+            and all(r == "write" or (r == "null" and n in fixed)
+                    for n, r in reqs.items())
+            and any(r == "write" for r in reqs.values())
             and exe._monitor_callback is None
         ):
             # updater state is keyed by NAME (same contract as

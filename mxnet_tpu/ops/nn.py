@@ -166,73 +166,208 @@ def _infer_conv(in_shapes, attrs):
     return shapes, [out]
 
 
-def _maybe_s2d_stem(data, weight, kernel, stride, pad, dilate, groups,
-                    layout):
-    """EXACT space-to-depth rewrite of the classic 7x7/stride-2/pad-3
-    stem conv (opt-in: MXNET_TPU_S2D_STEM=1).
+def _bf16_wgrad_active(kernel, data, weight):
+    """Whether the bf16 weight-grad accumulation path applies (opt-in:
+    MXTPU_BF16_WGRAD=1, small spatial kernels, floating inputs).
 
-    A C_in<=4 stem runs at ~12% MFU on the MXU (round-5 audit,
-    tools/mfu_decompose.py: 3 channels fill 3/128 contraction lanes at
-    224x224).  Factor-2 space-to-depth turns it into an equivalent
-    4x4/stride-1 conv on [H/2, W/2, 4*C_in]: input row 2Y+py folds into
-    channel c*4+py*2+px, and tap ky maps to (KY, py) via
-    py=(ky-3)%2, KY=(ky-3-py)//2+2 — a bijection over the 7 taps, so
-    the rewritten weights reproduce the original conv EXACTLY (the
-    (KY=0, py=0) slice stays zero).  Spatial padding becomes
-    (2,1)x(2,1) on the folded grid.  Returns None when the conv is not
-    that stem (or the flag is off)."""
+    The Inception-v3 training trace spends 27% of device time in f32
+    [C,C,k,k] weight-grad convolutions (BENCH_TABLE attribution): the
+    weight cotangent's cast back to the fp32 master dtype fuses into the
+    grad conv, forcing the slow f32-output MXU kernel.  Accumulating the
+    weight grad in bf16 (cast to master dtype AFTER the conv) keeps the
+    fast bf16 kernels reachable — README Roofline item 2 proved the HWIO
+    layouts keep them reachable; this flag actually takes them.  Gated to
+    small kernels (max dim <= 7: the 1x1/3x3/5x5/1x7/7x1 family the
+    attribution names) — large-kernel grads keep exact f32 accumulation.
+    Changes gradient NUMERICS (bf16 mantissa in the reduction): default
+    OFF, tolerance-pinned in tests/test_mfu_sinks.py."""
     from ..config import get as _cfg_get
 
-    if not _cfg_get("MXNET_TPU_S2D_STEM"):
-        return None
-    if (len(kernel) != 2 or tuple(kernel) != (7, 7)
-            or tuple(stride) != (2, 2) or tuple(pad) != (3, 3)
-            or tuple(dilate) != (1, 1) or groups != 1):
-        return None
+    from .. import telemetry
+
+    if not _cfg_get("MXTPU_BF16_WGRAD"):
+        if telemetry.enabled():
+            # unlatch: a conv traced with the flag OFF records the mode,
+            # so a run after an earlier bf16-wgrad run in the same
+            # process doesn't keep reporting wgrad_bf16=1
+            telemetry.set_gauge("ops.wgrad_bf16", 0)
+        return False
+    if max(kernel) > 7:
+        return False
+    if not (jnp.issubdtype(data.dtype, jnp.floating)
+            and jnp.issubdtype(weight.dtype, jnp.floating)):
+        return False
+    if telemetry.enabled():
+        # mode gauge (trace-time, once per compile): parse_log --telemetry
+        # renders it so a run's record says which grad numerics it used
+        telemetry.set_gauge("ops.wgrad_bf16", 1)
+    return True
+
+
+def _conv_call(data, weight, strides, padding, dilate, dn, groups, kernel):
+    """The one lax conv call both the direct and the space-to-depth paths
+    share: f32 inputs accumulate in f32 (preferred_element_type), and the
+    opt-in MXTPU_BF16_WGRAD path wraps the conv in a custom_vjp whose
+    WEIGHT gradient accumulates in bf16 (see _bf16_wgrad_active)."""
+    pet = jnp.float32 if data.dtype == jnp.float32 else None
+
+    def raw(d, w, p):
+        return lax.conv_general_dilated(
+            d, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=groups, preferred_element_type=p)
+
+    if not _bf16_wgrad_active(kernel, data, weight):
+        return raw(data, weight, pet)
+
+    @jax.custom_vjp
+    def conv(d, w):
+        return raw(d, w, pet)
+
+    def conv_fwd(d, w):
+        return raw(d, w, pet), (d, w)
+
+    def conv_bwd(res, g):
+        d, w = res
+        # data grad: EXACT same numerics as the uncustomized conv (the
+        # activation grad feeds the rest of the backward chain — only the
+        # weight grad, a leaf, tolerates the cheaper accumulation)
+        _, vjp_d = jax.vjp(lambda dd: raw(dd, w, pet), d)
+        (dd,) = vjp_d(g)
+        # weight grad: bf16 inputs + preferred_element_type=bf16 so JAX's
+        # conv transpose emits a bf16-accumulating grad kernel; cast to
+        # the master dtype AFTER the conv (not fused into it)
+        d16 = d.astype(jnp.bfloat16)
+        _, vjp_w = jax.vjp(lambda ww: raw(d16, ww, jnp.bfloat16),
+                           w.astype(jnp.bfloat16))
+        (dw,) = vjp_w(g.astype(jnp.bfloat16))
+        return dd, dw.astype(w.dtype)
+
+    conv.defvjp(conv_fwd, conv_bwd)
+    return conv(data, weight)
+
+
+def _s2d_fold_dim(k, p, size, out):
+    """Per-dimension tap bijection of the factor-2 fold of a stride-2
+    conv: original tap ky at pad p maps to parity py = (ky - p) % 2 and
+    folded tap KY = floor((ky - p) / 2) — injective, since (KY, py)
+    recovers ky = 2*KY + py + p.  Returns (py[k], shifted KY[k], folded
+    kernel size, folded (lo, hi) padding, folded input size)."""
+    import numpy as _onp
+
+    ks = _onp.arange(k)
+    py = (ks - p) % 2
+    KY = (ks - p - py) // 2
+    kmin, kmax = int(KY.min()), int(KY.max())
+    kf = kmax - kmin + 1
+    lo = -kmin
+    folded = (size + 1) // 2
+    hi = out - 1 + kf - lo - folded
+    return py, KY - kmin, kf, (lo, hi), folded
+
+
+def space_to_depth_stem(data, weight, kernel, stride, pad, dilate=(1, 1),
+                        groups=1, layout=None):
+    """EXACT factor-2 space-to-depth rewrite of a 2-D stride-2 conv.
+
+    A C_in<=4 stem conv runs at ~12% MFU on the MXU (round-5 audit,
+    tools/mfu_decompose.py: 3 channels fill 3/128 contraction lanes).
+    Folding factor-2 space-to-depth turns a [H, W, C] x (ky, kx)/s2 conv
+    into an equivalent stride-1 conv on [ceil(H/2), ceil(W/2), 4*C]:
+    input row 2Y+py folds into channel c*4 + py*2 + px, and each tap ky
+    maps to (KY, py) per _s2d_fold_dim — a bijection over the taps, so
+    the rewritten weights reproduce the original conv EXACTLY (slots no
+    tap maps to stay zero).  Odd H/W zero-pad up to even first; any
+    folded tap that could read the parity row carries a zero weight, so
+    exactness holds for odd inputs too (e.g. Inception-v3's 299x299
+    3x3/s2/p0 stem, not just ResNet's even 224x224 7x7/s2/p3).
+
+    Raises ValueError on configurations the fold cannot express (not
+    2-D, stride != 2, dilation != 1, or grouped) — callers that merely
+    probe eligibility use _maybe_s2d_stem, which gates instead of
+    raising."""
+    kernel = tuple(int(x) for x in kernel)
+    if len(kernel) != 2:
+        raise ValueError(
+            "space_to_depth_stem: only 2-D convolutions fold (kernel %s)"
+            % (kernel,))
+    if tuple(int(s) for s in stride) != (2, 2):
+        raise ValueError(
+            "space_to_depth_stem: the factor-2 fold requires stride "
+            "(2, 2), got %s" % (tuple(stride),))
+    if tuple(int(d) for d in dilate) != (1, 1):
+        raise ValueError(
+            "space_to_depth_stem: dilation is not supported (got %s)"
+            % (tuple(dilate),))
+    if int(groups) != 1:
+        raise ValueError(
+            "space_to_depth_stem: grouped convolutions do not fold "
+            "(num_group=%d)" % int(groups))
+    import numpy as _onp
+
     last = _channel_last(layout)
     N = data.shape[0]
     if last:
         H, W, C = data.shape[1], data.shape[2], data.shape[3]
     else:
         C, H, W = data.shape[1], data.shape[2], data.shape[3]
-    if C > 4 or H % 2 or W % 2:
-        return None
-    # tap bijection: ky -> (KY, py)
-    import numpy as _onp
-
-    ks = _onp.arange(7)
-    ps = (ks - 3) % 2
-    Ks = (ks - 3 - ps) // 2 + 2
-    iky, ikx = _onp.meshgrid(ks, ks, indexing="ij")
-    KYa = Ks[iky].reshape(-1)
-    KXa = Ks[ikx].reshape(-1)
-    pypx = (ps[iky] * 2 + ps[ikx]).reshape(-1)           # [49]
-    ch = (_onp.arange(C)[None, :] * 4 + pypx[:, None])   # [49, C]
+    (ky, kx), (py_, px_) = kernel, (int(pad[0]), int(pad[1]))
+    oy = _conv_out_dim(H, ky, 2, py_, 1)
+    ox = _conv_out_dim(W, kx, 2, px_, 1)
+    pyv, KYs, kfy, pady, Y = _s2d_fold_dim(ky, py_, H, oy)
+    pxv, KXs, kfx, padx, X = _s2d_fold_dim(kx, px_, W, ox)
+    if H % 2 or W % 2:
+        spatial_pad = ((0, H % 2), (0, W % 2))
+        widths = ((0, 0),) + (spatial_pad + ((0, 0),) if last
+                              else ((0, 0),) + spatial_pad)
+        data = jnp.pad(data, widths)
+    iky, ikx = _onp.meshgrid(_onp.arange(ky), _onp.arange(kx),
+                             indexing="ij")
+    KYa = KYs[iky].reshape(-1)
+    KXa = KXs[ikx].reshape(-1)
+    pypx = (pyv[iky] * 2 + pxv[ikx]).reshape(-1)         # [ky*kx]
+    ch = (_onp.arange(C)[None, :] * 4 + pypx[:, None])   # [ky*kx, C]
     if last:
         # x: [N,H,W,C] -> [N,Y,X,C*4] with channel c*4 + py*2 + px
-        x2 = data.reshape(N, H // 2, 2, W // 2, 2, C)
-        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, H // 2, W // 2,
-                                                    C * 4)
+        x2 = data.reshape(N, Y, 2, X, 2, C)
+        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, Y, X, C * 4)
         O = weight.shape[3]                               # HWIO
-        taps = weight[iky.reshape(-1), ikx.reshape(-1)]   # [49, C, O]
-        w2 = jnp.zeros((4, 4, C * 4, O), weight.dtype)
+        taps = weight[iky.reshape(-1), ikx.reshape(-1)]   # [ky*kx, C, O]
+        w2 = jnp.zeros((kfy, kfx, C * 4, O), weight.dtype)
         w2 = w2.at[KYa[:, None], KXa[:, None], ch].set(taps)
     else:
         # x: [N,C,H,W] -> [N,C*4,Y,X]
-        x2 = data.reshape(N, C, H // 2, 2, W // 2, 2)
-        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, H // 2,
-                                                    W // 2)
+        x2 = data.reshape(N, C, Y, 2, X, 2)
+        x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, Y, X)
         O = weight.shape[0]                               # OIHW
-        taps = weight[:, :, iky.reshape(-1), ikx.reshape(-1)]  # [O,C,49]
-        taps = taps.transpose(2, 1, 0)                    # [49, C, O]
-        w2 = jnp.zeros((4, 4, C * 4, O), weight.dtype)
+        taps = weight[:, :, iky.reshape(-1), ikx.reshape(-1)]  # [O,C,n]
+        taps = taps.transpose(2, 1, 0)                    # [n, C, O]
+        w2 = jnp.zeros((kfy, kfx, C * 4, O), weight.dtype)
         w2 = w2.at[KYa[:, None], KXa[:, None], ch].set(taps)
         w2 = w2.transpose(3, 2, 0, 1)                     # -> OIHW
-    return lax.conv_general_dilated(
-        x2, w2, window_strides=(1, 1), padding=((2, 1), (2, 1)),
-        dimension_numbers=_conv_dn(layout, 2),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.float32
-        else None)
+    return _conv_call(x2, w2, strides=(1, 1), padding=(pady, padx),
+                      dilate=(1, 1), dn=_conv_dn(layout, 2), groups=1,
+                      kernel=(kfy, kfx))
+
+
+def _maybe_s2d_stem(data, weight, kernel, stride, pad, dilate, groups,
+                    layout):
+    """Eligibility gate for the opt-in stem rewrite (MXNET_TPU_S2D_STEM=1):
+    folds any 2-D stride-2 C_in<=4 undilated ungrouped conv via
+    space_to_depth_stem; returns None (caller runs the direct conv) for
+    everything else or when the flag is off."""
+    from ..config import get as _cfg_get
+
+    if not _cfg_get("MXNET_TPU_S2D_STEM"):
+        return None
+    if (len(kernel) != 2 or tuple(stride) != (2, 2)
+            or tuple(dilate) != (1, 1) or groups != 1):
+        return None
+    c_in = data.shape[3] if _channel_last(layout) else data.shape[1]
+    if c_in > 4:
+        return None
+    return space_to_depth_stem(data, weight, kernel, stride, pad,
+                               dilate=dilate, groups=groups, layout=layout)
 
 
 @register("Convolution", inputs=("data", "weight", "bias"), infer_shape=_infer_conv,
@@ -276,16 +411,9 @@ def convolution(
     out = _maybe_s2d_stem(data, weight, kernel, stride, p, dilate,
                           int(_lit(num_group)), layout)
     if out is None:
-        out = lax.conv_general_dilated(
-            data,
-            weight,
-            window_strides=stride,
-            padding=pairs,
-            rhs_dilation=dilate,
-            dimension_numbers=dn,
-            feature_group_count=int(_lit(num_group)),
-            preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None,
-        )
+        out = _conv_call(data, weight, strides=stride, padding=pairs,
+                         dilate=dilate, dn=dn,
+                         groups=int(_lit(num_group)), kernel=kernel)
     if bias is not None and not _bool(no_bias):
         if _channel_last(layout):
             out = out + bias  # C is minormost: plain broadcast

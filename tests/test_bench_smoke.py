@@ -64,6 +64,36 @@ def test_bench_imperative_fuses_the_chain():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("sink", ["s2d_stem", "bf16_wgrad", "lstm_pack",
+                                  "frozen_bn"])
+def test_bench_ab_smoke_runs_both_sides(sink):
+    """bench.py --ab <sink> --smoke: the matched A/B harness for the four
+    attributed MFU sinks (docs/perf.md "MFU sinks") runs both sides
+    back-to-back in one process on CPU and emits one JSON row with both
+    values, per-side stdev, and the delta — so every README Roofline
+    item-8 entry stays reproducible with one command."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXNET_TPU_S2D_STEM", "MXTPU_BF16_WGRAD",
+                 "MXTPU_FROZEN_BN"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ab", sink,
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == sink and out["smoke"] is True
+    assert out["unit"] == ("tokens/s" if sink == "lstm_pack" else "img/s")
+    for side in ("a", "b"):
+        assert out[side]["value"] > 0
+        assert out[side]["stdev"] >= 0
+    # the delta is computed from the sides it reports
+    expect = round((out["b"]["value"] - out["a"]["value"])
+                   / out["a"]["value"] * 100.0, 2)
+    assert abs(out["delta_pct"] - expect) < 0.05
+
+
+@pytest.mark.slow
 def test_bench_smoke_honors_k_flag():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(

@@ -37,9 +37,13 @@ def _ids(findings):
 # ----------------------------------------------------------------------
 
 def test_repo_is_lint_clean():
-    """`python -m tools.analysis mxnet_tpu` must exit 0: every finding
-    fixed or allowlisted with a justification (docs/engine.md)."""
-    findings, suppressed, errors = run_paths([os.path.join(ROOT, "mxnet_tpu")])
+    """`python -m tools.analysis mxnet_tpu bench.py` must exit 0: every
+    finding fixed or allowlisted with a justification (docs/engine.md).
+    bench.py is in the sweep because its A/B harness (`--ab`) toggles
+    framework env vars — an unregistered read there would ship an
+    undocumented knob just like one inside the package."""
+    findings, suppressed, errors = run_paths([os.path.join(ROOT, "mxnet_tpu"),
+                                              os.path.join(ROOT, "bench.py")])
     assert not errors, errors
     assert not findings, "\n".join(str(f) for f in findings)
     # the allowlist is in use and every entry carries its justification
@@ -450,6 +454,56 @@ def test_w103_flags_only_undocumented_framework_vars(tmp_path):
     findings, _, _ = _lint_src(tmp_path, W103_READS, config_src=W103_CONFIG)
     assert _ids(findings) == ["W103"]
     assert "MXTPU_SECRET_KNOB" in findings[0].message
+
+
+# the MFU-sink knobs (docs/perf.md "MFU sinks"): reads are W103 findings
+# unless the registry declares them — pinned per knob so dropping a
+# registration (or reading a knob the registry never gained) fails tier-1
+SINK_KNOB_READS = """
+import os
+a = os.environ.get("MXTPU_BF16_WGRAD")
+b = os.environ.get("MXTPU_FROZEN_BN")
+c = os.environ.get("MXNET_TPU_S2D_STEM")
+"""
+
+SINK_KNOB_CONFIG = """
+EnvVar = None
+REGISTRY = [EnvVar("MXTPU_BF16_WGRAD", int, 0, "bf16 wgrad"),
+            EnvVar("MXTPU_FROZEN_BN", int, 0, "frozen-BN fit default"),
+            EnvVar("MXNET_TPU_S2D_STEM", int, 0, "s2d stem fold")]
+ABSORBED = {}
+"""
+
+
+def test_w103_sink_knobs_must_be_registered(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, SINK_KNOB_READS)
+    assert _ids(findings) == ["W103", "W103", "W103"]
+    hit = "\n".join(f.message for f in findings)
+    for name in ("MXTPU_BF16_WGRAD", "MXTPU_FROZEN_BN",
+                 "MXNET_TPU_S2D_STEM"):
+        assert name in hit
+
+
+def test_w103_sink_knobs_clean_when_registered(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, SINK_KNOB_READS,
+                               config_src=SINK_KNOB_CONFIG)
+    assert findings == []
+
+
+def test_sink_knobs_registered_in_real_config():
+    """The real registry declares every MFU-sink knob (so the generated
+    env_var.md documents them and W103 lets framework reads through)."""
+    import ast
+
+    cfg = os.path.join(ROOT, "mxnet_tpu", "config.py")
+    with open(cfg, "rb") as f:
+        tree = ast.parse(f.read().decode("utf-8"))
+    names = {n.args[0].value for n in ast.walk(tree)
+             if isinstance(n, ast.Call) and getattr(n.func, "id", "") == "EnvVar"
+             and n.args and isinstance(n.args[0], ast.Constant)}
+    for knob in ("MXTPU_BF16_WGRAD", "MXTPU_FROZEN_BN",
+                 "MXNET_TPU_S2D_STEM"):
+        assert knob in names, knob
 
 
 # ----------------------------------------------------------------------

@@ -171,9 +171,15 @@ def test_bucketing_module():
         mod.backward()
         mod.update()
         assert mod.get_outputs()[0].shape == (8, 3)
-    # parameters are shared across bucket executors (reference shared_exec)
-    default_exec = mod._buckets[20]._exec_group.execs[0]
-    small_exec = mod._buckets[10]._exec_group.execs[0]
+    # parameters are shared across bucket executors (reference shared_exec);
+    # _buckets is keyed by (bucket_key, batch shapes) so a bucket emitting
+    # several batch shapes compiles one executor per shape
+    def _bucket_exec(key):
+        (m,) = [m for (k, _), m in mod._buckets.items() if k == key]
+        return m._exec_group.execs[0]
+
+    default_exec = _bucket_exec(20)
+    small_exec = _bucket_exec(10)
     assert default_exec.arg_dict["fc_shared_weight"] is small_exec.arg_dict["fc_shared_weight"]
 
 

@@ -111,6 +111,10 @@ def parse_telemetry(lines):
             "lazy_flushes": lazy_flushes if has_lazy else None,
             "chain_mean": chain_mean,
             "fusion_hit_pct": fusion_hit_pct,
+            # mode gauges (docs/perf.md "MFU sinks"): which grad/BN
+            # numerics the run used — '-' for records that predate them
+            "wgrad_bf16": gauges.get("ops.wgrad_bf16"),
+            "frozen_bn": gauges.get("module.frozen_bn"),
         })
     return rows
 
@@ -118,7 +122,7 @@ def parse_telemetry(lines):
 _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "mfu", "dispatches", "cache_hits", "cache_misses",
                    "io_wait_p50", "h2d_bytes", "lazy_flushes", "chain_mean",
-                   "fusion_hit_pct"]
+                   "fusion_hit_pct", "wgrad_bf16", "frozen_bn"]
 
 
 def _print_telemetry(rows, fmt):
