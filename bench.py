@@ -51,6 +51,24 @@ def parse_args():
                         "elementwise NDArray chain, lazy fusion vs "
                         "MXTPU_LAZY=0 eager — reports ops/s, dispatch "
                         "counts, and fusion-cache hit rate")
+    p.add_argument("--serve", action="store_true",
+                   help="serving load driver (docs/serving.md): a mixed "
+                        "ResNet-50/152 two-tenant ModelServer on one "
+                        "device, closed- or open-loop clients, reporting "
+                        "img/s + p50/p99 latency + batch-fill ratio at "
+                        "the stated offered load.  With --smoke: tiny "
+                        "CPU tenants through the identical path "
+                        "(tests/test_bench_smoke.py)")
+    p.add_argument("--clients", type=int, default=4,
+                   help="--serve closed loop: concurrent clients per "
+                        "tenant (default 4)")
+    p.add_argument("--offered-load", type=float, default=0.0,
+                   help="--serve: target aggregate request rate in "
+                        "req/s (open loop); 0 = closed loop driven by "
+                        "--clients")
+    p.add_argument("--requests", type=int, default=None,
+                   help="--serve: total timed requests across tenants "
+                        "(default: 96 smoke / 512 full)")
     p.add_argument("--ab", choices=sorted(AB_SINKS),
                    help="matched A/B of one attributed MFU sink "
                         "(docs/perf.md 'MFU sinks'): runs the before/"
@@ -103,6 +121,8 @@ def _fence(mod, name):
 
 def main():
     args = parse_args()
+    if args.serve:
+        return serve(args)
     if args.ab:
         return ab(args)
     if args.smoke:
@@ -656,6 +676,216 @@ def smoke(args):
         "telemetry_stage_occupancy_seen": stage_seen,
         "telemetry_mfu": snap["gauges"].get("module.mfu"),
     }))
+
+
+# ----------------------------------------------------------------------
+# --serve: the serving load driver (docs/serving.md).  Two tenants share
+# one device behind serving.ModelServer; clients drive it closed-loop
+# (each submits its next request when the previous completes — the
+# throughput-seeking shape) or open-loop (--offered-load R: requests
+# arrive on a fixed schedule regardless of completions — the tail-
+# latency-honest shape, since a slow server cannot slow its own arrival
+# process).  Every ladder bucket is compiled during warmup, telemetry is
+# reset, and the timed window must run compile-free — the row reports
+# img/s, p50/p99 from the serving.request_seconds histogram, and the
+# exact batch-fill ratio from the slots-used/padded counters.
+# ----------------------------------------------------------------------
+
+
+def _hist_q(hist, q):
+    """Quantile from a telemetry fixed-bucket histogram snapshot — THE
+    parse_log math (one implementation; the bench row and the rendered
+    telemetry table must never disagree on what p99 means)."""
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.parse_log import _hist_quantile
+
+    return _hist_quantile(hist, q)
+
+
+def _serve_predictor(mx, net, sample_shape, ctx):
+    """Predictor from a fresh randomly-initialized checkpoint of `net`
+    (bound at batch 1; the server rebinds per bucket through the
+    predictor's signature cache)."""
+    mod = mx.mod.Module(net, context=ctx)
+    mod.bind(data_shapes=[("data", (1,) + sample_shape)], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    arg, aux = mod.get_params()
+    params = {"arg:%s" % k: v for k, v in arg.items()}
+    params.update({"aux:%s" % k: v for k, v in aux.items()})
+    return mx.Predictor(net, params, {"data": (1,) + sample_shape}, ctx=ctx)
+
+
+def serve(args):
+    import threading
+
+    if args.smoke:
+        # must win over any site TPU default BEFORE jax is first imported
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    # like --smoke, this harness asserts its own instrumentation
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+    if args.smoke:
+        def tiny(hidden, classes, seed):
+            mx.random.seed(seed)
+            d = mx.sym.Variable("data")
+            h = mx.sym.Activation(
+                mx.sym.FullyConnected(d, num_hidden=hidden, name="fc1"),
+                act_type="relu")
+            return mx.sym.SoftmaxOutput(
+                mx.sym.FullyConnected(h, num_hidden=classes, name="fc2"),
+                name="softmax")
+
+        sample, ctx = (12,), mx.cpu()
+        nets = {"small": tiny(16, 5, 0), "big": tiny(32, 7, 1)}
+        max_batch, wait_ms = 8, 5.0
+        total = args.requests or 96
+    else:
+        from mxnet_tpu.models.resnet import resnet
+
+        sample, ctx = (224, 224, 3), mx.tpu()
+        nets = {"resnet50": resnet(50, layout="NHWC"),
+                "resnet152": resnet(152, layout="NHWC")}
+        max_batch = args.batch or 32
+        wait_ms = None  # registered default
+        total = args.requests or 512
+
+    server = mx.serving.ModelServer(
+        {name: _serve_predictor(mx, net, sample, ctx)
+         for name, net in nets.items()},
+        max_batch=max_batch, wait_ms=wait_ms)
+    tenants = server.tenants
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(*sample).astype("float32") for _ in range(16)]
+
+    # warmup: compile every (tenant, bucket) program deterministically
+    # (one synchronous dummy fill each — not via submit(), whose fill
+    # grouping depends on batching-window timing) so the timed window
+    # below is provably compile-free
+    server.warmup()
+    telemetry.reset()
+    miss0 = telemetry.counter_value("executor.compile_cache_misses")
+
+    # failures (timeouts past deadline, admission rejections under
+    # overload) are the MEASUREMENT in an overload run, not a crash:
+    # count them and report them in the row
+    failed = [0]
+    fail_lock = threading.Lock()
+
+    def _await(f):
+        try:
+            f.result(timeout=600)
+        except Exception:
+            with fail_lock:
+                failed[0] += 1
+
+    per_tenant = total // len(tenants)
+    futs, t0 = [], time.time()
+    if args.offered_load > 0:
+        # open loop: fixed arrival schedule, round-robin over tenants —
+        # arrivals never slow down because the server is slow, which is
+        # exactly why overload must surface as counted failures here
+        interval = 1.0 / args.offered_load
+        for i in range(per_tenant * len(tenants)):
+            at = t0 + i * interval
+            delay = at - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futs.append(server.submit(tenants[i % len(tenants)],
+                                          {"data": xs[i % len(xs)]}))
+            except Exception:
+                with fail_lock:
+                    failed[0] += 1
+        for f in futs:
+            _await(f)
+    else:
+        # closed loop: --clients concurrent clients per tenant
+        def client(tenant, n):
+            for i in range(n):
+                try:
+                    _await(server.submit(tenant, {"data": xs[i % len(xs)]}))
+                except Exception:
+                    with fail_lock:
+                        failed[0] += 1
+
+        threads = []
+        # ceil: round UP so --requests is a floor, never silently cut
+        n_per_client = max(1, -(-per_tenant // args.clients))
+        for t in tenants:
+            for _ in range(args.clients):
+                th = threading.Thread(target=client, args=(t, n_per_client))
+                th.start()
+                threads.append(th)
+        for th in threads:
+            th.join()
+    elapsed = time.time() - t0
+    server.close()
+
+    snap = telemetry.snapshot()
+    counters, gauges = snap["counters"], snap["gauges"]
+    used = counters.get("serving.batch_slots_used", 0)
+    padded = counters.get("serving.batch_slots_padded", 0)
+    fill_pct = 100.0 * used / (used + padded) if (used + padded) else None
+    lat = snap["histograms"].get("serving.request_seconds", {})
+    compile_misses = (telemetry.counter_value("executor.compile_cache_misses")
+                      - miss0)
+    completed = counters.get("serving.requests", 0)
+    mode = "open" if args.offered_load > 0 else "closed"
+    row = {
+        "metric": "serving img/s, %d-tenant %s-loop continuous batching "
+                  "(%s)" % (len(tenants), mode,
+                            "tiny CPU smoke" if args.smoke
+                            else "ResNet-50+152, 1 chip"),
+        "value": round(completed / elapsed, 2),
+        "unit": "img/s",
+        "mode": mode,
+        "offered_load": round(args.offered_load
+                              or completed / elapsed, 2),
+        "p50_ms": round(_hist_q(lat, 0.5) * 1e3, 3) if lat.get("count") else None,
+        "p99_ms": round(_hist_q(lat, 0.99) * 1e3, 3) if lat.get("count") else None,
+        "fill_pct": round(fill_pct, 2) if fill_pct is not None else None,
+        "dispatches": counters.get("serving.dispatches", 0),
+        "requests": completed,
+        "failed": failed[0],
+        "timeouts": counters.get("serving.timeouts", 0),
+        "compile_misses_timed": compile_misses,
+        "queue_depth_seen": gauges.get("serving.queue_depth") is not None,
+        "max_batch": max_batch,
+        "ladder": list(server.ladder),
+        "tenants": {
+            t: {"requests": counters.get("serving.requests.%s" % t, 0),
+                "p99_ms": round(_hist_q(
+                    snap["histograms"].get(
+                        "serving.request_seconds.%s" % t, {}), 0.99) * 1e3, 3)
+                if snap["histograms"].get(
+                    "serving.request_seconds.%s" % t, {}).get("count")
+                else None}
+            for t in tenants},
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        # the CI pins (tests/test_bench_smoke.py) start here: the
+        # instrumentation must have seen the run, the timed window must
+        # be compile-free, and nobody may have timed out
+        assert row["fill_pct"] and row["fill_pct"] > 0, counters
+        assert row["p99_ms"] and row["p99_ms"] > 0, snap["histograms"]
+        assert row["timeouts"] == 0, counters
+        assert row["failed"] == 0, "smoke run dropped requests"
+        assert compile_misses == 0, "timed window recompiled"
+        assert row["queue_depth_seen"], gauges
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

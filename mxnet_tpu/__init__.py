@@ -43,6 +43,7 @@ from .kvstore import KVStore
 from . import callback
 from . import predict
 from .predict import Predictor
+from . import serving
 from . import image
 from . import rtc
 from . import config

@@ -115,6 +115,34 @@ REGISTRY = [
            "the graph even without a sync point, bounding host memory "
            "held by deferred operands and compile time of the fused "
            "program (lazy.py)"),
+    # ---- inference serving (serving/; docs/serving.md) ----
+    EnvVar("MXTPU_SERVE_MAX_BATCH", int, 32,
+           "serving.ModelServer: largest batch bucket the continuous "
+           "batcher packs requests into (the top of the bucket ladder). "
+           "One forward program is compiled per (tenant, bucket) and "
+           "reused across every later fill"),
+    EnvVar("MXTPU_SERVE_BUCKETS", str, "",
+           "Comma-separated batch-bucket ladder for the continuous "
+           "batcher (e.g. '1,2,4,8,16'); empty = powers of two up to "
+           "MXTPU_SERVE_MAX_BATCH. A fill is padded up to the smallest "
+           "bucket that holds it, so compiled-program count stays "
+           "O(len(ladder)) instead of one per observed batch size"),
+    EnvVar("MXTPU_SERVE_TIMEOUT_MS", float, 5000.0,
+           "Default per-request deadline: a request still queued this "
+           "many ms after submit() fails with a timeout error instead "
+           "of being dispatched (ModelServer.submit(timeout_ms=) "
+           "overrides per call). Counted in serving.timeouts"),
+    EnvVar("MXTPU_SERVE_MAX_QUEUE", int, 1024,
+           "Admission control: submit() raises instead of enqueueing "
+           "when this many requests are already pending across all "
+           "tenants (bounds queue memory and tail latency; rejected "
+           "requests count in serving.rejected)"),
+    EnvVar("MXTPU_SERVE_WAIT_MS", float, 2.0,
+           "Continuous-batcher batching window: a tenant's queue head "
+           "may wait this many ms for more requests to arrive before "
+           "the batcher dispatches a partial fill (a full "
+           "MXTPU_SERVE_MAX_BATCH dispatches immediately). Larger = "
+           "better fill ratio, worse p99 under light load"),
     # ---- telemetry (telemetry.py; docs/observability.md) ----
     EnvVar("MXTPU_TELEMETRY", int, 1,
            "Metrics registry (telemetry.py): counters/gauges/histograms "

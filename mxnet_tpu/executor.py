@@ -664,6 +664,67 @@ class Executor:
         return self._outputs_cache
 
     # ------------------------------------------------------------------
+    # serving dispatch: a forward-only program whose batch inputs are a
+    # separate (donated) leading argument — the continuous batcher
+    # (serving/) stages a padded request batch to device and calls this
+    # directly, so no NDArray arg_dict mutation sits on the hot path and
+    # the staged input buffer is recycled by XLA the moment the fill's
+    # compute consumes it (the "ping-pong donated buffer" half of the
+    # serving pipeline; docs/serving.md)
+    # ------------------------------------------------------------------
+    def serve_program(self, input_names):
+        """Jitted inference program `fn(input_vals, other_vals, aux_vals,
+        seed) -> outputs` with `input_names` gathered into the donated
+        leading tuple and every remaining argument (params, dead label
+        args) in `other_vals`.  Cached in the executor's jit cache under
+        the input-name signature, so a (tenant, bucket) program compiles
+        ONCE and every later fill is a cache hit (counted in
+        executor.compile_cache_hits/_misses like the training paths)."""
+        names = tuple(input_names)
+        key = ("serve", names)
+        self._note_compile_cache(key in self._jit_fwd)
+        if key not in self._jit_fwd:
+            an = self._arg_names
+            missing = [n for n in names if n not in an]
+            if missing:
+                raise MXNetError("serve_program: unknown inputs %s" % missing)
+            in_idx = [an.index(n) for n in names]
+            other_idx = [i for i in range(len(an)) if i not in set(in_idx)]
+            entries, order, xn = self._entries, self._order, self._aux_names
+            boundary, cast, mesh = self._boundary(), self._cast(), self._mesh
+
+            def f(input_vals, other_vals, aux_vals, seed):
+                vals = [None] * len(an)
+                for i, v in zip(in_idx, input_vals):
+                    vals[i] = v
+                for i, v in zip(other_idx, other_vals):
+                    vals[i] = v
+                rng = jax.random.key(seed)
+                outs, _aux = _run_graph(entries, order, an, xn, tuple(vals),
+                                        aux_vals, False, rng,
+                                        boundary=boundary, cast=cast,
+                                        mesh=mesh)
+                return outs
+
+            # donation is a TPU/GPU memory optimization; XLA:CPU does not
+            # implement it and would warn on every dispatch — gate on
+            # THIS executor's device, not the process default backend
+            # (a host-side predictor may serve beside a TPU trainer)
+            platform = self._first_ctx.jax_device().platform
+            donate = (0,) if platform != "cpu" else ()
+            self._jit_fwd[key] = jax.jit(f, donate_argnums=donate)
+        return self._jit_fwd[key]
+
+    def serve_args(self, input_names):
+        """(other_vals, aux_vals) companions for :meth:`serve_program` —
+        parameter/aux device refs gathered at dispatch time (cheap, and
+        picks up params written between fills)."""
+        names = set(input_names)
+        other = tuple(self.arg_dict[n].data for n in self._arg_names
+                      if n not in names)
+        return other, self._gather_aux()
+
+    # ------------------------------------------------------------------
     # single-dispatch training step (fwd + bwd + optimizer update in ONE
     # XLA executable with donated param/state buffers — the reference's
     # bulk-exec + update_on_kvstore taken to its limit)

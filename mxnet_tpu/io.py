@@ -23,7 +23,7 @@ from .ndarray import NDArray, array
 __all__ = [
     "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
     "PrefetchingIter", "DeviceStagedIter", "StagedBlock", "MNISTIter",
-    "CSVIter", "ImageRecordIter", "ImageDetRecordIter",
+    "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "stage_put",
 ]
 
 
@@ -414,6 +414,26 @@ class StagedBlock:
         self.pad = pad
 
 
+def stage_put(name, arr, place_fn=None):
+    """Count and place ONE stacked host input — the H2D half shared by
+    the training staging pipeline (DeviceStagedIter blocks) and the
+    serving continuous batcher (request batches, serving/session.py):
+    the staged bytes land in the same `io.stage_bytes` /
+    `io.stage_block_bytes` books either way, so "is the host feeding
+    the device in big-enough transfers" has one answer across both
+    pipelines.  `place_fn(name, arr)` does the actual device placement;
+    None keeps the array host-side."""
+    from . import telemetry
+
+    if telemetry.enabled():
+        telemetry.inc("io.stage_bytes", int(arr.nbytes))
+        # size DISTRIBUTION too: whether transfers are big enough to
+        # amortize the per-transfer overhead is a bucket question
+        telemetry.observe("io.stage_block_bytes", int(arr.nbytes),
+                          buckets=telemetry.BYTE_BUCKETS)
+    return place_fn(name, arr) if place_fn is not None else arr
+
+
 class DeviceStagedIter(DataIter):
     """Async device staging: groups K batches from `data_iter` into one
     stacked StagedBlock and `jax.device_put`s it from a BACKGROUND engine
@@ -514,20 +534,9 @@ class DeviceStagedIter(DataIter):
             return _np.asarray(a)
 
         def stack_put(names, rows):
-            out = []
-            for i, name in enumerate(names):
-                arr = _np.stack([host(b[i]) for b in rows])
-                if telemetry.enabled():
-                    telemetry.inc("io.stage_bytes", int(arr.nbytes))
-                    # size DISTRIBUTION too: one stacked input's bytes —
-                    # whether blocks are big enough to amortize the
-                    # per-transfer overhead is a bucket question
-                    telemetry.observe("io.stage_block_bytes",
-                                      int(arr.nbytes),
-                                      buckets=telemetry.BYTE_BUCKETS)
-                out.append(self._place_fn(name, arr)
-                           if self._place_fn is not None else arr)
-            return out
+            return [stage_put(name, _np.stack([host(b[i]) for b in rows]),
+                              self._place_fn)
+                    for i, name in enumerate(names)]
 
         data_names = self._names(self.provide_data)
         data = stack_put(data_names, [b.data for b in batches])

@@ -23,6 +23,14 @@ from .context import cpu, current_context
 
 __all__ = ["Predictor"]
 
+# bound on the per-Predictor executor-signature cache: covers every
+# realistic serving bucket ladder (≤ ~10 signatures) and reshape
+# ping-pong with room to spare, while a pathological caller reshaping
+# to per-request-unique shapes evicts oldest-first instead of retaining
+# one bound executor (device buffers + jitted programs) per shape
+# forever (the lazy.py _FUSION_CACHE_CAP discipline)
+_EXEC_CACHE_CAP = 32
+
 
 class Predictor:
     """One bound inference session (reference PredictorHandle)."""
@@ -55,7 +63,19 @@ class Predictor:
                 self._aux_params[k[4:]] = v
             else:  # plain names accepted too
                 self._arg_params[k] = v
-        self._bind(dict(input_shapes), type_dict)
+        # executors cached by input-shape signature: reshape() and the
+        # serving bucket ladder (serving/session.py) re-bind the SAME
+        # graph at many batch sizes, and each signature's executor (and
+        # its compiled programs) must be built once, not per visit
+        self._exec_cache = {}
+        # executor_for may be called from several serving threads (a
+        # warmup racing the batcher): the check-then-build-then-evict
+        # sequence must be atomic or the same signature binds twice
+        import threading
+
+        self._cache_lock = threading.Lock()
+        self._type_dict = dict(type_dict) if type_dict else None
+        self._bind(dict(input_shapes))
 
     @classmethod
     def from_checkpoint(cls, prefix, epoch, input_shapes, ctx=None,
@@ -68,8 +88,38 @@ class Predictor:
         return cls(json_str, params, input_shapes, ctx=ctx,
                    output_names=output_names)
 
-    def _bind(self, input_shapes, type_dict=None):
+    def _bind(self, input_shapes):
         self._input_names = list(input_shapes)
+        self._exec = self.executor_for(input_shapes)
+
+    def executor_for(self, input_shapes):
+        """Bound forward-only executor for these input shapes, from the
+        signature cache: the first visit of a signature binds (and its
+        first forward compiles); every later visit — another reshape()
+        round trip, another fill of the same serving bucket — returns
+        the SAME executor, so its jit cache keeps the compiled program.
+        Counted in predict.bind_cache_hits/_misses."""
+        self._check_open()
+        sig = tuple(sorted((n, tuple(s)) for n, s in input_shapes.items()))
+        from . import telemetry
+
+        with self._cache_lock:
+            # re-check under the lock: a concurrent close() tears down
+            # under this lock, so passing here guarantees a live cache
+            self._check_open()
+            exe = self._exec_cache.get(sig)
+            if telemetry.enabled():
+                telemetry.inc("predict.bind_cache_hits" if exe is not None
+                              else "predict.bind_cache_misses")
+            if exe is None:
+                while len(self._exec_cache) >= _EXEC_CACHE_CAP:
+                    self._exec_cache.pop(next(iter(self._exec_cache)))
+                exe = self._exec_cache[sig] = \
+                    self._build_exec(dict(input_shapes))
+        return exe
+
+    def _build_exec(self, input_shapes):
+        type_dict = self._type_dict
         arg_names = self._symbol.list_arguments()
         aux_names = self._symbol.list_auxiliary_states()
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
@@ -96,14 +146,34 @@ class Predictor:
             if name not in self._aux_params:
                 raise MXNetError("missing aux state %s" % name)
             aux[name] = self._aux_params[name]
-        self._exec = self._symbol.bind(self._ctx, args, args_grad=None,
-                                       grad_req="null", aux_states=aux)
+        return self._symbol.bind(self._ctx, args, args_grad=None,
+                                 grad_req="null", aux_states=aux)
+
+    def _check_open(self):
+        if self._exec_cache is None:
+            raise MXNetError("Predictor is closed (close() released its "
+                             "executors and parameters; build a new one)")
+
+    def close(self):
+        """Release the bound executors (their jitted programs and device
+        input/output buffers) and drop the parameter references, so a
+        long-lived serving process can retire a model without waiting
+        for GC.  Idempotent; every later API call raises a clear error
+        (reference MXPredFree, c_predict_api.cc:237).  Teardown happens
+        under the cache lock, so a caller racing close() gets the
+        closed-error, never a half-torn-down predictor."""
+        with self._cache_lock:
+            self._exec = None
+            self._exec_cache = None
+            self._arg_params = {}
+            self._aux_params = {}
 
     # -- the C predict API surface --------------------------------------
     def set_input(self, name, data):
         """MXPredSetInput (c_predict_api.cc:243).  A flat buffer with the
         right element count is accepted and reshaped (the C ABI passes
         row-major float pointers without shape)."""
+        self._check_open()
         if name not in self._input_names:
             raise MXNetError("unknown input %s (inputs: %s)"
                              % (name, self._input_names))
@@ -115,6 +185,7 @@ class Predictor:
 
     def forward(self, **inputs):
         """MXPredForward (c_predict_api.cc:258); inputs may be given inline."""
+        self._check_open()
         for name, data in inputs.items():
             self.set_input(name, data)
         self._exec.forward(is_train=False)
@@ -122,10 +193,12 @@ class Predictor:
 
     def get_output(self, index=0):
         """MXPredGetOutput → numpy."""
+        self._check_open()
         return self._exec.outputs[index].asnumpy()
 
     def get_output_shape(self, index=0):
         """MXPredGetOutputShape: shape tuple of output `index`."""
+        self._check_open()
         return tuple(int(d) for d in self._exec.outputs[index].shape)
 
     def get_output_bytes(self, index=0):
@@ -136,10 +209,13 @@ class Predictor:
 
     @property
     def num_outputs(self):
+        self._check_open()
         return len(self._exec.outputs)
 
     def reshape(self, input_shapes):
         """MXPredReshape (c_predict_api.cc:150-210): rebind with new input
-        shapes, parameters shared."""
+        shapes, parameters shared.  A signature seen before comes out of
+        the executor cache, so a reshape ping-pong (bucketed inference)
+        never recompiles."""
         self._bind(dict(input_shapes))
         return self

@@ -1,0 +1,23 @@
+"""mxnet_tpu.serving — continuous-batching inference on one device.
+
+The deployment layer above :mod:`mxnet_tpu.predict`: where a Predictor
+answers ONE caller at a time, :class:`ModelServer` takes concurrent
+requests from many callers for many models (tenants), packs them into
+shape-bucketed padded batches, and runs each fill through a compiled
+program that is built once per (tenant, bucket) and reused forever —
+the Orca/vLLM continuous-batching recipe expressed on this framework's
+own engine, executor-cache, staging, and telemetry machinery.  See
+docs/serving.md for the architecture and docs/observability.md for the
+``serving.*`` metric catalog.
+"""
+from __future__ import annotations
+
+from .bucket import bucket_ladder, choose_bucket, pad_rows
+from .request import (AdmissionError, Request, RequestQueue, RequestTimeout,
+                      ServerClosed)
+from .server import ModelServer
+from .session import TenantSession
+
+__all__ = ["ModelServer", "TenantSession", "Request", "RequestQueue",
+           "RequestTimeout", "AdmissionError", "ServerClosed",
+           "bucket_ladder", "choose_bucket", "pad_rows"]

@@ -1,0 +1,228 @@
+"""Request plumbing for the serving engine: futures, deadlines,
+admission control, and the fairness-aware pending queue.
+
+One :class:`Request` is one caller-visible unit of work — a dict of
+sample-shaped inputs plus a `concurrent.futures.Future` the caller
+waits on.  The :class:`RequestQueue` holds pending requests per tenant
+behind one condition variable and answers the continuous batcher's only
+scheduling question — *which tenant should the next fill serve, and
+when* — with the oldest-deadline-first policy: among tenants whose
+queue head is "ripe" (a full batch is waiting, the batching window
+expired, the head's deadline passed, or the server is draining), pick
+the one whose head request must finish soonest.  With equal per-tenant
+timeouts this degrades to oldest-arrival-first, i.e. global FIFO
+across tenants — no tenant can starve another by flooding.
+
+Deadlines are enforced at dequeue time: a request still queued past its
+deadline fails with :class:`RequestTimeout` instead of wasting a batch
+slot on an answer nobody is waiting for (the Orca/vLLM admission
+discipline).  Admission control bounds the queue itself — beyond
+``MXTPU_SERVE_MAX_QUEUE`` pending requests, ``submit()`` raises
+:class:`AdmissionError` immediately so overload surfaces as fast
+rejections, not unbounded tail latency.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["Request", "RequestQueue", "RequestTimeout", "AdmissionError",
+           "ServerClosed"]
+
+
+class RequestTimeout(MXNetError):
+    """The request sat in the queue past its deadline and was dropped
+    before dispatch (serving.timeouts counts these)."""
+
+
+class AdmissionError(MXNetError):
+    """The server's pending queue is full; the request was rejected at
+    submit() (serving.rejected counts these)."""
+
+
+class ServerClosed(MXNetError):
+    """The server was closed: either this submit() arrived after
+    close(), or close(drain=False) failed the still-queued request."""
+
+
+class Request:
+    """One pending inference request."""
+
+    __slots__ = ("tenant", "inputs", "future", "arrival", "deadline")
+
+    def __init__(self, tenant, inputs, timeout_s):
+        self.tenant = tenant
+        # SNAPSHOT the inputs (the engine-op operand discipline,
+        # ndarray._snapshot): the caller may refill its buffer the
+        # moment submit() returns, while the batcher reads these up to
+        # a full batching window later
+        self.inputs = {k: _np.array(v) for k, v in inputs.items()}
+        self.future = Future()
+        self.arrival = time.monotonic()
+        self.deadline = self.arrival + float(timeout_s)
+
+    def fail(self, exc):
+        """set_exception that tolerates caller-cancelled futures — a
+        cancelled request must never kill the batcher thread."""
+        if not self.future.done():
+            try:
+                self.future.set_exception(exc)
+            except InvalidStateError:  # cancelled in the check window
+                pass
+
+    def fulfil(self, result):
+        """set_result with the same cancellation tolerance."""
+        if not self.future.done():
+            try:
+                self.future.set_result(result)
+            except InvalidStateError:
+                pass
+
+
+class RequestQueue:
+    """Thread-safe per-tenant pending queues + the batcher's scheduler.
+
+    Producers (any thread) call :meth:`put`; the single batcher thread
+    alternates :meth:`next_work` / :meth:`take`.  Every mutation updates
+    the ``serving.queue_depth`` gauges so the backlog renders as a
+    chrome counter lane beside the dispatch spans."""
+
+    def __init__(self, max_queue):
+        self._cv = threading.Condition()
+        self._queues = {}
+        self._depth = 0
+        self._max_queue = int(max_queue)
+
+    def register(self, tenant):
+        with self._cv:
+            self._queues.setdefault(tenant, deque())
+
+    def depth(self, tenant=None):
+        with self._cv:
+            if tenant is None:
+                return self._depth
+            return len(self._queues.get(tenant, ()))
+
+    def _note_depth(self, tenant):
+        # called under self._cv; telemetry's lock is a leaf lock
+        from .. import telemetry
+
+        if telemetry.enabled():
+            telemetry.set_gauge("serving.queue_depth", self._depth)
+            telemetry.set_gauge("serving.queue_depth.%s" % tenant,
+                                len(self._queues[tenant]))
+
+    def put(self, req):
+        """Enqueue or reject (admission control).  Raises KeyError-free
+        errors for unknown tenants so a typo'd tenant name is a clear
+        client bug, not a silent new queue."""
+        from .. import telemetry
+
+        with self._cv:
+            if req.tenant not in self._queues:
+                raise MXNetError("unknown tenant %r (tenants: %s)"
+                                 % (req.tenant, sorted(self._queues)))
+            if self._depth >= self._max_queue:
+                if telemetry.enabled():
+                    telemetry.inc("serving.rejected")
+                raise AdmissionError(
+                    "serving queue is full (%d pending >= "
+                    "MXTPU_SERVE_MAX_QUEUE=%d); retry later or raise the "
+                    "bound" % (self._depth, self._max_queue))
+            self._queues[req.tenant].append(req)
+            self._depth += 1
+            self._note_depth(req.tenant)
+            self._cv.notify_all()
+
+    def kick(self):
+        """Wake the batcher (close() flips its stop flag, then kicks)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def next_work(self, wait_s, max_batch, stopping):
+        """Block until some tenant deserves a dispatch; return its name.
+
+        A tenant is *ripe* when its head request has waited out the
+        batching window, a full ``max_batch`` is already pending, the
+        head's deadline passed (so the timeout fires promptly), or
+        `stopping()` is true (drain mode dispatches everything).  Among
+        ripe tenants the one with the OLDEST head deadline wins.
+        Returns None only when stopping and fully drained."""
+        with self._cv:
+            while True:
+                now = time.monotonic()
+                best, best_deadline = None, None
+                next_event = None
+                draining = stopping()
+                for tenant, dq in self._queues.items():
+                    if not dq:
+                        continue
+                    head = dq[0]
+                    ripe = (draining or len(dq) >= max_batch
+                            or now - head.arrival >= wait_s
+                            or now >= head.deadline)
+                    if ripe:
+                        if best is None or head.deadline < best_deadline:
+                            best, best_deadline = tenant, head.deadline
+                    else:
+                        at = min(head.arrival + wait_s, head.deadline)
+                        if next_event is None or at < next_event:
+                            next_event = at
+                if best is not None:
+                    return best
+                if draining and self._depth == 0:
+                    return None
+                # fully idle: block until a put()/kick() notifies (close()
+                # always kicks after flipping its stop flag, so an
+                # indefinite wait cannot strand the batcher)
+                self._cv.wait(max(1e-4, next_event - now)
+                              if next_event is not None else None)
+
+    def take(self, tenant, limit):
+        """Pop up to `limit` live requests for `tenant`, failing expired
+        ones with RequestTimeout on the way (their callers stopped
+        waiting; a batch slot spent on them is pure waste)."""
+        from .. import telemetry
+
+        out, expired = [], []
+        with self._cv:
+            dq = self._queues[tenant]
+            now = time.monotonic()
+            while dq and len(out) < limit:
+                req = dq.popleft()
+                self._depth -= 1
+                (expired if now >= req.deadline else out).append(req)
+            self._note_depth(tenant)
+        for req in expired:
+            if telemetry.enabled():
+                telemetry.inc("serving.timeouts")
+                telemetry.inc("serving.timeouts.%s" % tenant)
+            req.fail(RequestTimeout(
+                "request to tenant %r spent %.1f ms queued, past its "
+                "%.1f ms deadline (MXTPU_SERVE_TIMEOUT_MS or the "
+                "submit() override)" % (
+                    tenant, (now - req.arrival) * 1e3,
+                    (req.deadline - req.arrival) * 1e3)))
+        return out
+
+    def fail_all(self, make_exc):
+        """Drain every queue, failing each request with `make_exc(req)`
+        (the close(drain=False) path)."""
+        with self._cv:
+            pending = []
+            for dq in self._queues.values():
+                pending.extend(dq)
+                dq.clear()
+            self._depth = 0
+            for tenant in self._queues:
+                self._note_depth(tenant)
+            self._cv.notify_all()
+        for req in pending:
+            req.fail(make_exc(req))
+        return len(pending)

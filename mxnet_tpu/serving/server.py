@@ -1,0 +1,195 @@
+"""ModelServer — the client-facing continuous-batching inference engine.
+
+``submit(tenant, inputs) -> Future`` is the whole client API: any
+thread may submit; one batcher thread turns the pending queue into
+shape-bucketed fills (Orca-style iteration-level scheduling — every
+fill is re-packed from whatever is pending NOW, so late requests join
+the next fill instead of waiting behind a fixed batch), dispatching
+each through the tenant's cached bucket program while the next fill's
+H2D stages in the background (session.py).  N tenants share one device;
+the oldest-deadline-first policy (request.py) arbitrates between them.
+
+Shutdown is explicit: :meth:`close` stops admission, then either drains
+(every queued request dispatched, every future resolved) or fails the
+queue with :class:`~.request.ServerClosed`.  Either way in-flight fills
+complete — no future is ever left unresolved.
+
+::
+
+    server = mx.serving.ModelServer({"resnet50": pred50, "resnet152": pred152})
+    fut = server.submit("resnet50", {"data": image})   # sample-shaped, no batch axis
+    probs = fut.result()[0]                            # one array per model output
+    server.close()
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from .bucket import bucket_ladder
+from .request import Request, RequestQueue, ServerClosed
+from .session import TenantSession
+
+__all__ = ["ModelServer"]
+
+
+class ModelServer:
+    """Continuous-batching server over N Predictor-backed tenants.
+
+    Knob defaults come from the config registry (docs/how_to/env_var.md):
+    ``MXTPU_SERVE_MAX_BATCH`` / ``_BUCKETS`` / ``_TIMEOUT_MS`` /
+    ``_MAX_QUEUE`` / ``_WAIT_MS``; constructor arguments override."""
+
+    def __init__(self, tenants=None, max_batch=None, buckets=None,
+                 timeout_ms=None, max_queue=None, wait_ms=None):
+        from .. import config
+
+        self._max_batch = int(max_batch if max_batch is not None
+                              else config.get("MXTPU_SERVE_MAX_BATCH"))
+        spec = buckets if buckets is not None else config.get("MXTPU_SERVE_BUCKETS")
+        if isinstance(spec, (list, tuple)):
+            spec = ",".join(str(int(b)) for b in spec)
+        self.ladder = bucket_ladder(self._max_batch, spec)
+        self._timeout_s = float(timeout_ms if timeout_ms is not None
+                                else config.get("MXTPU_SERVE_TIMEOUT_MS")) / 1e3
+        self._wait_s = float(wait_ms if wait_ms is not None
+                             else config.get("MXTPU_SERVE_WAIT_MS")) / 1e3
+        self._queue = RequestQueue(max_queue if max_queue is not None
+                                   else config.get("MXTPU_SERVE_MAX_QUEUE"))
+        self._sessions = {}
+        self._lock = threading.Lock()
+        self._stopping = False
+        self._closed = False
+        for name, pred in (tenants or {}).items():
+            self.add_tenant(name, pred)
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve_batcher", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # client surface
+    # ------------------------------------------------------------------
+    def add_tenant(self, name, predictor):
+        """Register one model under `name`.  Allowed while serving — a
+        new tenant starts empty and simply joins the fairness policy."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("cannot add tenant %r: server is closed"
+                                   % name)
+            if name in self._sessions:
+                raise MXNetError("tenant %r already registered" % name)
+            self._sessions[name] = TenantSession(name, predictor, self.ladder)
+            self._queue.register(name)
+
+    @property
+    def tenants(self):
+        return sorted(self._sessions)
+
+    def submit(self, tenant, inputs, timeout_ms=None):
+        """Enqueue one request; returns a `concurrent.futures.Future`
+        resolving to [one numpy array per model output], each
+        sample-shaped (the batcher owns the batch axis end to end).
+        Raises AdmissionError when the queue is full, ServerClosed
+        after close(), and a clear error for unknown tenants or
+        malformed inputs (validated HERE so a bad request fails its own
+        caller immediately instead of poisoning the fill it would have
+        been co-batched into)."""
+        timeout_s = (float(timeout_ms) / 1e3 if timeout_ms is not None
+                     else self._timeout_s)
+        # build (and SNAPSHOT) the request before taking the lock —
+        # concurrent submitters must not serialize on each other's
+        # input copies
+        req = Request(tenant, inputs, timeout_s)
+        # closed check, tenant lookup + validation, and enqueue share
+        # the close()/add_tenant() lock: a request that passes is
+        # enqueued before close() can drain/fail the queue (no future
+        # left unresolved), and a submit racing add_tenant can never
+        # slip an UNVALIDATED request past a just-registered tenant
+        # (validation is cheap shape checks — the copies stayed outside)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("ModelServer is closed; no new requests")
+            session = self._sessions.get(tenant)
+            if session is not None:
+                session.validate(req.inputs)
+            self._queue.put(req)
+        return req.future
+
+    def warmup(self, buckets=None):
+        """Pre-compile every (tenant, bucket) program with one dummy
+        fill each, synchronously, bypassing the queue — call BEFORE
+        taking traffic so no real request ever pays an XLA compile
+        (bench.py --serve does, and then asserts its timed window is
+        compile-free).  Returns the number of programs visited."""
+        buckets = list(buckets) if buckets is not None else list(self.ladder)
+        with self._lock:  # consistent view vs concurrent add_tenant
+            sessions = list(self._sessions.values())
+        return sum(session.warm(buckets) for session in sessions)
+
+    def stats(self):
+        """Cheap live view for load shedding / dashboards (the full
+        story is the telemetry registry, docs/observability.md)."""
+        with self._lock:
+            tenants = list(self._sessions)
+        return {
+            "queue_depth": self._queue.depth(),
+            "per_tenant_depth": {t: self._queue.depth(t) for t in tenants},
+            "ladder": list(self.ladder),
+            "closed": self._closed,
+        }
+
+    def close(self, drain=True, timeout=None):
+        """Stop the server.  ``drain=True`` (default) serves every
+        already-queued request before returning; ``drain=False`` fails
+        still-queued requests with ServerClosed.  In-flight fills
+        complete either way, so every future this server ever returned
+        is resolved when close() returns.  Idempotent."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+        if already and self._thread is None:
+            return
+        if not drain:
+            self._queue.fail_all(lambda req: ServerClosed(
+                "ModelServer.close(drain=False) dropped the queued "
+                "request to tenant %r" % req.tenant))
+        self._stopping = True
+        self._queue.kick()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                # the contract is "every future resolved when close()
+                # returns" — a timed-out join must not fake it
+                raise MXNetError(
+                    "ModelServer.close(timeout=%s) expired before the "
+                    "queue drained; fills are still running — call "
+                    "close() again to keep waiting, or "
+                    "close(drain=False) to drop the backlog" % timeout)
+            self._thread = None
+        for session in self._sessions.values():
+            session.close()
+
+    # ------------------------------------------------------------------
+    # the batcher thread
+    # ------------------------------------------------------------------
+    def _loop(self):
+        from .. import telemetry
+
+        while True:
+            tenant = self._queue.next_work(self._wait_s, self._max_batch,
+                                           lambda: self._stopping)
+            if tenant is None:
+                return
+            reqs = self._queue.take(tenant, self._max_batch)
+            if not reqs:
+                continue
+            try:
+                self._sessions[tenant].dispatch(reqs)
+            except BaseException as e:
+                # a failed fill fails ITS requests, never the server: the
+                # loop survives to serve the other tenants
+                if telemetry.enabled():
+                    telemetry.inc("serving.dispatch_errors")
+                for r in reqs:
+                    r.fail(e)

@@ -1,0 +1,232 @@
+"""Per-tenant serving session: bucketed compiled programs + the
+stage / compute / readback pipeline.
+
+One :class:`TenantSession` wraps one :class:`~mxnet_tpu.predict.Predictor`
+(one model's symbol + params, bound forward-only) and owns everything
+shape-shaped about serving it:
+
+  * **program cache** — each batch bucket binds through the predictor's
+    signature cache (`Predictor.executor_for`) and compiles ONE
+    forward-only program (`Executor.serve_program`) whose batch inputs
+    are a separate, donated argument tuple.  A bucket therefore
+    compiles exactly once; every later fill of any size in that bucket
+    is a jit-cache hit (`executor.compile_cache_hits`).
+  * **ping-pong staging** — the H2D of fill N+1 rides a background
+    engine op (the `io.DeviceStagedIter` recipe generalized from
+    training blocks to request batches, sharing `io.stage_put` so the
+    staged bytes land in the same books) while fill N computes.  Two
+    slot vars alternate; WAW ordering on a slot var queues the stage of
+    fill N+2 behind the readback of fill N, which bounds the pipeline
+    at classic double buffering without any explicit wait.
+  * **async readback** — output D2H + future resolution run as another
+    engine op, off the batcher thread, so packing the next fill never
+    waits on `np.asarray` of the previous one.  Partial-fill padding is
+    sliced back out here: request i gets row i of each output, the
+    `bucket - n` padded rows are never seen by a caller.
+
+Engine ops are pushed ``atomic=False`` (the ThreadedIter convention for
+callbacks running arbitrary foreign code with normal sync semantics);
+`mx.waitall()` and :meth:`drain` fence the pipeline via the slot vars.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading as _threading
+import time
+
+import numpy as _np
+
+from .. import engine
+from .. import io as _io
+from ..base import MXNetError
+from .bucket import choose_bucket, pad_rows
+
+__all__ = ["TenantSession"]
+
+
+class TenantSession:
+    """One model serving under one tenant name (see module docstring)."""
+
+    def __init__(self, name, predictor, ladder):
+        self.name = name
+        self._predictor = predictor
+        self._ladder = list(ladder)
+        predictor._check_open()
+        exe = predictor._exec
+        self._input_names = list(predictor._input_names)
+        # the tenant's per-request contract: the bound predictor's input
+        # shapes minus the leading batch axis
+        self._samples = {n: tuple(exe.arg_dict[n].shape[1:])
+                         for n in self._input_names}
+        self._dtypes = {n: _np.dtype(exe.arg_dict[n].data.dtype)
+                        for n in self._input_names}
+        self._device = exe._first_ctx.jax_device()
+        self._programs = {}
+        # serializes program build/lookup: warm() runs on a caller
+        # thread and may overlap the batcher's dispatch of the same
+        # bucket (add_tenant while serving) — without this, both sides
+        # could compile the same program and double-count
+        # serving.bucket_programs
+        self._prog_lock = _threading.Lock()
+        self._slot_vars = (engine.new_variable(), engine.new_variable())
+        self._fills = 0
+
+    @property
+    def sample_shapes(self):
+        return dict(self._samples)
+
+    def validate(self, inputs):
+        """Shape-check one request against the tenant contract — called
+        at submit() time so a malformed request fails ITS caller
+        immediately and never reaches a fill where its error would fail
+        every co-batched request."""
+        for name in self._input_names:
+            if name not in inputs:
+                raise MXNetError(
+                    "request for tenant %r is missing input %r "
+                    "(expected inputs: %s)"
+                    % (self.name, name, self._input_names))
+            shape = tuple(_np.shape(inputs[name]))
+            if shape != self._samples[name]:
+                raise MXNetError(
+                    "request input %r for tenant %r has shape %s, "
+                    "expected the sample shape %s (submit() takes "
+                    "UNBATCHED samples; the batcher owns the batch axis)"
+                    % (name, self.name, shape, self._samples[name]))
+
+    def _program(self, bucket):
+        """(executor, jitted fn) for one bucket.  The session PINS the
+        bucket's executor itself — the ladder is small and bounded, and
+        pinning makes compile-once-per-bucket immune to eviction from
+        the predictor's (capped) signature cache — while each fill still
+        goes through the executor's jit cache, so the telemetry counters
+        state the property directly: `serving.bucket_programs` and
+        `executor.compile_cache_misses` move only on a bucket's FIRST
+        fill; every later fill is a `executor.compile_cache_hits`
+        increment (the steady-state pin in tests/test_serving.py)."""
+        from .. import telemetry
+
+        with self._prog_lock:
+            exe = self._programs.get(bucket)
+            if exe is None:
+                exe = self._programs[bucket] = self._predictor.executor_for(
+                    {n: (bucket,) + self._samples[n]
+                     for n in self._input_names})
+                if telemetry.enabled():
+                    telemetry.inc("serving.bucket_programs")
+            fn = exe.serve_program(self._input_names)
+        return exe, fn
+
+    def warm(self, buckets):
+        """Compile-and-run this tenant's program for each bucket with a
+        zero-filled dummy batch, synchronously on the calling thread (no
+        queue, no engine ops) — ModelServer.warmup() calls this before
+        traffic so no real request ever pays an XLA compile."""
+        for b in buckets:
+            exe, fn = self._program(b)
+            dummy = tuple(_np.zeros((b,) + self._samples[n], self._dtypes[n])
+                          for n in self._input_names)
+            other_vals, aux_vals = exe.serve_args(self._input_names)
+            outs = fn(dummy, other_vals, aux_vals, _np.uint32(0))
+            _np.asarray(outs[0])  # block: compile + run complete
+        return len(buckets)
+
+    def dispatch(self, reqs):
+        """Run one fill: pack `reqs` into the smallest bucket that holds
+        them, stage, dispatch, and hand the readback to the engine.
+        Returns after the compute is DISPATCHED (not complete); the
+        requests' futures resolve from the readback op."""
+        import jax
+
+        from .. import profiler, telemetry
+
+        n = len(reqs)
+        bucket = choose_bucket(self._ladder, n)
+        exe, fn = self._program(bucket)
+        host = {
+            name: pad_rows([r.inputs[name] for r in reqs], bucket,
+                           self._samples[name], self._dtypes[name])
+            for name in self._input_names
+        }
+        slot_var = self._slot_vars[self._fills % 2]
+        handoff = _queue.Queue(1)
+        dev = self._device
+
+        def _stage(_host=host, _names=tuple(self._input_names), _dev=dev,
+                   _q=handoff):
+            # errors travel in-band: a deferred engine error would leave
+            # the batcher blocked on the handoff forever
+            try:
+                placed = tuple(
+                    _io.stage_put(nm, _host[nm],
+                                  lambda _n, a: jax.device_put(a, _dev))
+                    for nm in _names)
+            except BaseException as e:
+                _q.put((None, e))
+                return
+            _q.put((placed, None))
+
+        engine.push(_stage, write_vars=(slot_var,), atomic=False,
+                    name="serve_stage")
+        staged, err = handoff.get()
+        if err is not None:
+            raise err
+        other_vals, aux_vals = exe.serve_args(self._input_names)
+        with profiler.span("serve_dispatch(%s,b=%d)" % (self.name, bucket),
+                           cat="serving"):
+            outs = tuple(fn(staged, other_vals, aux_vals, _np.uint32(0)))
+        tenant = self.name
+
+        def _readback(_outs=outs, _reqs=reqs, _bucket=bucket, _tenant=tenant):
+            try:
+                host_outs = [_np.asarray(o) for o in _outs]
+                for ho in host_outs:
+                    if ho.ndim < 1 or ho.shape[0] != _bucket:
+                        raise MXNetError(
+                            "serving requires batch-major outputs: got "
+                            "output shape %s from a bucket-%d fill (a "
+                            "batch-reducing head cannot be unbatched per "
+                            "request)" % (tuple(ho.shape), _bucket))
+                now = time.monotonic()
+                tel = telemetry.enabled()
+                if tel:
+                    telemetry.inc("executor.d2h_bytes",
+                                  sum(int(ho.nbytes) for ho in host_outs))
+                for i, r in enumerate(_reqs):
+                    if r.future.cancelled():
+                        continue
+                    r.fulfil([ho[i] for ho in host_outs])
+                    if tel:
+                        telemetry.inc("serving.requests")
+                        telemetry.inc("serving.requests.%s" % _tenant)
+                        telemetry.observe("serving.request_seconds",
+                                          now - r.arrival)
+                        telemetry.observe(
+                            "serving.request_seconds.%s" % _tenant,
+                            now - r.arrival)
+            except BaseException as e:
+                for r in _reqs:
+                    r.fail(e)
+
+        engine.push(_readback, write_vars=(slot_var,), atomic=False,
+                    name="serve_readback")
+        self._fills += 1
+        if telemetry.enabled():
+            telemetry.inc("serving.dispatches")
+            telemetry.inc("serving.batch_slots_used", n)
+            telemetry.inc("serving.batch_slots_padded", bucket - n)
+            telemetry.set_gauge("serving.batch_fill_ratio", n / bucket)
+        return bucket
+
+    def drain(self):
+        """Fence the pipeline: returns once every in-flight stage and
+        readback op has completed (all dispatched futures resolved)."""
+        for var in self._slot_vars:
+            engine.wait_for_var(var, wait_reads=True)
+
+    def close(self):
+        """Drain and drop the bucket programs.  Does NOT close the
+        predictor — the caller owns its lifetime (it may serve
+        elsewhere, or be retired with Predictor.close())."""
+        self.drain()
+        self._programs.clear()

@@ -94,6 +94,43 @@ def test_bench_ab_smoke_runs_both_sides(sink):
 
 
 @pytest.mark.slow
+def test_bench_serve_smoke_reports_load_row():
+    """bench.py --serve --smoke: the serving load driver (docs/serving.md)
+    runs two tiny CPU tenants through the REAL ModelServer path —
+    continuous batching, bucketed programs, ping-pong staging — and
+    emits ONE JSON row with img/s, p50/p99 latency, and the exact
+    batch-fill ratio at the stated offered load.  The same driver with
+    ResNet-50/152 tenants produces the chip row."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXTPU_SERVE_MAX_BATCH", "MXTPU_SERVE_BUCKETS",
+                 "MXTPU_SERVE_TIMEOUT_MS", "MXTPU_SERVE_MAX_QUEUE",
+                 "MXTPU_SERVE_WAIT_MS"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["smoke"] is True and out["unit"] == "img/s"
+    assert out["value"] > 0 and out["offered_load"] > 0
+    # the batch_fill_ratio was observed and the p99 is reported — the
+    # acceptance criteria of the serving PR
+    assert out["fill_pct"] is not None and 0 < out["fill_pct"] <= 100
+    assert out["p50_ms"] is not None and out["p99_ms"] >= out["p50_ms"]
+    assert out["requests"] == sum(t["requests"]
+                                  for t in out["tenants"].values())
+    assert out["timeouts"] == 0 and out["failed"] == 0
+    # both tenants actually shared the device in this run
+    assert len(out["tenants"]) == 2
+    assert all(t["requests"] > 0 for t in out["tenants"].values())
+    # the timed window never recompiled: every bucket program was built
+    # in warmup and reused (compile-once-per-bucket, ladder reported)
+    assert out["compile_misses_timed"] == 0
+    assert out["ladder"][-1] == out["max_batch"]
+
+
+@pytest.mark.slow
 def test_bench_smoke_honors_k_flag():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(

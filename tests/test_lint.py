@@ -60,6 +60,19 @@ def test_cli_runs_and_is_clean():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_repo_gate_sweeps_the_serving_package():
+    """The gate's directory walk must cover mxnet_tpu/serving/ — the
+    batcher pushes engine callbacks and per-request telemetry, exactly
+    the surfaces E001/E002/E004 exist for.  Pinned so a future repack
+    (or an over-broad _SKIP_DIRS entry) cannot silently drop it."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    for mod in ("__init__", "request", "bucket", "session", "server"):
+        assert os.path.join("mxnet_tpu", "serving", "%s.py" % mod) in swept
+
+
 # ----------------------------------------------------------------------
 # E001 — undeclared dependencies
 # ----------------------------------------------------------------------
@@ -200,6 +213,50 @@ def test_missing_path_is_an_error_not_a_clean_pass(tmp_path):
     assert len(errors) == 1 and "does not exist" in errors[0][1]
 
 
+# a serving-batcher-shaped callback (serving/session.py dispatch): an
+# ATOMIC readback op that syncs on its outputs instead of reading the
+# raw payloads — exactly the deadlock shape E002 exists for (a blocked
+# worker starves the pool that must run the fill it waits on).  The
+# real pipeline pushes atomic=False (ThreadedIter convention); this
+# corpus pins that E002 still fires if someone "tightens" it to atomic.
+E002_SERVING_READBACK = """
+def dispatch(eng, outs, reqs, slot_var):
+    def readback(_outs=outs, _reqs=reqs):
+        for o in _outs:
+            o.wait_to_read()
+        host = [o.asnumpy() for o in _outs]
+        for i, r in enumerate(_reqs):
+            r.future.set_result([h[i] for h in host])
+    eng.push(readback, read_vars=[o._engine_var() for o in outs],
+             write_vars=[slot_var])
+"""
+
+
+def test_e002_fires_on_atomic_serving_readback(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_SERVING_READBACK)
+    got = _ids(findings)
+    assert got.count("E002") == 2, findings  # wait_to_read + asnumpy
+    assert any("wait_to_read" in f.message for f in findings)
+
+
+E002_SERVING_NON_ATOMIC = """
+def dispatch(eng, outs, reqs, slot_var):
+    def readback(_outs=outs, _reqs=reqs):
+        host = [o.asnumpy() for o in _outs]
+        for i, r in enumerate(_reqs):
+            r.future.set_result([h[i] for h in host])
+    eng.push(readback, read_vars=[o._engine_var() for o in outs],
+             write_vars=[slot_var], atomic=False)
+"""
+
+
+def test_e002_serving_readback_clean_when_non_atomic(tmp_path):
+    """The shape the real pipeline uses: atomic=False keeps normal sync
+    semantics, so the readback may block on payloads."""
+    findings, _, _ = _lint_src(tmp_path, E002_SERVING_NON_ATOMIC)
+    assert findings == []
+
+
 # ----------------------------------------------------------------------
 # E004 — telemetry/profiler recording must be behind the fast path
 # ----------------------------------------------------------------------
@@ -306,6 +363,48 @@ def test_e004_arbitrary_condition_is_not_a_guard(tmp_path):
     for src in (E004_WRONG_GUARD, E004_INVERTED_GUARD, E004_NESTED_GUARD):
         findings, _, _ = _lint_src(tmp_path, src)
         assert _ids(findings) == ["E004"], (src, findings)
+
+
+# a serving-batcher-shaped hot loop: per-request latency observation and
+# queue-depth gauge inside the fill/readback path — the highest-rate
+# instrumentation sites in the framework (once per REQUEST, not once per
+# step), so an unguarded call here is exactly the regression E004 guards
+# against
+E004_SERVING_UNGUARDED = """
+import time
+from . import telemetry
+
+def resolve_fill(reqs, host_outs, tenant):
+    now = time.monotonic()
+    for i, r in enumerate(reqs):
+        r.future.set_result([h[i] for h in host_outs])
+        telemetry.inc("serving.requests." + tenant)
+        telemetry.observe("serving.request_seconds", now - r.arrival)
+    telemetry.set_gauge("serving.queue_depth", 0)
+"""
+
+E004_SERVING_GUARDED = """
+import time
+from . import telemetry
+
+def resolve_fill(reqs, host_outs, tenant):
+    now = time.monotonic()
+    tel = telemetry.enabled()
+    for i, r in enumerate(reqs):
+        r.future.set_result([h[i] for h in host_outs])
+        if tel:
+            telemetry.inc("serving.requests." + tenant)
+            telemetry.observe("serving.request_seconds", now - r.arrival)
+    if tel:
+        telemetry.set_gauge("serving.queue_depth", 0)
+"""
+
+
+def test_e004_fires_on_unguarded_serving_batcher_telemetry(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_SERVING_UNGUARDED)
+    assert _ids(findings) == ["E004", "E004", "E004"], findings
+    findings, _, _ = _lint_src(tmp_path, E004_SERVING_GUARDED)
+    assert findings == []
 
 
 # ----------------------------------------------------------------------

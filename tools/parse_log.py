@@ -15,7 +15,10 @@ With ``--telemetry`` the input is a telemetry JSONL file instead
 step-time percentiles from the histogram, MFU, dispatch and
 compile-cache counters, plus the lazy-fusion columns (flush count,
 mean fused-chain length, fusion-cache hit %) when the run recorded
-the ``lazy`` namespace.  See docs/observability.md.
+the ``lazy`` namespace and the serving columns (queue depth, exact
+batch-fill %, request p99) when it recorded the ``serving`` namespace
+(docs/serving.md).  Older logs render '-' in columns they predate.
+See docs/observability.md.
 """
 from __future__ import annotations
 
@@ -96,6 +99,8 @@ def parse_telemetry(lines):
         f_misses = counters.get("lazy.fusion_cache_misses", 0)
         fusion_hit_pct = (100.0 * f_hits / (f_hits + f_misses)
                           if (f_hits + f_misses) else None)
+        slots_used = counters.get("serving.batch_slots_used", 0)
+        slots_padded = counters.get("serving.batch_slots_padded", 0)
         rows.append({
             "flush_seq": rec.get("flush_seq"),
             "step": rec.get("step"),
@@ -115,6 +120,13 @@ def parse_telemetry(lines):
             # numerics the run used — '-' for records that predate them
             "wgrad_bf16": gauges.get("ops.wgrad_bf16"),
             "frozen_bn": gauges.get("module.frozen_bn"),
+            # serving columns (docs/serving.md): backlog, exact mean
+            # batch-fill %, and request p99 — '-' for pre-serving logs
+            "serve_qdepth": gauges.get("serving.queue_depth"),
+            "fill_pct": (100.0 * slots_used / (slots_used + slots_padded)
+                         if (slots_used + slots_padded) else None),
+            "req_p99": _hist_quantile(
+                hist.get("serving.request_seconds", {}), 0.99),
         })
     return rows
 
@@ -122,7 +134,8 @@ def parse_telemetry(lines):
 _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "mfu", "dispatches", "cache_hits", "cache_misses",
                    "io_wait_p50", "h2d_bytes", "lazy_flushes", "chain_mean",
-                   "fusion_hit_pct", "wgrad_bf16", "frozen_bn"]
+                   "fusion_hit_pct", "wgrad_bf16", "frozen_bn",
+                   "serve_qdepth", "fill_pct", "req_p99"]
 
 
 def _print_telemetry(rows, fmt):
