@@ -36,6 +36,7 @@ from .optimizer import Optimizer
 from . import lr_scheduler
 from . import metric
 from . import io
+from . import data
 from . import recordio
 from . import kvstore
 from . import kvstore as kv

@@ -99,6 +99,35 @@ REGISTRY = [
            "by a background engine op (2 = classic double buffering, "
            "reference src/io/iter_prefetcher.h); raise only if H2D "
            "stalls show between fused_dispatch spans in the profile"),
+    # ---- multi-process data service (data/; docs/data.md) ----
+    EnvVar("MXTPU_DATA_WORKERS", int, 2,
+           "Worker PROCESSES per data service (data.DataService / "
+           "io.ShardedImageRecordIter num_workers default): each owns "
+           "batches b = w mod N of the (seed, epoch) epoch order and "
+           "decodes into its own shared-memory ring, with a "
+           "src/imdecode.cc thread pool per worker.  Scale toward the "
+           "host's physical cores; the batch SEQUENCE is identical for "
+           "any value (docs/data.md)"),
+    EnvVar("MXTPU_DATA_RING_SLOTS", int, 4,
+           "Shared-memory slots per data-service worker — the "
+           "backpressure bound: a worker this many decoded batches "
+           "ahead of the trainer blocks on the free-slot queue instead "
+           "of allocating without bound (data/shm.py)"),
+    EnvVar("MXTPU_DATA_SLOT_BYTES", int, 0,
+           "Bytes per data-service shared-memory slot; 0 = auto (one "
+           "batch exactly: batch_size x data_shape float32 + labels). "
+           "An explicit value smaller than one batch raises at "
+           "DataService construction instead of corrupting slots"),
+    EnvVar("MXTPU_DATA_HOST_INDEX", int, 0,
+           "This host's shard of the data service's RecordIO file — "
+           "composed ON TOP of worker sharding: hosts stride-shard "
+           "records exactly like ImageRecordIter part_index/num_parts "
+           "(image_io.shard_offsets), then each host's workers split "
+           "the surviving batches.  The per-host input story of the "
+           "multi-process mesh (docs/data.md)"),
+    EnvVar("MXTPU_DATA_NUM_HOSTS", int, 1,
+           "Total hosts sharding the data service's RecordIO file "
+           "(MXTPU_DATA_HOST_INDEX selects this host's stride)"),
     # ---- lazy imperative evaluation (lazy.py; docs/perf.md) ----
     EnvVar("MXTPU_LAZY", int, 1,
            "Lazy imperative evaluation (lazy.py): NDArray ops defer "

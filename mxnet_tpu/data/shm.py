@@ -1,0 +1,123 @@
+"""Shared-memory batch rings — the pickle-free payload path between
+data-service worker processes and the training process.
+
+Each worker owns one POSIX shared-memory segment carved into
+fixed-size slots; a slot holds exactly one assembled training batch
+(float32 data block + float32 label block, contiguous).  Workers decode
+straight into a slot's numpy view and pass only the SLOT INDEX (plus a
+few scalar stats) through a multiprocessing queue, so the hot ndarray
+payload never crosses a pickle boundary — the consumer maps the same
+slot and copies the batch out.  The free-slot queue doubles as
+backpressure: a worker that gets ahead of the trainer blocks on it
+instead of allocating unboundedly (the dmlc threadediter bounded-buffer
+contract, reference src/io/iter_prefetcher.h, stretched across
+processes).
+"""
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["ShmRing", "slot_bytes_needed", "batch_views"]
+
+
+def slot_bytes_needed(batch_size, data_shape, label_width):
+    """Bytes one batch occupies in a slot: float32 data + float32 label."""
+    n = int(batch_size)
+    data = n * 4
+    for d in data_shape:
+        data *= int(d)
+    return data + n * int(label_width) * 4
+
+
+def batch_views(buf, batch_size, data_shape, label_width):
+    """(data, label) numpy views over one slot buffer — the same layout
+    on both sides: workers decode INTO these, the consumer copies OUT
+    of them."""
+    data_shape = tuple(int(d) for d in data_shape)
+    data = _np.ndarray((batch_size,) + data_shape, dtype=_np.float32,
+                       buffer=buf)
+    lshape = (batch_size,) if label_width == 1 else (batch_size, label_width)
+    label = _np.ndarray(lshape, dtype=_np.float32, buffer=buf,
+                        offset=data.nbytes)
+    return data, label
+
+
+class ShmRing:
+    """A ring of `slots` fixed-size shared-memory slots.
+
+    The producer side creates the segment (`ShmRing(slots, slot_bytes)`);
+    worker processes attach by name (`ShmRing.attach(...)`).  Slot
+    hand-off (which index is free / full) is the owner's problem —
+    DataService runs one free queue and one full queue per worker.
+    """
+
+    def __init__(self, slots, slot_bytes, _shm=None):
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        if self.slots < 1 or self.slot_bytes < 1:
+            raise MXNetError("ShmRing needs >=1 slot of >=1 byte (got "
+                             "%d x %d)" % (self.slots, self.slot_bytes))
+        if _shm is not None:
+            self._shm = _shm
+        else:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=self.slots * self.slot_bytes)
+        self.name = self._shm.name
+        self._owner = _shm is None
+        self._closed = False
+        self._unlinked = False
+
+    @classmethod
+    def attach(cls, name, slots, slot_bytes):
+        """Worker-side attach to an existing ring by name.
+
+        No resource-tracker gymnastics on purpose: multiprocessing
+        children — fork AND spawn — share the CREATOR's tracker
+        process (the tracker fd travels in the spawn prep data), so the
+        attach-side ``register`` is a set-add no-op on the name the
+        creator already registered, and the creator's :meth:`unlink`
+        deregisters it exactly once.  (The CPython attach-side
+        premature-unlink hazard applies to UNRELATED processes running
+        their own tracker, which is not this topology.)"""
+        return cls(slots, slot_bytes,
+                   _shm=shared_memory.SharedMemory(name=name))
+
+    def slot_buffer(self, idx):
+        """memoryview of slot `idx` (0-based)."""
+        off = int(idx) * self.slot_bytes
+        return self._shm.buf[off:off + self.slot_bytes]
+
+    def close(self):
+        """Unmap the segment in THIS process.  Idempotent."""
+        if not self._closed:
+            self._closed = True
+            try:
+                self._shm.close()
+            except BufferError:
+                # a live numpy view still references the mapping; the
+                # fd is closed with the process, nothing leaks on disk
+                pass
+
+    def unlink(self):
+        """Remove the segment from the OS (creator side).  Idempotent;
+        closes first so no exported buffer pins the mapping."""
+        self.close()
+        if self._owner and not self._unlinked:
+            self._unlinked = True
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def __del__(self):
+        try:
+            if self._owner:
+                self.unlink()
+            else:
+                self.close()
+        except Exception:
+            pass

@@ -1,0 +1,168 @@
+"""Data-service worker process — the decode half of mxnet_tpu.data.
+
+One worker owns batches ``b ≡ wid (mod num_workers)`` of the host
+shard's epoch order and runs read → native JPEG decode
+(src/imdecode.cc thread pool) → augment → batch-assemble for each,
+writing the finished batch STRAIGHT into a shared-memory slot
+(data/shm.py) and publishing only the slot index.  The epoch order is
+a pure function of ``(seed, epoch)`` computed identically in every
+process (:func:`epoch_order`), so the batch sequence the consumer
+reassembles is deterministic — byte-identical to a single-process
+``ImageRecordIter`` epoch when augmentation is off — and every record
+of the shard appears exactly once per epoch across all workers.
+
+The worker is deliberately dumb about lifecycle: it waits on a command
+queue for ``("epoch", e)`` / ``("stop",)``, bails out of an epoch early
+when the shared ``latest_epoch`` value moves past its own (consumer
+reset mid-epoch; ``STOP_EPOCH`` means shut down), and always closes an
+epoch with a ``("done", e)`` marker so the consumer can drain
+deterministically.  ``latest_epoch`` is a LOCK-FREE RawValue on
+purpose: a worker killed mid-run (the crash path the service must
+survive) can die holding any lock it touches, and a lock-protected
+``Value``/``Event`` shared by everyone would then poison the whole
+service — consumer included — at the next access.  With a raw aligned
+word, the parent is the only writer and workers only load it, so
+nothing can be left locked.  The queues are safe by topology: each has
+exactly one reader and one writer process, so a dying holder can only
+poison itself.  Any exception is forwarded as ``("error", ...)`` with
+the full traceback — the consumer re-raises it as a
+``DataWorkerError`` instead of hanging.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import time
+import traceback
+
+import numpy as _np
+
+__all__ = ["epoch_order", "worker_main", "STOP_EPOCH"]
+
+# latest_epoch value meaning "shut down": no real epoch ever matches it,
+# so every wait loop (command, free-slot, batch) falls through and exits
+STOP_EPOCH = -2
+
+
+def epoch_order(n, seed, epoch, shuffle):
+    """The epoch's record order over ``n`` shard records — identical in
+    every process that computes it.  ``shuffle=False`` is file order;
+    ``shuffle=True`` is a permutation seeded ONLY by ``(seed, epoch)``,
+    so a run is reproducible from its seed and every epoch reshuffles."""
+    if not shuffle:
+        return _np.arange(n, dtype=_np.int64)
+    mix = (int(seed) * 1000003 + int(epoch) * 7919) % (2 ** 31 - 1)
+    return _np.random.RandomState(mix).permutation(n).astype(_np.int64)
+
+
+def _augment_rng(seed, epoch, batch_index):
+    """Per-(seed, epoch, GLOBAL batch index) augmentation stream: crop/
+    mirror draws are reproducible across runs AND independent of the
+    worker count — batch b draws the same randoms whether 1 process or
+    8 produced it, so the worker-count-invariance of the batch sequence
+    holds with augmentation on, not just off."""
+    mix = ((int(seed) * 2654435761 + int(epoch) * 97 + int(batch_index))
+           % (2 ** 32))
+    return _np.random.RandomState(mix)
+
+
+def _acquire_slot(free_q, latest_epoch, epoch):
+    """Block for a free slot (backpressure) without ever deadlocking:
+    returns None when the epoch was aborted or the service stopped."""
+    while True:
+        if latest_epoch.value != epoch:
+            return None
+        try:
+            return free_q.get(timeout=0.1)
+        except _queue.Empty:
+            continue
+
+
+def _run_epoch(spec, wid, epoch, state, free_q, full_q, latest_epoch):
+    from .shm import batch_views
+
+    offsets, reader, decoder, ring = state
+    batch = spec["batch_size"]
+    num_workers = spec["num_workers"]
+    n = len(offsets)
+    num_batches = -(-n // batch)
+    order = epoch_order(n, spec["seed"], epoch, spec["shuffle"])
+    for b in range(wid, num_batches, num_workers):
+        if latest_epoch.value != epoch:
+            break
+        slot = _acquire_slot(free_q, latest_epoch, epoch)
+        if slot is None:
+            break
+        t0 = time.time()
+        rng = _augment_rng(spec["seed"], epoch, b)
+        data, label = batch_views(ring.slot_buffer(slot), batch,
+                                  spec["data_shape"], spec["label_width"])
+        idx = order[b * batch:(b + 1) * batch]
+        chunk = [offsets[i] for i in idx]
+        nreal = len(chunk)
+        nbytes = decoder.fill_batch(reader, chunk, data, label, rng)
+        for j in range(nreal, batch):
+            # partial tail batch: pad by wrapping the chunk's own rows
+            # (ImageRecordIter pad semantics — the consumer gets `pad`)
+            data[j] = data[j - nreal]
+            label[j] = label[j - nreal]
+        del data, label  # release the shm views before the slot recycles
+        full_q.put(("batch", epoch, b, slot, batch - nreal,
+                    {"w": wid, "decode_s": time.time() - t0,
+                     "bytes": nbytes, "t0_us": int(t0 * 1e6)}))
+    full_q.put(("done", epoch))
+
+
+def worker_main(spec, wid, ring_name, free_q, full_q, cmd_q, latest_epoch):
+    """Worker process entry point.  `spec` is a plain dict (spawn-safe):
+    path/batch_size/data_shape/label_width/num_workers/seed/shuffle/
+    host_index/num_hosts/ring_slots/slot_bytes + decoder kwargs."""
+    state = None
+    try:
+        # heavyweight imports stay inside the function so a spawn-started
+        # worker pays them here, not at module pickle time
+        from ..image_io import RecordBatchDecoder, shard_offsets
+        from ..native import NativeRecordReader, native_index
+        from .shm import ShmRing
+
+        offsets = shard_offsets(native_index(spec["path"]),
+                                spec["host_index"], spec["num_hosts"])
+        reader = NativeRecordReader(spec["path"])
+        decoder = RecordBatchDecoder(
+            data_shape=spec["data_shape"], label_width=spec["label_width"],
+            mean=spec["mean"], scale=spec["scale"], resize=spec["resize"],
+            rand_crop=spec["rand_crop"], rand_mirror=spec["rand_mirror"],
+            preprocess_threads=spec["preprocess_threads"],
+            force_python_decode=spec["force_python_decode"])
+        ring = ShmRing.attach(ring_name, spec["ring_slots"],
+                              spec["slot_bytes"])
+        state = (offsets, reader, decoder, ring)
+        while latest_epoch.value != STOP_EPOCH:
+            try:
+                cmd = cmd_q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            if cmd[0] == "stop":
+                break
+            _run_epoch(spec, wid, cmd[1], state, free_q, full_q,
+                       latest_epoch)
+    except Exception:
+        # forward the failure in-band: the consumer re-raises it as a
+        # DataWorkerError at next_batch() instead of timing out blind
+        try:
+            full_q.put(("error", wid, traceback.format_exc()))
+        except Exception:
+            pass
+    finally:
+        if state is not None:
+            offsets, reader, decoder, ring = state
+            decoder.close()
+            reader.close()
+            ring.close()
+        # full_q is deliberately NOT cancel_join_thread'd: the last
+        # messages (the "done" marker, a forwarded error traceback) must
+        # flush to the pipe before exit or the consumer sees a bare
+        # "worker died".  The flush cannot block meaningfully — messages
+        # are far smaller than the pipe buffer and outstanding count is
+        # bounded by the ring — and the parent's close() escalation
+        # (terminate/kill) bounds the pathological case.  This worker
+        # never WRITES free_q/cmd_q, so there is nothing else to cancel.

@@ -3,11 +3,16 @@
 Parity: reference src/io/iter_image_recordio_2.cc composition chain
 (record parser → decode/augment workers → BatchLoader → Normalize →
 Prefetcher, SURVEY.md §3.3).  The byte-level record scan runs in native
-C++ (src/recordio.cc); decode+augment run in a Python thread pool (PIL/cv2
-release the GIL); batch assembly rides the dependency engine — each
-batch is one engine op on the shared worker pool (engine.ThreadedIter,
-the dmlc threadediter replacement), so prefetch depth is demand-driven
-and `mx.waitall()` fences the IO pipeline too.
+C++ (src/recordio.cc); decode+augment run through
+:class:`RecordBatchDecoder` — the native batched JPEG engine
+(src/imdecode.cc thread pool) with a Python thread-pool fallback
+(PIL/cv2 release the GIL) — which is SHARED with the multi-process
+data service (mxnet_tpu/data/worker.py), so both input pipelines
+produce bit-identical batches from one decode implementation.  Batch
+assembly rides the dependency engine — each batch is one engine op on
+the shared worker pool (engine.ThreadedIter, the dmlc threadediter
+replacement), so prefetch depth is demand-driven and `mx.waitall()`
+fences the IO pipeline too.
 """
 from __future__ import annotations
 
@@ -19,10 +24,188 @@ from .base import MXNetError
 from .engine.threaded_iter import ThreadedIter
 from .io import DataBatch, DataDesc, DataIter
 from .ndarray import array
-from .ops.random_ops import HOST_RNG
 from .recordio import unpack, _decode_img
 
-__all__ = ["ImageRecordIterImpl"]
+__all__ = ["ImageRecordIterImpl", "RecordBatchDecoder", "shard_offsets"]
+
+
+def shard_offsets(offsets, part_index, num_parts):
+    """``part_index/num_parts`` stride shard of a record-offset list
+    (reference dmlc::InputSplit rank sharding, iter_image_recordio.cc)
+    — ONE implementation shared by ``ImageRecordIter(part_index=,
+    num_parts=)`` and the data service's per-host sharding
+    (mxnet_tpu/data/service.py)."""
+    part_index, num_parts = int(part_index), int(num_parts)
+    if num_parts < 1 or not 0 <= part_index < num_parts:
+        raise MXNetError("invalid shard %d/%d (need 0 <= part < parts)"
+                         % (part_index, num_parts))
+    return list(offsets)[part_index::num_parts]
+
+
+class RecordBatchDecoder:
+    """The read → decode → augment → assemble core, shared by the
+    in-process ``ImageRecordIter`` and the data-service worker
+    processes (mxnet_tpu/data/worker.py).
+
+    Decode prefers the native batched JPEG engine (src/imdecode.cc:
+    one ctypes call decodes a whole batch on a C++ thread pool of
+    ``preprocess_threads`` workers); non-JPEG payloads and
+    toolchain-less installs fall back to per-image Python decode on a
+    ``preprocess_threads``-wide thread pool.  All augmentation randoms
+    (crop position, mirror) are drawn from the CALLER's rng, so the
+    caller owns reproducibility.
+    """
+
+    def __init__(self, data_shape, label_width=1, mean=None, scale=1.0,
+                 resize=0, rand_crop=False, rand_mirror=False,
+                 preprocess_threads=4, force_python_decode=False):
+        self.data_shape = tuple(data_shape)
+        self.label_width = int(label_width)
+        self.mean = (_np.zeros((3,), _np.float32) if mean is None
+                     else _np.asarray(mean, dtype=_np.float32))
+        self.scale = float(scale)
+        self.resize = int(resize)
+        self.rand_crop = bool(rand_crop)
+        self.rand_mirror = bool(rand_mirror)
+        # native batched JPEG decode (src/imdecode.cc) — the default fast
+        # path; Python/PIL remains the per-image fallback for non-JPEG
+        # payloads and toolchain-less installs
+        self._decoder = None
+        if not force_python_decode:
+            try:
+                from .native import NativeImageDecoder
+
+                self._decoder = NativeImageDecoder(preprocess_threads)
+            except Exception:
+                self._decoder = None
+        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+
+    # ------------------------------------------------------------------
+    def layout_code(self):
+        """0 = CHW (reference data_shape (c,h,w)); 1 = HWC ((h,w,c) —
+        the TPU-native channel-last graphs, see ops/nn.py layout)."""
+        return 0 if self.data_shape[0] in (1, 3, 4) else 1
+
+    def _label_of(self, header):
+        label = header.label
+        if not _np.isscalar(label) and hasattr(label, "__len__"):
+            label = _np.asarray(label, dtype=_np.float32)[: self.label_width]
+        return label
+
+    def decode_one(self, raw, rng):
+        """Per-image Python decode+augment path; returns (img, label)."""
+        header, payload = unpack(raw)
+        img = _decode_img(payload, rgb=True)
+        img = _np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.layout_code() == 0:
+            c, h, w = self.data_shape
+        else:
+            h, w, c = self.data_shape
+        # crop/resize to target (random crop for training parity:
+        # reference image_aug_default.cc rand_crop)
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            # upscale small images with nearest repeat
+            ry = max(1, -(-h // ih))
+            rx = max(1, -(-w // iw))
+            img = _np.repeat(_np.repeat(img, ry, axis=0), rx, axis=1)
+            ih, iw = img.shape[:2]
+        if self.rand_crop and (ih > h or iw > w):
+            y0 = rng.randint(0, ih - h + 1)
+            x0 = rng.randint(0, iw - w + 1)
+        else:
+            y0 = (ih - h) // 2
+            x0 = (iw - w) // 2
+        img = img[y0 : y0 + h, x0 : x0 + w]
+        if img.shape[2] < c:
+            img = _np.repeat(img, c, axis=2)
+        elif img.shape[2] > c:
+            img = img[:, :, :c]
+        if self.rand_mirror and rng.randint(2):
+            img = img[:, ::-1]
+        if self.layout_code() == 0:
+            out = img.transpose(2, 0, 1).astype(_np.float32)
+            if self.mean.any():
+                out -= self.mean[:c].reshape(c, 1, 1)
+        else:
+            out = img.astype(_np.float32)
+            if self.mean.any():
+                out -= self.mean[:c]
+        if self.scale != 1.0:
+            out *= self.scale
+        return out, self._label_of(header)
+
+    def _fill_native(self, raws, batch_data, batch_label, rng):
+        """Batched C++ decode of one chunk; returns False to use the
+        Python path (native decoder off or non-3-channel target)."""
+        if self._decoder is None:
+            return False
+        layout = self.layout_code()
+        c = self.data_shape[0] if layout == 0 else self.data_shape[-1]
+        if c != 3:
+            return False
+        n = len(raws)
+        payloads = []
+        for j, raw in enumerate(raws):
+            header, payload = unpack(raw)
+            batch_label[j] = self._label_of(header)
+            payloads.append(bytes(payload))
+        cu = rng.uniform(size=n).astype(_np.float32) if self.rand_crop \
+            else _np.full((n,), 0.5, _np.float32)
+        cv = rng.uniform(size=n).astype(_np.float32) if self.rand_crop \
+            else _np.full((n,), 0.5, _np.float32)
+        mir = rng.randint(0, 2, size=n).astype(_np.uint8) if self.rand_mirror \
+            else _np.zeros((n,), _np.uint8)
+        status = self._decoder.decode_batch(
+            payloads, batch_data[:n], cu, cv, mir, self.mean, self.scale,
+            resize_short=self.resize, layout=layout)
+        for j in _np.nonzero(status < 0)[0]:
+            # non-JPEG payload (PNG / raw array): per-image Python fallback
+            img, _ = self.decode_one(raws[j], rng)
+            batch_data[j] = img
+        return True
+
+    def fill_batch(self, reader, offsets, batch_data, batch_label, rng):
+        """Read+decode the records at `offsets` into the FIRST
+        ``len(offsets)`` rows of the preallocated ``batch_data`` /
+        ``batch_label`` (tail padding is the caller's policy).  Returns
+        the compressed bytes read — the decode-throughput accounting
+        both pipelines report (``data.worker_bytes`` /
+        ``parse_log --telemetry decode_mbps``)."""
+        raws = [reader.read_at(off) for off in offsets]
+        if not self._fill_native(raws, batch_data, batch_label, rng):
+            if self.rand_crop or self.rand_mirror:
+                # augmenting across pool threads: ONE shared RandomState
+                # is neither thread-safe nor deterministic, so draw a
+                # per-record seed SEQUENTIALLY from the caller's rng and
+                # give every task its own child stream — reproducible
+                # regardless of thread scheduling (the native path draws
+                # all its randoms in the caller thread for the same
+                # reason)
+                seeds = rng.randint(0, 2 ** 31, size=len(raws))
+                rngs = [_np.random.RandomState(s) for s in seeds]
+            else:
+                rngs = [rng] * len(raws)  # no draws happen
+            futures = [self._pool.submit(self.decode_one, raw, r)
+                       for raw, r in zip(raws, rngs)]
+            for j, fut in enumerate(futures):
+                img, label = fut.result()
+                batch_data[j] = img
+                batch_label[j] = label
+        return sum(len(r) for r in raws)
+
+    def close(self):
+        """Join the Python fallback pool's workers.  Idempotent; the
+        decoder is not usable afterwards."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    @property
+    def closed(self):
+        return self._pool is None
 
 
 class ImageRecordIterImpl(DataIter):
@@ -43,33 +226,20 @@ class ImageRecordIterImpl(DataIter):
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
-        self.rand_crop = rand_crop
-        self.rand_mirror = rand_mirror
-        self.mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
-        self.scale = scale
-        self.resize = int(resize)
-        self.data_name = data_name
-        self.label_name = label_name
         self._rng = _np.random.RandomState(seed)
         self._reader = NativeRecordReader(path_imgrec)
-        # native batched JPEG decode (src/imdecode.cc) — the default fast
-        # path; Python/PIL remains the per-image fallback for non-JPEG
-        # payloads and toolchain-less installs
-        self._decoder = None
-        if not kwargs.get("force_python_decode"):
-            try:
-                from .native import NativeImageDecoder
-
-                self._decoder = NativeImageDecoder(preprocess_threads)
-            except Exception:
-                self._decoder = None
-        offsets = native_index(path_imgrec)
+        self._core = RecordBatchDecoder(
+            data_shape=self.data_shape, label_width=label_width,
+            mean=[mean_r, mean_g, mean_b], scale=scale, resize=resize,
+            rand_crop=rand_crop, rand_mirror=rand_mirror,
+            preprocess_threads=preprocess_threads,
+            force_python_decode=bool(kwargs.get("force_python_decode")))
         # sharded reading for distributed training (reference
         # dmlc::InputSplit rank sharding, iter_image_recordio.cc)
-        self._offsets = offsets[part_index::num_parts]
+        self._offsets = shard_offsets(native_index(path_imgrec),
+                                      part_index, num_parts)
         if not self._offsets:
             raise MXNetError("no records in shard %d/%d of %s" % (part_index, num_parts, path_imgrec))
-        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         self._prefetch = max(1, int(prefetch_buffer))
         self._bg = None
         self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
@@ -78,92 +248,16 @@ class ImageRecordIterImpl(DataIter):
         ]
         self.reset()
 
-    # ------------------------------------------------------------------
-    def _decode_one(self, raw):
-        header, payload = unpack(raw)
-        img = _decode_img(payload, rgb=True)
-        img = _np.asarray(img)
-        if img.ndim == 2:
-            img = img[:, :, None]
-        if self._layout_code() == 0:
-            c, h, w = self.data_shape
-        else:
-            h, w, c = self.data_shape
-        # crop/resize to target (random crop for training parity:
-        # reference image_aug_default.cc rand_crop)
-        ih, iw = img.shape[:2]
-        if ih < h or iw < w:
-            # upscale small images with nearest repeat
-            ry = max(1, -(-h // ih))
-            rx = max(1, -(-w // iw))
-            img = _np.repeat(_np.repeat(img, ry, axis=0), rx, axis=1)
-            ih, iw = img.shape[:2]
-        if self.rand_crop and (ih > h or iw > w):
-            y0 = self._rng.randint(0, ih - h + 1)
-            x0 = self._rng.randint(0, iw - w + 1)
-        else:
-            y0 = (ih - h) // 2
-            x0 = (iw - w) // 2
-        img = img[y0 : y0 + h, x0 : x0 + w]
-        if img.shape[2] < c:
-            img = _np.repeat(img, c, axis=2)
-        elif img.shape[2] > c:
-            img = img[:, :, :c]
-        if self.rand_mirror and self._rng.randint(2):
-            img = img[:, ::-1]
-        if self._layout_code() == 0:
-            out = img.transpose(2, 0, 1).astype(_np.float32)
-            if self.mean.any():
-                out -= self.mean[:c].reshape(c, 1, 1)
-        else:
-            out = img.astype(_np.float32)
-            if self.mean.any():
-                out -= self.mean[:c]
-        if self.scale != 1.0:
-            out *= self.scale
-        return out, self._label_of(header)
+    # legacy attribute surface: the decode machinery lives on the shared
+    # core now, but `it._pool` / `it._decoder` stay readable (tests and
+    # user probes rely on them)
+    @property
+    def _pool(self):
+        return self._core._pool
 
-    def _label_of(self, header):
-        label = header.label
-        if not _np.isscalar(label) and hasattr(label, "__len__"):
-            label = _np.asarray(label, dtype=_np.float32)[: self.label_width]
-        return label
-
-    def _layout_code(self):
-        """0 = CHW (reference data_shape (c,h,w)); 1 = HWC ((h,w,c) —
-        the TPU-native channel-last graphs, see ops/nn.py layout)."""
-        return 0 if self.data_shape[0] in (1, 3, 4) else 1
-
-    def _fill_batch_native(self, chunk, batch_data, batch_label):
-        """Batched C++ decode of one batch; returns False to use the
-        Python path (native decoder off or non-3-channel target)."""
-        if self._decoder is None:
-            return False
-        layout = self._layout_code()
-        c = self.data_shape[0] if layout == 0 else self.data_shape[-1]
-        if c != 3:
-            return False
-        n = len(chunk)
-        raws = [self._reader.read_at(off) for off in chunk]
-        payloads = []
-        for j, raw in enumerate(raws):
-            header, payload = unpack(raw)
-            batch_label[j] = self._label_of(header)
-            payloads.append(bytes(payload))
-        cu = self._rng.uniform(size=n).astype(_np.float32) if self.rand_crop \
-            else _np.full((n,), 0.5, _np.float32)
-        cv = self._rng.uniform(size=n).astype(_np.float32) if self.rand_crop \
-            else _np.full((n,), 0.5, _np.float32)
-        mir = self._rng.randint(0, 2, size=n).astype(_np.uint8) if self.rand_mirror \
-            else _np.zeros((n,), _np.uint8)
-        status = self._decoder.decode_batch(
-            payloads, batch_data[:n], cu, cv, mir, self.mean, self.scale,
-            resize_short=self.resize, layout=layout)
-        for j in _np.nonzero(status < 0)[0]:
-            # non-JPEG payload (PNG / raw array): per-image Python fallback
-            img, _ = self._decode_one(raws[j])
-            batch_data[j] = img
-        return True
+    @property
+    def _decoder(self):
+        return self._core._decoder
 
     def _batches(self, order):
         """Generator yielding (data, label[, pad]) per batch; driven one
@@ -173,15 +267,8 @@ class ImageRecordIterImpl(DataIter):
         batch_label = _np.zeros(lshape, dtype=_np.float32)
         for start in range(0, len(order), self.batch_size):
             chunk = order[start:start + self.batch_size]
-            if not self._fill_batch_native(chunk, batch_data, batch_label):
-                futures = [
-                    self._pool.submit(self._decode_one, self._reader.read_at(off))
-                    for off in chunk
-                ]
-                for j, fut in enumerate(futures):
-                    img, label = fut.result()
-                    batch_data[j] = img
-                    batch_label[j] = label
+            self._core.fill_batch(self._reader, chunk, batch_data,
+                                  batch_label, self._rng)
             n = len(chunk)
             if n == self.batch_size:
                 yield (batch_data.copy(), batch_label.copy())
@@ -202,12 +289,10 @@ class ImageRecordIterImpl(DataIter):
         if self._bg is not None:
             self._bg.close()
             self._bg = None
-        if self._pool is not None:
-            self._pool.shutdown(wait=True)
-            self._pool = None
+        self._core.close()
 
     def reset(self):
-        if self._pool is None:
+        if self._core.closed:
             raise MXNetError("ImageRecordIter is closed")
         if self._bg is not None:
             self._bg.close()  # drains in-flight fetches before we rewind

@@ -23,7 +23,8 @@ from .ndarray import NDArray, array
 __all__ = [
     "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
     "PrefetchingIter", "DeviceStagedIter", "StagedBlock", "MNISTIter",
-    "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "stage_put",
+    "CSVIter", "ImageRecordIter", "ImageDetRecordIter",
+    "ShardedImageRecordIter", "stage_put",
 ]
 
 
@@ -665,3 +666,17 @@ def ImageDetRecordIter(**kwargs):
     from .det_io import ImageDetRecordIterImpl
 
     return ImageDetRecordIterImpl(**kwargs)
+
+
+def ShardedImageRecordIter(**kwargs):
+    """Multi-process sharded RecordIO image iterator (mxnet_tpu.data):
+    ``num_workers`` decode PROCESSES (default ``MXTPU_DATA_WORKERS``)
+    feed batches through shared-memory rings, with deterministic
+    ``(seed, epoch)`` coverage, per-host sharding composed on top
+    (``host_index``/``num_hosts``), and worker-crash detection.  Same
+    decode/augment surface as ``ImageRecordIter``; plugs into
+    ``DeviceStagedIter``/``Module.fit`` like any DataIter.  See
+    docs/data.md."""
+    from .data.iter import ShardedImageRecordIter as _Impl
+
+    return _Impl(**kwargs)

@@ -13,7 +13,7 @@ import threading
 import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "record_span", "record_counter"]
+           "record_span", "record_counter", "register_thread_name"]
 
 import os as _os
 
@@ -135,6 +135,16 @@ def record_span(name, start_us, dur_us, cat="operator", tid=None):
             _TID_NAMES[tid] = threading.current_thread().name
         _EVENTS.append({"name": name, "cat": cat, "ph": "X", "ts": start_us,
                         "dur": dur_us, "pid": PID_HOST, "tid": tid})
+
+
+def register_thread_name(tid, name):
+    """Label a SYNTHETIC trace lane: spans recorded on behalf of another
+    process (e.g. data-service worker decode, mxnet_tpu/data) carry a
+    caller-chosen tid outside the real-thread-id space; this maps it to
+    a human name in the dumped trace's thread_name metadata.  First
+    registration wins (matching the span-side harvest)."""
+    with _LOCK:
+        _TID_NAMES.setdefault(int(tid), str(name))
 
 
 # per-series floor between counter samples: engine gauges update on

@@ -63,3 +63,37 @@ def _fresh_name_manager():
 
     NameManager._current.value = NameManager()
     yield
+
+
+def pack_jpeg_rec(tmp_path, n_per_class=24, classes=3, size=24, name="pack"):
+    """Write a tiny labeled JPEG dataset and pack it with tools/im2rec.py;
+    returns the .rec/.idx prefix.  The ONE dataset builder shared by the
+    input-pipeline suites (test_data_service, test_io_hygiene) so the
+    im2rec invocation and dataset shape live in one place."""
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+    import pytest as _pytest
+
+    PIL = _pytest.importorskip("PIL.Image")
+    root = str(tmp_path / "imgs")
+    rng = np.random.RandomState(0)
+    hues = [(200, 40, 40), (40, 200, 40), (40, 40, 200), (200, 200, 40)]
+    for label in range(classes):
+        d = os.path.join(root, "class%d" % label)
+        os.makedirs(d, exist_ok=True)
+        base = hues[label % len(hues)]
+        for i in range(n_per_class):
+            img = np.tile(np.array(base, np.uint8), (size, size, 1))
+            noise = rng.randint(0, 40, img.shape).astype(np.uint8)
+            PIL.fromarray(np.clip(img.astype(int) + noise, 0, 255)
+                          .astype(np.uint8)).save(
+                os.path.join(d, "img%03d.jpg" % i), "JPEG", quality=90)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prefix = str(tmp_path / name)
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+         prefix, root], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return prefix

@@ -138,6 +138,44 @@ def test_device_staged_iter_propagates_source_errors():
     it.close()
 
 
+def test_sharded_iter_resets_leak_no_processes_fds_or_shm(tmp_path):
+    """The data-service regression pin: 3 reset() cycles reuse the SAME
+    worker processes, queues, and shm rings (no per-epoch process or fd
+    growth), and close() joins the workers, unlinks every segment, and
+    is idempotent; reset() after close errors instead of resurrecting a
+    half-torn pipeline."""
+    import multiprocessing as mp
+    import os
+
+    from conftest import pack_jpeg_rec
+
+    prefix = pack_jpeg_rec(tmp_path, n_per_class=8, classes=1, size=16)
+    it = mx.io.ShardedImageRecordIter(path_imgrec=prefix + ".rec",
+                                      data_shape=(3, 16, 16), batch_size=4,
+                                      num_workers=2, ring_slots=2)
+    assert sum(1 for _ in it) == 2
+    mx.waitall()
+    procs_before = len(mp.active_children())
+    fds_before = len(os.listdir("/proc/self/fd"))
+    for _ in range(3):
+        it.reset()
+        assert sum(1 for _ in it) == 2
+    mx.waitall()
+    assert len(mp.active_children()) == procs_before, (
+        "reset() cycles changed the worker-process count")
+    assert len(os.listdir("/proc/self/fd")) <= fds_before, (
+        "reset() cycles leaked file descriptors")
+    shm_names = [r.name for r in it._service._rings]
+    it.close()
+    it.close()  # idempotent
+    assert it._service is None and it._bg is None
+    for name in shm_names:
+        assert not os.path.exists("/dev/shm/%s" % name.lstrip("/")), (
+            "close() left shared-memory segment %s linked" % name)
+    with pytest.raises(mx.base.MXNetError, match="closed"):
+        it.reset()
+
+
 def test_image_record_iter_close_joins_decode_pool(tmp_path):
     """ImageRecordIter.close() shuts the decode pool down (joining its
     worker threads) and is idempotent; reset() after close errors

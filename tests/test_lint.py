@@ -73,6 +73,18 @@ def test_repo_gate_sweeps_the_serving_package():
         assert os.path.join("mxnet_tpu", "serving", "%s.py" % mod) in swept
 
 
+def test_repo_gate_sweeps_the_data_package():
+    """Same pin for mxnet_tpu/data/ — the data service's consumer fetch
+    rides engine ops and books per-batch telemetry (docs/data.md), so
+    every E00x surface exists there too."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    for mod in ("__init__", "service", "worker", "iter", "shm"):
+        assert os.path.join("mxnet_tpu", "data", "%s.py" % mod) in swept
+
+
 # ----------------------------------------------------------------------
 # E001 — undeclared dependencies
 # ----------------------------------------------------------------------
@@ -257,6 +269,40 @@ def test_e002_serving_readback_clean_when_non_atomic(tmp_path):
     assert findings == []
 
 
+# a data-service-consumer-shaped callback (data/iter.py _fetch runs as a
+# ThreadedIter engine op): the fetch blocks on the worker's full queue
+# and then SYNCS on a staged NDArray it built — fine under the
+# ThreadedIter atomic=False convention, a pool-deadlock shape the moment
+# someone "tightens" the push to atomic.  Corpus pins both sides.
+E002_DATA_FETCH_ATOMIC = """
+def schedule_fetch(eng, svc, staged, iter_var):
+    def fetch(_svc=svc, _staged=staged):
+        data, label, pad, meta = _svc.next_batch()
+        out = _staged.put(data, label)
+        out.wait_to_read()
+        return out.asnumpy(), pad
+    eng.push(fetch, write_vars=[iter_var])
+"""
+
+E002_DATA_FETCH_NON_ATOMIC = """
+def schedule_fetch(eng, svc, staged, iter_var):
+    def fetch(_svc=svc, _staged=staged):
+        data, label, pad, meta = _svc.next_batch()
+        out = _staged.put(data, label)
+        out.wait_to_read()
+        return out.asnumpy(), pad
+    eng.push(fetch, write_vars=[iter_var], atomic=False)
+"""
+
+
+def test_e002_fires_on_atomic_data_fetch(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_DATA_FETCH_ATOMIC)
+    got = _ids(findings)
+    assert got.count("E002") == 2, findings  # wait_to_read + asnumpy
+    findings, _, _ = _lint_src(tmp_path, E002_DATA_FETCH_NON_ATOMIC)
+    assert findings == []
+
+
 # ----------------------------------------------------------------------
 # E004 — telemetry/profiler recording must be behind the fast path
 # ----------------------------------------------------------------------
@@ -404,6 +450,40 @@ def test_e004_fires_on_unguarded_serving_batcher_telemetry(tmp_path):
     findings, _, _ = _lint_src(tmp_path, E004_SERVING_UNGUARDED)
     assert _ids(findings) == ["E004", "E004", "E004"], findings
     findings, _, _ = _lint_src(tmp_path, E004_SERVING_GUARDED)
+    assert findings == []
+
+
+# a data-service-consumer-shaped hot loop (data/service.py next_batch
+# booking worker stats once per BATCH): per-batch histogram + per-worker
+# byte counter + two gauges — unguarded, that is four argument
+# constructions per batch with telemetry off
+E004_DATA_BOOK_UNGUARDED = """
+from . import telemetry
+
+def book(meta, occupancy, alive):
+    telemetry.inc("data.batches_produced")
+    telemetry.observe("data.decode_seconds", meta["decode_s"])
+    telemetry.inc("data.worker_bytes.w%d" % meta["w"], meta["bytes"])
+    telemetry.set_gauge("data.ring_occupancy", occupancy())
+"""
+
+E004_DATA_BOOK_GUARDED = """
+from . import telemetry
+
+def book(meta, occupancy, alive):
+    if not telemetry.enabled():
+        return
+    telemetry.inc("data.batches_produced")
+    telemetry.observe("data.decode_seconds", meta["decode_s"])
+    telemetry.inc("data.worker_bytes.w%d" % meta["w"], meta["bytes"])
+    telemetry.set_gauge("data.ring_occupancy", occupancy())
+"""
+
+
+def test_e004_fires_on_unguarded_data_service_booking(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_DATA_BOOK_UNGUARDED)
+    assert _ids(findings) == ["E004"] * 4, findings
+    findings, _, _ = _lint_src(tmp_path, E004_DATA_BOOK_GUARDED)
     assert findings == []
 
 

@@ -9,6 +9,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples",
                         "image-classification")
@@ -45,14 +46,12 @@ def test_mnist_gate_lenet():
     assert acc >= 0.99, acc
 
 
-def test_mnist_gate_real_data():
-    """Real-MNIST gate (reference tests/nightly/test_all.sh:43-66 trains on
-    the actual dataset).  Fetches the ubyte.gz files via test_utils.download
-    when the host has egress (or finds them pre-staged under tests/data/
-    mnist); auto-skips on air-gapped hosts so the suite self-upgrades the
-    moment it runs on a connected machine."""
-    import pytest
-
+def _fetch_mnist_or_skip():
+    """The cached-dataset fallback: test_utils.download fetches the
+    ubyte.gz files when the host has egress, and short-circuits to
+    files pre-staged under tests/data/mnist on air-gapped hosts — so
+    the real-data gates run wherever EITHER is available and the suite
+    self-upgrades the moment it runs on a connected machine."""
     from mxnet_tpu.test_utils import download
 
     data_dir = os.path.join(os.path.dirname(__file__), "data", "mnist")
@@ -64,6 +63,26 @@ def test_mnist_gate_real_data():
             download(base + f, fname=f, dirname=data_dir)
     except IOError as e:
         pytest.skip("no egress and no pre-staged MNIST: %s" % e)
+    return data_dir
 
+
+def test_mnist_gate_real_data():
+    """Real-MNIST gate (reference tests/nightly/test_all.sh:43-66 trains on
+    the actual dataset)."""
+    data_dir = _fetch_mnist_or_skip()
     acc = _run("mlp", extra=["--data-dir", data_dir])
     assert acc >= 0.96, acc
+
+
+@pytest.mark.slow
+def test_mnist_gate_lenet_real_data():
+    """THE reference nightly gate, on real data: LeNet on actual MNIST
+    must reach val accuracy >= 0.99 (reference tests/nightly/
+    test_all.sh:43-66 threshold).  Slow-marked — full 60k train set for
+    several epochs — and egress-permitting via the cached-dataset
+    fallback, so at least one accuracy-on-real-data assertion at the
+    reference's own bar runs in CI."""
+    data_dir = _fetch_mnist_or_skip()
+    acc = _run("lenet", extra=["--data-dir", data_dir,
+                               "--num-epochs", "5", "--lr", "0.05"])
+    assert acc >= 0.99, acc

@@ -17,8 +17,10 @@ compile-cache counters, plus the lazy-fusion columns (flush count,
 mean fused-chain length, fusion-cache hit %) when the run recorded
 the ``lazy`` namespace and the serving columns (queue depth, exact
 batch-fill %, request p99) when it recorded the ``serving`` namespace
-(docs/serving.md).  Older logs render '-' in columns they predate.
-See docs/observability.md.
+(docs/serving.md), and the data-service columns (``data_qdepth`` ring
+backlog, ``decode_mbps`` compressed MB/s through the worker decoders)
+when it recorded the ``data`` namespace (docs/data.md).  Older logs
+render '-' in columns they predate.  See docs/observability.md.
 """
 from __future__ import annotations
 
@@ -101,6 +103,12 @@ def parse_telemetry(lines):
                           if (f_hits + f_misses) else None)
         slots_used = counters.get("serving.batch_slots_used", 0)
         slots_padded = counters.get("serving.batch_slots_padded", 0)
+        # data-service columns (mxnet_tpu/data, docs/data.md): ring
+        # backlog and compressed MB/s through the worker decoders —
+        # '-' for logs that predate the service
+        data_bytes = sum(v for k, v in counters.items()
+                         if k.startswith("data.worker_bytes."))
+        dec_h = hist.get("data.decode_seconds", {})
         rows.append({
             "flush_seq": rec.get("flush_seq"),
             "step": rec.get("step"),
@@ -127,6 +135,9 @@ def parse_telemetry(lines):
                          if (slots_used + slots_padded) else None),
             "req_p99": _hist_quantile(
                 hist.get("serving.request_seconds", {}), 0.99),
+            "data_qdepth": gauges.get("data.ring_occupancy"),
+            "decode_mbps": (data_bytes / dec_h["sum"] / 1e6
+                            if dec_h.get("sum") else None),
         })
     return rows
 
@@ -135,7 +146,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "mfu", "dispatches", "cache_hits", "cache_misses",
                    "io_wait_p50", "h2d_bytes", "lazy_flushes", "chain_mean",
                    "fusion_hit_pct", "wgrad_bf16", "frozen_bn",
-                   "serve_qdepth", "fill_pct", "req_p99"]
+                   "serve_qdepth", "fill_pct", "req_p99", "data_qdepth",
+                   "decode_mbps"]
 
 
 def _print_telemetry(rows, fmt):
