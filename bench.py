@@ -88,6 +88,20 @@ def parse_args():
                         "emits a single JSON row with both sides, "
                         "stdev, and the delta.  With --smoke: tiny "
                         "models on CPU (tests/test_bench_smoke.py)")
+    p.add_argument("--spmd-procs", type=int, default=0,
+                   help="multi-process SPMD row (docs/distributed.md): "
+                        "relaunch this bench as N jax.distributed "
+                        "processes via tools/launch.py --local-spmd, "
+                        "train through the K-step fused dispatch with "
+                        "bucketed hierarchical gradient collectives, and "
+                        "report MEASURED img/s + comm telemetry (bucket "
+                        "bytes, measured collective GB/s, overlap "
+                        "fraction).  With --smoke: tiny CPU model "
+                        "(tests/test_spmd_runtime.py pins the row)")
+    p.add_argument("--spmd-local-devices", type=int, default=2,
+                   help="--spmd-procs: devices per process (CPU mesh)")
+    p.add_argument("--spmd-worker", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: one rank of --spmd-procs
     p.add_argument("--chain-ops", type=int, default=64,
                    help="ops per imperative chain (default 64)")
     p.add_argument("--steps-per-dispatch", type=int, default=None,
@@ -133,6 +147,10 @@ def _fence(mod, name):
 
 def main():
     args = parse_args()
+    if args.spmd_worker:
+        return spmd_worker(args)
+    if args.spmd_procs:
+        return spmd(args)
     if args.decode:
         return decode(args)
     if args.serve:
@@ -781,6 +799,169 @@ def smoke(args):
         "telemetry_stage_occupancy_seen": stage_seen,
         "telemetry_mfu": snap["gauges"].get("module.mfu"),
     }))
+
+
+# ----------------------------------------------------------------------
+# --spmd-procs: the multi-process distributed-runtime row
+# (docs/distributed.md).  The parent relaunches this bench as N ranks
+# through tools/launch.py --local-spmd; every rank joins ONE
+# jax.distributed mesh, trains the same deterministic problem through
+# the K-step fused dispatch (explicit bucketed hierarchical gradient
+# collectives — executor._comm_mode arms automatically at
+# process_count > 1), runs the collective measure_comm probe, and
+# rank 0 prints the row with the comm telemetry snapshot.
+# ----------------------------------------------------------------------
+
+
+def spmd(args):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    if args.smoke:
+        # CPU smoke: a clean virtual-mesh runtime per rank (ranks size
+        # their own device count via MXTPU_LOCAL_DEVICES).  Non-smoke
+        # keeps the platform env INTACT — on real TPU hosts the
+        # per-rank chip partition (TPU_VISIBLE_DEVICES/PROCESS_BOUNDS)
+        # comes from the operator's environment, not from this driver
+        env.pop("XLA_FLAGS", None)
+        for k in list(env):
+            if k.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
+                env.pop(k)
+        env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, os.path.join(repo, "tools", "launch.py"),
+           "--local-spmd", "-n", str(args.spmd_procs), "-s", "0",
+           "--local-devices", str(args.spmd_local_devices),
+           sys.executable, os.path.join(repo, "bench.py"),
+           "--spmd-worker", "--spmd-procs", str(args.spmd_procs),
+           "--steps", str(args.steps)]
+    if args.smoke:
+        cmd.append("--smoke")
+    if args.batch:
+        cmd += ["--batch", str(args.batch)]
+    if args.steps_per_dispatch:
+        cmd += ["--steps-per-dispatch", str(args.steps_per_dispatch)]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=1200)
+    rows = [l[len("SPMDROW "):] for l in proc.stdout.splitlines()
+            if l.startswith("SPMDROW ")]
+    if proc.returncode != 0 or not rows:
+        raise SystemExit("spmd bench failed (rc=%d):\n%s\n%s"
+                         % (proc.returncode, proc.stdout, proc.stderr))
+    print(rows[0])
+
+
+def spmd_worker(args):
+    """One rank of --spmd-procs (launched under --local-spmd env)."""
+    import numpy as np
+
+    from mxnet_tpu.parallel import multihost
+
+    multihost.initialize()
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    rank = jax.process_index()
+    mesh = multihost.global_mesh(hierarchical=True)
+    n_dev = jax.device_count()
+    K = args.steps_per_dispatch or 2
+    BATCH = args.batch or (16 * n_dev if args.smoke else 32 * n_dev)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    if args.smoke:
+        X = rng.randn(BATCH * 4, 64).astype("float32")
+        y = rng.randint(0, 8, BATCH * 4).astype("float32")
+        it = mx.io.ResizeIter(mx.io.NDArrayIter(X, y, batch_size=BATCH),
+                              size=1 << 30)
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, num_hidden=256, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        fence_arg = "fc1_weight"
+    else:
+        from mxnet_tpu.models.resnet import resnet
+
+        it = _endless_iter(mx, rng, BATCH, (224, 224, 3), 1000)
+        net = resnet(50, layout="NHWC")
+        fence_arg = "fc1_weight"
+    mod = mx.mod.Module(net, context=mx.cpu() if args.smoke else mx.tpu(),
+                        mesh=mesh)
+    data_shape = it.provide_data[0][1]
+    label_shape = it.provide_label[0][1]
+    mod.bind(data_shapes=[("data", tuple(data_shape))],
+             label_shapes=[(it.provide_label[0][0], tuple(label_shape))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    exe = mod._exec_group.execs[0]
+    if exe._comm_mode() is None:
+        # a bare assert would vanish under python -O and let a row
+        # labelled "bucketed collectives" report an unarmed run
+        raise SystemExit("--spmd-procs: the bucketed collective path "
+                         "did not arm on this mesh (see "
+                         "executor._comm_mode) — the row would be "
+                         "mislabelled")
+    staged = mx.io.DeviceStagedIter(it, steps_per_dispatch=K,
+                                    place_fn=exe.place_block_input)
+    blocks_per_chunk = max(1, -(-args.steps // K // 3))
+    rates, steps_done = [], 0
+    try:
+        block = next(staged)  # compile + settle
+        mod.forward_backward(block)
+        mod.update()
+        _fence(mod, fence_arg)
+        for _ in range(3):
+            t0 = time.time()
+            n = 0
+            for _ in range(blocks_per_chunk):
+                block = next(staged)
+                mod.forward_backward(block)
+                mod.update()
+                n += block.count
+            _fence(mod, fence_arg)
+            rates.append(BATCH * n / (time.time() - t0))
+            steps_done += n
+    finally:
+        staged.close()
+    # the probe is COLLECTIVE: every rank calls it here, in step
+    probe = exe.measure_comm(iters=2)
+    snap = telemetry.snapshot()
+    if rank == 0:
+        import numpy as _np
+
+        comm_counters = {k: v for k, v in snap["counters"].items()
+                         if k.startswith("comm.")}
+        print("SPMDROW " + json.dumps({
+            "metric": "multi-process SPMD train img/s (%d procs x %d "
+                      "devices, K=%d, bucketed hierarchical collectives)"
+                      % (jax.process_count(),
+                         n_dev // jax.process_count(), K),
+            "value": round(float(_np.mean(rates)), 2),
+            "unit": "img/s",
+            "stdev": round(float(_np.std(rates)), 2),
+            "batch": BATCH,
+            "steps": steps_done,
+            "mesh_axes": list(mesh.axis_names),
+            "comm": {
+                "buckets": probe["buckets"],
+                "bucket_bytes": probe["bucket_bytes"],
+                "bytes_reduced": comm_counters.get("comm.bytes_reduced"),
+                "dispatches": comm_counters.get("comm.dispatches"),
+                "gbps": round(probe["comm_gbps"], 4),
+                "overlap_frac": round(probe["overlap_frac"], 4),
+            },
+        }))
+    multihost.sync_global_devices("bench_spmd_done")
 
 
 # ----------------------------------------------------------------------

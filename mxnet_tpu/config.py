@@ -64,6 +64,40 @@ REGISTRY = [
     EnvVar("MXTPU_PROCESS_ID", int, 0,
            "This host's process index in the multi-host mesh "
            "(parallel/multihost.py; falls back to DMLC_WORKER_ID)"),
+    EnvVar("MXTPU_MPIRUN", str, "mpirun",
+           "Binary tools/launch.py --launcher mpi invokes (tests shim it "
+           "without an MPI install)"),
+    EnvVar("MXTPU_QSUB", str, "qsub",
+           "Binary tools/launch.py --launcher sge submits array jobs "
+           "with (tests shim it without a grid engine)"),
+    EnvVar("MXTPU_QDEL", str, "qdel",
+           "Binary tools/launch.py --launcher sge cancels jobs with on "
+           "failure"),
+    EnvVar("MXTPU_LOCAL_DEVICES", int, 0,
+           "Per-process CPU device count for multi-process SPMD testing "
+           "(exported by tools/launch.py --local-spmd --local-devices; "
+           "multihost.initialize forces "
+           "--xla_force_host_platform_device_count to it).  0 = leave "
+           "the platform's own device discovery alone"),
+    # ---- gradient collectives (executor.py + parallel/collectives.py;
+    #      docs/distributed.md) ----
+    EnvVar("MXTPU_COMM_BUCKETED", str, "auto",
+           "Explicit bucketed hierarchical gradient all-reduce in the "
+           "K-step fused dispatch (executor._comm_mode): grads pack "
+           "into MXTPU_COMM_BUCKET_MB buckets, each hierarchical-"
+           "psum'd ICI-first then DCN inside the scan body, so every "
+           "bucket's reduction overlaps the remaining backward compute "
+           "structurally.  'auto' (default) arms it on multi-process "
+           "meshes only; 1 forces it on any >1-device data mesh "
+           "(single-host SPMD included); 0 keeps the implicit XLA "
+           "partitioner collectives everywhere"),
+    EnvVar("MXTPU_COMM_BUCKET_MB", float, 4.0,
+           "Target gradient bucket size in MB for the explicit "
+           "collective path (collectives.plan_buckets): small grads "
+           "coalesce into transfers big enough to reach wire "
+           "bandwidth, large grads get their own bucket.  Smaller = "
+           "earlier first all-reduce (more overlap), larger = fewer "
+           "per-collective fixed costs"),
     # ---- dependency engine (engine/) ----
     EnvVar("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
            "Execution engine backend (engine/): ThreadedEnginePerDevice "

@@ -201,13 +201,31 @@ class KVStore:
     def barrier(self):
         pass
 
+    def _require_updater(self, what):
+        """Optimizer state lives with the updater.  On dist stores (and
+        any update-on-kvstore topology without a local updater) the
+        optimizer runs ON THE SERVERS, so worker-side state files do
+        not exist — raise a real error with the working alternative
+        instead of a bare assert (python -O would silently skip it and
+        crash on self._updater.get_states())."""
+        if self._updater is None:
+            raise MXNetError(
+                "%s: this %r kvstore has no local updater — with "
+                "update-on-kvstore the optimizer state lives on the "
+                "server processes.  Checkpoint the worker-side view "
+                "instead: from rank 0 only, save params via "
+                "Module.save_checkpoint(prefix, epoch) and resume with "
+                "a fresh optimizer, or run with update_on_kvstore=False "
+                "so every worker holds the updater state locally"
+                % (what, self.type))
+
     def save_optimizer_states(self, fname):
-        assert self._updater is not None, "Cannot save states for distributed training"
+        self._require_updater("save_optimizer_states")
         with open(fname, "wb") as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
-        assert self._updater is not None, "Cannot load states for distributed training"
+        self._require_updater("load_optimizer_states")
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 

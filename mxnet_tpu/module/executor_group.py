@@ -231,7 +231,9 @@ class DataParallelExecutorGroup:
         exe = self.execs[0]
         k = getattr(exe, "_last_block_count", 0)
         if k:
-            preds = [_np.asarray(o.data) for o in exe.outputs]
+            # asnumpy (not np.asarray) so batch-sharded GLOBAL outputs of
+            # a multi-process mesh allgather their remote shards
+            preds = [o.asnumpy() for o in exe.outputs]
             if telemetry.enabled():
                 telemetry.inc("executor.d2h_bytes",
                               sum(int(p.nbytes) for p in preds))

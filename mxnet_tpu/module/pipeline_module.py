@@ -58,7 +58,7 @@ from ..executor import _run_graph
 from ..initializer import InitDesc, Uniform
 from ..ndarray import NDArray
 from ..symbol import Group, _topo_order
-from ..parallel.collectives import shard_map
+from ..parallel.collectives import shard_map, shard_map_unchecked
 from ..parallel.mesh import NamedSharding, P
 from ..parallel.pipeline_schedule import make_schedule, run_forward, run_schedule
 from .base_module import BaseModule
@@ -522,11 +522,10 @@ class PipelineModule(BaseModule):
                               rng, pipe, aux_row=aux_row)
             return out, buf * 0.0, aux_buf  # grads/aux unchanged on eval
 
-        return shard_map(
+        return shard_map_unchecked(
             engine, mesh=mesh,
             in_specs=(P(pipe), P(pipe), mb_spec, mb_spec, P()),
-            out_specs=(mb_spec, P(pipe), P(pipe)),
-            check_vma=False)
+            out_specs=(mb_spec, P(pipe), P(pipe)))
 
     def _get_train_jit(self):
         if self._train_jit is None:

@@ -274,7 +274,17 @@ class NDArray:
     # host transfer / sync (reference WaitToRead / asnumpy)
     # ------------------------------------------------------------------
     def asnumpy(self):
-        return _np.asarray(self.data)
+        d = self.data
+        if isinstance(d, jax.Array) and not d.is_fully_addressable \
+                and not d.is_fully_replicated:
+            # a batch-sharded GLOBAL array (multi-process mesh): remote
+            # shards must be allgathered before a host read — collective,
+            # so every process's training loop reaches here in the same
+            # order (SPMD); see parallel/multihost.fetch
+            from .parallel.multihost import fetch
+
+            return fetch(d)
+        return _np.asarray(d)
 
     def asscalar(self):
         return self.asnumpy().reshape(()).item()

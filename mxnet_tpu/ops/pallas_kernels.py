@@ -71,6 +71,20 @@ def bn_stats_supported(shape, channel_axis):
     return _pick_bm(m // fold) is not None
 
 
+def _compiler_params_cls(pltpu):
+    """The TPU compiler-params class under whichever name this jax
+    spells it (TPUCompilerParams -> CompilerParams rename); a rename to
+    a THIRD spelling fails with the version mismatch named, not a
+    'NoneType is not callable'."""
+    for name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            return cls
+    raise AttributeError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams — unsupported jax/pallas version")
+
+
 def _stats_kernel(x_ref, s1_ref, s2_ref):
     from jax.experimental import pallas as pl
 
@@ -100,7 +114,7 @@ def _stats_fwd_impl(x2, bm, bc):
                    pl.BlockSpec((1, bc), lambda ci, mi: (0, ci))],
         out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32),
                    jax.ShapeDtypeStruct((1, c), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls(pltpu)(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_INTERPRET,
     )(x2)

@@ -133,7 +133,7 @@ def ring_attention_op(query, key, value, causal=False, scale=None,
     from jax import lax as _lax
 
     from ..parallel import ring_attention as _ra
-    from ..parallel.collectives import shard_map
+    from ..parallel.collectives import shard_map_unchecked
     from ..parallel.mesh import P
 
     causal = _bool_attr(causal)
@@ -159,8 +159,8 @@ def ring_attention_op(query, key, value, causal=False, scale=None,
     def body(qs, ks, vs):
         return fn(qs, ks, vs, "seq", causal=causal, scale=sc)
 
-    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(query, key, value)
+    return shard_map_unchecked(body, mesh=mesh, in_specs=(spec, spec, spec),
+                               out_specs=spec)(query, key, value)
 
 
 def _bool_attr(v):

@@ -799,10 +799,19 @@ class DistKVStore:
             pass
 
     def save_optimizer_states(self, fname):
-        raise MXNetError("Cannot save states for distributed training")
+        raise MXNetError(
+            "save_optimizer_states on a %r store: the optimizer runs on "
+            "the server processes (set_optimizer shipped it there), so "
+            "workers hold no state to save.  Checkpoint params from "
+            "rank 0 only (kv.rank == 0) via Module.save_checkpoint and "
+            "resume with a fresh optimizer" % self.type)
 
     def load_optimizer_states(self, fname):
-        raise MXNetError("Cannot load states for distributed training")
+        raise MXNetError(
+            "load_optimizer_states on a %r store: the optimizer state "
+            "lives on the server processes.  Resume from a rank-0 "
+            "params checkpoint (Module.load + fit(begin_epoch=...)) "
+            "with a fresh optimizer instead" % self.type)
 
 
 # ----------------------------------------------------------------------

@@ -14,9 +14,56 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "data_parallel_mesh", "shard_batch", "replicate",
+           "data_axes", "batch_pspec", "global_put",
            "P", "Mesh", "NamedSharding"]
 
 P = PartitionSpec
+
+
+def data_axes(mesh):
+    """The mesh axes that carry the batch dimension, in mesh order.
+
+    A flat mesh names one axis 'data'; a hierarchical multi-host mesh
+    (multihost.global_mesh hierarchical=True) splits it into
+    'data_dcn' (outer, process-major) x 'data_ici' (inner, this host's
+    chips) so collectives can reduce ICI-first.  Both spell "sharded
+    over the batch" as P(data_axes(mesh)) — see batch_pspec."""
+    if mesh is None:
+        return ()
+    return tuple(n for n in mesh.axis_names
+                 if n == "data" or str(n).startswith("data_"))
+
+
+def batch_pspec(mesh, lead_dims=0):
+    """PartitionSpec sharding one dim over ALL data axes, after
+    `lead_dims` unsharded leading dims (K-step blocks pass 1: the batch
+    axis of a stacked (K, batch, ...) block is dim 1)."""
+    axes = data_axes(mesh)
+    if not axes:
+        return P()
+    entry = axes[0] if len(axes) == 1 else axes
+    return P(*([None] * lead_dims + [entry]))
+
+
+def global_put(value, sharding):
+    """device_put that also works when `sharding` spans devices of OTHER
+    processes (a jax.distributed multi-host mesh): every process holds
+    the SAME full host value and contributes its addressable shards
+    (jax.make_array_from_callback) — the GDA/pjit-style global-array
+    materialization step.  Single-process shardings take the plain
+    device_put fast path; an already-correctly-placed global array is
+    returned as-is (the staging pipeline re-places idempotently)."""
+    if isinstance(value, jax.Array) and value.sharding == sharding:
+        return value
+    if sharding.is_fully_addressable:
+        return jax.device_put(value, sharding)
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        # global -> global reshard: every process participates (SPMD),
+        # so the runtime's cross-process transfer path applies
+        return jax.device_put(value, sharding)
+    host = _np.asarray(value)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
 
 
 def make_mesh(axes, devices=None):

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .collectives import shard_map
+from .collectives import axis_size, shard_map, shard_map_unchecked
 from .mesh import P
 
 __all__ = ["top_k_gating", "moe_apply", "moe_sharded"]
@@ -73,7 +73,7 @@ def moe_apply(expert_fn, params, x, gate_w, k=1, capacity_factor=1.0,
     each shard applies its experts -> all_to_all back -> combine.
     Returns [T_local, D].
     """
-    n_shards = lax.axis_size(axis_name)
+    n_shards = axis_size(axis_name)
     t_local, d = x.shape
     local_experts = jax.tree_util.tree_leaves(params)[0].shape[0]
     n_exp = n_shards * local_experts
@@ -129,10 +129,9 @@ def moe_sharded(mesh, expert_fn, stacked_params, x, gate_w, k=1,
     body = functools.partial(moe_apply, expert_fn, k=k,
                              capacity_factor=capacity_factor,
                              axis_name=expert_axis)
-    return shard_map(
+    return shard_map_unchecked(
         body,
         mesh=mesh,
         in_specs=(param_spec, tok_spec, P()),
         out_specs=tok_spec,
-        check_vma=False,
     )(stacked_params, x, gate_w)

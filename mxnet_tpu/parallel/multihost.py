@@ -27,7 +27,8 @@ import os
 import jax
 
 __all__ = ["initialize", "is_initialized", "global_mesh",
-           "host_local_batch", "make_global_array", "sync_global_devices"]
+           "host_local_batch", "make_global_array", "sync_global_devices",
+           "fetch"]
 
 _STATE = {"initialized": False}
 
@@ -42,9 +43,14 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
     real TPU pods jax.distributed discovers these from the TPU metadata
     instead — then all arguments may be None.
 
-    local_device_count forces per-process CPU device count (testing)."""
+    local_device_count forces per-process CPU device count (testing);
+    it defaults to MXTPU_LOCAL_DEVICES when the launcher exported one
+    (tools/launch.py --local-spmd --local-devices)."""
     if _STATE["initialized"]:
         return
+    if local_device_count is None:
+        env_n = int(os.environ.get("MXTPU_LOCAL_DEVICES", "0"))
+        local_device_count = env_n if env_n > 0 else None
     if local_device_count is not None:
         flags = os.environ.get("XLA_FLAGS", "")
         flags = " ".join(f for f in flags.split() if not f.startswith(
@@ -65,6 +71,22 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
         process_id = int(os.environ.get(
             "MXTPU_PROCESS_ID", os.environ.get("DMLC_WORKER_ID", "0")))
     if num_processes > 1 or coordinator is not None:
+        # the CPU backend ships no cross-process collectives by default
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"): select the gloo implementation so a localhost
+        # "DCN" of CPU processes can all-reduce.  Set UNCONDITIONALLY —
+        # the knob only governs the CPU backend (TPU/GPU jobs ignore
+        # it), and gating on JAX_PLATFORMS=='cpu' missed every CPU host
+        # that never set the env var — but never clobber an
+        # implementation the user already chose (e.g. 'mpi')
+        try:
+            cur = getattr(jax.config, "jax_cpu_collectives_implementation",
+                          None)
+            if cur in (None, "", "none"):
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+        except Exception:  # pragma: no cover — older jaxlib
+            pass
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=num_processes,
                                    process_id=process_id)
@@ -75,14 +97,31 @@ def is_initialized():
     return _STATE["initialized"]
 
 
-def global_mesh(axes):
+def global_mesh(axes=None, hierarchical=False):
     """Mesh over ALL processes' devices from {'axis': size} (-1 inferred).
 
     Device order is jax.devices() — process-major, so a leading 'data'
     axis puts whole hosts in distinct data shards and cross-host traffic
-    is the gradient all-reduce on DCN, the efficient layout."""
+    is the gradient all-reduce on DCN, the efficient layout.
+
+    ``hierarchical=True`` (with axes=None) names the topology instead of
+    flattening it: {'data_dcn': process_count, 'data_ici': local_devices}
+    — the same device order, but collectives keyed off the axis split
+    (collectives.hierarchical_psum) reduce intra-host ICI first and move
+    ONE pre-reduced value per host across DCN.  Degenerates to a flat
+    {'data': -1} mesh when only one of the two levels has size > 1."""
     from .mesh import make_mesh
 
+    if hierarchical:
+        assert axes is None, "hierarchical=True builds its own axes"
+        n_proc = jax.process_count()
+        n_local = jax.device_count() // max(1, n_proc)
+        if n_proc > 1 and n_local > 1:
+            axes = {"data_dcn": n_proc, "data_ici": n_local}
+        else:
+            axes = {"data": -1}
+    elif axes is None:
+        axes = {"data": -1}
     return make_mesh(axes, devices=jax.devices())
 
 
@@ -114,3 +153,22 @@ def sync_global_devices(tag="barrier"):
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(tag)
+
+
+def fetch(x):
+    """Global jax.Array -> full host numpy on EVERY process.
+
+    Replicated arrays read their local copy; batch-sharded arrays
+    (e.g. stacked per-step outputs) allgather the remote shards first
+    (multihost_utils.process_allgather) — a COLLECTIVE: all processes
+    must call it in the same order, which SPMD training loops do by
+    construction.  Single-process/addressable arrays take the plain
+    numpy path."""
+    import numpy as np
+
+    if not isinstance(x, jax.Array) or x.is_fully_addressable \
+            or x.is_fully_replicated:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .collectives import shard_map
+from .collectives import axis_size, shard_map, shard_map_unchecked
 from .mesh import NamedSharding, P
 
 __all__ = ["pipeline_apply", "pipeline_sharded"]
@@ -50,7 +50,7 @@ def pipeline_apply(stage_fn, params, microbatches, axis_name="pipe",
                (replicated along 'pipe'; only stage 0 reads it).
     Returns [M, mb, ...] outputs, replicated along 'pipe'.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     my_stage = lax.axis_index(axis_name)
     params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, axis=0), params)
     num_mb = microbatches.shape[0]
@@ -108,11 +108,10 @@ def pipeline_sharded(mesh, stage_fn, stacked_params, x, num_microbatches,
 
     body = functools.partial(pipeline_apply, stage_fn, axis_name=pipe_axis,
                              remat=remat)
-    out = shard_map(
+    out = shard_map_unchecked(
         body,
         mesh=mesh,
         in_specs=(param_spec, mb_spec),
         out_specs=out_spec,
-        check_vma=False,
     )(stacked_params, mb)
     return out.reshape((batch,) + out.shape[2:])

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .collectives import shard_map
+from .collectives import axis_size, shard_map, shard_map_unchecked
 from .mesh import NamedSharding, P
 
 __all__ = ["ring_attention", "ring_attention_sharded", "blockwise_attention",
@@ -55,7 +55,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     Each of the `n` ring steps computes one (T_local x T_local) block and
     rotates K/V one hop (`lax.ppermute` — rides ICI on a TPU torus).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     scale = (d ** -0.5) if scale is None else scale
@@ -95,8 +95,8 @@ def ring_attention_sharded(mesh, q, k, v, seq_axis="seq", batch_axis=None,
     spec = P(batch, seq_axis, None, None)
 
     @jax.jit
-    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    @functools.partial(shard_map_unchecked, mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
     def f(qs, ks, vs):
         return ring_attention(qs, ks, vs, seq_axis, causal=causal, scale=scale)
 
@@ -148,7 +148,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
     seq sharding.  Complements ring attention: better for moderate T with
     many heads (two collectives total vs n ppermute hops).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, t_local, h, d = q.shape
     assert h % n == 0, "heads (%d) must divide the seq axis size (%d)" % (h, n)
     scale = (d ** -0.5) if scale is None else scale
@@ -180,8 +180,8 @@ def ulysses_attention_sharded(mesh, q, k, v, seq_axis="seq", batch_axis=None,
     spec = P(batch, seq_axis, None, None)
 
     @jax.jit
-    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    @functools.partial(shard_map_unchecked, mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
     def f(qs, ks, vs):
         return ulysses_attention(qs, ks, vs, seq_axis, causal=causal,
                                  scale=scale)

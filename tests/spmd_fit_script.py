@@ -1,0 +1,120 @@
+"""Worker for tests/test_spmd_runtime.py: one rank of a multi-process
+`Module.fit` job launched by `tools/launch.py --local-spmd -n 2`.
+
+Each process joins the jax.distributed mesh (multihost.initialize reads
+the launcher env), builds the hierarchical global mesh, and runs the
+REAL training stack — Module.fit -> DeviceStagedIter -> K-step fused
+dispatch with bucketed hierarchical gradient collectives — on a shared
+deterministic problem.  It prints per-dispatch loss values and a final
+parameter digest; the test asserts every rank agrees and matches the
+single-process answer.
+
+With --kvstore-check (launcher run with PS roles, -s > 0) it ALSO runs
+a dist_sync push/pull parity pin through the SAME processes: the
+reference-style parameter-server control plane and the SPMD mesh ride
+one launcher invocation.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_problem(mx, np):
+    rng = np.random.RandomState(7)
+    X = rng.randn(64, 12).astype(np.float32)
+    w = rng.randn(12, 1).astype(np.float32)
+    y = (X @ w + 0.1 * rng.randn(64, 1)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="lro_label")
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    a = mx.sym.Activation(h, act_type="tanh")
+    o = mx.sym.FullyConnected(a, num_hidden=1, name="fc2")
+    net = mx.sym.LinearRegressionOutput(o, name="lro")
+    return it, net
+
+
+def run_fit(mx, np, mesh, steps_per_dispatch):
+    from mxnet_tpu.ops.random_ops import HOST_RNG
+
+    mx.random.seed(0)
+    HOST_RNG.seed(123)
+    it, net = build_problem(mx, np)
+    mod = mx.mod.Module(net, label_names=("lro_label",), context=mx.cpu(),
+                        mesh=mesh)
+    losses = []
+
+    def on_batch(param):
+        for name, val in param.eval_metric.get_name_value():
+            losses.append(val)
+
+    mod.fit(it, num_epoch=2, kvstore=None, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric="mse",
+            steps_per_dispatch=steps_per_dispatch,
+            batch_end_callback=on_batch)
+    args, _ = mod.get_params()
+    digest = np.concatenate([args[n].asnumpy().ravel()
+                             for n in sorted(args)])
+    return losses, digest
+
+
+def kvstore_check(mx, np, rank):
+    kv = mx.kv.create("dist_sync")
+    shape = (5, 7)
+    kv.init("spmd_key", mx.nd.ones(shape))
+    kv.push("spmd_key", mx.nd.ones(shape) * (kv.rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("spmd_key", out=out)
+    expect = sum(r + 1 for r in range(kv.num_workers))
+    got = out.asnumpy()
+    assert np.allclose(got, expect), (got.ravel()[:4], expect)
+    kv.close()
+    print("KVOK rank=%d sum=%.1f" % (rank, float(got.ravel()[0])))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps-per-dispatch", type=int, default=1)
+    parser.add_argument("--kvstore-check", action="store_true")
+    parser.add_argument("--no-fit", action="store_true",
+                        help="skip the training run (fast control-plane-"
+                             "only checks)")
+    args = parser.parse_args()
+
+    from mxnet_tpu.parallel import multihost
+
+    multihost.initialize()
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    rank = jax.process_index()
+    mesh = multihost.global_mesh(hierarchical=True)
+    if not args.no_fit:
+        losses, digest = run_fit(mx, np, mesh, args.steps_per_dispatch)
+        # ONE unbuffered write: both ranks share the launcher's stdout
+        # pipe, and separate print() writes from two processes can
+        # interleave mid-line (single writes under PIPE_BUF are atomic)
+        sys.stdout.write("SPMDFIT rank=%d axes=%s losses=%s digest=%s\n"
+                         % (rank, ",".join(mesh.axis_names),
+                            ";".join("%.6f" % l for l in losses),
+                            ";".join("%.6f" % v for v in digest)))
+        sys.stdout.flush()
+    else:
+        sys.stdout.write("SPMDMESH rank=%d axes=%s devices=%d\n"
+                         % (rank, ",".join(mesh.axis_names),
+                            jax.device_count()))
+        sys.stdout.flush()
+    if args.kvstore_check:
+        kvstore_check(mx, np, rank)
+    multihost.sync_global_devices("spmd_fit_done")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,18 +37,37 @@ def _ids(findings):
 # ----------------------------------------------------------------------
 
 def test_repo_is_lint_clean():
-    """`python -m tools.analysis mxnet_tpu bench.py` must exit 0: every
-    finding fixed or allowlisted with a justification (docs/engine.md).
-    bench.py is in the sweep because its A/B harness (`--ab`) toggles
-    framework env vars — an unregistered read there would ship an
-    undocumented knob just like one inside the package."""
-    findings, suppressed, errors = run_paths([os.path.join(ROOT, "mxnet_tpu"),
-                                              os.path.join(ROOT, "bench.py")])
+    """`python -m tools.analysis mxnet_tpu bench.py tools/bandwidth
+    tools/launch.py` must exit 0: every finding fixed or allowlisted
+    with a justification (docs/engine.md).  bench.py is in the sweep
+    because its A/B harness (`--ab`) toggles framework env vars;
+    tools/bandwidth and the launcher joined in ISSUE 10 — the bandwidth
+    tool feeds SCALING.md's measured anchors and the launcher exports
+    the whole cluster env contract, so an undocumented knob or a
+    blocking-sync regression there ships user-facing rot."""
+    findings, suppressed, errors = run_paths(
+        [os.path.join(ROOT, "mxnet_tpu"),
+         os.path.join(ROOT, "bench.py"),
+         os.path.join(ROOT, "tools", "bandwidth"),
+         os.path.join(ROOT, "tools", "launch.py")])
     assert not errors, errors
     assert not findings, "\n".join(str(f) for f in findings)
     # the allowlist is in use and every entry carries its justification
     for f in suppressed:
         assert "[allowlisted:" in f.message
+
+
+def test_repo_gate_sweeps_bandwidth_tool_and_launcher():
+    """ISSUE 10 pin: the gate walk covers tools/bandwidth/ and
+    tools/launch.py (iter_py_files resolves files and directories), so
+    a future target-list edit cannot silently drop them."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "tools", "bandwidth"),
+                           os.path.join(ROOT, "tools", "launch.py")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    assert os.path.join("tools", "bandwidth", "measure.py") in swept
+    assert os.path.join("tools", "launch.py") in swept
 
 
 def test_cli_runs_and_is_clean():
@@ -727,3 +746,80 @@ def test_file_level_allowlist(tmp_path):
     findings, suppressed, _ = _lint_src(tmp_path, src)
     assert findings == []
     assert _ids(suppressed) == ["W102", "W102"]
+
+
+# ----------------------------------------------------------------------
+# ISSUE 10 corpus — dist control-plane callbacks (parallel/dist.py /
+# multi-process runtime shapes)
+# ----------------------------------------------------------------------
+
+# a dist_sync-shaped pushed comm callback: the worker pushes a per-key
+# engine op that RPCs the parameter server and then SYNCS on the pulled
+# array inside an atomic op — the pool-starvation shape E002 exists
+# for (the blocked worker can occupy the thread the producing op
+# needs).  The real control plane reads raw payloads (declared vars)
+# or pushes atomic=False.
+E002_DIST_PUSH_SYNC = """
+def dist_push(eng, kv, key, grad, key_var):
+    def rpc(_kv=kv, _key=key, _grad=grad):
+        _grad.wait_to_read()
+        _kv._rpc(0, 6, payload=_grad.asnumpy().tobytes())
+    eng.push(rpc, read_vars=[grad._engine_var()], write_vars=[key_var])
+"""
+
+E002_DIST_PUSH_CLEAN = """
+def dist_push(eng, kv, key, grad, key_var):
+    def rpc(_kv=kv, _key=key, _grad=grad):
+        _kv._rpc(0, 6, payload=_grad._raw().tobytes())
+    eng.push(rpc, read_vars=[grad._engine_var()], write_vars=[key_var])
+"""
+
+
+def test_e002_fires_on_blocking_sync_in_dist_comm_callback(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_DIST_PUSH_SYNC)
+    got = _ids(findings)
+    assert got.count("E002") == 2, findings  # wait_to_read + asnumpy
+    assert any("wait_to_read" in f.message for f in findings)
+
+
+def test_e002_dist_comm_callback_clean_on_raw_payload(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_DIST_PUSH_CLEAN)
+    assert findings == []
+
+
+# the bucket hot path (executor.fused_update_block comm accounting):
+# per-dispatch bucket-byte booking must sit behind telemetry.enabled()
+# — E004's contract — or every dispatch pays the recording cost even
+# with the registry off.
+E004_BUCKET_HOT_PATH = """
+from mxnet_tpu import telemetry
+
+
+def dispatch_block(plan, k):
+    telemetry.inc("comm.dispatches")
+    telemetry.inc("comm.bytes_reduced", sum(plan) * k)
+    for nb in plan:
+        telemetry.observe("comm.bucket_bytes", nb)
+"""
+
+E004_BUCKET_HOT_PATH_GUARDED = """
+from mxnet_tpu import telemetry
+
+
+def dispatch_block(plan, k):
+    if telemetry.enabled():
+        telemetry.inc("comm.dispatches")
+        telemetry.inc("comm.bytes_reduced", sum(plan) * k)
+        for nb in plan:
+            telemetry.observe("comm.bucket_bytes", nb)
+"""
+
+
+def test_e004_fires_on_unguarded_bucket_telemetry(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_BUCKET_HOT_PATH)
+    assert _ids(findings).count("E004") == 3, findings
+
+
+def test_e004_bucket_telemetry_clean_when_guarded(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_BUCKET_HOT_PATH_GUARDED)
+    assert findings == []

@@ -23,6 +23,18 @@ dmlc_tracker/sge.py); the scheduler stays on the launch host and the
 launcher exits when it does (all workers deregistered):
 
     python tools/launch.py -n 8 -s 4 --launcher sge -q gpu.q python train.py
+
+Local SPMD mode (docs/distributed.md) brings up a MULTI-PROCESS
+jax.distributed mesh on this host: every worker gets the coordinator
+address (MXTPU_COORDINATOR) plus its rank (MXTPU_PROCESS_ID), so
+`parallel.multihost.initialize()` joins them into ONE global device
+mesh — and the parameter-server control plane (scheduler + servers) is
+launched alongside, so reference-style `dist_sync` kvstore scripts run
+unmodified in the same processes (-s 0 skips the PS roles for
+pure-SPMD jobs):
+
+    python tools/launch.py --local-spmd -n 2 --local-devices 2 \
+        python train.py
 """
 from __future__ import annotations
 
@@ -86,12 +98,28 @@ def main():
     parser.add_argument("--mpi-flavor", choices=["openmpi", "mpich"],
                         default="openmpi",
                         help="(mpi) env-forwarding syntax: -x vs -genv")
+    parser.add_argument("--local-spmd", action="store_true",
+                        help="launch -n worker processes joined into ONE "
+                             "jax.distributed global device mesh on this "
+                             "host (exports MXTPU_COORDINATOR + "
+                             "MXTPU_PROCESS_ID per rank; workers call "
+                             "parallel.multihost.initialize()).  The PS "
+                             "scheduler/servers launch alongside so "
+                             "dist_sync kvstore scripts run unmodified; "
+                             "-s 0 skips them.  See docs/distributed.md")
+    parser.add_argument("--local-devices", type=int, default=0,
+                        help="(--local-spmd) per-process CPU device count "
+                             "(exported as MXTPU_LOCAL_DEVICES; "
+                             "multihost.initialize applies it via "
+                             "XLA_FLAGS); 0 = platform default")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if args.num_servers is None:
         args.num_servers = args.num_workers
     if not args.command:
         parser.error("no command given")
+    if args.local_spmd and args.launcher != "local":
+        parser.error("--local-spmd implies the local launcher")
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     base_env = {
@@ -104,23 +132,36 @@ def main():
         "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
     }
 
+    if args.local_spmd:
+        # one jax.distributed coordinator port for the SPMD mesh, one
+        # DMLC port for the (optional) parameter-server control plane —
+        # both on this host; each worker is one mesh process
+        base_env["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % _free_port()
+        if args.local_devices > 0:
+            base_env["MXTPU_LOCAL_DEVICES"] = str(args.local_devices)
+
     if args.launcher == "local":
         procs = []
 
-        def spawn(role):
+        def spawn(role, rank=None):
             env = dict(os.environ)
             env.update(base_env)
             env["DMLC_ROLE"] = role
+            if rank is not None:
+                env["MXTPU_PROCESS_ID"] = str(rank)
+                env["DMLC_WORKER_ID"] = str(rank)
             if role != "worker":
                 cmd = [sys.executable, "-c", _SERVER_BOOTSTRAP]
             else:
                 cmd = args.command
             return subprocess.Popen(cmd, env=env)
 
-        procs.append(spawn("scheduler"))
-        for _ in range(args.num_servers):
-            procs.append(spawn("server"))
-        workers = [spawn("worker") for _ in range(args.num_workers)]
+        if args.num_servers > 0:
+            procs.append(spawn("scheduler"))
+            for _ in range(args.num_servers):
+                procs.append(spawn("server"))
+        workers = [spawn("worker", rank=i if args.local_spmd else None)
+                   for i in range(args.num_workers)]
         rc = 0
         for p in workers:
             rc |= p.wait()

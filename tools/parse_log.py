@@ -19,8 +19,12 @@ the ``lazy`` namespace and the serving columns (queue depth, exact
 batch-fill %, request p99) when it recorded the ``serving`` namespace
 (docs/serving.md), and the data-service columns (``data_qdepth`` ring
 backlog, ``decode_mbps`` compressed MB/s through the worker decoders)
-when it recorded the ``data`` namespace (docs/data.md).  Older logs
-render '-' in columns they predate.  See docs/observability.md.
+when it recorded the ``data`` namespace (docs/data.md), and the
+distributed-comm columns (``comm_gbps`` measured collective bandwidth,
+``overlap_pct`` fraction of collective time hidden under backward
+compute) when it recorded the ``comm`` namespace
+(docs/distributed.md).  Older logs render '-' in columns they predate.
+See docs/observability.md.
 """
 from __future__ import annotations
 
@@ -138,6 +142,14 @@ def parse_telemetry(lines):
             "data_qdepth": gauges.get("data.ring_occupancy"),
             "decode_mbps": (data_bytes / dec_h["sum"] / 1e6
                             if dec_h.get("sum") else None),
+            # distributed-comm columns (docs/distributed.md): measured
+            # collective GB/s and % of collective time hidden under
+            # backward compute (executor.measure_comm gauges) — '-' for
+            # logs that predate the multi-process runtime
+            "comm_gbps": gauges.get("comm.gbps"),
+            "overlap_pct": (100.0 * gauges["comm.overlap_frac"]
+                            if gauges.get("comm.overlap_frac") is not None
+                            else None),
         })
     return rows
 
@@ -147,7 +159,7 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "io_wait_p50", "h2d_bytes", "lazy_flushes", "chain_mean",
                    "fusion_hit_pct", "wgrad_bf16", "frozen_bn",
                    "serve_qdepth", "fill_pct", "req_p99", "data_qdepth",
-                   "decode_mbps"]
+                   "decode_mbps", "comm_gbps", "overlap_pct"]
 
 
 def _print_telemetry(rows, fmt):

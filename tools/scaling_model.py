@@ -173,8 +173,34 @@ def _compile_step(n_devices, tp, batch_per_chip=32, depth=50, image=224,
             "collective_result_bytes": coll, "collective_counts": counts}
 
 
-def analyze(rec, measured_1chip_img_s=2502.0):
-    """Apply the bandwidth model; see SCALING.md for the derivation."""
+def load_bandwidth(path=None):
+    """Measured bandwidth anchors from BANDWIDTH.json (written by
+    `tools/bandwidth/measure.py --artifact`, schema-checked).  Returns
+    None when the artifact is absent; raises on a torn/invalid file —
+    modeling silently from garbage is worse than not modeling."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BANDWIDTH.json")
+    if not os.path.exists(path):
+        return None
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "bandwidth"))
+    import measure
+
+    return measure.load_artifact(path)
+
+
+def analyze(rec, measured_1chip_img_s=2502.0, w_ici=None):
+    """Apply the bandwidth model; see SCALING.md for the derivation.
+
+    `w_ici` overrides the assumed per-chip all-reduce bandwidth with a
+    MEASURED constant (bytes/s, e.g. BANDWIDTH.json's
+    allreduce.gbps_per_device * 1e9) — the DP rows re-derive from
+    evidence instead of the spec-sheet assumption; the record carries
+    w_ici_gbps + w_source so tables state which one they used."""
+    w = V5E_ICI_BW if w_ici is None else float(w_ici)
+    rec["w_ici_gbps"] = round(w / 1e9, 3)
+    rec["w_source"] = "assumed" if w_ici is None else "measured"
     n = rec["n_devices"]
     bpc = rec["batch_per_chip"]
     # compute time at this per-chip batch from the measured 1-chip rate
@@ -186,7 +212,7 @@ def analyze(rec, measured_1chip_img_s=2502.0):
             "reduce-scatter": (n - 1) / n, "all-to-all": (n - 1) / n,
             "collective-permute": 1.0}
     traffic = sum(v * ring[k] for k, v in cb.items())
-    t_comm_ici = traffic / V5E_ICI_BW
+    t_comm_ici = traffic / w
     # overlap: XLA overlaps the gradient all-reduce with remaining backward
     # compute; bound efficiency between zero and full overlap
     t_no = t_comp + t_comm_ici
@@ -545,7 +571,26 @@ def main():
     p.add_argument("--image", type=int, default=224)
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--out", default="SCALING.json")
+    p.add_argument("--use-measured", action="store_true",
+                   help="anchor the DP rows to BANDWIDTH.json's measured "
+                        "all-reduce GB/s (tools/bandwidth/measure.py "
+                        "--artifact) instead of the assumed W_ici, and "
+                        "print the assumed-vs-measured delta")
     args = p.parse_args()
+    w_measured = None
+    if args.use_measured:
+        bw = load_bandwidth()
+        if bw is None:
+            p.error("--use-measured: no BANDWIDTH.json found — run "
+                    "python tools/bandwidth/measure.py --artifact "
+                    "BANDWIDTH.json first")
+        w_measured = bw["allreduce"]["gbps_per_device"] * 1e9
+        print("# measured anchor: %s all-reduce %.3f GB/s/device "
+              "(x%d devices, BANDWIDTH.json) vs assumed W_ici %.1f GB/s "
+              "-> delta %.1fx"
+              % (bw["platform"], w_measured / 1e9,
+                 bw["allreduce"]["devices"], V5E_ICI_BW / 1e9,
+                 V5E_ICI_BW / w_measured), flush=True)
 
     if args.mesh is not None:
         import jax
@@ -570,7 +615,8 @@ def main():
             if tp and n % 4:
                 continue
             rec = analyze(run_child(n, tp, args.batch_per_chip, args.depth,
-                                    args.image, args.classes))
+                                    args.image, args.classes),
+                          w_ici=w_measured)
             recs.append(rec)
             print(json.dumps(rec), flush=True)
         for leg in ("pp", "ep", "sp"):
