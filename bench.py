@@ -936,9 +936,30 @@ def spmd_worker(args):
     # the probe is COLLECTIVE: every rank calls it here, in step
     probe = exe.measure_comm(iters=2)
     snap = telemetry.snapshot()
+    # per-rank skew column (docs/observability.md "Distributed
+    # observability"): allgather every rank's mean step seconds — a
+    # COLLECTIVE, so all ranks call it — and attribute the straggler
+    # with the same max/median ratio the obs aggregator uses
+    from jax.experimental import multihost_utils
+
+    from mxnet_tpu.obs import aggregate as obs_aggregate
+
+    # dispatch-latency histograms, not module.step_seconds: this driver
+    # calls forward_backward/update directly, so the module-level step
+    # books never fill here
+    d_sum = d_count = 0.0
+    for kind in ("block", "step"):
+        h = snap["histograms"].get("executor.dispatch_seconds.%s" % kind, {})
+        d_sum += h.get("sum", 0.0)
+        d_count += h.get("count", 0)
+    mean_step = (d_sum / d_count) if d_count else 0.0
+    per_rank_step = np.asarray(multihost_utils.process_allgather(
+        np.float64(mean_step))).reshape(-1)
     if rank == 0:
         import numpy as _np
 
+        skew = obs_aggregate.step_skew(
+            {i: float(v) for i, v in enumerate(per_rank_step)})
         comm_counters = {k: v for k, v in snap["counters"].items()
                          if k.startswith("comm.")}
         print("SPMDROW " + json.dumps({
@@ -952,6 +973,14 @@ def spmd_worker(args):
             "batch": BATCH,
             "steps": steps_done,
             "mesh_axes": list(mesh.axis_names),
+            "rank_skew": {
+                "per_rank_step_s": [round(float(v), 6)
+                                    for v in per_rank_step],
+                "max_over_median": (None
+                                    if skew["max_over_median"] is None
+                                    else round(skew["max_over_median"], 4)),
+                "slowest_rank": skew["slowest_rank"],
+            },
             "comm": {
                 "buckets": probe["buckets"],
                 "bucket_bytes": probe["bucket_bytes"],

@@ -223,6 +223,50 @@ REGISTRY = [
            "Hardware peak FLOP/s for the telemetry MFU gauge "
            "(module.mfu); <=0 or unset = the shared TPU v5e constant "
            "(tools/tpu_constants.py, 197e12 bf16 MAC=2)"),
+    # ---- distributed observability (obs/; docs/observability.md) ----
+    EnvVar("MXTPU_OBS_RECORDER", int, 1,
+           "Flight recorder (obs/recorder.py): a fixed-slot per-rank "
+           "ring of collective/dispatch edge events (enter/exit, seq, "
+           "bytes) recorded always-on from the fused-dispatch and "
+           "host-collective paths — the post-mortem substrate of the "
+           "stall watchdog.  0 disables; every call site fast-paths "
+           "out behind recorder.enabled() (mxlint E004)"),
+    EnvVar("MXTPU_OBS_RING_SLOTS", int, 512,
+           "Flight-recorder ring capacity in events (fixed slots, "
+           "preallocated; oldest events overwrite first)"),
+    EnvVar("MXTPU_OBS_STALL_SECONDS", float, 0.0,
+           "Stall watchdog (obs/watchdog.py): a collective/dispatch "
+           "edge event whose exit has not arrived after this many "
+           "seconds triggers a post-mortem artifact (last-K recorder "
+           "events, per-rank progress, Python stacks, straggler-vs-"
+           "hang attribution; write-then-rename to "
+           "MXTPU_OBS_DIR/postmortem.r<rank>.json).  Suppressed while "
+           "a compile bracket is open, so a minutes-long first XLA "
+           "compile never trips it.  0 (default) = watchdog off"),
+    EnvVar("MXTPU_OBS_STALL_ACTION", str, "dump",
+           "What the stall watchdog does after writing the artifact: "
+           "'dump' keeps the process alive (it may yet recover), "
+           "'abort' hard-exits with code 17 so the launcher observes "
+           "a failure instead of an indefinite hang"),
+    EnvVar("MXTPU_OBS_DIR", str, "",
+           "Directory for watchdog post-mortem artifacts (empty = "
+           "current directory)"),
+    EnvVar("MXTPU_OBS_PORT", int, 0,
+           "TCP port of the rank-0 observability aggregator "
+           "(obs/aggregate.py; host side comes from MXTPU_COORDINATOR). "
+           "When set — tools/launch.py --local-spmd --obs exports a "
+           "free one — every rank ships periodic telemetry/recorder "
+           "snapshots to rank 0, measures its wall-clock offset for "
+           "trace stitching (tools/obs_stitch.py), and the watchdog "
+           "can attribute stalls across ranks.  0 = aggregation off"),
+    EnvVar("MXTPU_OBS_INTERVAL_SECONDS", float, 5.0,
+           "Cadence of per-rank snapshot shipping AND of rank 0's "
+           "cluster JSONL records"),
+    EnvVar("MXTPU_OBS_CLUSTER_FILE", str, "",
+           "Non-empty: rank 0's aggregator appends one cluster-level "
+           "JSONL record per interval (per-rank steps/step-time/comm "
+           "columns + max/median step-skew straggler attribution) — "
+           "render with `python tools/parse_log.py --cluster FILE`"),
     # ---- memory (executor.py) ----
     EnvVar("MXNET_BACKWARD_DO_MIRROR", int, 0,
            "Memory mirroring: recompute cheap activations (BN/ReLU/elemwise) "

@@ -876,9 +876,9 @@ class Executor:
         scalars = schedule_prefix(
             opt, [self._fused_index_of_name[n] for n in diff_names], 1)[0]
         sig = tuple((n, tuple(l.shape for l in leaves_by_name[n])) for n in diff_names)
-        self._note_compile_cache(self._jit_step is not None
-                                 and self._jit_step[1] == sig)
-        if self._jit_step is None or self._jit_step[1] != sig:
+        first_call = self._jit_step is None or self._jit_step[1] != sig
+        self._note_compile_cache(not first_call)
+        if first_call:
             core = self._grad_core(diff_idx, nondiff_idx)
 
             def step(diff_vals, nondiff_vals, aux_vals, state_tuples, seed, scalars_arr):
@@ -903,18 +903,34 @@ class Executor:
         import time as _time
 
         from . import profiler, telemetry
+        from .obs import recorder
 
         tel = telemetry.enabled()
         if tel:
             self._note_bytes("executor.donated_bytes",
                              sum(v.nbytes for v in diff_vals)
                              + sum(l.nbytes for st in state_tuples for l in st))
+        # flight-recorder edge events (obs/recorder.py): the dispatch
+        # bracket is what the stall watchdog watches, and the compile
+        # bracket suppresses it across a legitimate first XLA compile
+        rec = recorder.enabled()
+        seq = self._train_dispatches + 1
+        if rec:
+            if first_call:
+                recorder.record("compile", "enter", seq, detail="step")
+            recorder.record("dispatch", "enter", seq, detail="step")
         t0 = _time.time() if tel else 0.0
-        with profiler.span("fused_step(fwd+bwd+update)", cat="executor"):
-            outs, aux_upd, new_params, new_states = fn(
-                diff_vals, nondiff_vals, self._place_repl(self._gather_aux()),
-                state_tuples, _np.uint32(self._step_seed), scalars,
-            )
+        try:
+            with profiler.span("fused_step(fwd+bwd+update)", cat="executor"):
+                outs, aux_upd, new_params, new_states = fn(
+                    diff_vals, nondiff_vals, self._place_repl(self._gather_aux()),
+                    state_tuples, _np.uint32(self._step_seed), scalars,
+                )
+        finally:
+            if rec:
+                if first_call:
+                    recorder.record("compile", "exit", seq)
+                recorder.record("dispatch", "exit", seq)
         if tel:
             self._note_dispatch("step", _time.time() - t0)
         self._train_dispatches += 1
@@ -1224,8 +1240,9 @@ class Executor:
             out_batch = self._out_batch_flags()
             assert out_batch is not None and all(out_batch),                 "comm mode armed without all-batch outputs (gate bug)"
         key = (k, tuple(an[i] for i in stream_idx), sig, comm)
-        self._note_compile_cache(key in self._jit_block)
-        if key not in self._jit_block:
+        first_call = key not in self._jit_block
+        self._note_compile_cache(not first_call)
+        if first_call:
             fn = self._build_block_fn(stream_idx, static_idx, comm,
                                       out_batch=out_batch)
             if comm is not None:
@@ -1244,6 +1261,7 @@ class Executor:
         import time as _time
 
         from . import profiler, telemetry
+        from .obs import recorder
 
         tel = telemetry.enabled()
         if tel:
@@ -1261,11 +1279,35 @@ class Executor:
                 for nb in plan:
                     telemetry.observe("comm.bucket_bytes", nb,
                                       buckets=telemetry.BYTE_BUCKETS)
+        # flight-recorder bracket (obs/recorder.py): seq is the dispatch
+        # counter, detail carries K and the comm bucket layout, bytes are
+        # the per-sweep reduced gradient bytes — the post-mortem's "which
+        # collective seq was in flight" answer.  The compile bracket
+        # suppresses the stall watchdog across a first XLA compile.
+        rec = recorder.enabled()
+        seq = self._train_dispatches + 1
+        if rec:
+            if comm is not None:
+                plan = self._comm_plan_bytes(comm)
+                detail = "block(K=%d,buckets=%d)" % (k, len(plan))
+                rec_bytes = sum(plan) * k
+            else:
+                detail, rec_bytes = "block(K=%d)" % k, 0
+            if first_call:
+                recorder.record("compile", "enter", seq, detail=detail)
+            recorder.record("dispatch", "enter", seq, detail=detail,
+                            nbytes=rec_bytes)
         t0 = _time.time() if tel else 0.0
-        with profiler.span("fused_dispatch(K=%d)" % k, cat="executor"):
-            outs, aux_upd, new_params, new_states = fn(
-                diff_vals, static_vals, self._place_repl(self._gather_aux()),
-                state_tuples, stream_vals, seeds, scalars)
+        try:
+            with profiler.span("fused_dispatch(K=%d)" % k, cat="executor"):
+                outs, aux_upd, new_params, new_states = fn(
+                    diff_vals, static_vals, self._place_repl(self._gather_aux()),
+                    state_tuples, stream_vals, seeds, scalars)
+        finally:
+            if rec:
+                if first_call:
+                    recorder.record("compile", "exit", seq)
+                recorder.record("dispatch", "exit", seq)
         if tel:
             self._note_dispatch("block", _time.time() - t0)
         self._train_dispatches += 1

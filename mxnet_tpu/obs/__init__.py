@@ -1,0 +1,68 @@
+"""mxnet_tpu.obs — the distributed observability plane.
+
+PR 4's telemetry registry and the profiler answer "how much" and
+"when" for ONE process; this package answers the multi-process
+questions the N-rank SPMD runtime (PRs 9-10), the data service, and
+the serving tier raise: *which rank is slow, which rank is stuck, and
+what was it doing* — the questions every fault-tolerance item on the
+ROADMAP (multi-replica serving with drain-on-death, elastic training)
+has to be able to answer before it can act.
+
+Three parts (docs/observability.md "Distributed observability"):
+
+  * :mod:`~mxnet_tpu.obs.recorder` — an always-on, fixed-slot per-rank
+    flight recorder of collective/dispatch edge events (enter/exit,
+    seq, bytes), the PyTorch-NCCL-flight-recorder shape.  ~Zero cost:
+    hot call sites guard behind ``recorder.enabled()`` (mxlint E004).
+  * :mod:`~mxnet_tpu.obs.watchdog` — a stall watchdog thread
+    (``MXTPU_OBS_STALL_SECONDS``) that detects an entered-but-never-
+    exited collective/dispatch, dumps a post-mortem artifact (last-K
+    events, per-rank progress, Python stacks, straggler-vs-hang
+    attribution) with write-then-rename, and optionally aborts the
+    wedged process so a job fails loudly instead of hanging forever.
+  * :mod:`~mxnet_tpu.obs.aggregate` — rank 0 aggregation: every rank
+    ships periodic registry snapshots over a tiny TCP control plane
+    (the parallel/dist.py framing), rank 0 writes one cluster-level
+    JSONL (``tools/parse_log.py --cluster``) with per-rank step-time
+    skew and straggler attribution, and the connect handshake measures
+    each rank's clock offset for trace stitching
+    (``tools/obs_stitch.py``).
+
+:func:`bootstrap` arms whatever the environment configures; it is
+called from ``parallel.multihost.initialize()`` so a
+``tools/launch.py --local-spmd --obs`` job gets the whole plane
+without touching user code.
+"""
+from __future__ import annotations
+
+from . import recorder
+
+__all__ = ["recorder", "bootstrap"]
+
+_BOOTSTRAPPED = False
+
+
+def bootstrap():
+    """Arm the observability plane from the environment (idempotent):
+    start the rank-0 aggregator + per-rank reporter when
+    ``MXTPU_OBS_PORT`` is set, and the stall watchdog when
+    ``MXTPU_OBS_STALL_SECONDS`` > 0.  Never raises — observability must
+    not be able to break mesh bring-up."""
+    global _BOOTSTRAPPED
+    if _BOOTSTRAPPED:
+        return
+    _BOOTSTRAPPED = True
+    import warnings
+
+    try:
+        from . import aggregate
+
+        aggregate.bootstrap_from_env()
+    except Exception as e:  # pragma: no cover — defensive
+        warnings.warn("obs aggregation bootstrap failed: %s" % e)
+    try:
+        from . import watchdog
+
+        watchdog.maybe_start_from_env()
+    except Exception as e:  # pragma: no cover — defensive
+        warnings.warn("obs watchdog bootstrap failed: %s" % e)

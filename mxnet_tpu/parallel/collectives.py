@@ -206,7 +206,11 @@ def bucketed_psum(grads, axis_names, bucket_bytes):
 
 def mesh_allreduce(mesh, arrays, axis="data"):
     """Host-level helper: all-reduce a list of replicated arrays over `axis`
-    by one fused shard_map call (used by KVStore device mode on a mesh)."""
+    by one fused shard_map call (used by KVStore device mode on a mesh).
+    Bracketed in the flight recorder (obs/recorder.py) — this is a host
+    entry point into a real collective, so a wedged reduction leaves an
+    open enter event for the stall watchdog to attribute."""
+    from ..obs import recorder
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -216,4 +220,13 @@ def mesh_allreduce(mesh, arrays, axis="data"):
     def _reduce(*xs):
         return tuple(lax.psum(x, axis) for x in xs)
 
-    return _reduce(*arrays)
+    seq = None
+    if recorder.enabled():
+        seq = recorder.record(
+            "allreduce", "enter", detail=str(axis),
+            nbytes=sum(int(getattr(a, "nbytes", 0)) for a in arrays))
+    try:
+        return _reduce(*arrays)
+    finally:
+        if recorder.enabled() and seq is not None:
+            recorder.record("allreduce", "exit", seq)

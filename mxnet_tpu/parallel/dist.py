@@ -664,7 +664,24 @@ class DistKVStore:
 
     def barrier(self, timeout=None):
         """Global worker barrier.  Raises (instead of hanging forever) when
-        the scheduler reports dead nodes or `timeout` elapses."""
+        the scheduler reports dead nodes or `timeout` elapses.  Bracketed
+        in the flight recorder (obs/recorder.py): a rendezvous this worker
+        is stuck in shows up as an open ``ps_barrier`` event in the
+        watchdog post-mortem, with the per-rank progress counters saying
+        which peer never arrived."""
+        from ..obs import recorder
+
+        rec_seq = None
+        if recorder.enabled():
+            rec_seq = recorder.record("ps_barrier", "enter",
+                                      detail="rank=%d" % self._rank)
+        try:
+            self._barrier_impl(timeout)
+        finally:
+            if recorder.enabled() and rec_seq is not None:
+                recorder.record("ps_barrier", "exit", rec_seq)
+
+    def _barrier_impl(self, timeout=None):
         timeout = BARRIER_TIMEOUT if timeout is None else timeout
         deadline = time.monotonic() + timeout
         with self._sched_recv_lock:

@@ -91,6 +91,19 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
                                    num_processes=num_processes,
                                    process_id=process_id)
     _STATE["initialized"] = True
+    # arm the distributed observability plane from the same launcher
+    # environment (obs/: rank-0 aggregation + clock-offset handshake
+    # when MXTPU_OBS_PORT is set, stall watchdog when
+    # MXTPU_OBS_STALL_SECONDS > 0).  Monitoring must never be able to
+    # fail mesh bring-up, so problems degrade to a warning.
+    try:
+        from ..obs import bootstrap as _obs_bootstrap
+
+        _obs_bootstrap()
+    except Exception as e:  # pragma: no cover — defensive
+        import warnings
+
+        warnings.warn("observability bootstrap failed: %s" % e)
 
 
 def is_initialized():
@@ -149,10 +162,22 @@ def make_global_array(mesh, spec, host_data, batch_axis=0):
 
 
 def sync_global_devices(tag="barrier"):
-    """Cross-host barrier (useful around checkpoint writes)."""
+    """Cross-host barrier (useful around checkpoint writes).  Bracketed
+    in the flight recorder: a peer that never arrives leaves this
+    rank's enter event open, which is exactly what the stall watchdog
+    (obs/watchdog.py) reports with the barrier tag."""
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(tag)
+    from ..obs import recorder
+
+    seq = None
+    if recorder.enabled():
+        seq = recorder.record("barrier", "enter", detail=str(tag))
+    try:
+        multihost_utils.sync_global_devices(tag)
+    finally:
+        if recorder.enabled() and seq is not None:
+            recorder.record("barrier", "exit", seq)
 
 
 def fetch(x):
@@ -171,4 +196,17 @@ def fetch(x):
         return np.asarray(x)
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    from ..obs import recorder
+
+    # flight-recorder bracket: the allgather is the readback-side
+    # collective a healthy rank actually BLOCKS in when a peer stops
+    # dispatching — an open enter here is the watchdog's stall subject
+    seq = None
+    if recorder.enabled():
+        seq = recorder.record("allgather", "enter",
+                              nbytes=getattr(x, "nbytes", 0))
+    try:
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    finally:
+        if recorder.enabled() and seq is not None:
+            recorder.record("allgather", "exit", seq)

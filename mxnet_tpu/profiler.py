@@ -13,7 +13,8 @@ import threading
 import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "record_span", "record_counter", "register_thread_name"]
+           "record_span", "record_counter", "register_thread_name",
+           "set_trace_meta"]
 
 import os as _os
 
@@ -35,6 +36,20 @@ _JAX_TRACE_DIR = None
 # tid -> human thread name, harvested as spans are recorded; dumped as
 # thread_name metadata so engine-worker lanes are labeled in the UI
 _TID_NAMES = {}
+# stitch metadata stamped into the dumped trace's otherData: this
+# rank's id and its measured wall-clock offset vs rank 0 (seconds*1e6;
+# obs/aggregate.py's clock handshake sets it) — what tools/obs_stitch.py
+# uses to merge N per-rank traces onto one aligned timeline
+_TRACE_META = {"rank": None, "clock_offset_us": 0.0}
+
+
+def set_trace_meta(rank=None, clock_offset_us=None):
+    """Stamp per-rank stitch metadata into subsequent dump_profile()
+    outputs (obs/aggregate.py calls this after its clock handshake)."""
+    if rank is not None:
+        _TRACE_META["rank"] = int(rank)
+    if clock_offset_us is not None:
+        _TRACE_META["clock_offset_us"] = float(clock_offset_us)
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -218,13 +233,30 @@ def _metadata_events():
 def dump_profile():
     """Write chrome-tracing JSON (parity: reference Profiler::DumpProfile
     src/engine/profiler.cc:134-190): process/thread naming metadata,
-    span lanes, and the telemetry counter lanes."""
+    span lanes, and the telemetry counter lanes.  In a multi-process
+    launch (MXTPU_PROCESS_ID exported) the output path is auto-suffixed
+    ``.r<rank>`` so N ranks never write over one file, and the payload's
+    ``otherData`` carries the rank + measured clock offset vs rank 0 —
+    exactly what ``tools/obs_stitch.py`` consumes to merge the per-rank
+    traces onto one aligned timeline.  Returns the path written."""
+    rank_env = _os.environ.get("MXTPU_PROCESS_ID", "")
+    rank = _TRACE_META["rank"]
+    if rank is None and rank_env != "":
+        rank = int(rank_env)
+    from . import telemetry
+
+    path = telemetry.rank_suffixed(_STATE["filename"])
     with _LOCK:
         payload = {"traceEvents": _metadata_events() + list(_EVENTS),
-                   "displayTimeUnit": "ms"}
-        with open(_STATE["filename"], "w") as f:
+                   "displayTimeUnit": "ms",
+                   "otherData": {
+                       "rank": 0 if rank is None else rank,
+                       "clock_offset_us": _TRACE_META["clock_offset_us"],
+                   }}
+        with open(path, "w") as f:
             json.dump(payload, f)
         _EVENTS.clear()
+    return path
 
 
 # env-driven bootstrap (reference docs/how_to/env_var.md:97-108)

@@ -109,6 +109,22 @@ class RequestQueue:
                 return self._depth
             return len(self._queues.get(tenant, ()))
 
+    def headroom(self):
+        """Admission slots left before submit() starts rejecting
+        (MXTPU_SERVE_MAX_QUEUE bound) — owned here so health() never
+        reaches into this queue's bookkeeping."""
+        with self._cv:
+            return max(0, self._max_queue - self._depth)
+
+    def oldest_deadline(self):
+        """Earliest deadline among the queue heads (monotonic seconds),
+        or None when nothing is pending — the urgency half of the
+        ModelServer.health() probe: how long before the most pressed
+        queued request starts timing out."""
+        with self._cv:
+            heads = [dq[0].deadline for dq in self._queues.values() if dq]
+        return min(heads) if heads else None
+
     def _note_depth(self, tenant):
         # called under self._cv; telemetry's lock is a leaf lock
         from .. import telemetry

@@ -60,6 +60,11 @@ class ModelServer:
         self._lock = threading.Lock()
         self._stopping = False
         self._closed = False
+        # per-server liveness counters for health() — instance-scoped on
+        # purpose (the telemetry serving.* counters are process-wide and
+        # a host may run several servers)
+        self._dispatches = 0
+        self._dispatch_errors = 0
         for name, pred in (tenants or {}).items():
             self.add_tenant(name, pred)
         self._thread = threading.Thread(target=self._loop,
@@ -138,6 +143,46 @@ class ModelServer:
             "closed": self._closed,
         }
 
+    def health(self):
+        """Structured health probe for a router/load balancer — the
+        surface the ROADMAP multi-replica tier polls before spreading
+        traffic to this replica (docs/observability.md "Distributed
+        observability").  Cheap by contract: lock + counter reads, never
+        touches the device or waits on the batcher.
+
+        Keys: ``healthy`` (batcher alive and accepting), ``closed``,
+        ``batcher_alive``, ``queue_depth`` / ``per_tenant_depth``
+        (backpressure), ``queue_headroom`` (admission slots left),
+        ``oldest_deadline_in_s`` (seconds until the most pressed queued
+        request times out; None when idle — negative means requests are
+        already expiring), ``dispatches`` / ``dispatch_errors`` (this
+        server's fill counts), ``tenants``, ``ladder``."""
+        import time
+
+        with self._lock:
+            tenants = list(self._sessions)
+            closed = self._closed
+            dispatches = self._dispatches
+            errors = self._dispatch_errors
+        thread = self._thread
+        alive = bool(thread is not None and thread.is_alive())
+        depth = self._queue.depth()
+        oldest = self._queue.oldest_deadline()
+        return {
+            "healthy": alive and not closed,
+            "closed": closed,
+            "batcher_alive": alive,
+            "queue_depth": depth,
+            "per_tenant_depth": {t: self._queue.depth(t) for t in tenants},
+            "queue_headroom": self._queue.headroom(),
+            "oldest_deadline_in_s": (None if oldest is None
+                                     else oldest - time.monotonic()),
+            "dispatches": dispatches,
+            "dispatch_errors": errors,
+            "tenants": sorted(tenants),
+            "ladder": list(self.ladder),
+        }
+
     def close(self, drain=True, timeout=None):
         """Stop the server.  ``drain=True`` (default) serves every
         already-queued request before returning; ``drain=False`` fails
@@ -186,9 +231,11 @@ class ModelServer:
                 continue
             try:
                 self._sessions[tenant].dispatch(reqs)
+                self._dispatches += 1
             except BaseException as e:
                 # a failed fill fails ITS requests, never the server: the
                 # loop survives to serve the other tenants
+                self._dispatch_errors += 1
                 if telemetry.enabled():
                     telemetry.inc("serving.dispatch_errors")
                 for r in reqs:

@@ -70,6 +70,11 @@ class TenantSession:
         self._prog_lock = _threading.Lock()
         self._slot_vars = (engine.new_variable(), engine.new_variable())
         self._fills = 0
+        # buckets whose program has RUN at least once (warm() or a
+        # fill): a first run pays the XLA compile, so dispatch brackets
+        # it in the flight recorder's compile bracket and the stall
+        # watchdog stays suppressed across it (obs/watchdog.py)
+        self._ran_buckets = set()
 
     @property
     def sample_shapes(self):
@@ -129,6 +134,7 @@ class TenantSession:
             other_vals, aux_vals = exe.serve_args(self._input_names)
             outs = fn(dummy, other_vals, aux_vals, _np.uint32(0))
             _np.asarray(outs[0])  # block: compile + run complete
+            self._ran_buckets.add(b)
         return len(buckets)
 
     def dispatch(self, reqs):
@@ -172,9 +178,33 @@ class TenantSession:
         if err is not None:
             raise err
         other_vals, aux_vals = exe.serve_args(self._input_names)
-        with profiler.span("serve_dispatch(%s,b=%d)" % (self.name, bucket),
-                           cat="serving"):
-            outs = tuple(fn(staged, other_vals, aux_vals, _np.uint32(0)))
+        from ..obs import recorder
+
+        # flight-recorder bracket: a serving fill wedged in the device
+        # dispatch is attributable post-mortem like a training
+        # collective.  An unwarmed bucket's first fill pays the XLA
+        # compile inside fn, so it also opens the compile bracket —
+        # without it, a long first compile on a cold tenant would trip
+        # the stall watchdog on a perfectly healthy server.
+        first_run = bucket not in self._ran_buckets
+        rec_seq = None
+        if recorder.enabled():
+            rec_seq = recorder.record(
+                "serve", "enter", seq=self._fills + 1,
+                detail="%s,b=%d" % (self.name, bucket))
+            if first_run:
+                recorder.record("compile", "enter", rec_seq,
+                                detail="serve:%s,b=%d" % (self.name, bucket))
+        try:
+            with profiler.span("serve_dispatch(%s,b=%d)" % (self.name, bucket),
+                               cat="serving"):
+                outs = tuple(fn(staged, other_vals, aux_vals, _np.uint32(0)))
+        finally:
+            self._ran_buckets.add(bucket)
+            if recorder.enabled() and rec_seq is not None:
+                if first_run:
+                    recorder.record("compile", "exit", rec_seq)
+                recorder.record("serve", "exit", rec_seq)
         tenant = self.name
 
         def _readback(_outs=outs, _reqs=reqs, _bucket=bucket, _tenant=tenant):

@@ -41,8 +41,8 @@ import time
 __all__ = [
     "enabled", "set_enabled", "inc", "set_gauge", "observe",
     "counter_value", "gauge_value", "snapshot", "reset", "flush",
-    "peak_flops", "flops_of_jaxpr", "TIME_BUCKETS", "BYTE_BUCKETS",
-    "COUNT_BUCKETS",
+    "rank_suffixed", "peak_flops", "flops_of_jaxpr", "TIME_BUCKETS",
+    "BYTE_BUCKETS", "COUNT_BUCKETS",
 ]
 
 # fixed bucket boundaries (seconds): half-decade exponential ladder from
@@ -195,6 +195,26 @@ def reset():
         _FLUSH_SEQ = 0
 
 
+def rank_suffixed(path):
+    """Per-rank sink path: ``path`` + ``.r<rank>`` when the launcher
+    exported ``MXTPU_PROCESS_ID`` (tools/launch.py --local-spmd),
+    unchanged otherwise.
+
+    N ranks of a multi-process job inherit the SAME
+    ``MXTPU_TELEMETRY_FILE`` / profiler filename from the launcher
+    environment; N processes appending to one file interleave partial
+    lines into a corrupt sink.  Every file sink (telemetry.flush,
+    profiler.dump_profile) routes its path through this helper, and
+    the downstream tools glob the suffix back up
+    (``tools/obs_stitch.py`` merges ``trace.json.r*``)."""
+    if not path:
+        return path
+    rank = _os.environ.get("MXTPU_PROCESS_ID", "")
+    if rank == "":
+        return path
+    return "%s.r%s" % (path, rank)
+
+
 def flush(path=None, extra=None):
     """Append ONE JSONL record of the current registry state to `path`
     (default ``MXTPU_TELEMETRY_FILE``; no-op when neither is set).
@@ -203,12 +223,13 @@ def flush(path=None, extra=None):
     clock stamp, and the global training-step counter
     (``module.steps``), so downstream tooling can order and diff
     records without trusting wall clocks.  ``tools/parse_log.py
-    --telemetry`` reads this format back.  Returns the record dict (or
-    None when no sink is configured)."""
+    --telemetry`` reads this format back.  In a multi-process launch
+    the path is auto-suffixed per rank (:func:`rank_suffixed`).
+    Returns the record dict (or None when no sink is configured)."""
     global _FLUSH_SEQ
     if not _ENABLED:
         return None
-    path = path or _os.environ.get("MXTPU_TELEMETRY_FILE", "")
+    path = rank_suffixed(path or _os.environ.get("MXTPU_TELEMETRY_FILE", ""))
     if not path:
         return None
     with _LOCK:

@@ -1,0 +1,87 @@
+"""One rank of the watchdog chaos test (tests/test_obs.py).
+
+Launched as `tools/launch.py --local-spmd -n 2 --obs` with the stall
+watchdog armed (MXTPU_OBS_STALL_SECONDS, action=abort).  Both ranks
+run the real multi-process training stack; RANK 1 STUB-STALLS
+mid-epoch — after a couple of dispatches it simply stops participating
+in collectives (the deterministic stand-in for a SIGSTOP'd /
+live-locked / dead rank).  The healthy rank then blocks inside its
+next collective dispatch, its stall watchdog must (a) produce a
+post-mortem artifact attributing the stall to rank 1 at the stalled
+sequence number, and (b) abort the process so the launcher returns
+instead of hanging forever.
+
+The stalled rank waits for the healthy rank's artifact to appear on
+the shared filesystem (bounded), then exits quietly — so the test's
+end-to-end wall time is governed by the watchdog window, not by an
+arbitrary sleep.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from mxnet_tpu.parallel import multihost
+
+    multihost.initialize()  # arms the obs plane from the launcher env
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    rank = jax.process_index()
+    mesh = multihost.global_mesh(hierarchical=True)
+    obs_dir = os.environ.get("MXTPU_OBS_DIR", ".")
+    healthy_artifact = os.path.join(obs_dir, "postmortem.r0.json")
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(64, 12).astype(np.float32)
+    w = rng.randn(12, 1).astype(np.float32)
+    y = (X @ w).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="lro_label")
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    o = mx.sym.FullyConnected(h, num_hidden=1, name="fc2")
+    net = mx.sym.LinearRegressionOutput(o, name="lro")
+    mod = mx.mod.Module(net, label_names=("lro_label",), context=mx.cpu(),
+                        mesh=mesh)
+    seen = [0]
+
+    def on_batch(param):
+        seen[0] += 1
+        if rank == 1 and seen[0] == 2:
+            sys.stdout.write("CHAOS rank=1 stub-stall after %d batches\n"
+                             % seen[0])
+            sys.stdout.flush()
+            # stop participating; leave once the healthy rank's
+            # post-mortem lands (bounded), so the launcher's wait on
+            # this process is bounded too
+            for _ in range(1800):
+                if os.path.exists(healthy_artifact):
+                    break
+                time.sleep(0.1)
+            os._exit(0)
+
+    sys.stdout.write("CHAOS rank=%d start axes=%s\n"
+                     % (rank, ",".join(mesh.axis_names)))
+    sys.stdout.flush()
+    # enough epochs that the healthy rank can only finish by hanging on
+    # the stalled peer — which the watchdog must turn into an abort
+    mod.fit(it, num_epoch=50, kvstore=None, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), eval_metric="mse",
+            steps_per_dispatch=2, batch_end_callback=on_batch)
+    # only reachable if the stall never happened — fail the test loudly
+    sys.stdout.write("CHAOS rank=%d finished WITHOUT stalling\n" % rank)
+    sys.stdout.flush()
+    sys.exit(5)
+
+
+if __name__ == "__main__":
+    main()

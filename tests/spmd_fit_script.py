@@ -83,6 +83,10 @@ def main():
     parser.add_argument("--no-fit", action="store_true",
                         help="skip the training run (fast control-plane-"
                              "only checks)")
+    parser.add_argument("--profile", default="",
+                        help="profile the fit and dump a chrome trace to "
+                             "this path (auto-suffixed .r<rank> per "
+                             "process; stitch with tools/obs_stitch.py)")
     args = parser.parse_args()
 
     from mxnet_tpu.parallel import multihost
@@ -96,6 +100,11 @@ def main():
 
     rank = jax.process_index()
     mesh = multihost.global_mesh(hierarchical=True)
+    if args.profile:
+        from mxnet_tpu import profiler
+
+        profiler.profiler_set_config(mode="all", filename=args.profile)
+        profiler.profiler_set_state("run")
     if not args.no_fit:
         losses, digest = run_fit(mx, np, mesh, args.steps_per_dispatch)
         # ONE unbuffered write: both ranks share the launcher's stdout
@@ -110,6 +119,13 @@ def main():
         sys.stdout.write("SPMDMESH rank=%d axes=%s devices=%d\n"
                          % (rank, ",".join(mesh.axis_names),
                             jax.device_count()))
+        sys.stdout.flush()
+    if args.profile:
+        from mxnet_tpu import profiler
+
+        profiler.profiler_set_state("stop")
+        sys.stdout.write("PROFILE rank=%d path=%s\n"
+                         % (rank, profiler.dump_profile()))
         sys.stdout.flush()
     if args.kvstore_check:
         kvstore_check(mx, np, rank)

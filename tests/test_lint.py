@@ -823,3 +823,59 @@ def test_e004_fires_on_unguarded_bucket_telemetry(tmp_path):
 def test_e004_bucket_telemetry_clean_when_guarded(tmp_path):
     findings, _, _ = _lint_src(tmp_path, E004_BUCKET_HOT_PATH_GUARDED)
     assert findings == []
+
+
+def test_repo_gate_sweeps_the_obs_package():
+    """ISSUE 11 pin: the gate walk covers mxnet_tpu/obs/ — the flight
+    recorder's record() sits on the fused-dispatch hot path, so the
+    E004 guard contract applies there exactly as to telemetry."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    for mod in ("__init__", "recorder", "watchdog", "aggregate"):
+        assert os.path.join("mxnet_tpu", "obs", "%s.py" % mod) in swept
+
+
+# the flight-recorder hot path (executor fused dispatch bracket): an
+# unguarded recorder.record() pays detail-string formatting and byte
+# sums on EVERY dispatch even with the recorder off — the same E004
+# contract as telemetry, with recorder.enabled() as the fast path.
+E004_RECORDER_HOT_PATH = """
+from mxnet_tpu.obs import recorder
+
+
+def dispatch(seq, k, plan):
+    recorder.record("dispatch", "enter", seq,
+                    detail="block(K=%d,buckets=%d)" % (k, len(plan)),
+                    nbytes=sum(plan) * k)
+    run()
+    recorder.record("dispatch", "exit", seq)
+"""
+
+E004_RECORDER_HOT_PATH_GUARDED = """
+from mxnet_tpu.obs import recorder
+
+
+def dispatch(seq, k, plan):
+    rec = recorder.enabled()
+    if rec:
+        recorder.record("dispatch", "enter", seq,
+                        detail="block(K=%d,buckets=%d)" % (k, len(plan)),
+                        nbytes=sum(plan) * k)
+    run()
+    if rec:
+        recorder.record("dispatch", "exit", seq)
+"""
+
+
+def test_e004_fires_on_unguarded_recorder_record(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_RECORDER_HOT_PATH)
+    got = _ids(findings)
+    assert got.count("E004") == 2, findings
+    assert all("recorder.enabled()" in f.message for f in findings)
+
+
+def test_e004_recorder_record_clean_when_guarded(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_RECORDER_HOT_PATH_GUARDED)
+    assert findings == []

@@ -136,6 +136,13 @@ def test_bench_spmd_procs_smoke_row():
     assert comm["bytes_reduced"] > 0 and comm["dispatches"] > 0
     assert comm["gbps"] > 0
     assert 0.0 <= comm["overlap_frac"] <= 1.0
+    # ISSUE 11: the per-rank skew column — one mean step time per rank
+    # plus the max/median straggler attribution (obs/aggregate.step_skew)
+    skew = row["rank_skew"]
+    assert len(skew["per_rank_step_s"]) == 2
+    assert all(v > 0 for v in skew["per_rank_step_s"])
+    assert skew["max_over_median"] >= 1.0
+    assert skew["slowest_rank"] in (0, 1)
 
 
 # ----------------------------------------------------------------------

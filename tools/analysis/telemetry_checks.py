@@ -9,9 +9,10 @@ formatting, ``time.time()`` pairs, byte-size sums) happens at the call
 site, before the callee can bail.
 
   * **E004** — a recording call (``telemetry.inc/set_gauge/observe/
-    flush``, ``profiler.record_span/record_counter``) that is not
-    guarded by the fast path.  Two guard shapes are recognized, the
-    ones the codebase actually uses:
+    flush``, ``profiler.record_span/record_counter``, and the obs
+    flight recorder's ``recorder.record``) that is not guarded by the
+    fast path.  Two guard shapes are recognized, the ones the codebase
+    actually uses:
 
       - an enclosing ``if`` whose test reaches ``enabled()`` /
         ``spans_active()`` — directly, or through a local bound from
@@ -33,10 +34,12 @@ from .core import Finding, register
 __all__ = ["UnguardedTelemetryCall"]
 
 # module-level handles the framework uses at instrumentation sites
-_MODULE_NAMES = {"telemetry", "profiler"}
+# (recorder = the obs flight recorder, whose record() sits on the same
+# hot dispatch paths and promises the same ~zero disabled cost)
+_MODULE_NAMES = {"telemetry", "profiler", "recorder"}
 # the recording entry points whose CALL must be guarded
 _RECORDING_ATTRS = {"inc", "set_gauge", "observe", "flush",
-                    "record_span", "record_counter"}
+                    "record_span", "record_counter", "record"}
 # the fast-path predicates
 _GUARD_ATTRS = {"enabled", "spans_active"}
 
@@ -143,5 +146,6 @@ class UnguardedTelemetryCall:
                 "in `if %s:` (or early-return) so the disabled cost is "
                 "one predicted branch"
                 % (call.func.value.id, call.func.attr,
-                   "telemetry.enabled()" if call.func.value.id == "telemetry"
-                   else "profiler.spans_active()"))
+                   {"telemetry": "telemetry.enabled()",
+                    "recorder": "recorder.enabled()"}.get(
+                       call.func.value.id, "profiler.spans_active()")))

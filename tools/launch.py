@@ -112,6 +112,18 @@ def main():
                              "(exported as MXTPU_LOCAL_DEVICES; "
                              "multihost.initialize applies it via "
                              "XLA_FLAGS); 0 = platform default")
+    parser.add_argument("--obs", action="store_true",
+                        help="(--local-spmd) arm the distributed "
+                             "observability plane: exports a free "
+                             "MXTPU_OBS_PORT so rank 0 aggregates "
+                             "cross-rank telemetry (cluster JSONL via "
+                             "MXTPU_OBS_CLUSTER_FILE, rendered by "
+                             "parse_log.py --cluster) and every rank "
+                             "measures its clock offset for trace "
+                             "stitching (tools/obs_stitch.py); combine "
+                             "with MXTPU_OBS_STALL_SECONDS for the "
+                             "collective stall watchdog.  See "
+                             "docs/observability.md")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if args.num_servers is None:
@@ -139,6 +151,13 @@ def main():
         base_env["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % _free_port()
         if args.local_devices > 0:
             base_env["MXTPU_LOCAL_DEVICES"] = str(args.local_devices)
+        if args.obs and not os.environ.get("MXTPU_OBS_PORT"):
+            # a third port for the rank-0 observability aggregator
+            # (obs/aggregate.py); an operator-exported port passes
+            # through the environment untouched
+            base_env["MXTPU_OBS_PORT"] = str(_free_port())
+    elif args.obs:
+        parser.error("--obs requires --local-spmd")
 
     if args.launcher == "local":
         procs = []

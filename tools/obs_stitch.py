@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Merge N per-rank chrome traces into ONE offset-aligned timeline.
+
+A multi-process launch (tools/launch.py --local-spmd) leaves one trace
+per rank — ``profile.json.r0``, ``profile.json.r1``, … (the per-rank
+sink suffix, mxnet_tpu/telemetry.py rank_suffixed) — each on its own
+wall clock.  At mesh bring-up every rank measured its clock offset
+against rank 0 (the obs aggregation handshake,
+mxnet_tpu/obs/aggregate.py) and stamped it into the trace's
+``otherData`` (``profiler.set_trace_meta``).  This tool:
+
+  * discovers the per-rank files from a base path (``profile.json`` →
+    ``profile.json.r*``) or takes explicit files;
+  * shifts every event's timestamp by its rank's offset so all lanes
+    share rank 0's timeline (``ts + clock_offset_us``);
+  * remaps pids into disjoint per-rank ranges and prefixes process
+    names with ``rank<i>/`` (→ ``rank0/host``, ``rank1/device (XLA)``
+    …), so chrome://tracing / Perfetto shows one process group per
+    rank;
+  * writes one merged chrome-JSON trace.
+
+Usage::
+
+    python tools/obs_stitch.py profile.json -o merged.json
+    python tools/obs_stitch.py profile.json.r0 profile.json.r1 -o merged.json
+
+See docs/observability.md "Distributed observability".
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# keep per-rank pid ranges disjoint: profiler.py uses pids 0 (host) and
+# 1 (device); 100 leaves room for future lanes per rank
+_PID_STRIDE = 100
+
+
+def _discover(paths):
+    """Resolve the argument list to concrete per-rank trace files."""
+    out = []
+    for p in paths:
+        if os.path.exists(p) and re.search(r"\.r\d+$", p):
+            out.append(p)
+            continue
+        hits = sorted(glob.glob(p + ".r*"),
+                      key=lambda s: int(s.rsplit(".r", 1)[1]))
+        hits = [h for h in hits if re.search(r"\.r\d+$", h)]
+        if hits:
+            out.extend(hits)
+        elif os.path.exists(p):
+            out.append(p)  # a single unsuffixed trace still merges
+        else:
+            raise SystemExit("obs_stitch: no trace at %r (nor %s.r*)"
+                             % (p, p))
+    return out
+
+
+def _rank_of(path, payload):
+    """Rank from the trace's otherData, else from the .r<i> suffix."""
+    other = payload.get("otherData") or {}
+    if isinstance(other.get("rank"), int):
+        return other["rank"]
+    m = re.search(r"\.r(\d+)$", path)
+    return int(m.group(1)) if m else 0
+
+
+def stitch(files):
+    """Merge trace `files` -> one chrome-JSON payload (module doc)."""
+    merged = []
+    ranks, offsets = [], {}
+    for path in files:
+        with open(path) as f:
+            payload = json.load(f)
+        rank = _rank_of(path, payload)
+        offset_us = float((payload.get("otherData") or {})
+                          .get("clock_offset_us", 0.0))
+        ranks.append(rank)
+        offsets[str(rank)] = offset_us
+        for e in payload.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = rank * _PID_STRIDE + int(e.get("pid", 0))
+            if "ts" in e:
+                # offset is rank-0 wall time minus this rank's: adding
+                # it moves local timestamps onto rank 0's timeline
+                e["ts"] = e["ts"] + offset_us
+            if e.get("ph") == "M":
+                args = dict(e.get("args") or {})
+                if e.get("name") == "process_name":
+                    args["name"] = "rank%d/%s" % (rank,
+                                                  args.get("name", "?"))
+                elif e.get("name") == "process_sort_index":
+                    args["sort_index"] = (rank * _PID_STRIDE
+                                          + int(args.get("sort_index", 0)))
+                e["args"] = args
+            merged.append(e)
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "otherData": {"stitched_ranks": sorted(ranks),
+                          "clock_offsets_us": offsets}}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank chrome traces onto one "
+                    "clock-offset-aligned timeline")
+    ap.add_argument("traces", nargs="+",
+                    help="base path (finds <base>.r*) or explicit "
+                         "per-rank trace files")
+    ap.add_argument("-o", "--output", default="stitched_trace.json")
+    args = ap.parse_args(argv)
+    files = _discover(args.traces)
+    if not files:
+        raise SystemExit("obs_stitch: nothing to merge")
+    payload = stitch(files)
+    with open(args.output, "w") as f:
+        json.dump(payload, f)
+    other = payload["otherData"]
+    print("wrote %s: %d events from ranks %s (offsets us: %s)"
+          % (args.output, len(payload["traceEvents"]),
+             other["stitched_ranks"],
+             {r: round(v, 1) for r, v in other["clock_offsets_us"].items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,14 @@ distributed-comm columns (``comm_gbps`` measured collective bandwidth,
 ``overlap_pct`` fraction of collective time hidden under backward
 compute) when it recorded the ``comm`` namespace
 (docs/distributed.md).  Older logs render '-' in columns they predate.
-See docs/observability.md.
+
+With ``--cluster`` the input is the rank-0 CLUSTER JSONL
+(``MXTPU_OBS_CLUSTER_FILE``, written by the obs aggregator —
+mxnet_tpu/obs/aggregate.py): one row per record with per-rank steps
+and step times, the max/median step-time skew ratio with the slowest
+rank named (straggler attribution), and the per-rank comm GB/s spread.
+Plain single-rank telemetry records fed to --cluster render '-' in
+every cluster column.  See docs/observability.md.
 """
 from __future__ import annotations
 
@@ -154,6 +161,57 @@ def parse_telemetry(lines):
     return rows
 
 
+def parse_cluster(lines):
+    """Cluster JSONL (obs/aggregate.py Aggregator records) -> one
+    summary row per record.  Records without the cluster shape (plain
+    per-rank telemetry flushes, pre-obs logs) yield all-None rows so
+    older logs render '-' instead of crashing the table."""
+    rows = []
+    for idx, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            print("warning: skipping malformed cluster line",
+                  file=sys.stderr)
+            continue
+        ranks = rec.get("ranks")
+        skew = rec.get("skew") or {}
+        if not isinstance(ranks, dict) or not ranks:
+            rows.append({c: (idx if c == "seq" else None)
+                         for c in _CLUSTER_COLS})
+            continue
+        order = sorted(ranks, key=int)
+
+        def col(key, scale=1.0, _r=ranks, _o=order):
+            vals = []
+            for r in _o:
+                v = _r[r].get(key)
+                vals.append("-" if v is None else "%.4g" % (v * scale))
+            return ";".join("r%s:%s" % (r, v) for r, v in zip(_o, vals))
+
+        gbps = [ranks[r].get("comm_gbps") for r in order]
+        gbps = [g for g in gbps if g is not None]
+        rows.append({
+            "seq": idx,
+            "nranks": rec.get("nranks", len(ranks)),
+            "steps": ";".join("r%s:%s" % (r, ranks[r].get("steps", "-"))
+                              for r in order),
+            "step_ms": col("step_mean_s", scale=1e3),
+            "skew": skew.get("max_over_median"),
+            "slowest": skew.get("slowest_rank"),
+            "gbps_min": min(gbps) if gbps else None,
+            "gbps_max": max(gbps) if gbps else None,
+        })
+    return rows
+
+
+_CLUSTER_COLS = ["seq", "nranks", "steps", "step_ms", "skew", "slowest",
+                 "gbps_min", "gbps_max"]
+
+
 _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "mfu", "dispatches", "cache_hits", "cache_misses",
                    "io_wait_p50", "h2d_bytes", "lazy_flushes", "chain_mean",
@@ -162,7 +220,7 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "decode_mbps", "comm_gbps", "overlap_pct"]
 
 
-def _print_telemetry(rows, fmt):
+def _print_rows(rows, cols, fmt):
     def cell(v):
         if v is None:
             return "-"
@@ -171,14 +229,22 @@ def _print_telemetry(rows, fmt):
         return str(v)
 
     if fmt == "markdown":
-        print("| " + " | ".join(_TELEMETRY_COLS) + " |")
-        print("|" + " --- |" * len(_TELEMETRY_COLS))
+        print("| " + " | ".join(cols) + " |")
+        print("|" + " --- |" * len(cols))
     for r in rows:
-        cells = [cell(r[c]) for c in _TELEMETRY_COLS]
+        cells = [cell(r[c]) for c in cols]
         if fmt == "markdown":
             print("| " + " | ".join(cells) + " |")
         else:
             print(*cells)
+
+
+def _print_telemetry(rows, fmt):
+    _print_rows(rows, _TELEMETRY_COLS, fmt)
+
+
+def _print_cluster(rows, fmt):
+    _print_rows(rows, _CLUSTER_COLS, fmt)
 
 
 def main():
@@ -191,8 +257,16 @@ def main():
                         help="input is a telemetry JSONL file "
                              "(MXTPU_TELEMETRY_FILE sink) instead of a "
                              "fit() text log")
+    parser.add_argument("--cluster", action="store_true",
+                        help="input is a rank-0 cluster JSONL "
+                             "(MXTPU_OBS_CLUSTER_FILE, obs aggregator): "
+                             "per-rank step/step-time columns + the "
+                             "max/median skew straggler attribution")
     args = parser.parse_args()
     lines = open(args.logfile).readlines() if args.logfile else sys.stdin.readlines()
+    if args.cluster:
+        _print_cluster(parse_cluster(lines), args.format)
+        return
     if args.telemetry:
         _print_telemetry(parse_telemetry(lines), args.format)
         return
