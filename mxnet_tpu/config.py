@@ -267,6 +267,27 @@ REGISTRY = [
            "JSONL record per interval (per-rank steps/step-time/comm "
            "columns + max/median step-skew straggler attribution) — "
            "render with `python tools/parse_log.py --cluster FILE`"),
+    EnvVar("MXTPU_COLLECTIVE_CHECK", int, 0,
+           "Cross-rank collective-schedule verifier (parallel/"
+           "schedule_check.py, the runtime half of mxlint E007): every "
+           "rank folds its flight-recorder stream of collective enter "
+           "events (kind, seq, bytes, bucket-plan fingerprint) into a "
+           "rolling structural hash, ships the digest in the obs "
+           "snapshot every MXTPU_OBS_INTERVAL_SECONDS, and compares "
+           "against every peer.  A divergent schedule is reported as a "
+           "ScheduleDivergence naming the first diverging event and "
+           "both ranks (sched_divergence.r<rank>.json artifact; with "
+           "MXTPU_OBS_STALL_ACTION=abort the rank exits code 18) — "
+           "catching the desync BEFORE the stall watchdog's timeout "
+           "would fire.  0 (default) = off"),
+    EnvVar("MXTPU_RETRACE_WARN", int, 0,
+           "Retrace-storm warning threshold (telemetry.note_retrace, "
+           "the runtime half of mxlint W104): every compiled-program "
+           "cache site counts signature churn in trace.retraces[.site]"
+           "; past this many DISTINCT signatures at one site a warning "
+           "logs the signature delta (previous vs new) naming the "
+           "unstable static arg.  0 (default) = count only, never "
+           "warn"),
     # ---- memory (executor.py) ----
     EnvVar("MXNET_BACKWARD_DO_MIRROR", int, 0,
            "Memory mirroring: recompute cheap activations (BN/ReLU/elemwise) "

@@ -32,6 +32,14 @@ from .symbol import _topo_order
 
 __all__ = ["Executor"]
 
+# monotonic retrace-monitor scope tokens: each binding's jit caches are
+# judged independently (telemetry.note_retrace scope=), and a counter —
+# unlike id(self) — can never alias a garbage-collected executor's
+# identity onto a fresh one
+import itertools as _itertools
+
+_RETRACE_SCOPE_SEQ = _itertools.count()
+
 
 def _run_graph(entries, order, arg_names, aux_names, arg_vals, aux_vals, is_train, rng,
                boundary=None, cast=None, mesh=None):
@@ -559,16 +567,26 @@ class Executor:
     # telemetry helpers (each early-returns when the registry is off,
     # so hot paths pay one predicted branch — the enabled() contract)
     # ------------------------------------------------------------------
-    def _note_compile_cache(self, hit):
+    def _note_compile_cache(self, hit, site=None, signature=None):
         """One executable-cache lookup: a miss means an XLA (re)compile —
         steady-state training must show hits only (a miss churn here is
-        the bucketing-rebind / shape-instability smell)."""
+        the bucketing-rebind / shape-instability smell).  Misses that
+        carry a `site`/`signature` also feed the retrace monitor
+        (telemetry.note_retrace, the runtime half of mxlint W104):
+        the second DISTINCT signature at one site counts a
+        ``trace.retraces`` and, past MXTPU_RETRACE_WARN, logs the
+        signature delta."""
         from . import telemetry
 
         if not telemetry.enabled():
             return
         telemetry.inc("executor.compile_cache_hits" if hit
                       else "executor.compile_cache_misses")
+        if not hit and site is not None:
+            scope = getattr(self, "_retrace_scope", None)
+            if scope is None:
+                scope = self._retrace_scope = next(_RETRACE_SCOPE_SEQ)
+            telemetry.note_retrace(site, signature, scope=scope)
 
     def _note_dispatch(self, kind, elapsed):
         """One training dispatch: wall latency split by dispatch shape
@@ -648,7 +666,8 @@ class Executor:
         from . import profiler
 
         compiled = is_train in self._jit_fwd
-        self._note_compile_cache(compiled)
+        self._note_compile_cache(compiled, site="executor.forward",
+                                 signature=is_train)
         fn = self._fwd_fn(is_train)
         args = self._place(self._gather_args())
         import numpy as _np
@@ -699,7 +718,8 @@ class Executor:
         executor.compile_cache_hits/_misses like the training paths)."""
         names = tuple(input_names)
         key = ("serve", names)
-        self._note_compile_cache(key in self._jit_fwd)
+        self._note_compile_cache(key in self._jit_fwd,
+                                 site="executor.serve", signature=names)
         if key not in self._jit_fwd:
             an = self._arg_names
             missing = [n for n in names if n not in an]
@@ -877,7 +897,8 @@ class Executor:
             opt, [self._fused_index_of_name[n] for n in diff_names], 1)[0]
         sig = tuple((n, tuple(l.shape for l in leaves_by_name[n])) for n in diff_names)
         first_call = self._jit_step is None or self._jit_step[1] != sig
-        self._note_compile_cache(not first_call)
+        self._note_compile_cache(not first_call,
+                                 site="executor.fused_step", signature=sig)
         if first_call:
             core = self._grad_core(diff_idx, nondiff_idx)
 
@@ -1241,7 +1262,8 @@ class Executor:
             assert out_batch is not None and all(out_batch),                 "comm mode armed without all-batch outputs (gate bug)"
         key = (k, tuple(an[i] for i in stream_idx), sig, comm)
         first_call = key not in self._jit_block
-        self._note_compile_cache(not first_call)
+        self._note_compile_cache(not first_call,
+                                 site="executor.fused_block", signature=key)
         if first_call:
             fn = self._build_block_fn(stream_idx, static_idx, comm,
                                       out_batch=out_batch)
@@ -1473,7 +1495,8 @@ class Executor:
             return
         has_heads = out_grads is not None
         key = (True, has_heads)
-        self._note_compile_cache(key in self._jit_bwd)
+        self._note_compile_cache(key in self._jit_bwd,
+                                 site="executor.backward", signature=key)
         if key not in self._jit_bwd:
             an = self._arg_names
             diff_idx = [an.index(n) for n in diff_names]

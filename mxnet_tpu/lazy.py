@@ -542,6 +542,14 @@ def _execute(program, vals, scalars):
                     # re-trace after this clear is a miss, not a hit
                     _SEEN_KEYS.clear()
                 runner = _FUSION_CACHE[program] = _make_runner(program)
+                # retrace monitor (the runtime half of mxlint W104):
+                # every NEW program fingerprint past the first is
+                # signature churn at this cache site — a float attr
+                # embedding per-value (not lifted to an operand) shows
+                # up here as trace.retraces.lazy.fusion climbing with
+                # MXTPU_RETRACE_WARN naming the fingerprint delta
+                if telemetry.enabled():
+                    telemetry.note_retrace("lazy.fusion", program)
             if telemetry.enabled():
                 # telemetry-only structure: bound it (a burst of
                 # spurious misses after a clear beats unbounded growth

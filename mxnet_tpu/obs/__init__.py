@@ -66,3 +66,9 @@ def bootstrap():
         watchdog.maybe_start_from_env()
     except Exception as e:  # pragma: no cover — defensive
         warnings.warn("obs watchdog bootstrap failed: %s" % e)
+    try:
+        from ..parallel import schedule_check
+
+        schedule_check.maybe_start_from_env()
+    except Exception as e:  # pragma: no cover — defensive
+        warnings.warn("schedule-check bootstrap failed: %s" % e)

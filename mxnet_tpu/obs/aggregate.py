@@ -114,7 +114,16 @@ def build_snapshot(rank=None):
     counters, gauges = snap["counters"], snap["gauges"]
     step_h = snap["histograms"].get("module.step_seconds", {})
     count = step_h.get("count", 0)
+    # collective-schedule digest (parallel/schedule_check.py): rides
+    # the snapshot only when MXTPU_COLLECTIVE_CHECK=1 — the verifier's
+    # cross-rank exchange reuses this exact framing, no new plane
+    sched = None
+    from ..parallel import schedule_check
+
+    if schedule_check.enabled():
+        sched = schedule_check.digest()
     return {
+        "sched": sched,
         "rank": _own_rank() if rank is None else int(rank),
         "t_wall": time.time(),
         "steps": counters.get("module.steps", 0),

@@ -40,7 +40,7 @@ import time
 
 __all__ = ["enabled", "set_enabled", "record", "events", "open_spans",
            "progress", "compiling", "last_compile_exit", "reset",
-           "ring_slots", "own_rank"]
+           "ring_slots", "own_rank", "set_schedule_hook"]
 
 _ENABLED = _os.environ.get("MXTPU_OBS_RECORDER", "1") not in ("0", "")
 _DEFAULT_SLOTS = 512
@@ -55,6 +55,12 @@ def _env_slots():
 
 
 _LOCK = threading.Lock()
+# collective-schedule hook (parallel/schedule_check.py installs it when
+# MXTPU_COLLECTIVE_CHECK=1): called OUTSIDE _LOCK with every enter
+# event's (kind, seq, nbytes, detail) so the cross-rank schedule
+# verifier folds the same stream the ring retains.  None when the
+# check is off — one predicate per record(), nothing else.
+_SCHED_HOOK = None
 _RING = [None] * _env_slots()  # fixed slots, preallocated — no growth
 _NEXT = 0  # total events ever recorded; slot = _NEXT % len(_RING)
 _KIND_SEQ = {}  # kind -> last auto-assigned sequence number
@@ -136,7 +142,18 @@ def record(kind, phase, seq=None, detail="", nbytes=0):
         _RING[_NEXT % len(_RING)] = (_NEXT, t, kind, phase, seq, detail,
                                      int(nbytes))
         _NEXT += 1
+    if _SCHED_HOOK is not None and phase == "enter":
+        _SCHED_HOOK(kind, seq, nbytes=nbytes, detail=detail)
     return seq
+
+
+def set_schedule_hook(fn):
+    """Install/remove the collective-schedule hook (module comment at
+    _SCHED_HOOK); returns the previous hook."""
+    global _SCHED_HOOK
+    prev = _SCHED_HOOK
+    _SCHED_HOOK = fn
+    return prev
 
 
 def events(last_k=None):

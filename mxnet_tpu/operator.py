@@ -198,6 +198,7 @@ def register(reg_name):
                     try:
                         return _direct_fwd(*xs)
                     except _untraceable:
+                        # mxlint: disable=E006 -- intentional trace-time latch: the op just PROVED untraceable, so this compile-time memo (idempotent, one name, never per-step state) steers every later trace straight to pure_callback
                         _HOST_OPS.add(reg_name)
                 outs = jax.pure_callback(_host_fwd, out_specs, *xs,
                                          vmap_method="sequential")

@@ -536,13 +536,14 @@ def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
     return jnp.matmul(lhs, rhs)
 
 
-@register("_linalg_gemm2", inputs=("A", "B"), aliases=("linalg_gemm2",))
+@register("_linalg_gemm2", inputs=("A", "B"), aliases=("linalg_gemm2",),
+          lift_floats=True)
 def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
     if _bool(transpose_a):
         A = jnp.swapaxes(A, -1, -2)
     if _bool(transpose_b):
         B = jnp.swapaxes(B, -1, -2)
-    return float(_lit(alpha)) * jnp.matmul(A, B)
+    return _scalarv(alpha) * jnp.matmul(A, B)
 
 
 @register("_linalg_potrf", inputs=("A",), aliases=("linalg_potrf",))
@@ -550,14 +551,16 @@ def linalg_potrf(A, **kw):
     return jnp.linalg.cholesky(A)
 
 
-@register("_linalg_syrk", inputs=("A",), aliases=("linalg_syrk",))
+@register("_linalg_syrk", inputs=("A",), aliases=("linalg_syrk",),
+          lift_floats=True)
 def linalg_syrk(A, transpose=False, alpha=1.0, **kw):
     if _bool(transpose):
         A = jnp.swapaxes(A, -1, -2)
-    return float(_lit(alpha)) * jnp.matmul(A, jnp.swapaxes(A, -1, -2))
+    return _scalarv(alpha) * jnp.matmul(A, jnp.swapaxes(A, -1, -2))
 
 
-@register("_linalg_gemm", inputs=("A", "B", "C"), aliases=("linalg_gemm",))
+@register("_linalg_gemm", inputs=("A", "B", "C"), aliases=("linalg_gemm",),
+          lift_floats=True)
 def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
                 beta=1.0, **kw):
     """BLAS3 gemm: alpha*op(A)@op(B) + beta*C (reference
@@ -566,10 +569,11 @@ def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
         A = jnp.swapaxes(A, -1, -2)
     if _bool(transpose_b):
         B = jnp.swapaxes(B, -1, -2)
-    return float(_lit(alpha)) * jnp.matmul(A, B) + float(_lit(beta)) * C
+    return _scalarv(alpha) * jnp.matmul(A, B) + _scalarv(beta) * C
 
 
-@register("_linalg_trmm", inputs=("A", "B"), aliases=("linalg_trmm",))
+@register("_linalg_trmm", inputs=("A", "B"), aliases=("linalg_trmm",),
+          lift_floats=True)
 def linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0, **kw):
     """Triangular matrix multiply: alpha*op(A)@B or alpha*B@op(A), A lower
     triangular (reference src/operator/tensor/la_op.cc:232-282).  On TPU a
@@ -577,15 +581,16 @@ def linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0, **kw):
     if _bool(transpose):
         A = jnp.swapaxes(A, -1, -2)
     prod = jnp.matmul(B, A) if _bool(rightside) else jnp.matmul(A, B)
-    return float(_lit(alpha)) * prod
+    return _scalarv(alpha) * prod
 
 
-@register("_linalg_trsm", inputs=("A", "B"), aliases=("linalg_trsm",))
+@register("_linalg_trsm", inputs=("A", "B"), aliases=("linalg_trsm",),
+          lift_floats=True)
 def linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0, **kw):
     """Solve op(A)@X = alpha*B (or X@op(A) = alpha*B), A lower triangular
     (reference src/operator/tensor/la_op.cc:293-345)."""
     return lax.linalg.triangular_solve(
-        A, float(_lit(alpha)) * B, left_side=not _bool(rightside),
+        A, _scalarv(alpha) * B, left_side=not _bool(rightside),
         lower=True, transpose_a=_bool(transpose))
 
 

@@ -15,9 +15,14 @@ parallelism is expressed as shardings over a `jax.sharding.Mesh`:
   * multihost.py  — multi-host SPMD bootstrap (jax.distributed over DCN;
     global mesh + per-host input slices), launcher-env compatible
   * dist.py       — multi-process control plane (Postoffice/tracker analog)
+  * schedule_check.py — cross-rank collective-schedule verifier
+    (MXTPU_COLLECTIVE_CHECK=1): catches rank-divergent collective
+    schedules at the obs interval, before the stall watchdog's
+    timeout — the runtime half of mxlint E007
 """
 from . import mesh
 from . import collectives
+from . import schedule_check
 from . import pipeline
 from . import moe
 from . import multihost

@@ -41,8 +41,8 @@ import time
 __all__ = [
     "enabled", "set_enabled", "inc", "set_gauge", "observe",
     "counter_value", "gauge_value", "snapshot", "reset", "flush",
-    "rank_suffixed", "peak_flops", "flops_of_jaxpr", "TIME_BUCKETS",
-    "BYTE_BUCKETS", "COUNT_BUCKETS",
+    "rank_suffixed", "note_retrace", "peak_flops", "flops_of_jaxpr",
+    "TIME_BUCKETS", "BYTE_BUCKETS", "COUNT_BUCKETS",
 ]
 
 # fixed bucket boundaries (seconds): half-decade exponential ladder from
@@ -162,6 +162,83 @@ def observe(name, value, buckets=TIME_BUCKETS):
         h.observe(value)
 
 
+# ----------------------------------------------------------------------
+# retrace monitor — the runtime half of mxlint W104.  Every compiled-
+# program cache in the framework (the executor's jit caches, the lazy
+# fusion cache) calls note_retrace on a cache MISS with the signature
+# it is about to compile; a site that keeps compiling NEW signatures
+# is a retrace storm — steps look slow, nothing errors.  The monitor
+# counts churn per cache site (``trace.retraces`` total +
+# ``trace.retraces.<site>``) and, past ``MXTPU_RETRACE_WARN=N``
+# distinct signatures at one site, logs the offending signature delta
+# (previous vs new) so the unstable static arg is named, not guessed.
+# ----------------------------------------------------------------------
+
+_RETRACE_SEEN = {}    # (site, scope) -> set of signature reprs (bounded)
+_RETRACE_LAST = {}    # (site, scope) -> last signature repr
+_RETRACE_SEEN_CAP = 64    # signatures retained per site
+_RETRACE_KEYS_CAP = 512   # (site, scope) keys retained process-wide: a
+# server rebinding executors forever must not grow monitor state
+# without bound — a wholesale clear (a burst of uncounted churn) beats
+# leaking; the counters themselves are never cleared
+_SIG_REPR_MAX = 400
+
+
+def _retrace_warn_threshold():
+    raw = _os.environ.get("MXTPU_RETRACE_WARN", "")
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0
+
+
+def note_retrace(site, signature, scope=None):
+    """Record one compile-cache miss at `site` (cold path — called
+    only when a compile is about to happen, never per dispatch).
+
+    The FIRST signature a (site, scope) compiles is not a retrace;
+    every later distinct signature counts one.  `scope` separates
+    same-named sites with independent caches (the executor passes
+    ``id(self)``: each bound executor owns its jit caches, so churn is
+    judged within one binding, not across models).  Returns True when
+    the miss was a retrace."""
+    if not _ENABLED:
+        return False
+    sig = repr(signature)
+    if len(sig) > _SIG_REPR_MAX:
+        sig = sig[:_SIG_REPR_MAX] + "...<truncated>"
+    key = (site, scope)
+    with _LOCK:
+        seen = _RETRACE_SEEN.get(key)
+        if seen is None:
+            if len(_RETRACE_SEEN) >= _RETRACE_KEYS_CAP:
+                _RETRACE_SEEN.clear()
+                _RETRACE_LAST.clear()
+            seen = _RETRACE_SEEN[key] = set()
+        first = not seen
+        known = sig in seen
+        prev = _RETRACE_LAST.get(key)
+        if len(seen) < _RETRACE_SEEN_CAP:
+            seen.add(sig)
+        _RETRACE_LAST[key] = sig
+        n_distinct = len(seen)
+    if first or known:
+        return False
+    inc("trace.retraces")
+    inc("trace.retraces.%s" % site)
+    warn_at = _retrace_warn_threshold()
+    if warn_at > 0 and n_distinct > warn_at:
+        import logging
+
+        logging.getLogger("mxnet_tpu.telemetry").warning(
+            "retrace storm at cache site %r: %d distinct signatures "
+            "(MXTPU_RETRACE_WARN=%d); signature delta:\n  was: %s\n  "
+            "now: %s\nA churning signature usually means a float/"
+            "unstable static arg that should be a traced operand "
+            "(mxlint W104)", site, n_distinct, warn_at, prev, sig)
+    return True
+
+
 def counter_value(name, default=0):
     with _LOCK:
         return _COUNTERS.get(name, default)
@@ -192,6 +269,8 @@ def reset():
         _COUNTERS.clear()
         _GAUGES.clear()
         _HISTOGRAMS.clear()
+        _RETRACE_SEEN.clear()
+        _RETRACE_LAST.clear()
         _FLUSH_SEQ = 0
 
 

@@ -1,0 +1,100 @@
+"""One rank of the collective-schedule divergence chaos test
+(tests/test_obs.py).
+
+Launched as `tools/launch.py --local-spmd -n 2 --obs` with
+MXTPU_COLLECTIVE_CHECK=1 and the stall watchdog armed FAR out
+(the test asserts the job terminates well before that deadline).
+Both ranks run the real multi-process training stack; RANK 1 TAKES A
+DIVERGENT BUCKET PATH mid-epoch — after a couple of dispatches it
+records one extra collective edge event with a different bucket-plan
+fingerprint into the flight recorder (the deterministic stand-in for
+a rank whose gradient bucketing, batch count, or rebind schedule
+desynced) and KEEPS TRAINING.  Nothing hangs: the point of the
+schedule verifier is to catch the divergence from the recorder
+streams alone, before any rank ever blocks.
+
+Each rank's verifier must then (a) name the first diverging collective
+— kind, seq, bucket fingerprint — and both ranks in its
+sched_divergence.r<rank>.json artifact, and (b) abort with exit code
+18 (DIVERGENCE_EXIT_CODE) so the launcher returns within the obs
+interval, not after the watchdog window.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from mxnet_tpu.parallel import multihost
+
+    multihost.initialize()  # arms obs + the schedule check from the env
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.obs import recorder
+
+    rank = jax.process_index()
+    mesh = multihost.global_mesh(hierarchical=True)
+    obs_dir = os.environ.get("MXTPU_OBS_DIR", ".")
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(64, 12).astype(np.float32)
+    w = rng.randn(12, 1).astype(np.float32)
+    y = (X @ w).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="lro_label")
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    o = mx.sym.FullyConnected(h, num_hidden=1, name="fc2")
+    net = mx.sym.LinearRegressionOutput(o, name="lro")
+    mod = mx.mod.Module(net, label_names=("lro_label",), context=mx.cpu(),
+                        mesh=mesh)
+    seen = [0]
+
+    def on_batch(param):
+        seen[0] += 1
+        if rank == 1 and seen[0] == 2:
+            sys.stdout.write("SCHED rank=1 divergent bucket path after "
+                             "%d batches\n" % seen[0])
+            sys.stdout.flush()
+            # the divergent bucket path: one collective edge event the
+            # peer never records, with a different plan fingerprint —
+            # then keep training normally (no hang; the verifier must
+            # catch this from the schedule streams alone)
+            s = recorder.record("allreduce", "enter",
+                                detail="divergent-bucket(b=9)",
+                                nbytes=4096)
+            recorder.record("allreduce", "exit", s)
+
+    sys.stdout.write("SCHED rank=%d start axes=%s check=%s\n"
+                     % (rank, ",".join(mesh.axis_names),
+                        os.environ.get("MXTPU_COLLECTIVE_CHECK")))
+    sys.stdout.flush()
+    # enough epochs that training outlives several obs intervals: the
+    # verifier must abort this process mid-run (exit 18)
+    mod.fit(it, num_epoch=200, kvstore=None, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), eval_metric="mse",
+            steps_per_dispatch=2, batch_end_callback=on_batch)
+    # only reachable if the verifier never fired — give it one last
+    # bounded window (a short run can finish between polls), then fail
+    # loudly so the test sees the miss
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.exists(os.path.join(obs_dir,
+                                       "sched_divergence.r%d.json" % rank)):
+            sys.exit(18)
+        time.sleep(0.25)
+    sys.stdout.write("SCHED rank=%d finished WITHOUT divergence "
+                     "detection\n" % rank)
+    sys.stdout.flush()
+    sys.exit(5)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,19 +37,18 @@ def _ids(findings):
 # ----------------------------------------------------------------------
 
 def test_repo_is_lint_clean():
-    """`python -m tools.analysis mxnet_tpu bench.py tools/bandwidth
-    tools/launch.py` must exit 0: every finding fixed or allowlisted
-    with a justification (docs/engine.md).  bench.py is in the sweep
-    because its A/B harness (`--ab`) toggles framework env vars;
-    tools/bandwidth and the launcher joined in ISSUE 10 — the bandwidth
-    tool feeds SCALING.md's measured anchors and the launcher exports
-    the whole cluster env contract, so an undocumented knob or a
-    blocking-sync regression there ships user-facing rot."""
+    """`python -m tools.analysis mxnet_tpu bench.py tools` must exit
+    0: every finding fixed or allowlisted with a justification
+    (docs/static_analysis.md).  bench.py is in the sweep because its
+    A/B harness (`--ab`) toggles framework env vars; ISSUE 12 widened
+    the target from tools/bandwidth + tools/launch.py to ALL of
+    tools/ — the trace/SPMD checks (E006/E007) apply to the bandwidth
+    tool's jit+psum probes and the new check modules themselves must
+    hold their own gate."""
     findings, suppressed, errors = run_paths(
         [os.path.join(ROOT, "mxnet_tpu"),
          os.path.join(ROOT, "bench.py"),
-         os.path.join(ROOT, "tools", "bandwidth"),
-         os.path.join(ROOT, "tools", "launch.py")])
+         os.path.join(ROOT, "tools")])
     assert not errors, errors
     assert not findings, "\n".join(str(f) for f in findings)
     # the allowlist is in use and every entry carries its justification
@@ -549,7 +548,7 @@ E005_CLEAN = """
 import jax.numpy as jnp
 from .registry import register
 
-@register("good_op", inputs=("data",))
+@register("good_op", inputs=("data",), lift_floats=True)
 def good_op(data, scalar=1.0, **kw):
     return jnp.abs(data) * scalar
 
@@ -879,3 +878,521 @@ def test_e004_fires_on_unguarded_recorder_record(tmp_path):
 def test_e004_recorder_record_clean_when_guarded(tmp_path):
     findings, _, _ = _lint_src(tmp_path, E004_RECORDER_HOT_PATH_GUARDED)
     assert findings == []
+
+
+# ----------------------------------------------------------------------
+# E006 — tracer leaks / host effects in traced code (ISSUE 12)
+# ----------------------------------------------------------------------
+
+E006_CONCRETIZE = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def step(x):
+    s = jnp.mean(x)
+    v = float(s)
+    h = np.asarray(x)
+    return x * v + h.sum()
+
+
+fn = jax.jit(step)
+"""
+
+
+def test_e006_flags_concretization_in_jitted_fn(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E006_CONCRETIZE)
+    got = _ids(findings)
+    assert got.count("E006") == 2, findings
+    assert any("float()" in f.message for f in findings)
+    assert any("np.asarray" in f.message for f in findings)
+
+
+E006_BRANCH = """
+import jax
+import jax.numpy as jnp
+
+
+def step(x):
+    s = jnp.sum(x)
+    if s > 0:
+        x = x - 1.0
+    while s < 10:
+        x = x + 1.0
+    return x
+
+
+fn = jax.jit(step)
+"""
+
+
+def test_e006_flags_python_branch_on_traced_value(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E006_BRANCH)
+    got = _ids(findings)
+    assert got.count("E006") == 2, findings
+    assert any("`if`" in f.message for f in findings)
+    assert any("`while`" in f.message for f in findings)
+
+
+# the ancestor-if NEGATIVE case: host-static conditions (is-None
+# checks, isinstance shims, closure config, string mode switches) are
+# how the executor's comm gate and the RNN cells are written — they
+# resolve identically at trace time on every rank and must stay silent
+E006_STATIC_BRANCHES_CLEAN = """
+import jax
+import jax.numpy as jnp
+
+
+def build(comm, mode):
+    def step(x, seed):
+        rng = None
+        if seed is not None:
+            rng = jax.random.key(seed)
+        if comm is not None:
+            x = x * 2.0
+        if mode == "lstm":
+            x = jnp.tanh(x)
+        if isinstance(x, tuple):
+            x = x[0]
+        n = 1
+        for d in x.shape:
+            n *= int(d)
+        if n > 4:
+            x = x + float(n)
+        return x, rng
+
+    return jax.jit(step)
+"""
+
+
+def test_e006_static_branches_and_shape_math_are_clean(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E006_STATIC_BRANCHES_CLEAN)
+    assert findings == [], findings
+
+
+E006_HOST_EFFECTS = """
+import time
+import jax
+from . import telemetry
+
+
+def make(outer_log):
+    def step(x):
+        t0 = time.time()
+        telemetry.inc("steps")
+        print("step!")
+        outer_log.append(t0)
+        return x
+
+    return jax.jit(step)
+"""
+
+
+def test_e006_flags_host_effects_and_closure_mutation(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E006_HOST_EFFECTS)
+    got = [f for f in findings if f.check_id == "E006"]
+    msgs = "\n".join(f.message for f in got)
+    assert len(got) == 4, findings
+    assert "time.time()" in msgs
+    assert "telemetry.inc" in msgs
+    assert "print()" in msgs
+    assert "outer_log" in msgs and "mutates" in msgs
+
+
+# the gate-idiom NEGATIVE case: the sanctioned trace-time mode gauge
+# (ops/nn.py _bf16_wgrad_active) — set_gauge behind the enabled()
+# guard records WHICH numerics this compile uses, once per compile,
+# by design
+E006_MODE_GAUGE_CLEAN = """
+import jax
+from . import telemetry
+
+
+def kernel(x):
+    if telemetry.enabled():
+        telemetry.set_gauge("ops.mode", 1)
+    return x * 2.0
+
+
+fn = jax.jit(kernel)
+"""
+
+
+def test_e006_guarded_trace_time_mode_gauge_is_clean(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E006_MODE_GAUGE_CLEAN)
+    assert findings == [], findings
+
+
+# the resolver follows the executor's builder idiom: jit applied to a
+# BUILDER CALL traces the closure the builder returns — interprocedural
+# through the assignment and the module-level helper it calls
+E006_BUILDER_RESOLUTION = """
+import jax
+from . import telemetry
+
+
+def _run_graph(vals):
+    telemetry.inc("nodes")
+    return vals
+
+
+class Executor:
+    def _build_fwd(self):
+        def f(vals):
+            return _run_graph(vals)
+
+        return f
+
+    def _fwd_fn(self):
+        fn = self._build_fwd()
+        return jax.jit(fn)
+"""
+
+
+def test_e006_resolves_through_builders_and_module_helpers(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E006_BUILDER_RESOLUTION)
+    got = [f for f in findings if f.check_id == "E006"]
+    assert len(got) == 1, findings
+    assert "telemetry.inc" in got[0].message
+
+
+E006_SCAN_DECORATOR = """
+import functools
+import jax
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+
+@functools.partial(shard_map, mesh=None, in_specs=(), out_specs=())
+def _reduce(x):
+    print("reducing")
+    return lax.psum(x, "data")
+
+
+def outer(xs):
+    def body(carry, x):
+        v = float(x)
+        return carry + v, carry
+
+    return lax.scan(body, 0.0, xs)
+"""
+
+
+def test_e006_covers_partial_shard_map_decorator_and_scan_body(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E006_SCAN_DECORATOR)
+    got = [f for f in findings if f.check_id == "E006"]
+    msgs = "\n".join(f.message for f in got)
+    assert "print()" in msgs and "shard_map" in msgs
+    assert "float()" in msgs and "scan" in msgs
+
+
+# ----------------------------------------------------------------------
+# E007 — collectives under rank-dependent control flow (ISSUE 12)
+# ----------------------------------------------------------------------
+
+E007_RANK_IF = """
+import jax
+from jax import lax
+
+
+def body(x):
+    if jax.process_index() == 0:
+        x = lax.psum(x, "data")
+    return x
+
+
+fn = jax.jit(body)
+"""
+
+E007_RANK_LOCAL = """
+import jax
+import os
+from jax import lax
+
+
+def body(x):
+    rank = int(os.environ.get("MXTPU_PROCESS_ID", "0"))
+    me = rank % 2
+    if me:
+        x = lax.all_gather(x, "data")
+    return x
+
+
+fn = jax.jit(body)
+"""
+
+
+def test_e007_flags_collective_under_rank_branch(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E007_RANK_IF)
+    got = [f for f in findings if f.check_id == "E007"]
+    assert len(got) == 1, findings
+    assert "psum" in got[0].message and "rank-varying" in got[0].message
+    findings, _, _ = _lint_src(tmp_path, E007_RANK_LOCAL)
+    got = [f for f in findings if f.check_id == "E007"]
+    assert len(got) == 1, findings
+    assert "all_gather" in got[0].message
+
+
+E007_DATA_DEPENDENT = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def body(g):
+    norm = jnp.linalg.norm(g)
+    if norm > 1.0:
+        g = lax.psum(g, "data")
+    return g
+
+
+fn = jax.jit(body)
+"""
+
+
+def test_e007_flags_collective_under_data_branch(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E007_DATA_DEPENDENT)
+    got = [f for f in findings if f.check_id == "E007"]
+    assert len(got) == 1, findings
+    assert "data-" in got[0].message
+    assert "MXTPU_COLLECTIVE_CHECK" in got[0].message
+
+
+# the ancestor-if NEGATIVE case: a collective under host-static
+# config — exactly the executor's comm-mode gate (`if comm is not
+# None:` around bucketed_psum) — is the sanctioned shape: every rank
+# resolves it identically at trace time
+E007_HOST_CONFIG_CLEAN = """
+import jax
+from jax import lax
+
+
+def build(comm, axes):
+    def body(grads):
+        if comm is not None:
+            grads = lax.psum(grads, "data")
+        for name in axes:
+            grads = lax.psum(grads, name)
+        return grads
+
+    return jax.jit(body)
+"""
+
+
+def test_e007_host_config_gate_and_loops_are_clean(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E007_HOST_CONFIG_CLEAN)
+    assert findings == [], findings
+
+
+# ----------------------------------------------------------------------
+# W104 — retrace hazards (ISSUE 12)
+# ----------------------------------------------------------------------
+
+W104_LIFT_BREAK = """
+from .registry import register
+
+
+@register("bad_scale", lift_floats=True)
+def bad_scale(data, scalar=1.0, **kw):
+    return data * float(scalar)
+"""
+
+W104_UNLIFTED = """
+from .registry import register
+
+
+@register("unlifted_scale", inputs=("data",))
+def unlifted_scale(data, scalar=2.0, **kw):
+    return data * scalar
+"""
+
+# the lifted-scalar NEGATIVE case: the _reg_scalar family shape —
+# lift_floats + the tracer-admitting _scalarv coercion (and the
+# static-embed idiom: a param NORMALIZED before use is a deliberate
+# per-model symbolic attr, not churn)
+W104_LIFTED_CLEAN = """
+from .registry import register
+
+
+def _scalarv(v):
+    return v
+
+
+@register("good_scale", lift_floats=True)
+def good_scale(data, scalar=1.0, **kw):
+    return data * _scalarv(scalar)
+
+
+@register("static_embed", inputs=("data",))
+def static_embed(data, eps=1e-5, **kw):
+    eps = float(eps)
+    return data + eps
+"""
+
+
+def test_w104_flags_lift_break_and_unlifted_scalar(tmp_path):
+    findings, _, _ = _lint_ops_src(tmp_path, W104_LIFT_BREAK)
+    got = [f for f in findings if f.check_id == "W104"]
+    assert len(got) == 1 and "float()" in got[0].message, findings
+    findings, _, _ = _lint_ops_src(tmp_path, W104_UNLIFTED)
+    got = [f for f in findings if f.check_id == "W104"]
+    assert len(got) == 1 and "lift_floats" in got[0].message, findings
+
+
+def test_w104_lifted_and_static_embed_kernels_are_clean(tmp_path):
+    findings, _, _ = _lint_ops_src(tmp_path, W104_LIFTED_CLEAN)
+    assert [f for f in findings if f.check_id == "W104"] == [], findings
+    # op registration patterns only apply under mxnet_tpu/ops/
+    findings, _, _ = _lint_src(tmp_path, W104_UNLIFTED)
+    assert "W104" not in _ids(findings)
+
+
+W104_CACHE_KEY = """
+class Exe:
+    def get(self, k, shapes, lr):
+        key = (k, [s for s in shapes], float(lr))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = 1
+        return self._jit_cache[key]
+"""
+
+W104_CACHE_KEY_CLEAN = """
+class Exe:
+    def get(self, k, shapes):
+        key = (k, tuple(tuple(s) for s in shapes))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = 1
+        return self._jit_cache[key]
+"""
+
+
+def test_w104_flags_unstable_jit_cache_keys(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, W104_CACHE_KEY)
+    got = [f for f in findings if f.check_id == "W104"]
+    assert got, findings
+    assert any("unhashable" in f.message for f in got)
+    findings, _, _ = _lint_src(tmp_path, W104_CACHE_KEY_CLEAN)
+    assert [f for f in findings if f.check_id == "W104"] == [], findings
+
+
+# ----------------------------------------------------------------------
+# JSON output + baseline gating + --stats (ISSUE 12 satellites)
+# ----------------------------------------------------------------------
+
+def _run_cli(args, cwd=None):
+    import subprocess
+
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis"] + args,
+        cwd=cwd or ROOT, capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, PYTHONPATH=ROOT + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")))
+
+
+def test_json_output_schema_is_stable(tmp_path):
+    """The machine-readable contract CI scripts parse: stable top-level
+    keys, per-finding keys, and an explicit justification on
+    suppressed entries."""
+    import json as _json
+
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "config.py").write_text("REGISTRY = []\n")
+    (pkg / "bad.py").write_text(
+        "def f(x=[]):\n    return x\n\n\n"
+        "def g(y={}):  # mxlint: disable=W101 -- sentinel, never mutated\n"
+        "    return y\n")
+    r = _run_cli(["--format", "json", str(pkg)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = _json.loads(r.stdout)
+    assert payload["schema"] == "mxlint-v1"
+    assert set(payload) == {"schema", "findings", "baselined",
+                            "suppressed", "errors", "stats"}
+    f = payload["findings"][0]
+    assert set(f) == {"check", "path", "line", "col", "message"}
+    assert f["check"] == "W101" and f["line"] == 1
+    s = payload["suppressed"][0]
+    assert set(s) == {"check", "path", "line", "col", "message",
+                      "justification"}
+    assert s["justification"] == "sentinel, never mutated"
+    assert payload["stats"]["files"] == 2
+    assert payload["errors"] == []
+
+
+def test_baseline_write_then_compare_gates_only_new_findings(tmp_path):
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "config.py").write_text("REGISTRY = []\n")
+    (pkg / "bad.py").write_text("def f(x=[]):\n    return x\n")
+    base = str(tmp_path / "baseline.json")
+    # snapshot the existing finding -> compare exits 0 (baselined)
+    r = _run_cli(["--write-baseline", base, str(pkg)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_cli(["--baseline", base, str(pkg)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "baselined" in r.stdout
+    # a NEW finding in another file still fails the gate
+    (pkg / "worse.py").write_text("def g(y={}):\n    return y\n")
+    r = _run_cli(["--baseline", base, str(pkg)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "worse.py" in r.stdout
+    # a garbage baseline is a usage error, never a silent un-gate
+    (tmp_path / "junk.json").write_text("{}")
+    r = _run_cli(["--baseline", str(tmp_path / "junk.json"), str(pkg)])
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+def test_committed_baseline_is_empty_and_schema_pinned():
+    """ISSUE 12 acceptance: the committed baseline carries ZERO
+    findings — the repo gate holds by fixes and justified allowlists,
+    not by baselining debt."""
+    import json as _json
+
+    path = os.path.join(ROOT, "tools", "analysis", "baseline.json")
+    payload = _json.load(open(path))
+    assert payload["schema"] == "mxlint-baseline-v1"
+    assert payload["findings"] == []
+
+
+def test_each_file_is_parsed_exactly_once_per_run(tmp_path, monkeypatch):
+    """ISSUE 12 satellite: one ast.parse per file, fanned out to every
+    registered check — pinned by counting calls through the core parse
+    hook.  config.py is both linted AND read by W103's registry
+    resolution; the shared per-run cache keeps it at one parse."""
+    import ast as _ast
+
+    from tools.analysis import core
+
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "config.py").write_text("REGISTRY = []\n")
+    (pkg / "a.py").write_text("import os\n"
+                              "x = os.environ.get('MXTPU_SOME_KNOB')\n")
+    (pkg / "b.py").write_text("def f():\n    return 1\n")
+    calls = []
+
+    def counting_parse(text, filename="<unknown>", *a, **kw):
+        calls.append(filename)
+        return _ast.parse(text, filename, *a, **kw)
+
+    monkeypatch.setattr(core, "_ast_parse", counting_parse)
+    findings, _, errors = run_paths([str(pkg)])
+    assert not errors
+    assert _ids(findings) == ["W103"]  # W103 resolved the registry
+    assert len(calls) == 3, calls
+    assert len(set(calls)) == 3, calls
+
+
+def test_stats_line_reports_files_findings_seconds(tmp_path):
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "config.py").write_text("REGISTRY = []\n")
+    r = _run_cli(["--stats", str(pkg)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stats: files=1 findings=0" in r.stdout
+    assert "seconds=" in r.stdout

@@ -597,6 +597,60 @@ def test_chaos_stalled_rank_yields_postmortem_and_no_forever_hang(tmp_path):
     assert any(r.get("nranks") == 2 for r in recs), recs[-1:]
 
 
+def test_chaos_divergent_schedule_named_before_watchdog_window(tmp_path):
+    """ISSUE 12 acceptance: 2-process --local-spmd fit with
+    MXTPU_COLLECTIVE_CHECK=1; rank 1 takes a divergent bucket path
+    mid-epoch (one extra collective edge event with a different
+    bucket-plan fingerprint) and KEEPS TRAINING — nothing hangs.  The
+    schedule verifier must name the first diverging collective (kind +
+    seq) and both ranks in its artifact, and the job must terminate
+    (exit 18, DIVERGENCE_EXIT_CODE) well before the far-out stall
+    watchdog deadline instead of relying on a hang + timeout."""
+    from mxnet_tpu.parallel.schedule_check import DIVERGENCE_EXIT_CODE
+
+    obs_dir = str(tmp_path)
+    cluster = os.path.join(obs_dir, "cluster.jsonl")
+    stall_s = 150.0
+    t0 = time.time()
+    proc = _launch_obs("sched_div_script.py", [], {
+        "MXTPU_COLLECTIVE_CHECK": "1",
+        "MXTPU_OBS_STALL_SECONDS": str(stall_s),
+        "MXTPU_OBS_STALL_ACTION": "abort",
+        "MXTPU_OBS_DIR": obs_dir,
+        "MXTPU_OBS_CLUSTER_FILE": cluster,
+        "MXTPU_OBS_INTERVAL_SECONDS": "0.25",
+    }, timeout=420)
+    elapsed = time.time() - t0
+    # the launcher returned NONZERO (verifier abort), and did so before
+    # the stall-watchdog deadline — the divergence was caught from the
+    # schedule streams, not from a hang
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert elapsed < stall_s, (elapsed, proc.stdout, proc.stderr)
+    assert "divergent bucket path" in proc.stdout, (
+        proc.stdout + proc.stderr)
+    arts = [os.path.join(obs_dir, "sched_divergence.r%d.json" % r)
+            for r in (0, 1)]
+    arts = [a for a in arts if os.path.exists(a)]
+    assert arts, (os.listdir(obs_dir), proc.stdout, proc.stderr)
+    art = json.load(open(arts[0]))
+    assert art["schema"] == "mxtpu-sched-divergence-v1"
+    rep = art["report"]
+    # both ranks named, and the first diverging event carries a kind +
+    # per-kind seq from the flight-recorder stream
+    assert rep["ranks"] == [0, 1], rep
+    events = [rep.get("event_here"), rep.get("event_peer")]
+    events = [e for e in events if e]
+    assert events, rep
+    assert all(e["kind"] in ("dispatch", "allreduce", "allgather",
+                             "barrier") and e["seq"] is not None
+               for e in events), rep
+    # the divergent bucket fingerprint is visible on one side
+    assert any("divergent-bucket" in (e.get("detail") or "")
+               for e in events), rep
+    # exit code is the verifier's, not the watchdog's (17)
+    assert (DIVERGENCE_EXIT_CODE & 0xFF) == 18
+
+
 def test_stitch_two_rank_profiles_and_cluster_table(tmp_path):
     """ISSUE 11 acceptance: a profiled 2-process fit leaves one trace
     per rank (.r<rank> suffix) with measured clock offsets; obs_stitch
@@ -644,3 +698,140 @@ def test_stitch_two_rank_profiles_and_cluster_table(tmp_path):
     assert "slowest" in pl.stdout
     assert any(("r0:" in l and "r1:" in l)
                for l in pl.stdout.splitlines()), pl.stdout
+
+
+# ----------------------------------------------------------------------
+# collective-schedule verifier (ISSUE 12): unit level — the chaos test
+# above drives it live across 2 launcher processes
+# ----------------------------------------------------------------------
+
+def _lockstep_logs(n=30):
+    from mxnet_tpu.parallel import schedule_check as sc
+
+    a, b = sc.ScheduleLog(), sc.ScheduleLog()
+    for i in range(1, n + 1):
+        for log in (a, b):
+            log.note("dispatch", i, nbytes=100, detail="block(K=2)")
+    return a, b
+
+
+def test_schedule_log_consistent_and_skew_tolerant():
+    from mxnet_tpu.parallel import schedule_check as sc
+
+    a, b = _lockstep_logs()
+    assert sc.first_divergence(a.digest(), b.digest()) is None
+    # skew (one rank ahead) is NOT divergence: common prefix agrees
+    b.note("dispatch", 31, nbytes=100, detail="block(K=2)")
+    b.note("dispatch", 32, nbytes=100, detail="block(K=2)")
+    assert sc.first_divergence(a.digest(), b.digest()) is None
+    # digests are shippable plain data
+    d = a.digest()
+    assert d["count"] == 30 and isinstance(d["hash"], str)
+    assert d["recent"][-1]["index"] == 29
+
+
+def test_schedule_divergence_names_first_event_and_both_sides():
+    from mxnet_tpu.parallel import schedule_check as sc
+
+    a, b = _lockstep_logs()
+    # rank b takes a divergent bucket path at index 30
+    b.note("allreduce", 7, nbytes=999, detail="divergent-bucket(b=9)")
+    for i in (31, 32):
+        a.note("dispatch", i, nbytes=100, detail="block(K=2)")
+        b.note("dispatch", i, nbytes=100, detail="block(K=2)")
+    div = sc.first_divergence(a.digest(), b.digest())
+    assert div is not None and div["index"] == 30
+    assert div["event_peer"] == {"kind": "allreduce", "seq": 7,
+                                 "nbytes": 999,
+                                 "detail": "divergent-bucket(b=9)"}
+    assert div["event_here"]["kind"] == "dispatch"
+    assert not div["truncated"]
+    # same-count different-bytes (a diverging bucket PLAN, not an
+    # extra event) also diverges — nbytes is part of the fingerprint
+    c, d = _lockstep_logs(5)
+    c.note("dispatch", 6, nbytes=100, detail="block(K=2,buckets=3)")
+    d.note("dispatch", 6, nbytes=400, detail="block(K=2,buckets=9)")
+    div = sc.first_divergence(c.digest(), d.digest())
+    assert div is not None and div["index"] == 5
+
+
+def test_schedule_verifier_dumps_aborts_and_caches_peers(tmp_path):
+    from mxnet_tpu.parallel import schedule_check as sc
+
+    a, b = _lockstep_logs()
+    b.note("barrier", 1, detail="divergent")
+    a.note("dispatch", 31, nbytes=100, detail="block(K=2)")
+    codes = []
+    peers = {1: {"sched": b.digest()}}
+    v = sc.ScheduleVerifier(interval_s=999, action="abort",
+                            artifact_dir=str(tmp_path), rank=0,
+                            query_fn=lambda: peers, digest_fn=a.digest,
+                            abort_fn=codes.append)
+    rep = v.check()
+    assert codes == [sc.DIVERGENCE_EXIT_CODE] and rep["ranks"] == [0, 1]
+    art = json.load(open(v.artifact_path))
+    assert art["schema"] == "mxtpu-sched-divergence-v1"
+    assert not os.path.exists(v.artifact_path + ".tmp")
+    assert art["report"]["event_peer"]["kind"] == "barrier"
+    # peer digests are CACHED: a dead aggregator (empty query) after
+    # the peer shipped once still detects — both sides of a divergence
+    # terminate even if one aborts first and takes the aggregator down
+    codes2 = []
+    v2 = sc.ScheduleVerifier(interval_s=999, action="abort",
+                             artifact_dir=str(tmp_path), rank=0,
+                             query_fn=lambda: peers, digest_fn=a.digest,
+                             abort_fn=codes2.append)
+    v2.check()
+    peers_now = {}
+    v2._query_fn = lambda: peers_now
+    assert codes2 == [sc.DIVERGENCE_EXIT_CODE]
+    # dump action raises a ScheduleDivergence naming the event
+    v3 = sc.ScheduleVerifier(interval_s=999, action="dump",
+                             artifact_dir=str(tmp_path), rank=0,
+                             query_fn=lambda: peers, digest_fn=a.digest)
+    with pytest.raises(sc.ScheduleDivergence) as ei:
+        v3.check()
+    assert "rank 0 and rank 1" in str(ei.value)
+    # reported once: the same divergence does not re-raise every poll
+    assert v3.check() is None
+
+
+def test_recorder_schedule_hook_feeds_only_collective_kinds():
+    """MXTPU_COLLECTIVE_CHECK wiring: with the hook installed, enter
+    events of collective-shaped kinds fold into the schedule log;
+    serve fills and compile brackets (rank-local, legitimately
+    divergent) do not, and exits never do."""
+    from mxnet_tpu.parallel import schedule_check as sc
+
+    sc.reset()
+    prev = sc.set_enabled(True)
+    try:
+        s = recorder.record("dispatch", "enter", detail="block(K=2)",
+                            nbytes=64)
+        recorder.record("dispatch", "exit", s)
+        recorder.record("serve", "enter", detail="t,b=4")
+        recorder.record("compile", "enter")
+        d = sc.digest()
+        assert d["count"] == 1
+        assert d["recent"][0]["kind"] == "dispatch"
+        assert d["recent"][0]["nbytes"] == 64
+    finally:
+        sc.set_enabled(prev)
+        sc.reset()
+
+
+def test_snapshot_carries_schedule_digest_only_when_armed():
+    from mxnet_tpu.parallel import schedule_check as sc
+
+    sc.reset()
+    prev = sc.set_enabled(False)
+    try:
+        assert aggregate.build_snapshot(rank=0)["sched"] is None
+        sc.set_enabled(True)
+        recorder.record("dispatch", "enter", detail="block(K=2)")
+        snap = aggregate.build_snapshot(rank=0)
+        assert snap["sched"]["count"] == 1
+        assert snap["sched"]["recent"][0]["kind"] == "dispatch"
+    finally:
+        sc.set_enabled(prev)
+        sc.reset()

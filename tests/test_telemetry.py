@@ -410,3 +410,120 @@ def test_monitor_sweep_records_duration_and_batches_stats():
     assert got["fc1_weight"] == pytest.approx(expect)
     assert telemetry.snapshot()["histograms"][
         "monitor.sweep_seconds"]["count"] == 1
+
+
+# ----------------------------------------------------------------------
+# retrace monitor (ISSUE 12): the runtime half of mxlint W104
+# ----------------------------------------------------------------------
+
+def test_note_retrace_counts_signature_churn_only():
+    """First signature at a site compiles for free; the same signature
+    again is never a retrace; each NEW distinct signature counts one
+    (total + per-site counters)."""
+    assert telemetry.note_retrace("site.a", ("x", (4, 4))) is False
+    assert telemetry.note_retrace("site.a", ("x", (4, 4))) is False
+    assert telemetry.note_retrace("site.a", ("x", (8, 4))) is True
+    assert telemetry.note_retrace("site.a", ("x", (16, 4))) is True
+    assert telemetry.counter_value("trace.retraces") == 2
+    assert telemetry.counter_value("trace.retraces.site.a") == 2
+    # scopes separate same-named sites with independent caches (the
+    # executor passes id(self)): a second binding's first compile is
+    # not churn
+    assert telemetry.note_retrace("site.a", ("x", (4, 4)),
+                                  scope=123) is False
+    assert telemetry.counter_value("trace.retraces") == 2
+    # disabled registry: no counting at all
+    prev = telemetry.set_enabled(False)
+    try:
+        assert telemetry.note_retrace("site.a", ("y",)) is False
+    finally:
+        telemetry.set_enabled(prev)
+
+
+def test_retrace_warn_threshold_logs_signature_delta(monkeypatch, caplog):
+    import logging
+
+    monkeypatch.setenv("MXTPU_RETRACE_WARN", "2")
+    telemetry.note_retrace("site.warn", "sigA")
+    telemetry.note_retrace("site.warn", "sigB")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.telemetry"):
+        telemetry.note_retrace("site.warn", "sigC")
+    joined = "\n".join(r.getMessage() for r in caplog.records)
+    assert "retrace storm" in joined and "site.warn" in joined
+    assert "sigB" in joined and "sigC" in joined  # the delta, named
+
+
+def test_forced_signature_churn_counts_through_the_lazy_cache():
+    """ISSUE 12 acceptance pin: a REAL signature-churn retrace is
+    counted end-to-end.  `clip` embeds its float attrs statically (no
+    lift_floats), so each distinct a_max keys its own fused program —
+    exactly the W104 bug class — and trace.retraces.lazy.fusion climbs;
+    the lifted scalar family (`x * 0.1` vs `x * 0.2`) shares ONE
+    program and counts nothing."""
+    import numpy as _np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import lazy
+
+    lazy.reset_cache()
+    x = mx.nd.array(_np.ones((4, 4), _np.float32))
+    for i in range(3):
+        y = mx.nd.clip(x, a_min=0.0, a_max=1.0 + i)
+        y.asnumpy()
+    churn = telemetry.counter_value("trace.retraces.lazy.fusion")
+    assert churn >= 2, telemetry.snapshot()["counters"]
+    assert telemetry.counter_value("trace.retraces") >= churn
+    # the lifted scalar family: the STRUCTURE costs one program (one
+    # fingerprint, counted once on first sight), then every distinct
+    # VALUE reuses it — value churn adds nothing
+    (x * 0.05).asnumpy()  # warm the _mul_scalar program fingerprint
+    before = telemetry.counter_value("trace.retraces.lazy.fusion")
+    for i in range(3):
+        y = x * (0.1 * (i + 1))  # lifted: one program, many values
+        y.asnumpy()
+    assert telemetry.counter_value("trace.retraces.lazy.fusion") == before
+
+
+def test_executor_forward_site_feeds_retrace_monitor():
+    """The executor's jit caches report their signatures: one binding
+    compiling a SECOND distinct signature at a site counts churn."""
+    import mxnet_tpu as mx
+
+    d = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(d, num_hidden=3, name="fc")
+    exe = mx.Executor.simple_bind(net, ctx=mx.cpu(), grad_req="null",
+                                  data=(2, 5))
+    exe.forward(is_train=False, data=mx.nd.zeros((2, 5)))
+    assert telemetry.counter_value("trace.retraces.executor.forward") == 0
+    exe.forward(is_train=True, data=mx.nd.zeros((2, 5)))
+    exe.outputs
+    assert telemetry.counter_value("trace.retraces.executor.forward") == 1
+
+
+def test_parse_log_telemetry_grows_retrace_and_sched_div_columns(tmp_path):
+    """ISSUE 12 satellite: --telemetry renders `retraces`/`sched_div`;
+    records that predate the counters render '-' (the prior column-
+    addition contract)."""
+    from tools.parse_log import _TELEMETRY_COLS, parse_telemetry
+
+    assert _TELEMETRY_COLS[-2:] == ["retraces", "sched_div"]
+    old = {"flush_seq": 1, "counters": {}, "gauges": {}, "histograms": {}}
+    new = {"flush_seq": 2,
+           "counters": {"trace.retraces": 3,
+                        "trace.retraces.lazy.fusion": 3,
+                        "schedule.divergences": 1},
+           "gauges": {}, "histograms": {}}
+    rows = parse_telemetry([json.dumps(old), json.dumps(new)])
+    assert rows[0]["retraces"] is None and rows[0]["sched_div"] is None
+    assert rows[1]["retraces"] == 3 and rows[1]["sched_div"] == 1
+    # and through the CLI: '-' for the legacy record, numbers after
+    f = tmp_path / "t.jsonl"
+    f.write_text(json.dumps(old) + "\n" + json.dumps(new) + "\n")
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         "--telemetry", str(f)], capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "retraces" in r.stdout and "sched_div" in r.stdout

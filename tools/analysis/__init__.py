@@ -1,12 +1,16 @@
-"""mxlint — static dependency-contract lint for mxnet_tpu.
+"""mxlint — static contract lint for mxnet_tpu.
 
-Run as ``python -m tools.analysis [paths...]``; see __main__.py for the
-CLI, core.py for the framework, engine_checks.py / general_checks.py
-for the checks, and docs/engine.md "Verifying scheduling contracts"
-for the user-facing story (including the runtime counterpart,
-``MXNET_ENGINE_TYPE=SanitizerEngine``).
+Run as ``python -m tools.analysis [paths...]``; see __main__.py for
+the CLI (JSON output, baseline gating, --stats), core.py for the
+one-parse-per-file framework, and docs/static_analysis.md for the
+full check catalog (E001-E007, W101-W104, L001), the justification-
+mandatory allowlist contract, and each check's runtime counterpart
+(SanitizerEngine, the collective-schedule verifier, the retrace
+monitor).
 """
 from .core import Finding, all_checks, register, run_paths
-from . import engine_checks, general_checks, lazy_checks, telemetry_checks  # noqa: F401  (register checks)
+from . import (engine_checks, general_checks, lazy_checks,  # noqa: F401
+               retrace_checks, spmd_checks, telemetry_checks,
+               trace_checks)  # noqa: F401  (register checks)
 
 __all__ = ["Finding", "all_checks", "register", "run_paths"]

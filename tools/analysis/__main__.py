@@ -1,9 +1,24 @@
 """mxlint CLI — ``python -m tools.analysis [paths...]``.
 
-Exit status: 0 clean (or everything allowlisted), 1 findings, 2 usage
-or parse errors.  ``--show-suppressed`` prints allowlisted findings
-with their justifications (the audit view referenced in
-docs/engine.md).
+Exit status: 0 clean (or everything allowlisted / baselined), 1 new
+findings, 2 usage or parse errors.  ``--show-suppressed`` prints
+allowlisted findings with their justifications (the audit view
+referenced in docs/static_analysis.md).
+
+Machine-readable mode: ``--format json`` emits one stable object —
+``{"schema": "mxlint-v1", "findings": [...], "suppressed": [...],
+"errors": [...], "stats": {...}}`` where every finding carries
+``check``/``path``/``line``/``col``/``message`` and suppressed
+findings additionally carry their allowlist ``justification``
+(tests/test_lint.py pins the schema).
+
+Baseline gating: ``--write-baseline FILE`` snapshots the current
+findings (paths repo-root-relative, matched by (check, path) counts so
+line drift does not churn it); ``--baseline FILE`` then fails only on
+findings NEW against the snapshot — the CI recipe for adopting a
+check without boiling the ocean.  This repo's committed baseline
+(tools/analysis/baseline.json) is EMPTY and the gate keeps it that
+way: every finding is fixed or justification-allowlisted.
 """
 from __future__ import annotations
 
@@ -11,14 +26,93 @@ import argparse
 import json
 import sys
 
-from .core import all_checks, run_paths
+from .core import _find_repo_root, all_checks, run_paths
+
+BASELINE_SCHEMA = "mxlint-baseline-v1"
+JSON_SCHEMA = "mxlint-v1"
+
+
+def _rel(path):
+    import os
+
+    return os.path.relpath(path, _find_repo_root(path)).replace(os.sep, "/")
+
+
+def vars_of(f, justification=None):
+    out = {"check": f.check_id, "path": f.path, "line": f.line,
+           "col": f.col, "message": f.message}
+    if justification is not None:
+        out["justification"] = justification
+    return out
+
+
+def _justification_of(f):
+    """The allowlist reason run_paths appended to a suppressed
+    finding's message."""
+    marker = "  [allowlisted: "
+    i = f.message.rfind(marker)
+    if i < 0:
+        return ""
+    # strip exactly the ONE closing bracket run_paths appended — a
+    # justification may itself end with ']'
+    tail = f.message[i + len(marker):]
+    return tail[:-1] if tail.endswith("]") else tail
+
+
+def _baseline_counts(findings):
+    counts = {}
+    for f in findings:
+        key = (f.check_id, _rel(f.path))
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def write_baseline(path, findings):
+    payload = {
+        "schema": BASELINE_SCHEMA,
+        "findings": sorted(
+            ({"check": c, "path": p, "count": n}
+             for (c, p), n in _baseline_counts(findings).items()),
+            key=lambda d: (d["path"], d["check"])),
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path):
+    """(check, relpath) -> allowed count; raises ValueError on a
+    schema mismatch (a silently-misread baseline would un-gate CI)."""
+    with open(path) as fh:
+        payload = json.load(fh)
+    if payload.get("schema") != BASELINE_SCHEMA:
+        raise ValueError("%s is not a %s file" % (path, BASELINE_SCHEMA))
+    return {(d["check"], d["path"]): int(d.get("count", 1))
+            for d in payload.get("findings", [])}
+
+
+def apply_baseline(findings, allowed):
+    """Split findings into (new, baselined) against the allowed
+    (check, path) counts — first `count` findings of a key are
+    baselined, the rest are new."""
+    budget = dict(allowed)
+    new, baselined = [], []
+    for f in findings:
+        key = (f.check_id, _rel(f.path))
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    return new, baselined
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m tools.analysis",
-        description="mxlint: engine dependency-contract lint (E0xx) + "
-                    "hygiene checks (W1xx). See docs/engine.md.")
+        description="mxlint: engine dependency-contract (E001-E005), "
+                    "trace/SPMD contract (E006-E007), and hygiene/"
+                    "retrace (W1xx) checks. See docs/static_analysis.md.")
     ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
                     help="files or directories (default: mxnet_tpu)")
     ap.add_argument("--select", action="append", default=[],
@@ -30,6 +124,14 @@ def main(argv=None):
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print allowlisted findings + justifications")
     ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument("--stats", action="store_true",
+                    help="print a files/findings/seconds summary line")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="fail only on findings NEW against this "
+                         "baseline snapshot (see --write-baseline)")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="snapshot current findings to FILE and exit 0 "
+                         "(the adopt-a-check-incrementally workflow)")
     args = ap.parse_args(argv)
 
     if args.list_checks:
@@ -40,14 +142,36 @@ def main(argv=None):
                            "`-- justification`"))
         return 0
 
+    stats = {}
     findings, suppressed, errors = run_paths(
-        args.paths, select=args.select or None, ignore=args.ignore)
+        args.paths, select=args.select or None, ignore=args.ignore,
+        stats=stats)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print("wrote %d finding(s) across %d (check, path) key(s) to %s"
+              % (len(findings), len(_baseline_counts(findings)),
+                 args.write_baseline))
+        return 2 if errors else 0
+
+    baselined = []
+    if args.baseline:
+        try:
+            allowed = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+            print("ERROR reading baseline: %s" % e, file=sys.stderr)
+            return 2
+        findings, baselined = apply_baseline(findings, allowed)
 
     if args.format == "json":
         print(json.dumps({
+            "schema": JSON_SCHEMA,
             "findings": [vars_of(f) for f in findings],
-            "suppressed": [vars_of(f) for f in suppressed],
+            "baselined": [vars_of(f) for f in baselined],
+            "suppressed": [vars_of(f, _justification_of(f))
+                           for f in suppressed],
             "errors": [{"path": p, "message": m} for p, m in errors],
+            "stats": stats,
         }, indent=2))
     else:
         for f in findings:
@@ -59,15 +183,18 @@ def main(argv=None):
             print("ERROR %s: %s" % (p, m), file=sys.stderr)
         summary = "%d finding(s), %d suppressed, %d error(s)" % (
             len(findings), len(suppressed), len(errors))
-        print(("" if not (findings or suppressed or errors) else "-- ") + summary)
+        if baselined:
+            summary += ", %d baselined" % len(baselined)
+        print(("" if not (findings or suppressed or errors or baselined)
+               else "-- ") + summary)
+        if args.stats:
+            print("stats: files=%d findings=%d suppressed=%d errors=%d "
+                  "seconds=%.2f" % (stats["files"], stats["findings"],
+                                    stats["suppressed"], stats["errors"],
+                                    stats["seconds"]))
     if errors:
         return 2
     return 1 if findings else 0
-
-
-def vars_of(f):
-    return {"check": f.check_id, "path": f.path, "line": f.line,
-            "col": f.col, "message": f.message}
 
 
 if __name__ == "__main__":

@@ -24,13 +24,54 @@ from __future__ import annotations
 
 import ast
 import os
+import time
 
 from .allowlist import parse_allowlist
 
 __all__ = ["Finding", "FileContext", "register", "all_checks", "run_paths",
-           "iter_py_files"]
+           "iter_py_files", "parsed_tree"]
 
 CHECKS = []
+
+# THE parse indirection: every AST this package builds comes through
+# here, and run_paths caches by path — one parse per file per run, no
+# matter how many checks consume the tree (tests/test_lint.py pins the
+# property by counting calls through this hook)
+_ast_parse = ast.parse
+
+# path -> tree, valid for the duration of one run_paths call.  Trees
+# (not source text) are retained: the one-parse guarantee must hold
+# for cross-file readers (W103's config resolution) that may request a
+# file before OR after the main loop lints it, and the text has no
+# second consumer — each FileContext keeps its own copy for exactly
+# its file's fan-out.
+_PARSE_CACHE = {}
+
+
+def _load(path):
+    """(text, tree) for `path`, parsed at most once per run.  Raises
+    SyntaxError/UnicodeDecodeError/OSError like open+parse would."""
+    with open(path, "rb") as f:
+        text = f.read().decode("utf-8")
+    tree = _PARSE_CACHE.get(path)
+    if tree is None:
+        tree = _ast_parse(text, filename=path)
+        _PARSE_CACHE[path] = tree
+    return text, tree
+
+
+def parsed_tree(path):
+    """The cached AST of `path` (parsed now if not yet seen this run)
+    — cross-file readers (W103's config-registry resolution) share the
+    linted files' single parse instead of re-parsing.  Returns None
+    when the file is missing or does not parse."""
+    tree = _PARSE_CACHE.get(path)
+    if tree is not None:
+        return tree
+    try:
+        return _load(path)[1]
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return None
 
 # directories never worth linting (build output, vendored binaries)
 _SKIP_DIRS = {"__pycache__", "_native", ".git", "build", "dist"}
@@ -142,30 +183,38 @@ def _find_repo_root(path):
         cur = nxt
 
 
-def run_paths(paths, select=None, ignore=None):
+def run_paths(paths, select=None, ignore=None, stats=None):
     """Lint `paths`; returns (findings, suppressed, errors).
 
     `select`/`ignore` are iterables of check-id prefixes ("E001", "W").
     `findings` survive the inline allowlist; `suppressed` carry their
     allowlist justification appended to the message; `errors` are
-    (path, message) pairs for files that would not parse.
+    (path, message) pairs for files that would not parse.  Pass a dict
+    as `stats` to receive {"files", "findings", "suppressed",
+    "errors", "seconds"} (the CLI's --stats line).
+
+    Each file is parsed ONCE and the tree fanned out to every
+    registered check (the _ast_parse/_PARSE_CACHE indirection above);
+    checks that read other files (W103's config registry) share the
+    same per-run cache via :func:`parsed_tree`.
     """
+    t_start = time.time()
     select = tuple(select) if select else None
     ignore = tuple(ignore) if ignore else ()
     checks = [cls() for cls in CHECKS]
     findings, suppressed, errors = [], [], []
+    _PARSE_CACHE.clear()
     # a missing path is an error, never a silent all-clear: the exit-0
     # CI gate must not pass because a typo'd/cwd-relative path linted
     # zero files
     for p in paths:
         if not os.path.exists(p):
             errors.append((p, "path does not exist (nothing was linted)"))
-    for path in iter_py_files(paths):
+    files = iter_py_files(paths)
+    for path in files:
         try:
-            with open(path, "rb") as f:
-                text = f.read().decode("utf-8")
-            tree = ast.parse(text, filename=path)
-        except (SyntaxError, UnicodeDecodeError) as e:
+            text, tree = _load(path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append((path, str(e)))
             continue
         ctx = FileContext(path, text, tree, _find_repo_root(path))
@@ -198,4 +247,9 @@ def run_paths(paths, select=None, ignore=None):
                 findings.append(f)
     findings.sort(key=Finding.sort_key)
     suppressed.sort(key=Finding.sort_key)
+    _PARSE_CACHE.clear()
+    if stats is not None:
+        stats.update(files=len(files), findings=len(findings),
+                     suppressed=len(suppressed), errors=len(errors),
+                     seconds=time.time() - t_start)
     return findings, suppressed, errors

@@ -87,13 +87,16 @@ class UndocumentedEnvVar:
     @staticmethod
     def _registry_names(repo_root):
         """Declared env-var names, parsed from mxnet_tpu/config.py:
-        EnvVar("NAME", ...) first arguments plus ABSORBED dict keys."""
+        EnvVar("NAME", ...) first arguments plus ABSORBED dict keys.
+        The tree comes from the run's shared parse cache
+        (core.parsed_tree), so linting config.py itself costs no
+        second parse."""
+        from .core import parsed_tree
+
         cfg = os.path.join(repo_root, "mxnet_tpu", "config.py")
         names = set()
-        try:
-            with open(cfg, "rb") as f:
-                tree = ast.parse(f.read().decode("utf-8"), filename=cfg)
-        except (OSError, SyntaxError):
+        tree = parsed_tree(cfg)
+        if tree is None:
             return frozenset()
         for n in ast.walk(tree):
             if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)

@@ -1,0 +1,267 @@
+"""mxlint retrace checks — compiled-program caches must not churn.
+
+Every jit cache in the framework — the executor's ``_jit_fwd`` /
+``_jit_step`` / ``_jit_block``, the serving bucket programs, the lazy
+fusion cache — promises compile-once, dispatch-forever.  That promise
+breaks silently: a float embedded where a signature belongs compiles
+one executable PER VALUE (the exact bug class PR 5's ``_scalarv`` lift
+fixed for the scalar op family), and a list in a cache key raises
+``TypeError: unhashable`` the first time it is looked up.  The result
+is a recompile storm that looks like slow steps, not like an error —
+the runtime counterpart is the retrace monitor
+(``telemetry.note_retrace`` + ``MXTPU_RETRACE_WARN``), which counts
+signature churn per cache site and names the signature delta.
+
+  * **W104 (lift break)** — in ``mxnet_tpu/ops/``, an op registered
+    with ``lift_floats=True`` whose kernel applies ``float()`` /
+    ``int()`` / ``bool()`` to a parameter: under lazy fusion that
+    parameter arrives as a TRACER (the lift is the point), and the
+    coercion concretizes it — route through the tracer-admitting
+    ``_scalarv`` coercion instead.
+  * **W104 (unlifted scalar)** — in ``mxnet_tpu/ops/``, a registered
+    op with a float-default parameter used BARE in arithmetic
+    (``data * scalar``) without ``lift_floats=True``: the float embeds
+    statically, so every distinct value keys its own fused program.
+    Kernels that normalize the attr first (``p = float(_lit(p))`` —
+    the static-embed idiom for per-model symbolic attrs) are exempt:
+    reassignment signals a deliberate static attr.
+  * **W104 (unstable cache key)** — a tuple used as a jit-cache key (a
+    name subscripted into a ``*_jit*`` / ``*_cache*`` container)
+    containing a list/dict/set display (unhashable — crashes) or a
+    float literal / ``float()`` call (value-keyed — churns one
+    executable per value).
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, register
+
+__all__ = ["RetraceHazard"]
+
+_COERCIONS = {"float", "int", "bool"}
+
+
+def _is_ops_file(ctx):
+    rel = os.path.relpath(ctx.path, ctx.repo_root).replace(os.sep, "/")
+    return "/ops/" in "/" + rel
+
+
+def _registration_sites(ctx):
+    """Yield (fn_node, opname, lift_floats) for every op registration
+    in the file — decorator form and direct-call form (the
+    lazy_checks.py recognizer, plus the lift_floats keyword)."""
+    def _is_register(fn):
+        if isinstance(fn, ast.Name):
+            return fn.id == "register"
+        return isinstance(fn, ast.Attribute) and fn.attr == "register"
+
+    def _lift_kw(call):
+        for k in call.keywords:
+            if k.arg == "lift_floats" and isinstance(k.value, ast.Constant):
+                return bool(k.value.value)
+        return False
+
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.FunctionDef):
+            for dec in n.decorator_list:
+                if isinstance(dec, ast.Call) and _is_register(dec.func):
+                    opname = None
+                    if dec.args and isinstance(dec.args[0], ast.Constant):
+                        opname = dec.args[0].value
+                    yield n, opname, _lift_kw(dec)
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Call) and _is_register(f.func) and n.args:
+                opname = None
+                if f.args and isinstance(f.args[0], ast.Constant):
+                    opname = f.args[0].value
+                lift = _lift_kw(f)
+                target = n.args[0]
+                if isinstance(target, ast.Lambda):
+                    yield target, opname, lift
+                elif isinstance(target, ast.Call):
+                    for sub in ast.walk(target):
+                        if isinstance(sub, ast.Lambda):
+                            yield sub, opname, lift
+
+
+def _float_default_params(fn):
+    """Parameter names with a float default value."""
+    a = fn.args
+    out = set()
+    pos = getattr(a, "posonlyargs", []) + a.args
+    for arg, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        if isinstance(d, ast.Constant) and isinstance(d.value, float):
+            out.add(arg.arg)
+    for arg, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None and isinstance(d, ast.Constant) \
+                and isinstance(d.value, float):
+            out.add(arg.arg)
+    return out
+
+
+def _param_names(fn):
+    a = fn.args
+    names = {arg.arg for arg in
+             a.args + a.kwonlyargs + getattr(a, "posonlyargs", [])}
+    for arg in (a.vararg, a.kwarg):
+        if arg is not None:
+            names.add(arg.arg)
+    return names
+
+
+def _body_nodes(fn):
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+def _reassigned_names(fn):
+    """Names stored to anywhere in the kernel body — a param that is
+    normalized (``p = float(_lit(p))``) before use is the deliberate
+    static-embed idiom and exempt from the unlifted-scalar pattern."""
+    out = set()
+    for n in _body_nodes(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+    return out
+
+
+def _has_unstable_member(expr):
+    """(node, why) for the first unhashable/value-unstable member of a
+    cache-key tuple expression, else None."""
+    for n in ast.walk(expr):
+        if isinstance(n, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.SetComp, ast.DictComp)):
+            return n, "a %s (unhashable: the cache lookup raises " \
+                "TypeError)" % type(n).__name__.lower()
+        if isinstance(n, ast.Constant) and isinstance(n.value, float):
+            return n, "a float value (one compiled program per " \
+                "distinct value — lift it to a traced operand)"
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "float":
+            return n, "a float() value (one compiled program per " \
+                "distinct value — lift it to a traced operand)"
+    return None
+
+
+def _cache_key_exprs(ctx):
+    """Yield (tuple_expr, container_name) for tuple displays used as
+    jit-cache keys: assigned to a name later subscripted into a
+    container whose name contains 'jit' or 'cache', or written inline
+    as the subscript of such a container."""
+    def _container_name(sub):
+        v = sub.value
+        if isinstance(v, ast.Attribute):
+            return v.attr
+        if isinstance(v, ast.Name):
+            return v.id
+        return None
+
+    def _is_cachey(name):
+        return name is not None and ("jit" in name or "cache" in name)
+
+    # inline: self._jit_x[(...)]
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Subscript):
+            cname = _container_name(n)
+            if _is_cachey(cname) and isinstance(n.slice, ast.Tuple):
+                yield n.slice, cname
+    # named: key = (...); ... container[key]
+    for scope in [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]:
+        assigns = {}
+        subs = []
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Tuple):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, n.value)
+            elif isinstance(n, ast.Subscript) \
+                    and isinstance(n.slice, ast.Name):
+                cname = _container_name(n)
+                if _is_cachey(cname):
+                    subs.append((n.slice.id, cname))
+        for key_name, cname in subs:
+            expr = assigns.get(key_name)
+            if expr is not None:
+                yield expr, cname
+
+
+@register
+class RetraceHazard:
+    """W104: retrace hazards at op registrations and jit-cache sites
+    (module docstring)."""
+
+    id = "W104"
+    title = ("static attrs and cache keys must be hashable and value-"
+             "stable: floats lift to operands, keys stay structural")
+
+    def run(self, ctx):
+        seen = set()
+        if _is_ops_file(ctx):
+            for fn, opname, lift in _registration_sites(ctx):
+                label = "`%s`" % opname if opname else "op"
+                params = _param_names(fn)
+                floats = _float_default_params(fn)
+                stored = _reassigned_names(fn)
+                for n in _body_nodes(fn):
+                    if not (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Name)):
+                        continue
+                    if lift and n.func.id in _COERCIONS and any(
+                            isinstance(x, ast.Name) and x.id in params
+                            for a in n.args for x in ast.walk(a)):
+                        key = (n.lineno, n.col_offset)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield Finding(
+                            "W104", ctx.path, n.lineno, n.col_offset,
+                            "registered op %s is lift_floats but its "
+                            "kernel calls `%s()` on a parameter: under "
+                            "lazy fusion the lifted attr arrives as a "
+                            "TRACER and the coercion concretizes it — "
+                            "route through the tracer-admitting "
+                            "_scalarv coercion" % (label, n.func.id))
+                if lift:
+                    continue
+                for n in _body_nodes(fn):
+                    if not isinstance(n, ast.BinOp):
+                        continue
+                    for side in (n.left, n.right):
+                        if isinstance(side, ast.Name) \
+                                and side.id in floats \
+                                and side.id not in stored:
+                            key = (n.lineno, n.col_offset)
+                            if key in seen:
+                                continue
+                            seen.add(key)
+                            yield Finding(
+                                "W104", ctx.path, n.lineno, n.col_offset,
+                                "registered op %s uses float attr `%s` "
+                                "in arithmetic without lift_floats: "
+                                "the value embeds in the fused-program "
+                                "fingerprint, compiling one executable "
+                                "per distinct value (the retrace storm "
+                                "`trace.retraces` counts at runtime) — "
+                                "register with lift_floats=True and "
+                                "coerce via _scalarv"
+                                % (label, side.id))
+        for expr, cname in _cache_key_exprs(ctx):
+            hit = _has_unstable_member(expr)
+            if hit is None:
+                continue
+            node, why = hit
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Finding(
+                "W104", ctx.path, node.lineno, node.col_offset,
+                "jit-cache key for `%s` contains %s — cache keys must "
+                "be structural (names, shapes, dtypes, ints)"
+                % (cname, why))

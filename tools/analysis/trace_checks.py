@@ -1,0 +1,453 @@
+"""mxlint trace checks — tracer leaks and host effects in traced code.
+
+Everything resolved as traced by :mod:`.traced` runs under ``jax.jit``
+/ ``lax.scan`` / ``shard_map`` tracing: the body executes ONCE at
+compile time over abstract values, then never again.  Host code that
+is harmless elsewhere is a bug there, in three families — the same
+taxonomy JAX's retrace/concretization debugging guidance chases:
+
+  * **E006 (concretization)** — ``float()`` / ``bool()`` /
+    ``np.asarray()`` / ``.item()`` / ``.tolist()`` / ``.asnumpy()`` /
+    ``.asscalar()`` applied to a traced value raises
+    ``ConcretizationTypeError`` under jit (or silently bakes a
+    trace-time constant under ``eval_shape``); an ``if``/``while``
+    comparing a traced value branches the PYTHON trace, compiling only
+    one side — ``lax.cond``/``jnp.where`` is the traced form.
+  * **E006 (host effect)** — telemetry/recorder/profiler recording,
+    ``print``, ``time.time()``, ``os.environ`` reads, and
+    ``engine.push`` inside a traced body run at TRACE time only: the
+    metric records once per compile instead of once per step, the
+    timestamp is frozen into the program, the engine op escapes the
+    compiled region entirely.  The ONE sanctioned shape is the
+    trace-time mode gauge (ops/nn.py ``_bf16_wgrad_active``):
+    ``telemetry.set_gauge`` behind the ``enabled()`` guard, recording
+    a per-compile MODE — that idiom is recognized and exempt.
+  * **E006 (closure mutation)** — assigning through ``nonlocal`` /
+    ``global``, storing to ``self.x`` or any closed-over object, or
+    ``.append()``-ing a closed-over container from inside a traced
+    body mutates host state once per COMPILE, not once per step — the
+    classic "my counter only went up once" trap.
+
+Names-level and conservative, like every mxlint check.  For
+concretization calls, a traced value is a parameter of the traced
+function (or a name assigned from one); for the BRANCH check the bar
+is higher — only names PROVABLY array-typed (assigned from a
+``jnp``/``lax``/``jax`` call) count, because a traced function's
+params legitimately mix operands with host attrs and shape ints.
+Values reached only through ``.shape`` / ``.dtype`` / ``.ndim`` /
+``len()`` are static under trace and exempt; ``is``/``is not``
+comparisons, ``isinstance`` tests, and equality against string/None
+literals are host checks and exempt; bare truthiness (``if not
+grads:`` on an operand pytree) is not flagged — emptiness of a host
+tuple is static, and mxlint does not claim to know pytrees from
+arrays.  The dynamic remainder belongs to the runtime: jax's own
+tracer errors, and the retrace monitor.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, register
+from .traced import traced_functions, own_statements
+
+__all__ = ["TracerLeakInTracedCode"]
+
+# attributes whose read off a traced value yields a STATIC value
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+                 "itemsize", "nbytes"}
+# builtin coercions that concretize a tracer (int() excluded on
+# purpose: in this codebase int() is shape/static-attr math)
+_CONCRETIZE_BUILTINS = {"float", "bool", "complex"}
+_NP_BASES = {"np", "_np", "numpy", "onp"}
+_NP_CONCRETIZE = {"asarray", "array", "asscalar"}
+_CONCRETIZE_METHODS = {"item", "tolist", "asnumpy", "asscalar",
+                       "wait_to_read", "wait_to_write"}
+# host-effect surfaces (recording sets shared with E004)
+_RECORDING_MODULES = {"telemetry", "recorder", "profiler"}
+_RECORDING_ATTRS = {"inc", "set_gauge", "observe", "flush",
+                    "record_span", "record_counter", "record", "span"}
+_TIME_ATTRS = {"time", "monotonic", "perf_counter"}
+_GUARD_ATTRS = {"enabled", "spans_active"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "update",
+                    "setdefault", "pop", "remove", "clear", "write"}
+
+
+def _base_name(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _param_names(fn):
+    a = fn.args
+    names = set()
+    for arg in (a.args + a.kwonlyargs + getattr(a, "posonlyargs", [])):
+        names.add(arg.arg)
+    for arg in (a.vararg, a.kwarg):
+        if arg is not None:
+            names.add(arg.arg)
+    names.discard("self")
+    return names
+
+
+def _local_names(fn):
+    """Names bound in `fn`'s own scope: params + every Store target +
+    for/comprehension/with targets + nested def names."""
+    names = set(_param_names(fn)) | {"self"}
+    for n in own_statements(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            names.add(n.id)
+        elif isinstance(n, (ast.For, ast.comprehension)):
+            names |= {x.id for x in ast.walk(n.target)
+                      if isinstance(x, ast.Name)}
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(n.name)
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            names |= {x.id for x in ast.walk(n.optional_vars)
+                      if isinstance(x, ast.Name)}
+    return names
+
+
+def _traced_value_names(fn):
+    """Params of the traced fn, plus names assigned from expressions
+    that mention one through a NON-static path (not just ``.shape``),
+    plus loop targets iterating one.  One fixpoint pass."""
+    traced = set(_param_names(fn))
+    changed = True
+    while changed:
+        changed = False
+        for n in own_statements(fn):
+            if isinstance(n, ast.Assign):
+                if _mentions_traced(n.value, traced):
+                    for t in n.targets:
+                        for x in ast.walk(t):
+                            if isinstance(x, ast.Name) \
+                                    and x.id not in traced:
+                                traced.add(x.id)
+                                changed = True
+            elif isinstance(n, (ast.For, ast.comprehension)):
+                if _mentions_traced(n.iter, traced):
+                    for x in ast.walk(n.target):
+                        if isinstance(x, ast.Name) and x.id not in traced:
+                            traced.add(x.id)
+                            changed = True
+    return traced
+
+
+_ARRAY_BASES = {"jnp", "lax", "jax"}
+# jax calls returning HOST values (rank/topology ints): not tracers —
+# branching on them is E007's rank question, not a concretization
+_HOST_VALUED_JAX = {"process_index", "process_count", "device_count",
+                    "local_device_count", "devices", "local_devices",
+                    "axis_size"}
+
+
+def _is_array_call(expr):
+    """A call into jax/jnp/lax (``jnp.sum(x)``, ``jax.nn.relu(x)``) —
+    its result is array-typed under a trace."""
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    if isinstance(f, ast.Attribute) and f.attr in _HOST_VALUED_JAX:
+        return False
+    while isinstance(f, ast.Attribute):
+        f = f.value
+    return isinstance(f, ast.Name) and f.id in _ARRAY_BASES
+
+
+def _array_value_names(fn):
+    """Names PROVABLY array-typed in this body: assigned from a
+    jnp/lax/jax call (or an expression mentioning an existing array
+    name through a value path), or iterating one.  Parameters are NOT
+    assumed — a kernel's params mix operands with host attrs and
+    shape ints, and mxlint does not claim to know which is which; the
+    branch checks only fire on the provable set."""
+    arrays = set()
+    changed = True
+    while changed:
+        changed = False
+        for n in own_statements(fn):
+            if isinstance(n, ast.Assign):
+                v = n.value
+                hit = _mentions_traced(v, arrays) or any(
+                    _is_array_call(x) for x in ast.walk(v))
+                if hit:
+                    for t in n.targets:
+                        for x in ast.walk(t):
+                            if isinstance(x, ast.Name) \
+                                    and x.id not in arrays:
+                                arrays.add(x.id)
+                                changed = True
+            elif isinstance(n, (ast.For, ast.comprehension)):
+                if _mentions_traced(n.iter, arrays):
+                    for x in ast.walk(n.target):
+                        if isinstance(x, ast.Name) and x.id not in arrays:
+                            arrays.add(x.id)
+                            changed = True
+    return arrays
+
+
+def _mentions_traced(expr, traced):
+    """Does `expr` touch a traced name through a value (non-static)
+    path?  ``g.shape`` / ``len(g)`` / ``g.dtype`` reads are static
+    under trace and do not count."""
+    parents = {}
+    for node in ast.walk(expr):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(expr):
+        if not (isinstance(node, ast.Name) and node.id in traced
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        p = parents.get(node)
+        # walk up through subscripts (g[0] is still traced)
+        while isinstance(p, ast.Subscript) and p.value is node:
+            node, p = p, parents.get(p)
+        if isinstance(p, ast.Attribute) and p.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(p, ast.Call) and isinstance(p.func, ast.Name) \
+                and p.func.id in ("len", "isinstance", "type", "id"):
+            continue
+        return True
+    return False
+
+
+def _is_static_test(test):
+    """Host-only condition shapes that never touch tracer VALUES:
+    ``x is None`` / ``is not``, ``isinstance(...)``, ``hasattr(...)``,
+    and any combination of them."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_static_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_static_test(test.operand)
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in test.ops)
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Name):
+        return test.func.id in ("isinstance", "hasattr", "callable")
+    return False
+
+
+def _value_compare_on_traced(test, traced):
+    """A value comparison (< <= > >= == !=) with a traced operand —
+    the branch-on-tracer shape.  Bare truthiness is NOT flagged (a
+    host container's emptiness is static; mxlint cannot tell pytrees
+    from arrays)."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE,
+                                ast.Eq, ast.NotEq)) for op in node.ops):
+            sides = [node.left] + node.comparators
+            # equality against a string/None literal is a host mode
+            # switch (`if mode == "lstm":`), never an array compare
+            if any(isinstance(s, ast.Constant)
+                   and (s.value is None or isinstance(s.value, str))
+                   for s in sides):
+                continue
+            for side in sides:
+                if _mentions_traced(side, traced):
+                    return True
+    return False
+
+
+def _guard_names(fn):
+    """Locals bound from enabled()/spans_active() (the E004 guard
+    resolution, duplicated small rather than imported — the modules
+    stay independently loadable)."""
+    names = set()
+    for n in own_statements(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            f = n.value.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr in _GUARD_ATTRS:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _behind_enabled_guard(ctx, call, fn):
+    guards = _guard_names(fn)
+    for anc in ctx.parent_chain(call):
+        if anc is fn:
+            break
+        if isinstance(anc, (ast.If, ast.IfExp)):
+            for n in ast.walk(anc.test):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    attr = f.attr if isinstance(f, ast.Attribute) else (
+                        f.id if isinstance(f, ast.Name) else None)
+                    if attr in _GUARD_ATTRS:
+                        return True
+                elif isinstance(n, ast.Name) and n.id in guards:
+                    return True
+    return False
+
+
+@register
+class TracerLeakInTracedCode:
+    """E006: traced bodies must stay free of host effects and
+    concretization (module docstring)."""
+
+    id = "E006"
+    title = ("code traced under jit/scan/shard_map must not concretize "
+             "tracers, record host telemetry, or mutate closure state")
+
+    def run(self, ctx):
+        traced = traced_functions(ctx)
+        for fn, (entry, entry_line) in traced.items():
+            where = "traced body (%s at line %d)" % (entry, entry_line)
+            tnames = _traced_value_names(fn)
+            anames = _array_value_names(fn)
+            local = _local_names(fn)
+            seen = set()
+            for n in own_statements(fn):
+                for f in self._check_node(ctx, fn, n, tnames, anames,
+                                          local, where):
+                    key = (f.check_id, f.line, f.col)
+                    if key not in seen:
+                        seen.add(key)
+                        yield f
+
+    def _check_node(self, ctx, fn, n, tnames, anames, local, where):
+        # --- concretization -------------------------------------------
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Name) and f.id in _CONCRETIZE_BUILTINS \
+                    and n.args and _mentions_traced(n.args[0], tnames):
+                yield Finding(
+                    "E006", ctx.path, n.lineno, n.col_offset,
+                    "`%s()` applied to a traced value inside a %s: "
+                    "concretizes the tracer (ConcretizationTypeError "
+                    "under jit) — keep it a jax value, or lift the "
+                    "scalar to a traced operand" % (f.id, where))
+            elif isinstance(f, ast.Attribute):
+                base = _base_name(f.value)
+                if f.attr in _NP_CONCRETIZE and base in _NP_BASES \
+                        and n.args and _mentions_traced(n.args[0], tnames):
+                    yield Finding(
+                        "E006", ctx.path, n.lineno, n.col_offset,
+                        "`%s.%s()` on a traced value inside a %s: forces "
+                        "a host transfer at trace time — use jnp, or "
+                        "move the host read outside the traced region"
+                        % (base, f.attr, where))
+                elif f.attr in _CONCRETIZE_METHODS and base in tnames:
+                    yield Finding(
+                        "E006", ctx.path, n.lineno, n.col_offset,
+                        "`.%s()` on traced value `%s` inside a %s: "
+                        "sync/concretization cannot run under a trace"
+                        % (f.attr, base, where))
+        # --- branch on traced value -----------------------------------
+        if isinstance(n, (ast.If, ast.While, ast.IfExp)) \
+                and not _is_static_test(n.test) \
+                and _value_compare_on_traced(n.test, anames):
+            yield Finding(
+                "E006", ctx.path, n.test.lineno, n.test.col_offset,
+                "Python `%s` compares a traced value inside a %s: the "
+                "trace takes ONE side at compile time (or raises) — "
+                "use lax.cond/lax.select/jnp.where for data-dependent "
+                "control flow"
+                % ("while" if isinstance(n, ast.While) else "if", where))
+        # --- host effects ---------------------------------------------
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                base, attr = f.value.id, f.attr
+                if base in _RECORDING_MODULES and attr in _RECORDING_ATTRS:
+                    # the sanctioned trace-time mode gauge: set_gauge
+                    # behind the enabled() guard (ops/nn.py idiom)
+                    if not (attr == "set_gauge"
+                            and _behind_enabled_guard(ctx, n, fn)):
+                        yield Finding(
+                            "E006", ctx.path, n.lineno, n.col_offset,
+                            "`%s.%s(...)` inside a %s records at TRACE "
+                            "time — once per compile, never per step.  "
+                            "Record outside the traced region, or use "
+                            "the guarded trace-time set_gauge mode-"
+                            "gauge idiom" % (base, attr, where))
+                elif base == "time" and attr in _TIME_ATTRS:
+                    yield Finding(
+                        "E006", ctx.path, n.lineno, n.col_offset,
+                        "`time.%s()` inside a %s is evaluated once at "
+                        "trace time and baked into the program as a "
+                        "constant — time the DISPATCH on the host "
+                        "side instead" % (attr, where))
+                elif attr == "push" and any(
+                        k.arg in ("read_vars", "write_vars")
+                        for k in n.keywords):
+                    yield Finding(
+                        "E006", ctx.path, n.lineno, n.col_offset,
+                        "engine push inside a %s: the engine op is "
+                        "scheduled at trace time, OUTSIDE the compiled "
+                        "program — push from the host caller" % where)
+            elif isinstance(f, ast.Name) and f.id == "print":
+                yield Finding(
+                    "E006", ctx.path, n.lineno, n.col_offset,
+                    "`print()` inside a %s prints at trace time only — "
+                    "use jax.debug.print for per-step output" % where)
+        if isinstance(n, (ast.Subscript, ast.Call)):
+            env = _env_read(n)
+            if env is not None:
+                yield Finding(
+                    "E006", ctx.path, n.lineno, n.col_offset,
+                    "os.environ read inside a %s bakes the trace-time "
+                    "value into the compiled program — resolve config "
+                    "on the host and close over the result" % where)
+        # --- closure mutation -----------------------------------------
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            yield Finding(
+                "E006", ctx.path, n.lineno, n.col_offset,
+                "`%s %s` inside a %s: the write happens once per "
+                "COMPILE, not per step — thread state through the "
+                "traced function's return value (a scan carry)"
+                % ("global" if isinstance(n, ast.Global) else "nonlocal",
+                   ", ".join(n.names), where))
+        elif isinstance(n, (ast.Attribute, ast.Subscript)) \
+                and isinstance(n.ctx, ast.Store):
+            base = _base_name(n.value)
+            if base is not None and base not in local:
+                kind = ("attribute" if isinstance(n, ast.Attribute)
+                        else "item")
+            elif base == "self":
+                base, kind = "self", "attribute"
+            else:
+                base = None
+            if base is not None:
+                yield Finding(
+                    "E006", ctx.path, n.lineno, n.col_offset,
+                    "%s store on closed-over `%s` inside a %s mutates "
+                    "host state at trace time (once per compile) — "
+                    "return the value instead" % (kind, base, where))
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATOR_METHODS \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.value.id not in local:
+            yield Finding(
+                "E006", ctx.path, n.lineno, n.col_offset,
+                "`%s.%s(...)` mutates a closed-over container inside "
+                "a %s — the mutation runs once per compile, not per "
+                "step; accumulate through the carry/return value"
+                % (n.func.value.id, n.func.attr, where))
+
+
+def _env_read(node):
+    """An os.environ/getenv read expression, or None."""
+    def _is_environ(v):
+        if isinstance(v, ast.Attribute) and v.attr == "environ":
+            return True
+        return isinstance(v, ast.Name) and v.id == "environ"
+
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                and _is_environ(fn.value):
+            return node
+        if isinstance(fn, ast.Attribute) and fn.attr == "getenv":
+            return node
+        if isinstance(fn, ast.Name) and fn.id == "getenv":
+            return node
+    elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
+            and _is_environ(node.value):
+        return node
+    return None

@@ -23,7 +23,11 @@ when it recorded the ``data`` namespace (docs/data.md), and the
 distributed-comm columns (``comm_gbps`` measured collective bandwidth,
 ``overlap_pct`` fraction of collective time hidden under backward
 compute) when it recorded the ``comm`` namespace
-(docs/distributed.md).  Older logs render '-' in columns they predate.
+(docs/distributed.md), and the trace-contract columns (``retraces``
+compiled-signature churn from the retrace monitor, ``sched_div``
+cross-rank collective-schedule divergences from
+``MXTPU_COLLECTIVE_CHECK=1``; docs/static_analysis.md).  Older logs
+render '-' in columns they predate.
 
 With ``--cluster`` the input is the rank-0 CLUSTER JSONL
 (``MXTPU_OBS_CLUSTER_FILE``, written by the obs aggregator —
@@ -157,6 +161,17 @@ def parse_telemetry(lines):
             "overlap_pct": (100.0 * gauges["comm.overlap_frac"]
                             if gauges.get("comm.overlap_frac") is not None
                             else None),
+            # trace-contract columns (ISSUE 12, docs/static_analysis.md):
+            # compiled-signature churn per run (telemetry.note_retrace,
+            # the runtime half of mxlint W104) and cross-rank collective-
+            # schedule divergences (parallel/schedule_check.py, the
+            # runtime half of E007) — '-' for logs that predate them
+            "retraces": (counters.get("trace.retraces", 0)
+                         if any(k == "trace.retraces"
+                                or k.startswith("trace.retraces.")
+                                for k in counters) else None),
+            "sched_div": (counters.get("schedule.divergences")
+                          if "schedule.divergences" in counters else None),
         })
     return rows
 
@@ -217,7 +232,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "io_wait_p50", "h2d_bytes", "lazy_flushes", "chain_mean",
                    "fusion_hit_pct", "wgrad_bf16", "frozen_bn",
                    "serve_qdepth", "fill_pct", "req_p99", "data_qdepth",
-                   "decode_mbps", "comm_gbps", "overlap_pct"]
+                   "decode_mbps", "comm_gbps", "overlap_pct", "retraces",
+                   "sched_div"]
 
 
 def _print_rows(rows, cols, fmt):
