@@ -477,6 +477,149 @@ def _lstm_ab_side(args, smoke, packed):
     return rates
 
 
+def _int8_tiny_net(mx):
+    """Tiny conv+FC classifier for the int8_serve CPU smoke: enough
+    eligible layers that the first/last skip policy still leaves int8
+    nodes in the middle."""
+    d = mx.sym.Variable("data")
+    c1 = mx.sym.Activation(mx.sym.Convolution(
+        d, kernel=(3, 3), num_filter=8, pad=(1, 1), name="conv1",
+        layout="NHWC"), act_type="relu")
+    c2 = mx.sym.Activation(mx.sym.Convolution(
+        c1, kernel=(3, 3), num_filter=8, pad=(1, 1), name="conv2",
+        layout="NHWC"), act_type="relu")
+    f1 = mx.sym.Activation(mx.sym.FullyConnected(
+        c2, num_hidden=32, name="fc1"), act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        f1, num_hidden=7, name="fc2"), name="softmax")
+
+
+def _int8_serve_ab(args):
+    """--ab int8_serve: matched bf16-vs-int8 INFERENCE A/B through the
+    real serving fill path (docs/serving.md "Int8 serving").
+
+    Per model, ONE ModelServer hosts the same symbol+params twice — a
+    ``dtype_mode='bf16'`` tenant and a calibrated ``dtype_mode='int8'``
+    tenant (the mixed-tenant serving this PR ships) — warmed so the
+    timed windows are compile-free, then each side serves the SAME eval
+    requests closed-loop.  The row reports per-side img/s and
+    request p50/p99 plus the top-1 disagreement between the sides on
+    the eval batch.  Top-1 here is argmax agreement against the bf16
+    side (the params are a fresh random init — there is no ImageNet in
+    this environment); the trained-accuracy bound (≤1% absolute top-1
+    delta on the LeNet real-data gate path) is pinned in
+    tests/test_quant.py."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import quant, telemetry
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    if args.smoke:
+        models = [("tiny", _int8_tiny_net(mx), (8, 8, 3),
+                   args.batch or 4, args.requests or 24)]
+    else:
+        from mxnet_tpu.models.inception_v3 import get_inception_v3
+        from mxnet_tpu.models.resnet import resnet
+
+        bucket = args.batch or 2
+        n_req = args.requests or 8
+        models = [
+            ("resnet50", resnet(50, layout="NHWC"), (224, 224, 3),
+             bucket, n_req),
+            ("inception_v3", get_inception_v3(layout="NHWC"),
+             (299, 299, 3), bucket, n_req),
+        ]
+    ctx = mx.cpu() if args.smoke else mx.tpu()
+    rows = {}
+    for name, net, sample, bucket, n_req in models:
+        mx.random.seed(0)
+        mod = mx.mod.Module(net, context=ctx)
+        mod.bind(data_shapes=[("data", (bucket,) + sample)],
+                 label_shapes=None, for_training=False)
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2))
+        arg, aux = mod.get_params()
+        params = {"arg:%s" % k: v for k, v in arg.items()}
+        params.update({"aux:%s" % k: v for k, v in aux.items()})
+        rng = np.random.RandomState(0)
+        calib = [{"data": rng.randn(bucket, *sample).astype("float32")}
+                 for _ in range(3)]
+        table = quant.calibrate(net, arg, aux, calib, ctx=ctx)
+        shapes = {"data": (bucket,) + sample}
+        server = mx.serving.ModelServer(
+            {"bf16": mx.Predictor(net, dict(params), shapes, ctx=ctx,
+                                  dtype_mode="bf16"),
+             "int8": mx.Predictor(net, dict(params), shapes, ctx=ctx,
+                                  dtype_mode="int8", calib_table=table)},
+            max_batch=bucket, buckets=str(bucket),
+            # the A/B is a matched-throughput measurement, not an SLO
+            # run: a whole side's requests queue at once, so the
+            # deadline must cover the full side on a slow host (the
+            # int8 side on XLA:CPU runs the generic int8 conv path)
+            timeout_ms=3600e3)
+        server.warmup()
+        miss0 = telemetry.counter_value("executor.compile_cache_misses")
+        erng = np.random.RandomState(1)
+        xs = [erng.randn(*sample).astype("float32") for _ in range(n_req)]
+        top1 = {}
+        side = {}
+        for tenant in ("bf16", "int8"):
+            t0 = time.time()
+            futs = [server.submit(tenant, {"data": x}) for x in xs]
+            outs = [f.result(timeout=3600) for f in futs]
+            elapsed = time.time() - t0
+            top1[tenant] = np.array([o[0].argmax() for o in outs])
+            lat = telemetry.snapshot()["histograms"].get(
+                "serving.request_seconds.%s" % tenant, {})
+            side[tenant] = {
+                "img_s": round(n_req / elapsed, 3),
+                "p50_ms": round(_hist_q(lat, 0.5) * 1e3, 3)
+                if lat.get("count") else None,
+                "p99_ms": round(_hist_q(lat, 0.99) * 1e3, 3)
+                if lat.get("count") else None,
+            }
+        compile_misses = (telemetry.counter_value(
+            "executor.compile_cache_misses") - miss0)
+        server.close()
+        disagree = float((top1["int8"] != top1["bf16"]).mean() * 100.0)
+        rows[name] = {
+            "bf16": side["bf16"], "int8": side["int8"],
+            "delta_pct": round((side["int8"]["img_s"]
+                                - side["bf16"]["img_s"])
+                               / side["bf16"]["img_s"] * 100.0, 2),
+            "top1_disagree_pct": round(disagree, 2),
+            "bucket": bucket, "requests": n_req,
+            "compile_misses_timed": compile_misses,
+            "quantized_nodes": int(telemetry.gauge_value(
+                "quant.nodes_quantized", 0)),
+        }
+    # headline a/b: the first model's sides (per-model detail in rows)
+    first = rows[models[0][0]]
+    row = {
+        "metric": "A/B int8_serve: bf16 vs int8 post-training-quantized "
+                  "inference through the serving fill path (%s)"
+                  % ("tiny CPU smoke" if args.smoke
+                     else "ResNet-50 + Inception-v3"),
+        "sink": "int8_serve",
+        "unit": "img/s",
+        "a": {"value": first["bf16"]["img_s"], "mode": "bf16"},
+        "b": {"value": first["int8"]["img_s"], "mode": "int8"},
+        "delta_pct": first["delta_pct"],
+        "top1_ref": "bf16-argmax agreement on the eval batch (random "
+                    "init; trained real-data bound in tests/test_quant.py)",
+        "models": rows,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        # CI pins (tests/test_bench_smoke.py) start here
+        assert first["compile_misses_timed"] == 0, "timed window recompiled"
+        assert first["quantized_nodes"] > 0, "no int8 nodes served"
+        assert first["top1_disagree_pct"] <= 50.0, rows
+    print(json.dumps(row))
+
+
 AB_SINKS = {
     "s2d_stem": {
         "unit": "img/s",
@@ -506,18 +649,35 @@ AB_SINKS = {
         "side": lambda args, smoke, flag: _conv_ab_side(
             args, smoke, None, flag, frozen=True),
     },
+    # inference-side sink: declares a whole-run body ("run") instead of
+    # the training-shaped off/on "side" pair — the A/B here is two
+    # NUMERICS MODES of the same serving path, not an env toggle, and
+    # the row carries latency percentiles + top-1 agreement beside the
+    # throughput delta
+    "int8_serve": {
+        "unit": "img/s",
+        "desc": "bf16 vs int8 post-training-quantized inference through "
+                "the ModelServer fill path (mixed-tenant, one device)",
+        "run": _int8_serve_ab,
+    },
 }
 
 
 def ab(args):
-    """Run one sink's matched A/B (see AB_SINKS) and print ONE JSON row."""
+    """Run one sink's matched A/B (see AB_SINKS) and print ONE JSON row.
+
+    Training sinks declare a ``side(args, smoke, flag)`` body run twice
+    (flag off/on); inference sinks declare a ``run(args)`` body that
+    owns both sides (and its extra columns) itself."""
     if args.smoke:
         # like smoke(): must win over any site TPU default BEFORE jax
         # is first imported
         os.environ["JAX_PLATFORMS"] = "cpu"
-    import numpy as np
-
     sink = AB_SINKS[args.ab]
+    if "run" in sink:
+        sink["run"](args)
+        return
+    import numpy as np
     a_rates = sink["side"](args, args.smoke, False)
     b_rates = sink["side"](args, args.smoke, True)
     a, b = float(np.mean(a_rates)), float(np.mean(b_rates))

@@ -206,6 +206,29 @@ REGISTRY = [
            "the batcher dispatches a partial fill (a full "
            "MXTPU_SERVE_MAX_BATCH dispatches immediately). Larger = "
            "better fill ratio, worse p99 under light load"),
+    # ---- int8 post-training quantization (quant/; docs/perf.md "Int8
+    #      serving", docs/serving.md) ----
+    EnvVar("MXTPU_QUANT_CALIB_MODE", str, "minmax",
+           "quant.calibrate default range mode: 'minmax' keeps the "
+           "observed per-channel |activation| max; 'percentile' "
+           "additionally caps every channel at the "
+           "MXTPU_QUANT_PERCENTILE-th percentile of the node's |x| "
+           "distribution (value-range histogram), trading saturation "
+           "of rare outliers for resolution on the bulk of the values "
+           "(clipped mass recorded per node as clip_pct)"),
+    EnvVar("MXTPU_QUANT_PERCENTILE", float, 99.99,
+           "Percentile (0, 100] for MXTPU_QUANT_CALIB_MODE=percentile; "
+           "99.99 clips ~the top 1e-4 of activation mass"),
+    EnvVar("MXTPU_QUANT_HIST_BINS", int, 2048,
+           "Bucket count (even) of the auto-ranging value-range "
+           "histograms calibration records activation distributions "
+           "into (telemetry.ValueHistogram; also the per-node "
+           "quant.calib.act.* telemetry histograms)"),
+    EnvVar("MXTPU_QUANT_SKIP_FIRST_LAST", int, 1,
+           "quantize_symbol policy: leave the FIRST and LAST eligible "
+           "conv/FC layer in float (the input stem and classifier head "
+           "are the classic accuracy-critical layers; the reference's "
+           "quantization excluded them too). 0 quantizes them as well"),
     # ---- telemetry (telemetry.py; docs/observability.md) ----
     EnvVar("MXTPU_TELEMETRY", int, 1,
            "Metrics registry (telemetry.py): counters/gauges/histograms "

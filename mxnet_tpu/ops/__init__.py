@@ -4,6 +4,7 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import quant_ops  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import spatial  # noqa: F401
 from . import optim_ops  # noqa: F401

@@ -460,8 +460,31 @@ def contrib_ifft(data, compute_size=128, **kw):
 
 # ----------------------------------------------------------------------
 # quantize / dequantize (reference src/operator/contrib/quantize-inl.h,
-# dequantize-inl.h — affine uint8 quantization with explicit ranges)
+# dequantize-inl.h — affine uint8 quantization with explicit ranges,
+# plus the reference's symmetric int8 branch (QuantizeV2 out_type=int8:
+# scale 127/max(|min|,|max|), zero-point-free) — the form the int8
+# inference pipeline consumes (mxnet_tpu/quant/, docs/perf.md)
 # ----------------------------------------------------------------------
+
+# symmetric int8 target: one sign bit + 7 magnitude bits, zero point at
+# 0 — -128 is deliberately unused so |q| <= 127 and negation is closed
+INT8_QMAX = 127.0
+
+
+def int8_symmetric_quantize(data, amax):
+    """f32 -> int8 with the shared symmetric recipe: scale = amax/127,
+    round-to-nearest-even, saturate to [-127, 127].  `amax` broadcasts,
+    so the same helper serves the per-tensor contrib op and the
+    per-channel folded scales in ops/quant_ops.py."""
+    scale = jnp.maximum(jnp.asarray(amax, jnp.float32), 1e-30) / INT8_QMAX
+    q = jnp.round(data.astype(jnp.float32) / scale)
+    return jnp.clip(q, -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+
+
+def int8_symmetric_dequantize(q, amax):
+    """int8 -> f32 inverse of :func:`int8_symmetric_quantize`."""
+    scale = jnp.asarray(amax, jnp.float32) / INT8_QMAX
+    return q.astype(jnp.float32) * scale
 
 
 def _infer_quantize(in_shapes, attrs):
@@ -472,7 +495,17 @@ def _infer_quantize(in_shapes, attrs):
 @register("_contrib_quantize", inputs=("data", "min_range", "max_range"),
           num_outputs=3, infer_shape=_infer_quantize)
 def contrib_quantize(data, min_range, max_range, out_type="uint8", **kw):
-    """f32 -> uint8 with scale 255/(max-min) (quantize-inl.h:29-44)."""
+    """f32 -> uint8 with scale 255/(max-min) (quantize-inl.h:29-44), or
+    the symmetric int8 form with scale 127/max(|min|,|max|) under
+    ``out_type='int8'`` (the reference QuantizeV2 int8 branch).  The
+    symmetric outputs carry the SIGNED range ±amax back, so dequantize
+    round-trips without knowing which branch quantized."""
+    if str(out_type) == "int8":
+        amax = jnp.maximum(jnp.abs(min_range[0]), jnp.abs(max_range[0]))
+        q = int8_symmetric_quantize(data, amax)
+        return (lax.stop_gradient(q),
+                (-amax).reshape(min_range.shape),
+                amax.reshape(max_range.shape))
     scale = 255.0 / (max_range[0] - min_range[0])
     q = jnp.floor((data - min_range[0]) * scale + 0.5)
     q = jnp.clip(q, 0, 255).astype(jnp.uint8)
@@ -482,6 +515,12 @@ def contrib_quantize(data, min_range, max_range, out_type="uint8", **kw):
 @register("_contrib_dequantize", inputs=("data", "min_range", "max_range"),
           infer_shape=lambda s, a: (list(s), [s[0]]))
 def contrib_dequantize(data, min_range, max_range, out_type="float32", **kw):
+    """Inverse of _contrib_quantize: branch on the STORAGE dtype of the
+    quantized input (int8 = symmetric, uint8 = affine), matching the
+    reference dequantize-inl.h pairing."""
+    if data.dtype == jnp.int8:
+        amax = jnp.maximum(jnp.abs(min_range[0]), jnp.abs(max_range[0]))
+        return int8_symmetric_dequantize(data, amax)
     scale = (max_range[0] - min_range[0]) / 255.0
     return data.astype(jnp.float32) * scale + min_range[0]
 

@@ -1,0 +1,186 @@
+"""Int8 post-training-quantized inference kernels (L3 op layer).
+
+The MXU's int8 mode doubles throughput again below bf16 (v5e: 394 vs
+197 TOPS); these ops are the forward-emission half of the PTQ pipeline
+(mxnet_tpu/quant/): ``quantize_symbol`` rewrites eligible
+Convolution / FullyConnected nodes onto them, feeding each node a
+calibrated per-input-channel activation-range vector as a NEW argument
+(``<node>_act_amax``, produced by quant/calib.py).
+
+The block a quantized node compiles to, entirely inside the one jitted
+program so XLA fuses the boundaries:
+
+  1. **quantize per-channel** — ``q_x[..., c] = rint(x / (amax_c/127))``
+     saturated to ±127 (the shared symmetric recipe,
+     contrib_ops.int8_symmetric_quantize — the same op the contrib
+     quantize/dequantize pair exposes imperatively);
+  2. **int8 matmul / conv** with ``preferred_element_type=jnp.int32``
+     accumulation (the MXU int8 path; never let XLA accumulate in 8
+     bits);
+  3. **fused dequant + bias** back in the surrounding compute dtype
+     (bf16 under serving's mixed-precision executors) — per-OUTPUT-
+     channel weight scales, with the per-input-channel activation
+     scale FOLDED into the weight before its own quantization:
+     ``w'[c,k] = w[c,k]·(amax_c/127)``, ``q_w = sym8(w', wmax_k)``, so
+     ``out_k = (Σ_c q_x q_w)·(wmax_k/127) ≈ Σ_c x_c w_ck`` exactly
+     factorizes per-channel activation AND per-channel weight
+     quantization into one integer contraction.
+
+Weight quantization happens at trace time from the ORIGINAL float
+weights (they ride in as ordinary executor args, so the int8 fold is
+part of the compiled program, not a separate param-conversion step);
+a bound serving program therefore re-derives ``q_w`` per dispatch —
+O(params) elementwise work that is noise next to the contraction, and
+it keeps checkpoints/params identical across bf16 and int8 tenants.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .contrib_ops import INT8_QMAX, int8_symmetric_quantize
+from .nn import _channel_last, _conv_dn, _infer_conv, _pair
+from .registry import register
+from .tensor import _bool, _lit, _shape
+
+__all__ = ["quantized_fully_connected", "quantized_conv2d"]
+
+# floor on quantization scales: a dead channel (amax 0) must produce
+# q=0, not NaNs from a 0/0
+_EPS = 1e-30
+
+
+def _amax_vec(act_amax):
+    return jnp.maximum(act_amax.astype(jnp.float32).reshape(-1), _EPS)
+
+
+def _infer_qfc(in_shapes, attrs):
+    data = in_shapes[0]
+    num_hidden = int(_lit(attrs["num_hidden"]))
+    no_bias = _bool(attrs.get("no_bias", False))
+    flatten = _bool(attrs.get("flatten", True))
+    if flatten:
+        in_dim = 1
+        for d in data[1:]:
+            in_dim *= d
+        out = (data[0], num_hidden)
+    else:
+        in_dim = data[-1]
+        out = tuple(data[:-1]) + (num_hidden,)
+    shapes = [data, (num_hidden, in_dim), (in_dim,)]
+    if not no_bias:
+        shapes.append((num_hidden,))
+    return shapes, [out]
+
+
+@register("_quantized_fully_connected",
+          inputs=("data", "weight", "act_amax", "bias"),
+          infer_shape=_infer_qfc)
+def quantized_fully_connected(data, weight, act_amax, bias=None,
+                              num_hidden=None, no_bias=False, flatten=True,
+                              **kw):
+    """Int8 FullyConnected: per-channel symmetric activation quant →
+    s8×s8→s32 ``dot_general`` → fused per-output-channel dequant +
+    bias in the incoming compute dtype (module docstring for the scale
+    factorization).  ``act_amax`` is the calibrated |activation| range
+    per input channel (flattened feature dim under ``flatten``)."""
+    odt = data.dtype if jnp.issubdtype(data.dtype, jnp.floating) \
+        else jnp.float32
+    if _bool(flatten):
+        data = data.reshape((data.shape[0], -1))
+    lead = data.shape[:-1]
+    x = data.reshape((-1, data.shape[-1]))
+    amax = _amax_vec(act_amax)
+    if amax.shape[0] != x.shape[-1]:
+        raise MXNetError(
+            "_quantized_fully_connected: act_amax has %d channels but the "
+            "(flattened) input feature dim is %d — recalibrate with the "
+            "shapes this executor binds" % (amax.shape[0], x.shape[-1]))
+    qx = int8_symmetric_quantize(x, amax[None, :])
+    # fold the activation scale into the weight, then quantize the folded
+    # weight per OUTPUT channel
+    w = weight.astype(jnp.float32) * (amax / INT8_QMAX)[None, :]
+    wmax = jnp.maximum(jnp.max(jnp.abs(w), axis=1), _EPS)
+    qw = int8_symmetric_quantize(w, wmax[:, None])
+    acc = lax.dot_general(qx, qw, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (wmax / INT8_QMAX)[None, :]
+    if bias is not None and not _bool(no_bias):
+        out = out + bias.astype(jnp.float32)
+    out = out.astype(odt)
+    return out.reshape(lead + (out.shape[-1],))
+
+
+def _infer_qconv(in_shapes, attrs):
+    # the float conv's bidirectional inference, with the act_amax shape
+    # (C_in,) inserted at its input slot
+    shapes, outs = _infer_conv(in_shapes, attrs)
+    data = in_shapes[0]
+    c_in = data[-1] if _channel_last(attrs.get("layout")) else data[1]
+    shapes.insert(2, (c_in,))
+    return shapes, outs
+
+
+@register("_quantized_conv2d",
+          inputs=("data", "weight", "act_amax", "bias"),
+          infer_shape=_infer_qconv)
+def quantized_conv2d(data, weight, act_amax, bias=None, kernel=None,
+                     num_filter=None, stride=None, pad=None, dilate=None,
+                     num_group=1, no_bias=False, layout=None, **kw):
+    """Int8 2-D convolution: per-input-channel symmetric activation
+    quant → s8×s8→s32 ``conv_general_dilated`` → fused per-output-
+    channel dequant + bias in the incoming compute dtype.  Supports
+    exactly what the transform's eligibility gate admits — 2-D,
+    ungrouped, NCHW or NHWC — and raises a clear error otherwise (the
+    graph transform leaves such nodes on the float op instead)."""
+    kernel = _shape(kernel)
+    if len(kernel) != 2:
+        raise MXNetError(
+            "_quantized_conv2d supports 2-D convolutions only (kernel "
+            "%s); leave this node on the float Convolution op"
+            % (kernel,))
+    groups = int(_lit(num_group))
+    if groups != 1:
+        raise MXNetError(
+            "_quantized_conv2d does not support grouped convolutions "
+            "(num_group=%d): per-input-channel scale folding crosses "
+            "group boundaries; leave this node on the float op" % groups)
+    n = 2
+    stride = _pair(stride, n)
+    dilate = _pair(dilate, n)
+    p = _shape(pad) or (0,) * n
+    pairs = [(int(x), int(x)) for x in p]
+    cl = _channel_last(layout)
+    odt = data.dtype if jnp.issubdtype(data.dtype, jnp.floating) \
+        else jnp.float32
+    amax = _amax_vec(act_amax)
+    c_in = data.shape[-1] if cl else data.shape[1]
+    if amax.shape[0] != c_in:
+        raise MXNetError(
+            "_quantized_conv2d: act_amax has %d channels but the input "
+            "has %d — recalibrate with the shapes this executor binds"
+            % (amax.shape[0], c_in))
+    ch_axis = data.ndim - 1 if cl else 1
+    bshape = [1] * data.ndim
+    bshape[ch_axis] = -1
+    qx = int8_symmetric_quantize(data, amax.reshape(bshape))
+    sa = amax / INT8_QMAX
+    if cl:                               # HWIO: fold along I (axis 2)
+        w = weight.astype(jnp.float32) * sa[None, None, :, None]
+        wmax = jnp.maximum(jnp.max(jnp.abs(w), axis=(0, 1, 2)), _EPS)
+        qw = int8_symmetric_quantize(w, wmax[None, None, None, :])
+    else:                                # OIHW: fold along I (axis 1)
+        w = weight.astype(jnp.float32) * sa[None, :, None, None]
+        wmax = jnp.maximum(jnp.max(jnp.abs(w), axis=(1, 2, 3)), _EPS)
+        qw = int8_symmetric_quantize(w, wmax[:, None, None, None])
+    acc = lax.conv_general_dilated(
+        qx, qw, window_strides=stride, padding=pairs, rhs_dilation=dilate,
+        dimension_numbers=_conv_dn(layout, n), feature_group_count=1,
+        preferred_element_type=jnp.int32)
+    oshape = [1] * acc.ndim
+    oshape[acc.ndim - 1 if cl else 1] = -1
+    out = acc.astype(jnp.float32) * (wmax / INT8_QMAX).reshape(oshape)
+    if bias is not None and not _bool(no_bias):
+        out = out + bias.astype(jnp.float32).reshape(oshape)
+    return out.astype(odt)

@@ -36,11 +36,35 @@ class Predictor:
     """One bound inference session (reference PredictorHandle)."""
 
     def __init__(self, symbol_json, param_bytes, input_shapes, ctx=None,
-                 output_names=None, type_dict=None):
+                 output_names=None, type_dict=None, dtype_mode=None,
+                 calib_table=None):
         """symbol_json: JSON string (or dict of a loaded graph);
         param_bytes: raw .params file content (reference binary NDArray-list
-        ABI or the native container); input_shapes: {name: shape}."""
+        ABI or the native container); input_shapes: {name: shape}.
+
+        `dtype_mode` selects the serving numerics per PREDICTOR (and so
+        per serving tenant — docs/serving.md "Int8 serving"):
+
+          * ``None`` / ``"f32"`` — the legacy full-precision bind;
+          * ``"bf16"`` — mixed-precision executors (params stored f32,
+            conv/matmul compute in bf16 via ``compute_dtype``);
+          * ``"int8"`` — the post-training-quantized graph: eligible
+            conv/FC nodes rewritten onto the int8 kernels using the
+            required `calib_table` (a :class:`mxnet_tpu.quant.CalibTable`,
+            its dict form, or a path to a saved one), everything else in
+            bf16.  Params load UNCHANGED — the calibrated ``*_act_amax``
+            scale vectors ride as extra fp32 arguments.
+
+        The mode is part of the executor-signature cache key, so one
+        process serving the same graph under several modes compiles
+        each (mode, shape) pair exactly once."""
         self._ctx = ctx or current_context()
+        if dtype_mode not in (None, "f32", "bf16", "int8"):
+            raise MXNetError(
+                "dtype_mode must be one of None/'f32'/'bf16'/'int8', got "
+                "%r" % (dtype_mode,))
+        self._dtype_mode = dtype_mode or "f32"
+        self._fp32_names = ()
         net = sym.load_json(symbol_json) if isinstance(symbol_json, str) else symbol_json
         if output_names:
             internals = net.get_internals()
@@ -63,6 +87,23 @@ class Predictor:
                 self._aux_params[k[4:]] = v
             else:  # plain names accepted too
                 self._arg_params[k] = v
+        if self._dtype_mode == "int8":
+            if calib_table is None:
+                raise MXNetError(
+                    "dtype_mode='int8' needs a calib_table (run "
+                    "mx.quant.calibrate over representative batches "
+                    "first; docs/serving.md 'Int8 serving')")
+            from .quant import CalibTable, quantize_symbol
+
+            if isinstance(calib_table, str):
+                calib_table = CalibTable.load(calib_table)
+            self._symbol, scale_args = quantize_symbol(self._symbol,
+                                                       calib_table)
+            self._arg_params.update(scale_args)
+            # calibrated ranges stay fp32 under the bf16 compute cast:
+            # the quantize step divides by them, and re-rounding the
+            # scale itself through bf16 shifts every grid point
+            self._fp32_names = tuple(scale_args)
         # executors cached by input-shape signature: reshape() and the
         # serving bucket ladder (serving/session.py) re-bind the SAME
         # graph at many batch sizes, and each signature's executor (and
@@ -100,7 +141,16 @@ class Predictor:
         the SAME executor, so its jit cache keeps the compiled program.
         Counted in predict.bind_cache_hits/_misses."""
         self._check_open()
-        sig = tuple(sorted((n, tuple(s)) for n, s in input_shapes.items()))
+        # the dtype mode leads the signature.  Today it is constant per
+        # Predictor (the cache is instance-scoped and the mode fixed at
+        # construction — mixed serving tenants are separate Predictors
+        # with separate caches), so this key component is an INVARIANT
+        # STATEMENT, not a live discriminator: it makes the
+        # (mode, shapes) -> program contract explicit and keeps any
+        # future mode-switching surface from silently aliasing programs
+        # across numerics
+        sig = (self._dtype_mode,) + tuple(
+            sorted((n, tuple(s)) for n, s in input_shapes.items()))
         from . import telemetry
 
         with self._cache_lock:
@@ -146,8 +196,22 @@ class Predictor:
             if name not in self._aux_params:
                 raise MXNetError("missing aux state %s" % name)
             aux[name] = self._aux_params[name]
+        if self._dtype_mode in ("bf16", "int8"):
+            from .executor import Executor
+
+            return Executor.bind(self._symbol, self._ctx, args,
+                                 args_grad=None, grad_req="null",
+                                 aux_states=aux, compute_dtype="bfloat16",
+                                 fp32_names=self._fp32_names)
         return self._symbol.bind(self._ctx, args, args_grad=None,
                                  grad_req="null", aux_states=aux)
+
+    @property
+    def dtype_mode(self):
+        """The serving numerics this predictor binds ('f32'/'bf16'/
+        'int8') — fixed at construction; a tenant that should serve
+        another mode is a NEW Predictor over the same symbol+params."""
+        return self._dtype_mode
 
     def _check_open(self):
         if self._exec_cache is None:

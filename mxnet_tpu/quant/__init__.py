@@ -1,0 +1,24 @@
+"""mxnet_tpu.quant — post-training int8 quantization (docs/perf.md
+"Int8 serving", docs/serving.md).
+
+The pipeline in three calls::
+
+    table = mx.quant.calibrate(sym, arg_params, aux_params, batches)
+    qsym, scale_args = mx.quant.quantize_symbol(sym, table)
+    # ...or let the serving stack do both halves of the consumption:
+    pred = mx.Predictor(sym, params, shapes, dtype_mode="int8",
+                        calib_table=table)
+
+``calibrate`` records per-channel activation ranges over representative
+batches (minmax or histogram-percentile, quant/calib.py);
+``quantize_symbol`` rewrites eligible conv/FC nodes onto the int8
+kernels (ops/quant_ops.py) with the calibrated ranges bound as new
+``*_act_amax`` arguments (quant/transform.py); the Predictor /
+ModelServer ``dtype_mode`` plumbing serves the result next to bf16
+tenants on the same chip (predict.py, serving/).
+"""
+from .calib import CalibTable, calibrate
+from .transform import QUANT_OP_MAP, eligible_nodes, quantize_symbol
+
+__all__ = ["CalibTable", "calibrate", "quantize_symbol", "eligible_nodes",
+           "QUANT_OP_MAP"]

@@ -74,9 +74,25 @@ class ModelServer:
     # ------------------------------------------------------------------
     # client surface
     # ------------------------------------------------------------------
-    def add_tenant(self, name, predictor):
+    def add_tenant(self, name, predictor, dtype_mode=None):
         """Register one model under `name`.  Allowed while serving — a
-        new tenant starts empty and simply joins the fairness policy."""
+        new tenant starts empty and simply joins the fairness policy.
+
+        The tenant's numerics are the PREDICTOR's ``dtype_mode`` (an
+        int8 tenant is a ``Predictor(..., dtype_mode='int8',
+        calib_table=...)``; the mode rides the predictor's executor-
+        signature cache, so mixed bf16/int8 tenants compile one program
+        per (tenant, bucket, mode)).  `dtype_mode` here is an assertion
+        only: pass it to fail FAST when the wired predictor serves a
+        different mode than the deployment intended."""
+        mode = getattr(predictor, "dtype_mode", "f32")
+        if dtype_mode is not None and dtype_mode != mode:
+            raise MXNetError(
+                "tenant %r: requested dtype_mode=%r but the predictor "
+                "was built with %r — the mode is fixed at Predictor "
+                "construction (build it with dtype_mode=%r and, for "
+                "int8, a calib_table)" % (name, dtype_mode, mode,
+                                          dtype_mode))
         with self._lock:
             if self._closed:
                 raise ServerClosed("cannot add tenant %r: server is closed"
@@ -85,6 +101,14 @@ class ModelServer:
                 raise MXNetError("tenant %r already registered" % name)
             self._sessions[name] = TenantSession(name, predictor, self.ladder)
             self._queue.register(name)
+        from .. import telemetry
+
+        if telemetry.enabled():
+            # per-tenant numerics gauge, rendered by parse_log
+            # --telemetry's tenant_bits column: 8 = int8, 16 = bf16,
+            # 32 = f32 (docs/observability.md)
+            telemetry.set_gauge("quant.tenant_bits.%s" % name,
+                                {"int8": 8, "bf16": 16}.get(mode, 32))
 
     @property
     def tenants(self):
@@ -135,10 +159,12 @@ class ModelServer:
         """Cheap live view for load shedding / dashboards (the full
         story is the telemetry registry, docs/observability.md)."""
         with self._lock:
-            tenants = list(self._sessions)
+            sessions = dict(self._sessions)
         return {
             "queue_depth": self._queue.depth(),
-            "per_tenant_depth": {t: self._queue.depth(t) for t in tenants},
+            "per_tenant_depth": {t: self._queue.depth(t) for t in sessions},
+            "tenant_modes": {t: getattr(s._predictor, "dtype_mode", "f32")
+                             for t, s in sessions.items()},
             "ladder": list(self.ladder),
             "closed": self._closed,
         }

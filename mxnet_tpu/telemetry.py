@@ -40,6 +40,7 @@ import time
 
 __all__ = [
     "enabled", "set_enabled", "inc", "set_gauge", "observe",
+    "observe_values", "attach_value_histogram", "ValueHistogram",
     "counter_value", "gauge_value", "snapshot", "reset", "flush",
     "rank_suffixed", "note_retrace", "peak_flops", "flops_of_jaxpr",
     "TIME_BUCKETS", "BYTE_BUCKETS", "COUNT_BUCKETS",
@@ -160,6 +161,230 @@ def observe(name, value, buckets=TIME_BUCKETS):
         if h is None:
             h = _HISTOGRAMS[name] = _Histogram(buckets)
         h.observe(value)
+
+
+class ValueHistogram:
+    """VALUE-RANGE histogram — the distribution recorder the fixed
+    TIME/BYTE/COUNT ladders cannot be: those ladders are tuned for
+    latencies and byte totals, while activation magnitudes (the int8
+    calibration use, mxnet_tpu/quant/calib.py) span unknown,
+    model-dependent ranges.
+
+    Two bucket modes:
+
+      * **caller-supplied** — pass explicit ``boundaries`` (any sorted
+        upper edges); behaves like the fixed ladders plus an overflow
+        bucket, but over the caller's range.
+      * **auto-ranging** (default) — ``n_buckets`` equal-width buckets
+        over ``[0, hi]`` where ``hi`` starts at the first batch's max
+        and DOUBLES (merging adjacent bucket pairs, counts preserved)
+        whenever a later value exceeds it, so one pass over data of
+        unknown magnitude still yields a usable distribution.  Auto
+        mode records magnitudes: negative values clip to 0 (record
+        ``abs(x)`` for signed data).
+
+    Bulk ingestion (:meth:`observe_array`) bins a whole numpy array per
+    call — a calibration pass feeds multi-megabyte activation tensors,
+    so per-element Python dispatch is off the table.  ``as_dict()``
+    emits the same count/sum/min/max/buckets schema as the fixed-bucket
+    histograms (non-cumulative ``le_*`` counts summing to ``count``),
+    so snapshot/flush/parse_log render it unchanged; :meth:`quantile`
+    adds within-bucket linear interpolation for the percentile
+    calibration mode."""
+
+    __slots__ = ("n", "hi", "counts", "boundaries", "count", "sum",
+                 "min", "max", "_lock")
+
+    def __init__(self, n_buckets=64, boundaries=None):
+        # per-histogram lock: binning is O(array) and must NOT ride the
+        # registry-wide _LOCK (a multi-MB calibration observe would
+        # stall every serving thread's telemetry.inc for its duration)
+        self._lock = threading.Lock()
+        if boundaries is not None:
+            bs = tuple(float(b) for b in boundaries)
+            if not bs or list(bs) != sorted(bs):
+                raise ValueError("boundaries must be a non-empty sorted "
+                                 "sequence, got %r" % (boundaries,))
+            self.boundaries = bs
+            self.counts = [0] * (len(bs) + 1)   # + overflow
+            self.n = None
+            self.hi = None
+        else:
+            n = int(n_buckets)
+            if n < 2 or n % 2:
+                raise ValueError("n_buckets must be an even int >= 2 "
+                                 "(pair-merge range doubling), got %r"
+                                 % (n_buckets,))
+            self.boundaries = None
+            self.n = n
+            self.hi = 0.0
+            self.counts = [0] * n
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, value):
+        self.observe_array((value,))
+
+    def observe_array(self, values):
+        import numpy as _np
+
+        a = _np.asarray(values, dtype=_np.float64).reshape(-1)
+        if a.size == 0:
+            return
+        with self._lock:
+            self._observe_locked(a, _np)
+
+    def _observe_locked(self, a, _np):
+        lo, hi = float(a.min()), float(a.max())
+        self.count += int(a.size)
+        self.sum += float(a.sum())
+        self.min = lo if self.min is None else min(self.min, lo)
+        self.max = hi if self.max is None else max(self.max, hi)
+        if self.boundaries is not None:
+            idx = _np.searchsorted(_np.asarray(self.boundaries), a,
+                                   side="left")
+            for i, c in enumerate(_np.bincount(idx,
+                                               minlength=len(self.counts))):
+                self.counts[i] += int(c)
+            return
+        a = _np.maximum(a, 0.0)
+        m = float(a.max())
+        if self.hi <= 0.0:
+            self.hi = m if m > 0.0 else 1.0
+        while m > self.hi:
+            # double the range: bucket k of the new width covers exactly
+            # old buckets 2k and 2k+1, so the merge loses no counts and
+            # keeps the widths equal
+            c = self.counts
+            half = [c[2 * i] + c[2 * i + 1] for i in range(self.n // 2)]
+            self.counts = half + [0] * (self.n - self.n // 2)
+            self.hi *= 2.0
+        width = self.hi / self.n
+        idx = _np.clip(_np.ceil(a / width).astype(_np.int64) - 1, 0,
+                       self.n - 1)
+        for i, c in enumerate(_np.bincount(idx, minlength=self.n)):
+            self.counts[i] += int(c)
+
+    def _edges(self):
+        if self.boundaries is not None:
+            return self.boundaries
+        width = (self.hi or 1.0) / self.n
+        return tuple(width * (i + 1) for i in range(self.n))
+
+    def quantile(self, q):
+        """Value at quantile ``q`` (0..1), linearly interpolated inside
+        the containing bucket; None when empty.  Clamped to the
+        observed max so a sparse top bucket cannot report a value no
+        observation reached."""
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def _quantile_locked(self, q):
+        if not self.count:
+            return None
+        target = q * self.count
+        edges = self._edges()
+        seen = 0.0
+        prev = 0.0
+        for i, c in enumerate(self.counts):
+            if i >= len(edges):      # explicit-mode overflow bucket
+                return self.max
+            if c and seen + c >= target:
+                frac = (target - seen) / c
+                val = prev + frac * (edges[i] - prev)
+                return min(val, self.max) if self.max is not None else val
+            seen += c
+            prev = edges[i]
+        return self.max
+
+    def fraction_above(self, value):
+        """Approximate fraction of observations strictly above `value`
+        (linear interpolation inside the containing bucket) — the
+        clip-rate readout for a percentile-capped calibration."""
+        with self._lock:
+            return self._fraction_above_locked(value)
+
+    def _fraction_above_locked(self, value):
+        if not self.count:
+            return 0.0
+        value = float(value)
+        edges = self._edges()
+        above = 0.0
+        prev = 0.0
+        for i, c in enumerate(self.counts):
+            if i >= len(edges):          # explicit-mode overflow bucket
+                above += c
+                break
+            hi = edges[i]
+            if value <= prev:
+                above += c
+            elif value < hi:
+                above += c * (hi - value) / (hi - prev)
+            prev = hi
+        return above / self.count
+
+    def as_dict(self):
+        with self._lock:
+            edges = self._edges()
+            buckets = {("le_%g" % b): c
+                       for b, c in zip(edges, self.counts)}
+            buckets["le_inf"] = (self.counts[len(edges)]
+                                 if self.boundaries is not None else 0)
+            return {
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "buckets": buckets,
+            }
+
+
+def observe_values(name, values, n_buckets=64, boundaries=None):
+    """Bulk-record a numpy array (or scalar) into the VALUE-RANGE
+    histogram `name` (created on first use as a :class:`ValueHistogram`
+    with the given ``n_buckets`` / explicit ``boundaries``; later calls
+    reuse the existing instance and ignore the creation arguments).
+    The E004 hot-path contract applies exactly as for :func:`observe`:
+    guard the call (and the array construction feeding it) behind
+    :func:`enabled`.  The registry lock covers only the lookup; the
+    O(array) binning runs under the histogram's OWN lock, so a bulk
+    observe never stalls unrelated telemetry calls."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        h = _HISTOGRAMS.get(name)
+        if h is None:
+            h = _HISTOGRAMS[name] = ValueHistogram(n_buckets=n_buckets,
+                                                   boundaries=boundaries)
+        elif not isinstance(h, ValueHistogram):
+            raise ValueError(
+                "histogram %r already exists with fixed ladder buckets; "
+                "observe_values needs a ValueHistogram (pick a distinct "
+                "metric name)" % name)
+    h.observe_array(values)
+
+
+def attach_value_histogram(name, hist):
+    """Expose a caller-OWNED :class:`ValueHistogram` under `name` in the
+    registry (shared object, nothing copied), so snapshots and flushes
+    see the same distribution the caller keeps binning into — the int8
+    calibrator owns its histograms for the percentile/cap math and
+    attaches them rather than binning every activation tensor twice.
+    No-op when disabled (the registry stays untouched); replacing an
+    existing fixed-ladder name is refused like :func:`observe_values`.
+    Same E004 guard contract as every recording call."""
+    if not _ENABLED:
+        return
+    if not isinstance(hist, ValueHistogram):
+        raise ValueError("attach_value_histogram needs a ValueHistogram, "
+                         "got %r" % type(hist).__name__)
+    with _LOCK:
+        h = _HISTOGRAMS.get(name)
+        if h is not None and not isinstance(h, ValueHistogram):
+            raise ValueError(
+                "histogram %r already exists with fixed ladder buckets; "
+                "pick a distinct metric name" % name)
+        _HISTOGRAMS[name] = hist
 
 
 # ----------------------------------------------------------------------

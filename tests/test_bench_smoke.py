@@ -178,3 +178,40 @@ def test_bench_smoke_honors_k_flag():
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["steps_per_dispatch"] == 8
     assert out["dispatches"] == out["expected_dispatches"] == 3  # ceil(24/8)
+
+
+@pytest.mark.slow
+def test_bench_ab_int8_serve_smoke():
+    """bench.py --ab int8_serve --smoke: the inference-side A/B body
+    (docs/perf.md "Int8 serving") runs a tiny bf16+int8 TENANT PAIR of
+    one model through the real ModelServer fill path — calibration,
+    quantize_symbol, mixed-tenant warmup, compile-free timed windows —
+    and emits one JSON row with both sides' img/s, p50/p99, and the
+    top-1 agreement column.  The same driver with ResNet-50 /
+    Inception-v3 produces the README Roofline row."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXTPU_QUANT_CALIB_MODE", "MXTPU_QUANT_PERCENTILE",
+                 "MXTPU_QUANT_SKIP_FIRST_LAST", "MXTPU_SERVE_BUCKETS",
+                 "MXTPU_SERVE_MAX_BATCH"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ab",
+         "int8_serve", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == "int8_serve" and out["smoke"] is True
+    assert out["unit"] == "img/s"
+    assert out["a"]["mode"] == "bf16" and out["b"]["mode"] == "int8"
+    assert out["a"]["value"] > 0 and out["b"]["value"] > 0
+    row = out["models"]["tiny"]
+    assert row["compile_misses_timed"] == 0   # warmup owned every compile
+    assert row["quantized_nodes"] > 0         # int8 nodes actually served
+    assert row["requests"] > 0 and row["bucket"] > 0
+    for side in ("bf16", "int8"):
+        assert row[side]["img_s"] > 0
+        assert row[side]["p99_ms"] >= row[side]["p50_ms"] > 0
+    assert 0 <= row["top1_disagree_pct"] <= 50.0
+    expect = round((out["b"]["value"] - out["a"]["value"])
+                   / out["a"]["value"] * 100.0, 2)
+    assert abs(out["delta_pct"] - expect) < 0.05

@@ -289,3 +289,67 @@ def test_multibox_detection_rejects_nonzero_background_id():
     with pytest.raises(MXNetError, match="background_id"):
         contrib.ndarray.MultiBoxDetection(cls_prob, loc_pred, anchor,
                                           background_id=1)
+
+
+# ----------------------------------------------------------------------
+# symmetric int8 quantize/dequantize (the imperative surface of the form
+# the quant/ PTQ pipeline consumes; uint8-affine behavior regression-
+# pinned in tests/test_contrib_ops2.py)
+# ----------------------------------------------------------------------
+
+def test_quantize_int8_round_trip():
+    C = contrib.ndarray
+    x = np.linspace(-0.9, 0.95, 37).astype(np.float32)
+    q, mn, mxr = C.quantize(mx.nd.array(x), mx.nd.array([-1.0]),
+                            mx.nd.array([1.0]), out_type="int8")
+    qn = q.asnumpy()
+    assert qn.dtype == np.int8
+    ref = np.clip(np.round(x * 127.0), -127, 127).astype(np.int8)
+    np.testing.assert_array_equal(qn, ref)
+    # symmetric branch hands the signed range back out
+    assert mn.asnumpy()[0] == -1.0 and mxr.asnumpy()[0] == 1.0
+    d = C.dequantize(q, mn, mxr).asnumpy()
+    np.testing.assert_allclose(d, x, atol=1.0 / 127 + 1e-6)
+
+
+def test_quantize_int8_asymmetric_range_symmetrizes_on_amax():
+    """An asymmetric calibrated range (-0.5, 2.0) quantizes against
+    amax = 2.0 on BOTH sides (zero-point-free), and the returned range
+    is the symmetrized ±amax so dequantize round-trips blind."""
+    C = contrib.ndarray
+    x = np.array([-0.5, 0.0, 1.0, 2.0], np.float32)
+    q, mn, mxr = C.quantize(mx.nd.array(x), mx.nd.array([-0.5]),
+                            mx.nd.array([2.0]), out_type="int8")
+    np.testing.assert_array_equal(
+        q.asnumpy(), np.round(x * 127.0 / 2.0).astype(np.int8))
+    assert mn.asnumpy()[0] == -2.0 and mxr.asnumpy()[0] == 2.0
+    d = C.dequantize(q, mn, mxr).asnumpy()
+    np.testing.assert_allclose(d, x, atol=2.0 / 127 + 1e-6)
+
+
+def test_quantize_int8_saturates_never_wraps():
+    """Out-of-range values saturate to ±127 — -128 stays unused (the
+    symmetric grid is negation-closed) and nothing ever wraps."""
+    C = contrib.ndarray
+    x = np.array([10.0, -10.0, 1.0, -1.0, 1.0001], np.float32)
+    q, _, _ = C.quantize(mx.nd.array(x), mx.nd.array([-1.0]),
+                         mx.nd.array([1.0]), out_type="int8")
+    np.testing.assert_array_equal(q.asnumpy(),
+                                  np.array([127, -127, 127, -127, 127],
+                                           np.int8))
+
+
+def test_quantize_int8_symbolic_path():
+    """The same ops compose symbolically (the graph surface the PTQ
+    transform's building blocks ride)."""
+    data = mx.sym.Variable("data")
+    lo = mx.sym.Variable("lo")
+    hi = mx.sym.Variable("hi")
+    q = mx.sym._contrib_quantize(data, lo, hi, out_type="int8")
+    deq = mx.sym._contrib_dequantize(q[0], q[1], q[2])
+    x = np.linspace(-2.0, 2.0, 9).astype(np.float32)
+    ex = deq.bind(mx.cpu(), {"data": mx.nd.array(x),
+                             "lo": mx.nd.array([-2.0]),
+                             "hi": mx.nd.array([2.0])}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, x, atol=2.0 / 127 + 1e-6)

@@ -1396,3 +1396,47 @@ def test_stats_line_reports_files_findings_seconds(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "stats: files=1 findings=0" in r.stdout
     assert "seconds=" in r.stdout
+
+
+def test_repo_gate_sweeps_the_quant_package():
+    """ISSUE 13 pin: the gate walk covers mxnet_tpu/quant/ (calibration
+    books telemetry and the transform runs trace-adjacent code — the
+    E004/E006 surfaces) and the int8 kernels in ops/quant_ops.py."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    for mod in ("__init__", "calib", "transform"):
+        assert os.path.join("mxnet_tpu", "quant", "%s.py" % mod) in swept
+    assert os.path.join("mxnet_tpu", "ops", "quant_ops.py") in swept
+
+
+E004_OBSERVE_VALUES_UNGUARDED = """
+import numpy as np
+from . import telemetry
+
+def calib_sweep(acts):
+    for a in acts:
+        telemetry.observe_values("quant.calib.act", np.abs(a))
+"""
+
+E004_OBSERVE_VALUES_GUARDED = """
+import numpy as np
+from . import telemetry
+
+def calib_sweep(acts):
+    for a in acts:
+        if telemetry.enabled():
+            telemetry.observe_values("quant.calib.act", np.abs(a))
+"""
+
+
+def test_e004_covers_observe_values(tmp_path):
+    """The value-range histogram recorder (telemetry.observe_values,
+    ISSUE 13) is a recording call like observe: the E004 fast-path
+    guard contract applies — notably to the array math feeding it."""
+    findings, _, _ = _lint_src(tmp_path, E004_OBSERVE_VALUES_UNGUARDED)
+    assert _ids(findings) == ["E004"]
+    assert "telemetry.observe_values" in findings[0].message
+    findings, _, _ = _lint_src(tmp_path, E004_OBSERVE_VALUES_GUARDED)
+    assert findings == [], findings

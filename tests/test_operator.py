@@ -742,6 +742,8 @@ COVERED_ELSEWHERE = {
     "_contrib_BlockwiseAttention",
     # test_moe_op.py (first-class parallel layers, ops/sharded_ops.py)
     "MoE", "RingAttention",
+    # test_quant.py (int8 PTQ serving kernels, ops/quant_ops.py)
+    "_quantized_conv2d", "_quantized_fully_connected",
     # test_contrib_ops2.py
     "_contrib_fft", "_contrib_ifft", "_contrib_quantize",
     "_contrib_dequantize", "_contrib_count_sketch", "_contrib_Proposal",

@@ -506,7 +506,8 @@ def test_parse_log_telemetry_grows_retrace_and_sched_div_columns(tmp_path):
     addition contract)."""
     from tools.parse_log import _TELEMETRY_COLS, parse_telemetry
 
-    assert _TELEMETRY_COLS[-2:] == ["retraces", "sched_div"]
+    assert _TELEMETRY_COLS[-4:] == ["retraces", "sched_div",
+                                    "quant_clip_pct", "tenant_bits"]
     old = {"flush_seq": 1, "counters": {}, "gauges": {}, "histograms": {}}
     new = {"flush_seq": 2,
            "counters": {"trace.retraces": 3,
@@ -527,3 +528,92 @@ def test_parse_log_telemetry_grows_retrace_and_sched_div_columns(tmp_path):
         timeout=120)
     assert r.returncode == 0, r.stderr
     assert "retraces" in r.stdout and "sched_div" in r.stdout
+
+
+# ----------------------------------------------------------------------
+# value-range histograms (ValueHistogram / observe_values — the int8
+# calibration recorder, docs/observability.md)
+# ----------------------------------------------------------------------
+
+def test_value_histogram_auto_range_doubles_preserving_counts():
+    h = telemetry.ValueHistogram(n_buckets=4)
+    h.observe_array([0.5, 1.0])
+    assert h.count == 2 and h.hi == 1.0
+    # 3.9 forces two doublings (1 -> 2 -> 4); pair-merge keeps every
+    # prior observation counted
+    h.observe(3.9)
+    assert h.hi == 4.0
+    assert h.count == 3 and sum(h.counts) == 3
+    assert h.min == 0.5 and h.max == 3.9
+    d = h.as_dict()
+    assert d["count"] == 3 and sum(d["buckets"].values()) == 3
+    assert d["buckets"]["le_inf"] == 0  # auto mode grows, never overflows
+
+
+def test_value_histogram_quantile_and_fraction_above():
+    h = telemetry.ValueHistogram(n_buckets=64)
+    h.observe_array(np.linspace(0.0, 100.0, 10001))
+    q99 = h.quantile(0.99)
+    assert abs(q99 - 99.0) < 2.0
+    assert abs(h.fraction_above(q99) - 0.01) < 0.005
+    assert h.quantile(1.0) == 100.0  # clamped to the observed max
+    assert telemetry.ValueHistogram().quantile(0.5) is None  # empty
+
+
+def test_value_histogram_explicit_boundaries_and_overflow():
+    h = telemetry.ValueHistogram(boundaries=(1.0, 2.0))
+    h.observe_array([0.5, 1.5, 5.0])
+    d = h.as_dict()
+    assert d["buckets"] == {"le_1": 1, "le_2": 1, "le_inf": 1}
+    assert h.fraction_above(2.0) == pytest.approx(1.0 / 3.0)
+
+
+def test_value_histogram_rejects_bad_construction():
+    with pytest.raises(ValueError):
+        telemetry.ValueHistogram(n_buckets=3)   # odd: pair-merge breaks
+    with pytest.raises(ValueError):
+        telemetry.ValueHistogram(boundaries=(2.0, 1.0))  # unsorted
+
+
+def test_observe_values_registry_schema_and_disabled():
+    telemetry.observe_values("test.vals", np.array([1.0, 2.0, 3.0]))
+    telemetry.observe_values("test.vals", 4.0)
+    snap = telemetry.snapshot()["histograms"]["test.vals"]
+    assert snap["count"] == 4 and snap["max"] == 4.0
+    assert sum(snap["buckets"].values()) == 4
+    # the snapshot schema is the one parse_log's quantile math reads
+    from tools.parse_log import _hist_quantile
+
+    assert _hist_quantile(snap, 0.5) is not None
+    # disabled: zero registry mutation (the E004 fast-path promise)
+    telemetry.set_enabled(False)
+    telemetry.observe_values("test.off", np.array([1.0]))
+    telemetry.set_enabled(True)
+    assert "test.off" not in telemetry.snapshot()["histograms"]
+    # a name already holding a fixed-ladder histogram is a clear error
+    telemetry.observe("test.fixed", 1.0)
+    with pytest.raises(ValueError, match="fixed ladder"):
+        telemetry.observe_values("test.fixed", np.array([1.0]))
+
+
+def test_attach_value_histogram_shares_one_object():
+    """The calibration recorder owns its histograms and ATTACHES them —
+    the registry snapshot sees the same distribution the caller keeps
+    binning into, with every array binned exactly once."""
+    h = telemetry.ValueHistogram(n_buckets=8)
+    telemetry.attach_value_histogram("test.shared", h)
+    h.observe_array(np.array([1.0, 2.0, 3.0]))
+    snap = telemetry.snapshot()["histograms"]["test.shared"]
+    assert snap["count"] == 3 and snap["max"] == 3.0
+    # disabled: registry untouched (the recording-call contract)
+    telemetry.set_enabled(False)
+    telemetry.attach_value_histogram("test.shared.off",
+                                     telemetry.ValueHistogram())
+    telemetry.set_enabled(True)
+    assert "test.shared.off" not in telemetry.snapshot()["histograms"]
+    with pytest.raises(ValueError, match="ValueHistogram"):
+        telemetry.attach_value_histogram("test.bad", object())
+    telemetry.observe("test.fixed2", 1.0)
+    with pytest.raises(ValueError, match="fixed ladder"):
+        telemetry.attach_value_histogram("test.fixed2",
+                                         telemetry.ValueHistogram())

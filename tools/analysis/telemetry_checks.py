@@ -38,8 +38,9 @@ __all__ = ["UnguardedTelemetryCall"]
 # hot dispatch paths and promises the same ~zero disabled cost)
 _MODULE_NAMES = {"telemetry", "profiler", "recorder"}
 # the recording entry points whose CALL must be guarded
-_RECORDING_ATTRS = {"inc", "set_gauge", "observe", "flush",
-                    "record_span", "record_counter", "record"}
+_RECORDING_ATTRS = {"inc", "set_gauge", "observe", "observe_values",
+                    "attach_value_histogram", "flush", "record_span",
+                    "record_counter", "record"}
 # the fast-path predicates
 _GUARD_ATTRS = {"enabled", "spans_active"}
 

@@ -26,8 +26,11 @@ compute) when it recorded the ``comm`` namespace
 (docs/distributed.md), and the trace-contract columns (``retraces``
 compiled-signature churn from the retrace monitor, ``sched_div``
 cross-rank collective-schedule divergences from
-``MXTPU_COLLECTIVE_CHECK=1``; docs/static_analysis.md).  Older logs
-render '-' in columns they predate.
+``MXTPU_COLLECTIVE_CHECK=1``; docs/static_analysis.md), and the int8-
+quantization columns (``quant_clip_pct`` mean calibration clip rate,
+``tenant_bits`` per-tenant serving numerics as ``name:8`` int8 /
+``name:16`` bf16 / ``name:32`` f32; docs/perf.md "Int8 serving").
+Older logs render '-' in columns they predate.
 
 With ``--cluster`` the input is the rank-0 CLUSTER JSONL
 (``MXTPU_OBS_CLUSTER_FILE``, written by the obs aggregator —
@@ -172,6 +175,17 @@ def parse_telemetry(lines):
                                 for k in counters) else None),
             "sched_div": (counters.get("schedule.divergences")
                           if "schedule.divergences" in counters else None),
+            # int8-quantization columns (mxnet_tpu/quant, docs/perf.md
+            # "Int8 serving"): mean calibration clip rate and the
+            # per-tenant serving numerics (name:bits, 8 = int8,
+            # 16 = bf16, 32 = f32) — '-' for logs that predate the
+            # quant pipeline
+            "quant_clip_pct": gauges.get("quant.clip_pct"),
+            "tenant_bits": (";".join(
+                "%s:%d" % (k[len("quant.tenant_bits."):], int(v))
+                for k, v in sorted(gauges.items())
+                if k.startswith("quant.tenant_bits."))
+                or None),
         })
     return rows
 
@@ -233,7 +247,7 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "fusion_hit_pct", "wgrad_bf16", "frozen_bn",
                    "serve_qdepth", "fill_pct", "req_p99", "data_qdepth",
                    "decode_mbps", "comm_gbps", "overlap_pct", "retraces",
-                   "sched_div"]
+                   "sched_div", "quant_clip_pct", "tenant_bits"]
 
 
 def _print_rows(rows, cols, fmt):
