@@ -59,6 +59,17 @@ def parse_args():
                         "the stated offered load.  With --smoke: tiny "
                         "CPU tenants through the identical path "
                         "(tests/test_bench_smoke.py)")
+    p.add_argument("--replicas", type=str, default="",
+                   help="--serve: comma-separated replica counts (e.g. "
+                        "'1,2,4') — for each N, launch N ReplicaAgent "
+                        "processes via tools/launch.py --serve-replicas "
+                        "and drive the SAME load through a Router "
+                        "(docs/serving.md 'Multi-replica tier'); one "
+                        "JSON row reports img/s + route p50/p99 per "
+                        "count and the 1->max scaling.  Empty = the "
+                        "single in-process ModelServer path")
+    p.add_argument("--serve-agent", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: one replica of --replicas
     p.add_argument("--clients", type=int, default=4,
                    help="--serve closed loop: concurrent clients per "
                         "tenant (default 4)")
@@ -153,7 +164,11 @@ def main():
         return spmd(args)
     if args.decode:
         return decode(args)
+    if args.serve_agent:
+        return serve_agent(args)
     if args.serve:
+        if args.replicas:
+            return serve_replicas(args)
         return serve(args)
     if args.ab:
         return ab(args)
@@ -1196,21 +1211,20 @@ def _serve_predictor(mx, net, sample_shape, ctx):
     return mx.Predictor(net, params, {"data": (1,) + sample_shape}, ctx=ctx)
 
 
-def serve(args):
-    import threading
+# the one statement of the --serve tenant contract, importable without
+# building predictors: the agent subprocess builds tenants from
+# _serve_models while the --replicas driver only needs the sample shape
+# and request floor — sharing the constants keeps the two processes in
+# lockstep by construction
+SERVE_SMOKE_SAMPLE, SERVE_SMOKE_REQUESTS = (12,), 96
+SERVE_FULL_SAMPLE, SERVE_FULL_REQUESTS = (224, 224, 3), 512
 
-    if args.smoke:
-        # must win over any site TPU default BEFORE jax is first imported
-        os.environ["JAX_PLATFORMS"] = "cpu"
-    import numpy as np
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import telemetry
-
-    # like --smoke, this harness asserts its own instrumentation
-    telemetry.set_enabled(True)
-    telemetry.reset()
-
+def _serve_models(args, mx):
+    """(tenant predictors, sample shape, max_batch, wait_ms, total) —
+    shared by the in-process ModelServer path, the --serve-agent
+    replica process, and so the --replicas router path: every mode
+    serves the IDENTICAL tenant set."""
     if args.smoke:
         def tiny(hidden, classes, seed):
             mx.random.seed(seed)
@@ -1222,39 +1236,35 @@ def serve(args):
                 mx.sym.FullyConnected(h, num_hidden=classes, name="fc2"),
                 name="softmax")
 
-        sample, ctx = (12,), mx.cpu()
+        sample, ctx = SERVE_SMOKE_SAMPLE, mx.cpu()
         nets = {"small": tiny(16, 5, 0), "big": tiny(32, 7, 1)}
         max_batch, wait_ms = 8, 5.0
-        total = args.requests or 96
+        total = args.requests or SERVE_SMOKE_REQUESTS
     else:
         from mxnet_tpu.models.resnet import resnet
 
-        sample, ctx = (224, 224, 3), mx.tpu()
+        sample, ctx = SERVE_FULL_SAMPLE, mx.tpu()
         nets = {"resnet50": resnet(50, layout="NHWC"),
                 "resnet152": resnet(152, layout="NHWC")}
         max_batch = args.batch or 32
         wait_ms = None  # registered default
-        total = args.requests or 512
+        total = args.requests or SERVE_FULL_REQUESTS
+    preds = {name: _serve_predictor(mx, net, sample, ctx)
+             for name, net in nets.items()}
+    return preds, sample, max_batch, wait_ms, total
 
-    server = mx.serving.ModelServer(
-        {name: _serve_predictor(mx, net, sample, ctx)
-         for name, net in nets.items()},
-        max_batch=max_batch, wait_ms=wait_ms)
-    tenants = server.tenants
-    rng = np.random.RandomState(0)
-    xs = [rng.randn(*sample).astype("float32") for _ in range(16)]
 
-    # warmup: compile every (tenant, bucket) program deterministically
-    # (one synchronous dummy fill each — not via submit(), whose fill
-    # grouping depends on batching-window timing) so the timed window
-    # below is provably compile-free
-    server.warmup()
-    telemetry.reset()
-    miss0 = telemetry.counter_value("executor.compile_cache_misses")
+def _drive_load(submit, tenants, xs, args, total):
+    """Drive `total` requests through `submit(tenant, inputs)` —
+    closed loop (--clients concurrent clients per tenant) or open loop
+    (--offered-load req/s fixed arrival schedule).  Failures (timeouts
+    past deadline, admission rejections under overload) are the
+    MEASUREMENT in an overload run, not a crash: counted and returned.
+    Returns (elapsed seconds, failed count, requests driven) — driven
+    can exceed `total` because the closed loop rounds the per-client
+    share UP (--requests is a floor, never silently cut)."""
+    import threading
 
-    # failures (timeouts past deadline, admission rejections under
-    # overload) are the MEASUREMENT in an overload run, not a crash:
-    # count them and report them in the row
     failed = [0]
     fail_lock = threading.Lock()
 
@@ -1265,7 +1275,10 @@ def serve(args):
             with fail_lock:
                 failed[0] += 1
 
-    per_tenant = total // len(tenants)
+    # ceil BOTH splits (tenant and per-client) so --requests is a true
+    # floor — an odd total must never drive fewer requests than asked
+    per_tenant = -(-total // len(tenants))
+    driven = per_tenant * len(tenants)
     futs, t0 = [], time.time()
     if args.offered_load > 0:
         # open loop: fixed arrival schedule, round-robin over tenants —
@@ -1278,8 +1291,8 @@ def serve(args):
             if delay > 0:
                 time.sleep(delay)
             try:
-                futs.append(server.submit(tenants[i % len(tenants)],
-                                          {"data": xs[i % len(xs)]}))
+                futs.append(submit(tenants[i % len(tenants)],
+                                   {"data": xs[i % len(xs)]}))
             except Exception:
                 with fail_lock:
                     failed[0] += 1
@@ -1290,7 +1303,7 @@ def serve(args):
         def client(tenant, n):
             for i in range(n):
                 try:
-                    _await(server.submit(tenant, {"data": xs[i % len(xs)]}))
+                    _await(submit(tenant, {"data": xs[i % len(xs)]}))
                 except Exception:
                     with fail_lock:
                         failed[0] += 1
@@ -1298,6 +1311,7 @@ def serve(args):
         threads = []
         # ceil: round UP so --requests is a floor, never silently cut
         n_per_client = max(1, -(-per_tenant // args.clients))
+        driven = n_per_client * args.clients * len(tenants)
         for t in tenants:
             for _ in range(args.clients):
                 th = threading.Thread(target=client, args=(t, n_per_client))
@@ -1305,7 +1319,39 @@ def serve(args):
                 threads.append(th)
         for th in threads:
             th.join()
-    elapsed = time.time() - t0
+    return time.time() - t0, failed[0], driven
+
+
+def serve(args):
+    if args.smoke:
+        # must win over any site TPU default BEFORE jax is first imported
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    # like --smoke, this harness asserts its own instrumentation
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+    preds, sample, max_batch, wait_ms, total = _serve_models(args, mx)
+    server = mx.serving.ModelServer(preds, max_batch=max_batch,
+                                    wait_ms=wait_ms)
+    tenants = server.tenants
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(*sample).astype("float32") for _ in range(16)]
+
+    # warmup: compile every (tenant, bucket) program deterministically
+    # (one synchronous dummy fill each — not via submit(), whose fill
+    # grouping depends on batching-window timing) so the timed window
+    # below is provably compile-free
+    server.warmup()
+    telemetry.reset()
+    miss0 = telemetry.counter_value("executor.compile_cache_misses")
+
+    elapsed, failed, _driven = _drive_load(server.submit, tenants, xs,
+                                           args, total)
     server.close()
 
     snap = telemetry.snapshot()
@@ -1333,7 +1379,7 @@ def serve(args):
         "fill_pct": round(fill_pct, 2) if fill_pct is not None else None,
         "dispatches": counters.get("serving.dispatches", 0),
         "requests": completed,
-        "failed": failed[0],
+        "failed": failed,
         "timeouts": counters.get("serving.timeouts", 0),
         "compile_misses_timed": compile_misses,
         "queue_depth_seen": gauges.get("serving.queue_depth") is not None,
@@ -1360,6 +1406,192 @@ def serve(args):
         assert row["failed"] == 0, "smoke run dropped requests"
         assert compile_misses == 0, "timed window recompiled"
         assert row["queue_depth_seen"], gauges
+    print(json.dumps(row))
+
+
+# ----------------------------------------------------------------------
+# --serve --replicas N: the multi-replica tier (docs/serving.md
+# "Multi-replica tier").  For each requested count, a fleet of N
+# ReplicaAgent processes (each the SAME tenants as --serve, launched by
+# tools/launch.py --serve-replicas) takes the SAME offered load through
+# one Router — the measured composition row for ROADMAP item 1.
+# ----------------------------------------------------------------------
+
+
+def serve_agent(args):
+    """One replica of --serve --replicas: build the --serve tenant set,
+    warm every bucket, and serve it on MXTPU_ROUTER_PORT until the
+    router sends CLOSE (internal; spawned via tools/launch.py)."""
+    if args.smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.router import ReplicaAgent
+
+    # the replica's health replies carry the serving.* fill extract the
+    # router's ladder adaptation (and the bench row) feeds on — force
+    # it on like serve() does, regardless of an inherited
+    # MXTPU_TELEMETRY=0
+    telemetry.set_enabled(True)
+    preds, _sample, max_batch, wait_ms, _total = _serve_models(args, mx)
+    agent = ReplicaAgent(preds, max_batch=max_batch, wait_ms=wait_ms)
+    agent.warmup()
+    print("AGENT_READY replica=%d port=%d" % (agent.replica_id, agent.port),
+          flush=True)
+    agent.serve_forever()
+
+
+def _launch_fleet(n, args):
+    """Spawn the N-replica fleet via the real launcher; returns
+    (launcher process, replica address list)."""
+    import subprocess
+    import sys
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(repo, "tools", "launch.py"),
+           "--serve-replicas", str(n),
+           sys.executable, os.path.join(repo, "bench.py"), "--serve-agent"]
+    if args.smoke:
+        cmd.append("--smoke")
+    if args.batch:
+        cmd += ["--batch", str(args.batch)]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True, cwd=repo)
+    addrs = None
+    for line in proc.stdout:
+        if line.startswith("MXTPU_ROUTER_REPLICAS="):
+            addrs = line.strip().split("=", 1)[1].split(",")
+            break
+    if not addrs:
+        proc.terminate()
+        raise RuntimeError("launch.py --serve-replicas printed no "
+                           "MXTPU_ROUTER_REPLICAS line")
+    # keep draining the shared pipe (replica AGENT_READY lines) so a
+    # chatty fleet can never block on a full pipe
+    threading.Thread(target=proc.stdout.read, daemon=True).start()
+    return proc, addrs
+
+
+def serve_replicas(args):
+    if args.smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.router import Router
+
+    telemetry.set_enabled(True)
+    counts = sorted({int(c) for c in args.replicas.split(",") if c.strip()})
+    sample = SERVE_SMOKE_SAMPLE if args.smoke else SERVE_FULL_SAMPLE
+    total = args.requests or (SERVE_SMOKE_REQUESTS if args.smoke
+                              else SERVE_FULL_REQUESTS)
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(*sample).astype("float32") for _ in range(16)]
+    poll_ms = 100.0 if args.smoke else None
+    per_count = {}
+    for n in counts:
+        proc, addrs = _launch_fleet(n, args)
+        router = None
+        try:
+            # adaptation off for the bench: every count must serve the
+            # same ladder, or the rows measure ladder drift instead of
+            # scaling.  connect_timeout must cover the fleet's warmup:
+            # each agent binds its socket, then compiles EVERY
+            # (tenant, bucket) program before serve_forever() accepts —
+            # minutes for the full-mode ResNet pair, so the Router's
+            # default 60s HELLO bound would give up mid-compile
+            router = Router(addrs, poll_ms=poll_ms, adapt_window_s=0,
+                            connect_timeout=120.0 if args.smoke
+                            else 1800.0)
+            router.warmup()
+            telemetry.reset()
+            elapsed, failed, driven = _drive_load(
+                router.submit, router.tenants, xs, args, total)
+            # let the final health poll land so the per-replica fill
+            # accounting below reflects the whole run
+            time.sleep(3 * (poll_ms or 200.0) / 1e3)
+            snap = telemetry.snapshot()
+            counters, gauges = snap["counters"], snap["gauges"]
+            lat = snap["histograms"].get("router.route_seconds", {})
+            health = router.health()
+            per_replica, used, padded = {}, 0, 0
+            for name, rep in sorted(health["replicas"].items()):
+                serving = ((rep.get("health") or {}).get("serving")) or {}
+                per_replica[name] = {
+                    "dispatches": serving.get("dispatches", 0),
+                    "requests": serving.get("requests", 0),
+                }
+                used += serving.get("slots_used", 0)
+                padded += serving.get("slots_padded", 0)
+            completed = counters.get("router.requests", 0)
+            router.close(shutdown_replicas=True)
+            rc = proc.wait(timeout=300)
+        except BaseException:
+            # never orphan the fleet: a bring-up or drive failure must
+            # still CLOSE the replicas (or kill the launcher) before
+            # the error propagates
+            if router is not None:
+                try:
+                    router.close(drain=False, shutdown_replicas=True,
+                                 timeout=30)
+                except Exception:
+                    pass
+            if proc.poll() is None:
+                proc.terminate()
+            proc.wait(timeout=60)
+            raise
+        per_count[str(n)] = {
+            "img_s": round(completed / elapsed, 2),
+            "p50_ms": (round(_hist_q(lat, 0.5) * 1e3, 3)
+                       if lat.get("count") else None),
+            "p99_ms": (round(_hist_q(lat, 0.99) * 1e3, 3)
+                       if lat.get("count") else None),
+            "requests": completed,
+            "driven": driven,
+            "failed": failed,
+            "redispatches": counters.get("router.redispatches", 0),
+            "replicas_healthy": gauges.get("router.replicas_healthy"),
+            "fill_pct": (round(100.0 * used / (used + padded), 2)
+                         if (used + padded) else None),
+            "per_replica": per_replica,
+            "launcher_rc": rc,
+        }
+    top = per_count[str(counts[-1])]
+    mode = "open" if args.offered_load > 0 else "closed"
+    row = {
+        "metric": "multi-replica serving img/s through the router, "
+                  "N in %s, %s loop (%s)"
+                  % (counts, mode,
+                     "tiny CPU smoke" if args.smoke
+                     else "ResNet-50+152 per replica"),
+        "value": top["img_s"],
+        "unit": "img/s",
+        "mode": mode,
+        "replica_counts": per_count,
+        "scaling_1_to_max": (round(top["img_s"]
+                                   / per_count["1"]["img_s"], 3)
+                             if "1" in per_count and counts[-1] != 1
+                             and per_count["1"]["img_s"] else None),
+        "host_cores": os.cpu_count(),
+        "requests_per_count": total,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        # the CI pins (tests/test_bench_smoke.py) start here
+        for n in counts:
+            sub = per_count[str(n)]
+            assert sub["failed"] == 0, per_count
+            # every DRIVEN request completed (driven >= the --requests
+            # floor: the closed loop rounds per-client shares up)
+            assert sub["requests"] == sub["driven"] >= total, per_count
+            assert sub["redispatches"] == 0, per_count
+            assert sub["launcher_rc"] == 0, per_count
+            assert sub["p99_ms"] and sub["p99_ms"] >= sub["p50_ms"] > 0
+            served = [r for r in sub["per_replica"].values()
+                      if r["dispatches"] > 0]
+            # the router genuinely SPREAD traffic: with >1 replica at
+            # least two served fills
+            assert len(served) >= min(n, 2), per_count
     print(json.dumps(row))
 
 

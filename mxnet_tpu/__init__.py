@@ -45,6 +45,7 @@ from . import callback
 from . import predict
 from .predict import Predictor
 from . import serving
+from . import router
 from . import quant
 from . import image
 from . import rtc

@@ -206,6 +206,38 @@ REGISTRY = [
            "the batcher dispatches a partial fill (a full "
            "MXTPU_SERVE_MAX_BATCH dispatches immediately). Larger = "
            "better fill ratio, worse p99 under light load"),
+    # ---- multi-replica serving tier (router/; docs/serving.md
+    #      "Multi-replica tier") ----
+    EnvVar("MXTPU_ROUTER_PORT", int, 0,
+           "router.ReplicaAgent bind port (one ModelServer behind a "
+           "socket); 0 = ephemeral, read back from agent.port. "
+           "tools/launch.py --serve-replicas exports a free one per "
+           "replica process"),
+    EnvVar("MXTPU_ROUTER_REPLICAS", str, "",
+           "Comma-separated host:port replica list Router() connects "
+           "to by default — launch.py --serve-replicas prints and "
+           "exports it for the fleet it spawned"),
+    EnvVar("MXTPU_REPLICA_ID", int, 0,
+           "This replica's index in the serving fleet (exported per "
+           "process by launch.py --serve-replicas; names the replica "
+           "in Router.health() and the chaos-test dead list)"),
+    EnvVar("MXTPU_ROUTER_POLL_MS", float, 200.0,
+           "Router health-poll cadence: every interval each replica "
+           "answers its ModelServer.health() probe + serving telemetry "
+           "extract. A replica silent for 5 intervals (>=2 s floor) is "
+           "declared dead and its in-flight requests replay to peers"),
+    EnvVar("MXTPU_ROUTER_REDISPATCH", int, 2,
+           "Drain-on-death budget: how many times one request may be "
+           "replayed to a new replica (submit-time snapshot) after "
+           "replica deaths/admission bounces before its future fails "
+           "with ReplicaDead. Counted in router.redispatches"),
+    EnvVar("MXTPU_ROUTER_ADAPT_WINDOW_S", float, 10.0,
+           "Traffic-adaptive bucket-ladder window: per replica, the "
+           "router derives the mean fill from the serving.batch_slots "
+           "counter deltas over this many seconds and pushes a re-warm "
+           "with a tighter ladder when >25% of the common bucket is "
+           "padding (router/policy.py derive_ladder). 0 = adaptation "
+           "off (ladders stay as deployed)"),
     # ---- int8 post-training quantization (quant/; docs/perf.md "Int8
     #      serving", docs/serving.md) ----
     EnvVar("MXTPU_QUANT_CALIB_MODE", str, "minmax",

@@ -248,6 +248,15 @@ class Aggregator:
         reflects their final state."""
         self._maybe_write_cluster_record(force=True)
 
+    def seen_since(self, rank, t):
+        """Has `rank`'s latest snapshot been PROCESSED at/after monotonic
+        `t`?  The exit-flush ordering check: a reporter's final _SNAP is
+        fire-and-forget, so rank 0's atexit must wait for the serve
+        thread to stamp it before force_write — or the JSONL would end
+        on a stale mid-run record whenever the write wins the race."""
+        with self._lock:
+            return self._latest.get(int(rank), (0.0,))[0] >= t
+
     def close(self):
         self._stopped = True
         try:
@@ -269,6 +278,7 @@ class Reporter(threading.Thread):
             lambda: build_snapshot(self.rank))
         self._stop_evt = threading.Event()
         self.offset_s = None  # rank-0 wall time minus local wall time
+        self.final_sent_at = None  # monotonic stamp of the exit flush
 
     def stop(self):
         self._stop_evt.set()
@@ -329,6 +339,11 @@ class Reporter(threading.Thread):
             _send_frame(sock, _SNAP,
                         payload=json.dumps(self._snapshot_fn(),
                                            default=str).encode())
+            # the aggregator PROCESSES this strictly after the last byte
+            # is delivered, i.e. after sendall returned — so a stamp
+            # taken now lower-bounds the processing stamp (_atexit_flush
+            # waits on it before force_write)
+            self.final_sent_at = time.monotonic()
         except (ConnectionError, OSError, ValueError):
             pass
         try:
@@ -412,6 +427,18 @@ def _atexit_flush():
     agg = _STATE["aggregator"]
     if agg is not None:
         try:
+            if rep is not None and rep.final_sent_at is not None:
+                # bounded wait for the final snapshot to be PROCESSED
+                # (frames on the reporter connection land in order, so
+                # a stamp at/after the send means it — or something
+                # even fresher — is in): on an idle host this is one
+                # loop iteration; under load it is the difference
+                # between the JSONL ending on the run's final state
+                # and ending on a stale mid-run record
+                deadline = time.monotonic() + 2.0
+                while (not agg.seen_since(rep.rank, rep.final_sent_at)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
             agg.force_write()
         except Exception:  # pragma: no cover — shutdown best effort
             pass

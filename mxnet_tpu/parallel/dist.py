@@ -40,7 +40,8 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["Scheduler", "Server", "DistKVStore", "run_scheduler", "run_server"]
+__all__ = ["LivenessBook", "Scheduler", "Server", "DistKVStore",
+           "run_scheduler", "run_server"]
 
 # frame commands
 _REGISTER = 1
@@ -138,6 +139,65 @@ def _parse_meta(meta):
 
 
 # ----------------------------------------------------------------------
+# Liveness bookkeeping — shared by the PS scheduler and the serving
+# router (mxnet_tpu/router): who is alive, who deregistered cleanly,
+# who vanished
+# ----------------------------------------------------------------------
+
+
+class LivenessBook:
+    """Per-node liveness ledger: last-seen stamps, clean deregistrations
+    (ps-lite Finalize), and vanished connections.  ``dead()`` is the
+    CheckDeadNodes answer — nodes that left WITHOUT finalizing, plus
+    nodes whose last stamp is older than `timeout`.
+
+    NOT internally synchronized: the owner (Scheduler under its
+    condition lock, Router under its own lock) brackets every call —
+    one lock discipline instead of two nested ones."""
+
+    def __init__(self, timeout=None):
+        self.timeout = DEAD_NODE_TIMEOUT if timeout is None else float(timeout)
+        self._last_seen = {}  # node -> monotonic timestamp
+        self._left = set()  # nodes whose connection closed
+        self._finalized = set()  # clean deregistrations
+
+    def beat(self, node):
+        self._last_seen[node] = time.monotonic()
+
+    def left(self, node):
+        """The node's connection dropped (dead unless it finalized)."""
+        self._left.add(node)
+
+    def finalize(self, node):
+        """Clean deregistration: never reported dead afterwards."""
+        self._finalized.add(node)
+
+    def revive(self, node):
+        """A recovered node rejoins under its old identity: clear every
+        verdict and restamp."""
+        self._left.discard(node)
+        self._finalized.discard(node)
+        self.beat(node)
+
+    def dead(self):
+        """Sorted dead-node list: left-without-finalize first, then
+        silent nodes past the heartbeat timeout."""
+        now = time.monotonic()
+        dead = sorted(self._left - self._finalized)
+        for node, seen in self._last_seen.items():
+            if node in self._left or node in self._finalized:
+                continue
+            if now - seen > self.timeout:
+                dead.append(node)
+        return dead
+
+    def unclean(self):
+        """Nodes that vanished without finalizing (exit-code accounting:
+        run_scheduler propagates these as failure)."""
+        return set(self._left) - self._finalized
+
+
+# ----------------------------------------------------------------------
 # Scheduler — rank assignment + address book + barrier (Postoffice analog)
 # ----------------------------------------------------------------------
 
@@ -154,9 +214,7 @@ class Scheduler:
         self._server_addrs = {}
         self._ranks = {"worker": 0, "server": 0}
         self._barrier_waiters = []
-        self._last_seen = {}  # node id "role:rank" -> monotonic timestamp
-        self._left = set()  # nodes whose connection closed
-        self._finalized = set()  # nodes that deregistered cleanly (ps-lite Finalize)
+        self._book = LivenessBook()  # guarded by self._lock
         self._send_locks = {}  # id(conn) -> Lock serializing frame sends
         self._current_conn = {}  # node -> id(conn) of its LIVE connection
         self._worker_threads = []
@@ -173,14 +231,7 @@ class Scheduler:
         """Nodes that vanished WITHOUT a _FINALIZE deregistration.  A clean
         exit (FINALIZE then close) is never reported dead — matching ps-lite,
         where Finalize() removes the node before the connection drops."""
-        now = time.monotonic()
-        dead = sorted(self._left - self._finalized)
-        for node, seen in self._last_seen.items():
-            if node in self._left or node in self._finalized:
-                continue
-            if now - seen > DEAD_NODE_TIMEOUT:
-                dead.append(node)
-        return dead
+        return self._book.dead()
 
     def serve_forever(self):
         """Register num_workers+num_servers nodes, then service barriers,
@@ -228,7 +279,7 @@ class Scheduler:
                 if role == "server":
                     self._server_addrs[rank] = (info["host"], info["port"])
                 node = "%s:%d" % (role, rank)
-                self._last_seen[node] = time.monotonic()
+                self._book.beat(node)
                 self._current_conn[node] = conn
             conns.append((conn, role, rank))
         self.sock.settimeout(None)
@@ -292,9 +343,7 @@ class Scheduler:
         role, rank = info["role"], int(info["recover"])
         node = "%s:%d" % (role, rank)
         with self._lock:
-            self._left.discard(node)
-            self._finalized.discard(node)
-            self._last_seen[node] = time.monotonic()
+            self._book.revive(node)
             old = self._current_conn.get(node)
             self._current_conn[node] = conn
             addrs = [self._server_addrs[r]
@@ -332,7 +381,7 @@ class Scheduler:
             while True:
                 cmd, meta, _ = _recv_frame(conn)
                 with self._lock:
-                    self._last_seen[node] = time.monotonic()
+                    self._book.beat(node)
                 if cmd == _BARRIER:
                     done = None
                     with self._lock:
@@ -357,7 +406,7 @@ class Scheduler:
                     self._send(conn, _DEADNODES_R, _meta(dead=dead))
                 elif cmd == _FINALIZE:
                     with self._lock:
-                        self._finalized.add(node)
+                        self._book.finalize(node)
                     self._send(conn, _ACK)
                 # _HEARTBEAT: timestamp already refreshed above
         except (ConnectionError, OSError):
@@ -365,7 +414,7 @@ class Scheduler:
                 if self._current_conn.get(node) is not conn:
                     return  # stale socket of an already-recovered node
                 # a closed connection counts as dead unless the job is done
-                self._left.add(node)
+                self._book.left(node)
                 # a worker that died INSIDE a barrier must not keep
                 # occupying a waiter slot: the next rendezvous would
                 # "complete" against its dead socket and skip the live
@@ -850,7 +899,7 @@ def run_scheduler():
         print("scheduler: %s" % e, file=_sys.stderr)
         return 1
     with sched._lock:
-        unclean = sched._left - sched._finalized
+        unclean = sched._book.unclean()
     return 1 if unclean else 0
 
 

@@ -1,0 +1,123 @@
+"""Serve wire protocol — SUBMIT/RESULT/HEALTH/WARMUP/CLOSE frames over
+the parameter-server transport.
+
+The multi-replica tier (docs/serving.md "Multi-replica tier") speaks
+the same length-prefixed binary framing the PS control plane already
+uses (`parallel/dist.py` ``_send_frame``/``_recv_frame``: ``[u32
+total][u8 cmd][u32 meta_len][meta][payload]``) — one transport, one
+set of framing bugs.  Command ids live above the dist.py range so a
+frame mis-delivered across planes fails loudly instead of aliasing.
+
+Tensor data rides the payload RAW (numpy ``tobytes``, no pickling —
+the dist.py discipline); the meta dict carries an ``arrays`` spec list
+of ``{name?, shape, dtype}`` entries giving each array's slice of the
+concatenated payload.  Meta itself is the ``repr``/``literal_eval``
+encoding dist.py uses, so every value must be a plain Python scalar /
+list / dict — :func:`pyify` converts numpy scalars at the boundary
+(a ``np.float32`` smuggled into a health snapshot would otherwise
+fail the peer's ``literal_eval``).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..parallel.dist import _meta, _parse_meta, _recv_frame, _send_frame
+
+__all__ = ["HELLO", "SUBMIT", "RESULT", "RERROR", "HEALTH", "HEALTH_R",
+           "WARMUP", "CLOSE", "ACK", "pack_arrays", "unpack_arrays",
+           "pyify", "send", "recv"]
+
+# frame commands — above the dist.py control-plane ids (1..17) so a
+# cross-plane mis-delivery is an unknown command, never a silent alias
+HELLO = 32      # router -> agent on connect; agent replies HELLO
+SUBMIT = 33     # router -> agent: one inference request (arrays payload)
+RESULT = 34     # agent -> router: resolved outputs for req id
+RERROR = 35     # agent -> router: failed request / failed control op
+HEALTH = 36     # router -> agent: health probe
+HEALTH_R = 37   # agent -> router: health() + serving telemetry extract
+WARMUP = 38     # router -> agent: (re)warm, optional new bucket ladder
+CLOSE = 39      # router -> agent: shut the replica down
+ACK = 40        # agent -> router: control-op acknowledgement
+
+
+def pyify(obj):
+    """Recursively convert to plain Python scalars/containers — the
+    repr/literal_eval meta encoding chokes on numpy scalars."""
+    if isinstance(obj, dict):
+        return {pyify(k): pyify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [pyify(v) for v in obj]
+    if isinstance(obj, _np.bool_):
+        return bool(obj)
+    if isinstance(obj, _np.integer):
+        return int(obj)
+    if isinstance(obj, _np.floating):
+        return float(obj)
+    return obj
+
+
+def pack_arrays(arrays):
+    """(specs, payload) for a list of numpy arrays: specs is the meta
+    ``arrays`` entry, payload the concatenated raw bytes."""
+    specs, chunks = [], []
+    for a in arrays:
+        a = _np.ascontiguousarray(a)
+        specs.append({"shape": [int(s) for s in a.shape],
+                      "dtype": str(a.dtype)})
+        chunks.append(a.tobytes())
+    return specs, b"".join(chunks)
+
+
+def unpack_arrays(specs, payload):
+    """Rebuild the array list from a spec + payload pair.  Returns
+    WRITABLE arrays (copies): callers hand them to numpy math and to
+    futures whose consumers may mutate."""
+    out, off = [], 0
+    for spec in specs:
+        dtype = _np.dtype(spec["dtype"])
+        shape = tuple(int(s) for s in spec["shape"])
+        count = 1
+        for s in shape:
+            count *= s
+        nbytes = count * dtype.itemsize
+        if off + nbytes > len(payload):
+            raise MXNetError(
+                "wire: array spec %r overruns the %d-byte payload at "
+                "offset %d — truncated or mis-framed message"
+                % (spec, len(payload), off))
+        out.append(_np.frombuffer(payload, dtype=dtype, count=count,
+                                  offset=off).reshape(shape).copy())
+        off += nbytes
+    if off != len(payload):
+        raise MXNetError(
+            "wire: %d payload bytes but specs account for %d — array "
+            "list and payload disagree" % (len(payload), off))
+    return out
+
+
+def send(sock, cmd, lock=None, arrays=None, **meta):
+    """One frame out.  `lock` serializes concurrent senders on a shared
+    socket (an async RESULT callback racing a HEALTH_R reply would
+    interleave mid-frame — the Scheduler._send discipline)."""
+    if arrays is not None:
+        specs, payload = pack_arrays(arrays)
+        meta["arrays"] = specs
+    else:
+        payload = b""
+    raw = _meta(**pyify(meta))
+    if lock is not None:
+        with lock:
+            _send_frame(sock, cmd, raw, payload)
+    else:
+        _send_frame(sock, cmd, raw, payload)
+
+
+def recv(sock):
+    """One frame in: (cmd, meta dict, arrays-or-None)."""
+    cmd, meta, payload = _recv_frame(sock)
+    info = _parse_meta(meta)
+    arrays = None
+    if "arrays" in info:
+        arrays = unpack_arrays(info.pop("arrays"), payload)
+    return cmd, info, arrays

@@ -125,6 +125,22 @@ class RequestQueue:
             heads = [dq[0].deadline for dq in self._queues.values() if dq]
         return min(heads) if heads else None
 
+    def probe(self):
+        """One ATOMIC health snapshot — total depth, per-tenant depths,
+        admission headroom, and the oldest head deadline read under a
+        single lock acquisition, so ModelServer.health() can never
+        report a torn view (a depth from before a concurrent put and a
+        headroom from after it)."""
+        with self._cv:
+            heads = [dq[0].deadline for dq in self._queues.values() if dq]
+            return {
+                "queue_depth": self._depth,
+                "per_tenant_depth": {t: len(dq)
+                                     for t, dq in self._queues.items()},
+                "queue_headroom": max(0, self._max_queue - self._depth),
+                "oldest_deadline": min(heads) if heads else None,
+            }
+
     def _note_depth(self, tenant):
         # called under self._cv; telemetry's lock is a leaf lock
         from .. import telemetry

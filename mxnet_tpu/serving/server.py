@@ -24,6 +24,7 @@ complete — no future is ever left unresolved.
 from __future__ import annotations
 
 import threading
+import time
 
 from ..base import MXNetError
 from .bucket import bucket_ladder
@@ -183,24 +184,28 @@ class ModelServer:
         request times out; None when idle — negative means requests are
         already expiring), ``dispatches`` / ``dispatch_errors`` (this
         server's fill counts), ``tenants``, ``ladder``."""
-        import time
-
+        # the queue probe is taken WHILE holding the server lock (the
+        # queue's cv already nests under it on the submit path), so a
+        # concurrent add_tenant/close cannot produce a torn probe —
+        # per_tenant_depth, headroom, and the tenant list are one
+        # consistent view
         with self._lock:
             tenants = list(self._sessions)
             closed = self._closed
             dispatches = self._dispatches
             errors = self._dispatch_errors
+            probe = self._queue.probe()
         thread = self._thread
         alive = bool(thread is not None and thread.is_alive())
-        depth = self._queue.depth()
-        oldest = self._queue.oldest_deadline()
+        oldest = probe["oldest_deadline"]
         return {
             "healthy": alive and not closed,
             "closed": closed,
             "batcher_alive": alive,
-            "queue_depth": depth,
-            "per_tenant_depth": {t: self._queue.depth(t) for t in tenants},
-            "queue_headroom": self._queue.headroom(),
+            "queue_depth": probe["queue_depth"],
+            "per_tenant_depth": {t: probe["per_tenant_depth"].get(t, 0)
+                                 for t in tenants},
+            "queue_headroom": probe["queue_headroom"],
             "oldest_deadline_in_s": (None if oldest is None
                                      else oldest - time.monotonic()),
             "dispatches": dispatches,

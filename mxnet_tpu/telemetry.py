@@ -474,6 +474,15 @@ def gauge_value(name, default=None):
         return _GAUGES.get(name, default)
 
 
+def histogram_moments(name):
+    """Cheap ``(count, sum)`` point read of one histogram — probe
+    paths (the router agent's per-HEALTH serving extract) read two
+    moments without the full-registry deep copy snapshot() takes."""
+    with _LOCK:
+        h = _HISTOGRAMS.get(name)
+        return (0, 0.0) if h is None else (h.count, h.sum)
+
+
 def snapshot():
     """Nested plain-dict view of the whole registry — the test/bench
     sink.  Stable schema: top-level ``counters`` / ``gauges`` /

@@ -1,0 +1,61 @@
+"""One ReplicaAgent process for tests/test_router.py.
+
+Builds the tests' tiny deterministic MLP tenant (same seed ->
+identical params in every process, the test_serving.py parity
+pattern), binds an EPHEMERAL port, prints ``AGENT_PORT=<port>`` once
+warm, and serves until the router sends CLOSE (or the test kills it —
+the chaos path).  Options arrive as one JSON argv blob:
+
+    python router_agent_script.py '{"seed": 0, "max_batch": 8,
+                                    "wait_ms": 20, "replica_id": 1}'
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    opts = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+    import mxnet_tpu as mx
+    from mxnet_tpu.router import ReplicaAgent
+
+    seed = int(opts.get("seed", 0))
+    mx.random.seed(seed)
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=16, name="fc1"),
+        act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=5, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 12))], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    params = {"arg:%s" % k: v for k, v in arg.items()}
+    params.update({"aux:%s" % k: v for k, v in aux.items()})
+    pred = mx.Predictor(net, params, {"data": (1, 12)}, ctx=mx.cpu())
+
+    # port: an explicit option wins; otherwise the launcher-exported
+    # MXTPU_ROUTER_PORT (falling back to ephemeral when neither is set,
+    # the registry default — the test then reads AGENT_PORT= back)
+    port = opts.get("port")
+    agent = ReplicaAgent(
+        {"m": pred},
+        port=None if port is None else int(port),
+        replica_id=opts.get("replica_id"),
+        max_batch=int(opts.get("max_batch", 8)),
+        buckets=opts.get("buckets"),
+        wait_ms=float(opts.get("wait_ms", 20.0)),
+        timeout_ms=opts.get("timeout_ms"))
+    agent.warmup()
+    print("AGENT_PORT=%d" % agent.port, flush=True)
+    agent.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

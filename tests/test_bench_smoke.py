@@ -131,6 +131,46 @@ def test_bench_serve_smoke_reports_load_row():
 
 
 @pytest.mark.slow
+def test_bench_serve_replicas_smoke_scaling_row():
+    """bench.py --serve --replicas 1,2 --smoke: the multi-replica tier
+    row (docs/serving.md "Multi-replica tier") launches each fleet via
+    the REAL tools/launch.py --serve-replicas path, drives the same
+    offered load through a Router per replica count, and emits ONE
+    JSON row with img/s + route p50/p99 per count and the 1->max
+    scaling.  The same driver at --replicas 1,2,4 with ResNet tenants
+    produces the BENCH_TABLE row."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXTPU_SERVE_MAX_BATCH", "MXTPU_SERVE_BUCKETS",
+                 "MXTPU_ROUTER_POLL_MS", "MXTPU_ROUTER_REDISPATCH",
+                 "MXTPU_ROUTER_ADAPT_WINDOW_S", "MXTPU_ROUTER_REPLICAS"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--smoke", "--replicas", "1,2"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["smoke"] is True and out["unit"] == "img/s"
+    assert set(out["replica_counts"]) == {"1", "2"}
+    for n, sub in out["replica_counts"].items():
+        # zero lost futures, every driven request completed (driven is
+        # >= the --requests floor: closed loop rounds per-client shares
+        # up), the fleet came up and tore down via the launcher (rc 0)
+        assert sub["requests"] == sub["driven"] >= out["requests_per_count"]
+        assert sub["failed"] == 0 and sub["redispatches"] == 0
+        assert sub["launcher_rc"] == 0
+        assert sub["p99_ms"] >= sub["p50_ms"] > 0
+        assert sub["replicas_healthy"] == float(n)
+        assert len(sub["per_replica"]) == int(n)
+    # the router genuinely spread the N=2 load over both replicas
+    n2 = out["replica_counts"]["2"]["per_replica"]
+    assert sum(1 for r in n2.values() if r["dispatches"] > 0) == 2, n2
+    assert out["value"] == out["replica_counts"]["2"]["img_s"]
+    assert out["scaling_1_to_max"] is not None
+    assert out["host_cores"] >= 1
+
+
+@pytest.mark.slow
 def test_bench_decode_reports_measured_rows():
     """bench.py --decode --smoke: the decode-throughput harness
     (docs/data.md) packs a synthetic JPEG RecordIO file and drives the

@@ -103,6 +103,19 @@ def test_repo_gate_sweeps_the_data_package():
         assert os.path.join("mxnet_tpu", "data", "%s.py" % mod) in swept
 
 
+def test_repo_gate_sweeps_the_router_package():
+    """Same pin for mxnet_tpu/router/ (ISSUE 14) — the router books
+    per-request telemetry on the resolve path and its poll/reader
+    threads are exactly where a blocking sync would wedge the tier, so
+    the E002/E004 surfaces exist there too."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    for mod in ("__init__", "wire", "agent", "policy", "router"):
+        assert os.path.join("mxnet_tpu", "router", "%s.py" % mod) in swept
+
+
 # ----------------------------------------------------------------------
 # E001 — undeclared dependencies
 # ----------------------------------------------------------------------
@@ -321,6 +334,48 @@ def test_e002_fires_on_atomic_data_fetch(tmp_path):
     assert findings == []
 
 
+# a router-poll-shaped callback (ISSUE 14: the health-poll tick pushed
+# as an engine op): the poll syncs on a staged health tensor inside an
+# ATOMIC callback — on a worker the fence is a silent no-op and the
+# "fresh" probe reads stale bytes, or the blocked worker starves the
+# pool serving the very replica it polls.  The real router polls on a
+# plain thread (no engine op at all); this corpus pins that E002 fires
+# the moment someone routes the poll through an atomic push.
+E002_ROUTER_POLL_ATOMIC = """
+def schedule_poll(eng, replicas, staged, poll_var):
+    def poll(_reps=replicas, _staged=staged):
+        for rep in _reps:
+            rep.probe_op(_staged)
+        _staged.wait_to_read()
+        depths = _staged.asnumpy()
+        for rep, depth in zip(_reps, depths):
+            rep.last_depth = float(depth)
+    eng.push(poll, read_vars=[staged._engine_var()],
+             write_vars=[poll_var])
+"""
+
+E002_ROUTER_POLL_NON_ATOMIC = """
+def schedule_poll(eng, replicas, staged, poll_var):
+    def poll(_reps=replicas, _staged=staged):
+        for rep in _reps:
+            rep.probe_op(_staged)
+        _staged.wait_to_read()
+        depths = _staged.asnumpy()
+        for rep, depth in zip(_reps, depths):
+            rep.last_depth = float(depth)
+    eng.push(poll, read_vars=[staged._engine_var()],
+             write_vars=[poll_var], atomic=False)
+"""
+
+
+def test_e002_fires_on_atomic_router_poll(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_ROUTER_POLL_ATOMIC)
+    got = _ids(findings)
+    assert got.count("E002") == 2, findings  # wait_to_read + asnumpy
+    findings, _, _ = _lint_src(tmp_path, E002_ROUTER_POLL_NON_ATOMIC)
+    assert findings == []
+
+
 # ----------------------------------------------------------------------
 # E004 — telemetry/profiler recording must be behind the fast path
 # ----------------------------------------------------------------------
@@ -502,6 +557,46 @@ def test_e004_fires_on_unguarded_data_service_booking(tmp_path):
     findings, _, _ = _lint_src(tmp_path, E004_DATA_BOOK_UNGUARDED)
     assert _ids(findings) == ["E004"] * 4, findings
     findings, _, _ = _lint_src(tmp_path, E004_DATA_BOOK_GUARDED)
+    assert findings == []
+
+
+# a router-resolve-shaped hot path (ISSUE 14, router/router.py: once
+# per ROUTED REQUEST — the tier's highest-rate instrumentation site,
+# plus the death path's redispatch booking): the `router.*` namespace
+# must ride the same enabled() fast path as every other layer.  Corpus
+# pins both sides so the guard discipline survives refactors.
+E004_ROUTER_UNGUARDED = """
+import time
+from . import telemetry
+
+def resolve(flight, arrays, replay):
+    flight.future.set_result(arrays)
+    telemetry.inc("router.requests")
+    telemetry.observe("router.route_seconds",
+                      time.monotonic() - flight.t_submit)
+    if replay:
+        telemetry.inc("router.redispatches")
+"""
+
+E004_ROUTER_GUARDED = """
+import time
+from . import telemetry
+
+def resolve(flight, arrays, replay):
+    flight.future.set_result(arrays)
+    if telemetry.enabled():
+        telemetry.inc("router.requests")
+        telemetry.observe("router.route_seconds",
+                          time.monotonic() - flight.t_submit)
+    if replay and telemetry.enabled():
+        telemetry.inc("router.redispatches")
+"""
+
+
+def test_e004_fires_on_unguarded_router_telemetry(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_ROUTER_UNGUARDED)
+    assert _ids(findings) == ["E004"] * 3, findings
+    findings, _, _ = _lint_src(tmp_path, E004_ROUTER_GUARDED)
     assert findings == []
 
 

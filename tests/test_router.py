@@ -1,0 +1,521 @@
+"""mxnet_tpu.router — the multi-replica serving tier.
+
+The acceptance pins (ISSUE 14 / ROADMAP item 1): Router.submit results
+are allclose to direct ModelServer.submit for every bucket and a
+partial fill (router parity), killing one replica process mid-load
+loses ZERO futures and double-resolves none while Router.health()
+names the dead replica and p99 recovers within a bounded window (the
+chaos test), the wire protocol round-trips arrays exactly, the
+routing/ladder policy math holds, traffic-adaptive ladder pushes
+re-warm a live replica, the launch.py --serve-replicas fleet comes up
+and tears down cleanly, and the router telemetry renders through
+parse_log (pre-router logs -> '-').  Replica agents run as REAL
+subprocesses throughout — same-seed tiny MLPs, so parity is assertable
+cross-process (the test_serving.py pattern).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.router import (NoHealthyReplica, ReplicaAgent, Router,
+                              derive_ladder, pick_replica, wire)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+AGENT = os.path.join(ROOT, "tests", "router_agent_script.py")
+
+
+def _mlp(hidden, classes, seed):
+    mx.random.seed(seed)
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
+        act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=classes, name="fc2"),
+        name="softmax")
+
+
+def _predictor(net, sample=(12,)):
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1,) + sample)], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    params = {"arg:%s" % k: v for k, v in arg.items()}
+    params.update({"aux:%s" % k: v for k, v in aux.items()})
+    return mx.Predictor(net, params, {"data": (1,) + sample}, ctx=mx.cpu())
+
+
+def _ref_predictor():
+    """The in-process oracle: seed 0 -> the SAME params every agent
+    subprocess builds (router_agent_script.py)."""
+    return _predictor(_mlp(16, 5, 0))
+
+
+def _spawn_agent(**opts):
+    """One replica agent subprocess; returns (proc, 'host:port')."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, AGENT, json.dumps(opts)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=ROOT)
+    deadline = time.time() + 120
+    port = None
+    for line in proc.stdout:
+        if line.startswith("AGENT_PORT="):
+            port = int(line.strip().split("=", 1)[1])
+            break
+        if time.time() > deadline:
+            break
+    if port is None:
+        proc.kill()
+        raise AssertionError("agent never reported its port")
+    threading.Thread(target=proc.stdout.read, daemon=True).start()
+    return proc, "127.0.0.1:%d" % port
+
+
+def _cleanup(router, *procs):
+    try:
+        router.close(drain=False, shutdown_replicas=True, timeout=30)
+    except Exception:
+        pass
+    for p in procs:
+        try:
+            p.wait(timeout=30)  # CLOSE was sent: let it drain and exit 0
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=30)
+
+
+# ----------------------------------------------------------------------
+# wire protocol
+# ----------------------------------------------------------------------
+
+def test_wire_roundtrip_arrays_and_meta():
+    import socket
+
+    a, b = socket.socketpair()
+    try:
+        arrs = [np.arange(6, dtype="float32").reshape(2, 3),
+                np.ones((4,), "int32"), np.zeros((0, 5), "float32")]
+        wire.send(a, wire.SUBMIT, arrays=arrs, req=7, tenant="m",
+                  names=["x", "y", "z"], timeout_ms=None,
+                  f=np.float32(1.5), n=np.int64(3))
+        cmd, info, out = wire.recv(b)
+        assert cmd == wire.SUBMIT
+        assert info["req"] == 7 and info["timeout_ms"] is None
+        # numpy scalars crossed as plain python (pyify) — literal_eval
+        # would have rejected them otherwise
+        assert info["f"] == 1.5 and info["n"] == 3
+        assert len(out) == 3
+        for x, y in zip(arrs, out):
+            assert x.dtype == y.dtype and x.shape == y.shape
+            assert np.array_equal(x, y)
+        # frames without arrays carry meta only
+        wire.send(a, wire.HEALTH)
+        cmd, info, out = wire.recv(b)
+        assert cmd == wire.HEALTH and out is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_rejects_mis_framed_payload():
+    from mxnet_tpu.router.wire import unpack_arrays
+
+    specs, payload = wire.pack_arrays([np.zeros((2, 2), "float32")])
+    with pytest.raises(mx.MXNetError, match="overruns"):
+        unpack_arrays(specs, payload[:-4])
+    with pytest.raises(mx.MXNetError, match="disagree"):
+        unpack_arrays(specs, payload + b"xx")
+
+
+# ----------------------------------------------------------------------
+# routing + ladder policy
+# ----------------------------------------------------------------------
+
+def test_pick_replica_gates_and_balances():
+    ok = {"healthy": True, "queue_headroom": 4, "queue_depth": 0}
+    full = {"healthy": True, "queue_headroom": 0, "queue_depth": 9}
+    sick = {"healthy": False, "queue_headroom": 4}
+    # least live inflight wins among the usable
+    assert pick_replica([("a", ok, 3, False), ("b", ok, 1, False)]) == "b"
+    # full admission queues and unhealthy batchers are gated out
+    assert pick_replica([("a", full, 0, False), ("b", ok, 9, False)]) == "b"
+    assert pick_replica([("a", sick, 0, False), ("b", ok, 9, False)]) == "b"
+    # a rebucketing replica is deprioritized, not excluded
+    assert pick_replica([("a", ok, 0, True), ("b", ok, 5, False)]) == "b"
+    assert pick_replica([("a", ok, 0, True)]) == "a"
+    # never-heard-from (health None) replicas are not routed blind
+    with pytest.raises(NoHealthyReplica):
+        pick_replica([("a", None, 0, False), ("b", sick, 0, False),
+                      ("c", full, 0, False)])
+
+
+def test_derive_ladder_adapts_to_fill_drift():
+    # mean fill 5 in bucket 8 pads 37.5% away -> add a 5 bucket
+    assert derive_ladder(5.0, [1, 2, 4, 8], 8) == [1, 2, 4, 5, 8]
+    # near-full fills: the ladder already serves the mix
+    assert derive_ladder(7.8, [1, 2, 4, 8], 8) is None
+    # exact bucket hit: no waste
+    assert derive_ladder(4.0, [1, 2, 4, 8], 8) is None
+    # the top bucket is pinned: a mix at/above max_batch never grows it
+    assert derive_ladder(8.0, [1, 2, 4, 8], 8) is None
+    assert derive_ladder(12.0, [1, 2, 4, 8], 8) is None
+    # idle / no data
+    assert derive_ladder(None, [1, 2, 4, 8], 8) is None
+    assert derive_ladder(0.0, [1, 2, 4, 8], 8) is None
+    # bounded growth: past the cap the proposal stops
+    fat = [1, 2, 3, 4, 5, 6, 7, 8, 16]
+    assert derive_ladder(9.0, fat, 16) is None
+
+
+def test_liveness_book_dead_and_unclean():
+    from mxnet_tpu.parallel.dist import LivenessBook
+
+    book = LivenessBook(timeout=0.05)
+    book.beat("replica:0")
+    book.beat("replica:1")
+    assert book.dead() == []
+    book.left("replica:1")
+    assert book.dead() == ["replica:1"]
+    assert book.unclean() == {"replica:1"}
+    # a clean deregistration is never dead
+    book.finalize("replica:1")
+    assert book.dead() == [] and book.unclean() == set()
+    # silence past the timeout is death; a revive clears the verdict
+    time.sleep(0.06)
+    assert "replica:0" in book.dead()
+    book.revive("replica:0")
+    assert book.dead() == []
+
+
+# ----------------------------------------------------------------------
+# ACCEPTANCE: router parity — every bucket and a partial fill
+# ----------------------------------------------------------------------
+
+def test_router_parity_every_bucket_and_partial_fill():
+    """Router.submit through a real agent subprocess is allclose to
+    direct ModelServer.submit on the identical (same-seed) model, for
+    every ladder bucket full AND partial."""
+    proc, addr = _spawn_agent(seed=0, max_batch=8, wait_ms=20)
+    ref = _ref_predictor()
+    server = mx.serving.ModelServer({"m": _ref_predictor()}, max_batch=8,
+                                    wait_ms=20, timeout_ms=60000)
+    router = Router([addr], poll_ms=100, adapt_window_s=0)
+    try:
+        assert router.tenants == ["m"]
+        rng = np.random.RandomState(3)
+        for n in (1, 2, 3, 4, 5, 7, 8):  # every bucket + partials
+            xs = [rng.randn(12).astype("float32") for _ in range(n)]
+            routed = [router.submit("m", {"data": x}) for x in xs]
+            direct = [server.submit("m", {"data": x}) for x in xs]
+            for x, rf, df in zip(xs, routed, direct):
+                out = rf.result(timeout=120)
+                via_server = df.result(timeout=120)
+                expect = ref.forward(data=x[None]).get_output(0)[0]
+                assert isinstance(out, list) and len(out) == 1
+                assert np.allclose(out[0], via_server[0], atol=1e-5), n
+                assert np.allclose(out[0], expect, atol=1e-5), n
+    finally:
+        server.close()
+        _cleanup(router, proc)
+    assert proc.returncode == 0  # CLOSE drained the agent cleanly
+
+
+def test_router_submit_errors_match_the_modelserver_surface():
+    proc, addr = _spawn_agent(seed=0, max_batch=8, wait_ms=10)
+    router = Router([addr], poll_ms=100, adapt_window_s=0)
+    try:
+        # unknown tenant fails ITS caller with a clear error
+        fut = router.submit("nope", {"data": np.zeros(12, "f")})
+        with pytest.raises(mx.MXNetError, match="unknown tenant"):
+            fut.result(timeout=60)
+        # malformed shape too
+        fut = router.submit("m", {"data": np.zeros((2, 12), "f")})
+        with pytest.raises(mx.MXNetError, match="sample shape"):
+            fut.result(timeout=60)
+    finally:
+        _cleanup(router, proc)
+
+
+# ----------------------------------------------------------------------
+# ACCEPTANCE: chaos — kill one replica mid-load
+# ----------------------------------------------------------------------
+
+def test_chaos_kill_one_replica_zero_lost_futures():
+    """SIGKILL one of two replicas while a burst is in flight: every
+    future resolves exactly once with the correct answer (drain-on-
+    death re-dispatch from submit-time snapshots), Router.health()
+    names the dead replica, and post-death latency recovers within a
+    bounded window."""
+    proc_a, addr_a = _spawn_agent(seed=0, max_batch=8, wait_ms=15,
+                                  replica_id=0)
+    proc_b, addr_b = _spawn_agent(seed=0, max_batch=8, wait_ms=15,
+                                  replica_id=1)
+    ref = _ref_predictor()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    router = Router([addr_a, addr_b], poll_ms=100, adapt_window_s=0,
+                    redispatch_cap=3)
+    rng = np.random.RandomState(11)
+    try:
+        # phase 1: healthy traffic across both replicas
+        xs = [rng.randn(12).astype("float32") for _ in range(16)]
+        for x, f in [(x, router.submit("m", {"data": x})) for x in xs]:
+            assert np.allclose(
+                f.result(timeout=120)[0],
+                ref.forward(data=x[None]).get_output(0)[0], atol=1e-5)
+        h0 = router.health()
+        assert h0["replicas_alive"] == 2 and not h0["dead"]
+
+        # phase 2: a burst, then SIGKILL replica A while it holds work
+        xs = [rng.randn(12).astype("float32") for _ in range(64)]
+        futs = [router.submit("m", {"data": x}) for x in xs]
+        proc_a.send_signal(signal.SIGKILL)
+        resolved = []
+        for x, f in zip(xs, futs):
+            out = f.result(timeout=120)  # ZERO lost futures
+            resolved.append(out)
+            assert np.allclose(
+                out[0], ref.forward(data=x[None]).get_output(0)[0],
+                atol=1e-5)
+        assert len(resolved) == len(xs)  # and none resolved twice: a
+        # Future resolves exactly once by construction; the flight
+        # table popped each req under one lock
+
+        # the router names the dead replica
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            h = router.health()
+            if h["dead"]:
+                break
+            time.sleep(0.1)
+        assert len(h["dead"]) == 1 and "replica:0" in h["dead"][0], h
+        assert h["replicas_alive"] == 1
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("router.redispatches", 0) >= 1, \
+            snap["counters"]
+        assert snap["counters"].get("router.lost", 0) == 0
+
+        # phase 3: p99 recovers within a bounded window — a full batch
+        # through the surviving replica completes promptly
+        t0 = time.monotonic()
+        xs = [rng.randn(12).astype("float32") for _ in range(16)]
+        futs = [router.submit("m", {"data": x}) for x in xs]
+        for x, f in zip(xs, futs):
+            assert np.allclose(
+                f.result(timeout=120)[0],
+                ref.forward(data=x[None]).get_output(0)[0], atol=1e-5)
+        recovery_s = time.monotonic() - t0
+        assert recovery_s < 30.0, recovery_s  # the bounded window
+    finally:
+        _cleanup(router, proc_a, proc_b)
+
+
+def test_router_fails_cleanly_when_whole_fleet_dies():
+    proc, addr = _spawn_agent(seed=0, max_batch=8, wait_ms=10)
+    router = Router([addr], poll_ms=100, adapt_window_s=0,
+                    redispatch_cap=1)
+    try:
+        fut = router.submit("m", {"data": np.zeros(12, "f")})
+        fut.result(timeout=60)
+        proc.kill()
+        # every later submit either fails fast (death observed) or its
+        # future fails with the replay verdict — never a hang
+        deadline = time.time() + 60
+        saw_failure = False
+        while time.time() < deadline and not saw_failure:
+            try:
+                fut = router.submit("m", {"data": np.zeros(12, "f")})
+            except (NoHealthyReplica, mx.MXNetError):
+                saw_failure = True
+                break
+            try:
+                fut.result(timeout=60)
+            except mx.MXNetError:
+                saw_failure = True
+            time.sleep(0.05)
+        assert saw_failure
+    finally:
+        _cleanup(router, proc)
+
+
+# ----------------------------------------------------------------------
+# traffic-adaptive bucket ladders
+# ----------------------------------------------------------------------
+
+def test_router_pushes_adapted_ladder_and_replica_rewarms():
+    """Drive a steady small-burst mix on the default [1,2,4,8] ladder:
+    within the adapt window the router pushes a ladder with a new
+    intermediate bucket sized to the OBSERVED mean fill (the exact
+    bucket depends on how the batching window groups the bursts), the
+    replica drains + re-warms onto it, and traffic keeps serving
+    correct answers across the swap."""
+    proc, addr = _spawn_agent(seed=0, max_batch=8, wait_ms=25)
+    ref = _ref_predictor()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    router = Router([addr], poll_ms=100, adapt_window_s=1.0)
+    rng = np.random.RandomState(5)
+    try:
+        assert router.health()["replicas"][list(
+            router.health()["replicas"])[0]]["ladder"] == [1, 2, 4, 8]
+
+        def burst():
+            xs = [rng.randn(12).astype("float32") for _ in range(5)]
+            futs = [router.submit("m", {"data": x}) for x in xs]
+            for x, f in zip(xs, futs):
+                assert np.allclose(
+                    f.result(timeout=120)[0],
+                    ref.forward(data=x[None]).get_output(0)[0], atol=1e-5)
+
+        # enough 5-fills to close an adapt window with >=5 dispatches
+        deadline = time.time() + 60
+        pushed = False
+        while time.time() < deadline and not pushed:
+            burst()
+            pushed = telemetry.counter_value("router.ladder_pushes") >= 1
+        assert pushed, "router never pushed an adapted ladder"
+        # the replica re-warmed onto the adapted ladder: a bucket the
+        # power-of-two default never contains, fitted to the mix
+        deadline = time.time() + 30
+        adaptive = set()
+        while time.time() < deadline:
+            rep = list(router.health()["replicas"].values())[0]
+            adaptive = set(rep["ladder"]) - {1, 2, 4, 8}
+            if adaptive and not rep["rebucketing"]:
+                break
+            time.sleep(0.1)
+        assert adaptive and all(1 < b < 8 for b in adaptive), rep
+        burst()  # traffic is still correct on the new ladder
+    finally:
+        _cleanup(router, proc)
+
+
+# ----------------------------------------------------------------------
+# the launcher fleet
+# ----------------------------------------------------------------------
+
+def test_launch_serve_replicas_fleet_up_and_down():
+    """tools/launch.py --serve-replicas 2: the fleet comes up on the
+    printed address list, serves routed traffic from both replicas,
+    and exits 0 when the router shuts it down."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    launcher = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "--serve-replicas", "2",
+         sys.executable, AGENT, json.dumps({"seed": 0, "max_batch": 8,
+                                            "wait_ms": 10})],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=ROOT)
+    addrs = None
+    for line in launcher.stdout:
+        if line.startswith("MXTPU_ROUTER_REPLICAS="):
+            addrs = line.strip().split("=", 1)[1].split(",")
+            break
+    assert addrs and len(addrs) == 2, "launcher printed no replica list"
+    threading.Thread(target=launcher.stdout.read, daemon=True).start()
+    ref = _ref_predictor()
+    router = Router(addrs, poll_ms=100, adapt_window_s=0)
+    try:
+        h = router.health()
+        assert h["replicas_alive"] == 2
+        # the launcher-assigned replica ids name the replicas
+        names = sorted(h["replicas"])
+        assert any("replica:0" in n for n in names)
+        assert any("replica:1" in n for n in names)
+        rng = np.random.RandomState(2)
+        xs = [rng.randn(12).astype("float32") for _ in range(24)]
+        futs = [router.submit("m", {"data": x}) for x in xs]
+        for x, f in zip(xs, futs):
+            assert np.allclose(
+                f.result(timeout=120)[0],
+                ref.forward(data=x[None]).get_output(0)[0], atol=1e-5)
+        router.close(shutdown_replicas=True)
+        assert launcher.wait(timeout=60) == 0
+    finally:
+        try:
+            router.close(drain=False, shutdown_replicas=True, timeout=10)
+        except Exception:
+            pass
+        if launcher.poll() is None:
+            launcher.kill()
+            launcher.wait(timeout=30)
+
+
+# ----------------------------------------------------------------------
+# health-probe hygiene (the ISSUE 14 serving satellite)
+# ----------------------------------------------------------------------
+
+def test_health_probe_is_not_torn_under_tenant_churn():
+    """health() snapshots tenants + per-tenant depths + headroom under
+    one consistent view: per_tenant_depth keys always equal the tenant
+    list even while add_tenant churns concurrently."""
+    server = mx.serving.ModelServer({"m": _ref_predictor()}, max_batch=4,
+                                    wait_ms=5)
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            try:
+                server.add_tenant("t%d" % i, _ref_predictor())
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+                return
+            i += 1
+            time.sleep(0.002)
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(200):
+            h = server.health()
+            assert sorted(h["per_tenant_depth"]) == h["tenants"], h
+            assert h["queue_headroom"] >= 0
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        server.close()
+    assert not errors
+
+
+# ----------------------------------------------------------------------
+# telemetry rendering (parse_log --telemetry router columns)
+# ----------------------------------------------------------------------
+
+def test_parse_log_renders_router_columns():
+    from tools.parse_log import parse_telemetry
+
+    router_rec = {
+        "flush_seq": 1, "step": 0,
+        "counters": {"router.requests": 96, "router.redispatches": 3},
+        "gauges": {"router.replicas_healthy": 2.0},
+        "histograms": {"router.route_seconds": {
+            "count": 4, "sum": 0.2, "min": 0.01, "max": 0.09,
+            "buckets": {"le_0.01": 1, "le_0.1": 3, "le_inf": 0}}},
+    }
+    legacy_rec = {"flush_seq": 2, "step": 5, "counters": {},
+                  "gauges": {}, "histograms": {}}
+    rows = parse_telemetry([json.dumps(router_rec), json.dumps(legacy_rec)])
+    assert rows[0]["replicas_healthy"] == 2.0
+    assert rows[0]["redispatches"] == 3
+    assert rows[0]["route_p99"] == pytest.approx(0.1)
+    # pre-router records render '-' (None) in every router column
+    assert rows[1]["replicas_healthy"] is None
+    assert rows[1]["redispatches"] is None
+    assert rows[1]["route_p99"] is None

@@ -506,8 +506,11 @@ def test_parse_log_telemetry_grows_retrace_and_sched_div_columns(tmp_path):
     addition contract)."""
     from tools.parse_log import _TELEMETRY_COLS, parse_telemetry
 
-    assert _TELEMETRY_COLS[-4:] == ["retraces", "sched_div",
-                                    "quant_clip_pct", "tenant_bits"]
+    # the ISSUE 12/13 columns stay one contiguous block in order (the
+    # tail has since grown the ISSUE 14 router columns)
+    i = _TELEMETRY_COLS.index("retraces")
+    assert _TELEMETRY_COLS[i:i + 4] == ["retraces", "sched_div",
+                                        "quant_clip_pct", "tenant_bits"]
     old = {"flush_seq": 1, "counters": {}, "gauges": {}, "histograms": {}}
     new = {"flush_seq": 2,
            "counters": {"trace.retraces": 3,

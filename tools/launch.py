@@ -35,6 +35,16 @@ pure-SPMD jobs):
 
     python tools/launch.py --local-spmd -n 2 --local-devices 2 \
         python train.py
+
+Serve-replica mode (docs/serving.md "Multi-replica tier") launches a
+serving FLEET: N copies of the command, each one replica process that
+builds its tenants and calls `mxnet_tpu.router.ReplicaAgent(...).
+serve_forever()` on its own exported MXTPU_ROUTER_PORT.  The full
+address list is exported to every replica AND printed as one
+`MXTPU_ROUTER_REPLICAS=...` line on stdout, so the operator's Router
+(or bench.py --serve --replicas N, which wraps this) can connect:
+
+    python tools/launch.py --serve-replicas 4 python serve_my_model.py
 """
 from __future__ import annotations
 
@@ -85,7 +95,7 @@ def _free_port():
 
 def main():
     parser = argparse.ArgumentParser(description="Launch a distributed job")
-    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-n", "--num-workers", type=int, default=None)
     parser.add_argument("-s", "--num-servers", type=int, default=None)
     parser.add_argument("-H", "--hostfile", type=str, default=None)
     parser.add_argument("--launcher", choices=["local", "ssh", "mpi", "sge",
@@ -124,16 +134,72 @@ def main():
                              "with MXTPU_OBS_STALL_SECONDS for the "
                              "collective stall watchdog.  See "
                              "docs/observability.md")
+    parser.add_argument("--serve-replicas", type=int, default=0,
+                        help="launch a serving fleet instead of a PS/SPMD "
+                             "job: N copies of the command, each one "
+                             "router.ReplicaAgent process with its own "
+                             "exported MXTPU_ROUTER_PORT + "
+                             "MXTPU_REPLICA_ID; the full address list is "
+                             "exported to every replica and printed as "
+                             "one MXTPU_ROUTER_REPLICAS= line for the "
+                             "Router to connect to (docs/serving.md "
+                             "'Multi-replica tier')")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
-    if args.num_servers is None:
-        args.num_servers = args.num_workers
     if not args.command:
         parser.error("no command given")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    if args.serve_replicas:
+        if args.launcher != "local" or args.local_spmd:
+            parser.error("--serve-replicas implies the local launcher")
+        ports = [_free_port() for _ in range(args.serve_replicas)]
+        addrs = ",".join("127.0.0.1:%d" % p for p in ports)
+        # the line the operator's router (and bench.py --serve
+        # --replicas) reads back; flushed BEFORE the fleet spawns so a
+        # wrapper can start connecting while replicas warm up
+        print("MXTPU_ROUTER_REPLICAS=%s" % addrs, flush=True)
+        procs = []
+
+        # a terminated launcher must take its fleet down with it: the
+        # finally below never runs on SIGTERM (default handling exits
+        # without unwinding), which would orphan N serve_forever()
+        # processes holding ports and CPU
+        import signal as _signal
+
+        def _reap(signum, _frame):
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            sys.exit(128 + signum)
+
+        _signal.signal(_signal.SIGTERM, _reap)
+        _signal.signal(_signal.SIGINT, _reap)
+        for i, port in enumerate(ports):
+            env = dict(os.environ)
+            env["MXTPU_REPLICA_ID"] = str(i)
+            env["MXTPU_ROUTER_PORT"] = str(port)
+            env["MXTPU_ROUTER_REPLICAS"] = addrs
+            env["PYTHONPATH"] = (repo_root + os.pathsep
+                                 + os.environ.get("PYTHONPATH", ""))
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        try:
+            for p in procs:
+                rc |= p.wait()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+        sys.exit(rc)
+
+    if args.num_workers is None:
+        parser.error("-n/--num-workers is required (except with "
+                     "--serve-replicas)")
+    if args.num_servers is None:
+        args.num_servers = args.num_workers
     if args.local_spmd and args.launcher != "local":
         parser.error("--local-spmd implies the local launcher")
-
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     base_env = {
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": str(args.num_servers),

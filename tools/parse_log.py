@@ -29,8 +29,11 @@ cross-rank collective-schedule divergences from
 ``MXTPU_COLLECTIVE_CHECK=1``; docs/static_analysis.md), and the int8-
 quantization columns (``quant_clip_pct`` mean calibration clip rate,
 ``tenant_bits`` per-tenant serving numerics as ``name:8`` int8 /
-``name:16`` bf16 / ``name:32`` f32; docs/perf.md "Int8 serving").
-Older logs render '-' in columns they predate.
+``name:16`` bf16 / ``name:32`` f32; docs/perf.md "Int8 serving"), and
+the multi-replica router columns (``replicas_healthy`` live replica
+count, ``redispatches`` drain-on-death replays, ``route_p99``
+submit-to-result p99 through the tier; docs/serving.md "Multi-replica
+tier").  Older logs render '-' in columns they predate.
 
 With ``--cluster`` the input is the rank-0 CLUSTER JSONL
 (``MXTPU_OBS_CLUSTER_FILE``, written by the obs aggregator —
@@ -186,6 +189,18 @@ def parse_telemetry(lines):
                 for k, v in sorted(gauges.items())
                 if k.startswith("quant.tenant_bits."))
                 or None),
+            # multi-replica router columns (mxnet_tpu/router,
+            # docs/serving.md "Multi-replica tier"): live healthy-
+            # replica count, drain-on-death replays, and the
+            # submit-to-result p99 through the tier — '-' for logs
+            # that predate the router
+            "replicas_healthy": gauges.get("router.replicas_healthy"),
+            "redispatches": (counters.get("router.redispatches", 0)
+                             if any(k.startswith("router.")
+                                    for k in list(counters)
+                                    + list(gauges)) else None),
+            "route_p99": _hist_quantile(
+                hist.get("router.route_seconds", {}), 0.99),
         })
     return rows
 
@@ -247,7 +262,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "fusion_hit_pct", "wgrad_bf16", "frozen_bn",
                    "serve_qdepth", "fill_pct", "req_p99", "data_qdepth",
                    "decode_mbps", "comm_gbps", "overlap_pct", "retraces",
-                   "sched_div", "quant_clip_pct", "tenant_bits"]
+                   "sched_div", "quant_clip_pct", "tenant_bits",
+                   "replicas_healthy", "redispatches", "route_p99"]
 
 
 def _print_rows(rows, cols, fmt):
