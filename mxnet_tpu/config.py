@@ -238,6 +238,25 @@ REGISTRY = [
            "with a tighter ladder when >25% of the common bucket is "
            "padding (router/policy.py derive_ladder). 0 = adaptation "
            "off (ladders stay as deployed)"),
+    # ---- request-scoped tracing (obs/tracing.py;
+    #      docs/observability.md "Request tracing & SLOs") ----
+    EnvVar("MXTPU_TRACE_SAMPLE", float, 0.0,
+           "Head-based request-trace sampling fraction for the serving "
+           "tier: each Router.submit / ModelServer.submit mints a "
+           "(trace_id, span_id, sampled) context, and a sampled "
+           "request decomposes into router_queue/wire/replica_queue/"
+           "batch_fill/h2d/compute/readback/reply segments across the "
+           "router and replica traces (stitch with tools/obs_stitch.py"
+           ").  Requests that end in timeout/redispatch/error are "
+           "recorded regardless of the head verdict so every failure "
+           "is explained.  0 (default) = tracing entirely off — the "
+           "fast path books nothing"),
+    EnvVar("MXTPU_TRACE_BUFFER", int, 4096,
+           "In-process span-buffer capacity of the request tracer "
+           "(obs/tracing.py): the oldest MXTPU_TRACE_BUFFER spans are "
+           "kept per process, later ones drop (counted in "
+           "trace.spans_dropped); the profiler chrome mirror is "
+           "unaffected"),
     # ---- int8 post-training quantization (quant/; docs/perf.md "Int8
     #      serving", docs/serving.md) ----
     EnvVar("MXTPU_QUANT_CALIB_MODE", str, "minmax",

@@ -27,6 +27,13 @@ Three parts (docs/observability.md "Distributed observability"):
     skew and straggler attribution, and the connect handshake measures
     each rank's clock offset for trace stitching
     (``tools/obs_stitch.py``).
+  * :mod:`~mxnet_tpu.obs.tracing` — request-scoped distributed
+    tracing for the serving tier (docs/observability.md "Request
+    tracing & SLOs"): head-sampled per-request trace contexts ride the
+    router wire frames and decompose one request into router-queue /
+    wire / replica-queue / batch-fill / H2D / compute / readback /
+    reply segments, stitched across processes by the same
+    clock-offset machinery.
 
 :func:`bootstrap` arms whatever the environment configures; it is
 called from ``parallel.multihost.initialize()`` so a
@@ -36,8 +43,9 @@ without touching user code.
 from __future__ import annotations
 
 from . import recorder
+from . import tracing
 
-__all__ = ["recorder", "bootstrap"]
+__all__ = ["recorder", "tracing", "bootstrap"]
 
 _BOOTSTRAPPED = False
 

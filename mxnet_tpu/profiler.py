@@ -13,8 +13,8 @@ import threading
 import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "record_span", "record_counter", "register_thread_name",
-           "set_trace_meta"]
+           "record_span", "record_counter", "record_flow",
+           "register_thread_name", "set_trace_meta"]
 
 import os as _os
 
@@ -135,11 +135,14 @@ def spans_active():
     return _STATE["running"]
 
 
-def record_span(name, start_us, dur_us, cat="operator", tid=None):
+def record_span(name, start_us, dur_us, cat="operator", tid=None, args=None):
     """Record one span; called by executors and engine workers when
     profiling is on.  `tid` defaults to the REAL calling thread id so
     engine worker lanes render as separate rows in chrome://tracing
-    (reference SetOprStart/SetOprEnd record per-thread ProfileStat)."""
+    (reference SetOprStart/SetOprEnd record per-thread ProfileStat).
+    `args` (a plain dict) lands in the event's chrome ``args`` — the
+    request tracer (obs/tracing.py) carries trace/span/parent ids
+    there so stitched traces stay groupable per request."""
     if not _STATE["running"]:
         return
     own_thread = tid is None
@@ -148,8 +151,28 @@ def record_span(name, start_us, dur_us, cat="operator", tid=None):
     with _LOCK:
         if own_thread and tid not in _TID_NAMES:
             _TID_NAMES[tid] = threading.current_thread().name
-        _EVENTS.append({"name": name, "cat": cat, "ph": "X", "ts": start_us,
-                        "dur": dur_us, "pid": PID_HOST, "tid": tid})
+        ev = {"name": name, "cat": cat, "ph": "X", "ts": start_us,
+              "dur": dur_us, "pid": PID_HOST, "tid": tid}
+        if args:
+            ev["args"] = dict(args)
+        _EVENTS.append(ev)
+
+
+def record_flow(name, fid, phase, ts_us, tid=0, cat="trace"):
+    """Append one chrome FLOW endpoint (``phase`` ``"s"`` start /
+    ``"f"`` finish, bound by `fid` + `cat` + `name`): the causal
+    arrows the request tracer draws between a router-side span and the
+    replica-side span chain it triggered (obs/tracing.py; the two ends
+    live in different processes' traces and bind after
+    tools/obs_stitch.py merges them)."""
+    if not _STATE["running"]:
+        return
+    ev = {"name": name, "cat": cat, "ph": phase, "id": int(fid),
+          "ts": int(ts_us), "pid": PID_HOST, "tid": int(tid)}
+    if phase == "f":
+        ev["bp"] = "e"  # bind to the enclosing slice (chrome flow spec)
+    with _LOCK:
+        _EVENTS.append(ev)
 
 
 def register_thread_name(tid, name):

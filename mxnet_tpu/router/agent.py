@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 
 from ..base import MXNetError
 from ..serving.server import ModelServer
@@ -38,11 +39,13 @@ from . import wire
 __all__ = ["ReplicaAgent"]
 
 
-def _serving_extract():
-    """The ladder-adaptation slice of the telemetry registry: exact
-    cumulative fill accounting plus the request-latency histogram
-    moments.  Counters are process-wide, which is exactly right here —
-    one agent process serves one ModelServer."""
+def _serving_extract(tenants=()):
+    """The ladder-adaptation + SLO slice of the telemetry registry:
+    exact cumulative fill accounting, the request-latency histogram
+    moments, the queue/service split p99s (WHICH segment moved when a
+    tenant's p99 burns), and the per-tenant SLO ledger declared at
+    ``add_tenant(slo_ms=)``.  Counters are process-wide, which is
+    exactly right here — one agent process serves one ModelServer."""
     from .. import telemetry
 
     if not telemetry.enabled():
@@ -52,6 +55,18 @@ def _serving_extract():
     # deep copy (every histogram ladder) on that cadence is real work
     lat_count, lat_sum = telemetry.histogram_moments(
         "serving.request_seconds")
+    slo = {}
+    for t in tenants:
+        budget = telemetry.gauge_value("slo.budget_ms.%s" % t)
+        if budget is None:
+            continue
+        slo[t] = {
+            "budget_ms": budget,
+            "target": telemetry.gauge_value("slo.target.%s" % t),
+            "burn": telemetry.gauge_value("slo.burn.%s" % t),
+            "availability": telemetry.gauge_value(
+                "slo.availability.%s" % t),
+        }
     return {
         "slots_used": telemetry.counter_value("serving.batch_slots_used"),
         "slots_padded": telemetry.counter_value(
@@ -62,6 +77,13 @@ def _serving_extract():
             "serving.batch_fill_ratio"),
         "request_seconds_count": lat_count,
         "request_seconds_sum": lat_sum,
+        # the latency-localization split (docs/observability.md
+        # "Request tracing & SLOs"): queue-wait vs fill-to-resolution
+        "queue_p99": telemetry.histogram_quantile(
+            "serving.queue_seconds", 0.99),
+        "service_p99": telemetry.histogram_quantile(
+            "serving.service_seconds", 0.99),
+        "slo": slo,
     }
 
 
@@ -158,6 +180,22 @@ class ReplicaAgent:
                               ladder=self.ladder)
                 elif cmd == wire.SUBMIT:
                     self._handle_submit(conn, send_lock, info, arrays)
+                elif cmd == wire.CLOCK:
+                    # NTP-style clock leg (the obs/aggregate.py recipe):
+                    # echo the router's t0 plus our wall clock; the
+                    # router folds the pair into the stitch offset
+                    wire.send(conn, wire.CLOCK_R, lock=send_lock,
+                              t0=info.get("t0", 0.0),
+                              t_server=time.time())
+                elif cmd == wire.TRACEMETA:
+                    # the router's measured offset (router wall minus
+                    # ours): stamped into our profiler trace so
+                    # tools/obs_stitch.py can shift this replica's
+                    # spans onto the router's timeline
+                    from .. import profiler
+
+                    profiler.set_trace_meta(
+                        clock_offset_us=float(info.get("offset_us", 0.0)))
                 elif cmd == wire.HEALTH:
                     self._handle_health(conn, send_lock)
                 elif cmd == wire.WARMUP:
@@ -182,25 +220,43 @@ class ReplicaAgent:
                 pass
 
     def _handle_submit(self, conn, send_lock, info, arrays):
+        from ..obs import tracing
+
+        t_recv = time.time()
         req_id = info["req"]
         inputs = dict(zip(info["names"], arrays or []))
+        ctx = tracing.from_meta(info.get("trace"))
+        if tracing.enabled() and ctx is not None:
+            # close the router->replica causal flow arrow at receipt
+            tracing.flow(ctx, "submit", "f", t_recv)
         with self._server_lock:
             server = self._server
         try:
             fut = server.submit(info["tenant"], inputs,
-                                timeout_ms=info.get("timeout_ms"))
+                                timeout_ms=info.get("timeout_ms"),
+                                trace=ctx)
         except BaseException as e:  # noqa: BLE001 — travels the wire
             self._send_error(conn, send_lock, req_id, e)
             return
 
-        def _reply(f, _req=req_id, _conn=conn, _lock=send_lock):
+        def _reply(f, _req=req_id, _conn=conn, _lock=send_lock,
+                   _ctx=ctx, _t_recv=t_recv):
             exc = f.exception()
+            extra = {}
+            if tracing.enabled() and _ctx is not None and _ctx.sampled:
+                t_done = time.time()
+                # replica wall boundary stamps: the router maps them
+                # onto its own timeline with the HELLO clock offset and
+                # records the cross-process `wire`/`reply` segments
+                extra["trace_reply"] = {"t_recv": _t_recv,
+                                        "t_done": t_done}
+                tracing.flow(_ctx, "reply", "s", t_done)
             try:
                 if exc is not None:
                     self._send_error(_conn, _lock, _req, exc)
                 else:
                     wire.send(_conn, wire.RESULT, lock=_lock, req=_req,
-                              arrays=f.result())
+                              arrays=f.result(), **extra)
             except (ConnectionError, OSError):
                 pass  # router died mid-reply: its successor replays
 
@@ -218,7 +274,7 @@ class ReplicaAgent:
             health = self._server.health()
         health["replica"] = self.replica_id
         health["name"] = self.name
-        health["serving"] = _serving_extract()
+        health["serving"] = _serving_extract(health.get("tenants", ()))
         wire.send(conn, wire.HEALTH_R, lock=send_lock, **health)
 
     def _handle_warmup(self, conn, send_lock, info):

@@ -73,10 +73,14 @@ _REPLAYABLE_KINDS = ("AdmissionError", "ServerClosed")
 
 class _Flight:
     """One in-flight submission: the caller's future plus the
-    submit-time snapshot a replay is served from."""
+    submit-time snapshot a replay is served from.  ``trace`` is the
+    request's trace context (obs/tracing.py, None when tracing is
+    off); ``t_sent`` the monotonic stamp of the last wire send — the
+    ``router_queue`` / ``wire`` segment boundary."""
 
     __slots__ = ("req_id", "tenant", "inputs", "names", "future",
-                 "t_submit", "timeout_ms", "replica", "redispatches")
+                 "t_submit", "timeout_ms", "replica", "redispatches",
+                 "trace", "t_sent")
 
     def __init__(self, req_id, tenant, inputs, timeout_ms):
         from concurrent.futures import Future
@@ -93,6 +97,8 @@ class _Flight:
         self.t_submit = time.monotonic()
         self.replica = None
         self.redispatches = 0
+        self.trace = None
+        self.t_sent = None
 
     def fulfil(self, result):
         if not self.future.done():
@@ -115,7 +121,7 @@ class _Replica:
     __slots__ = ("addr", "name", "sock", "send_lock", "reader", "alive",
                  "health", "health_at", "inflight", "ladder", "tenants",
                  "rebucketing", "ctl_pending", "acks", "adapt_base",
-                 "adapt_at")
+                 "adapt_at", "offset_s")
 
     def __init__(self, addr):
         self.addr = addr
@@ -134,6 +140,12 @@ class _Replica:
         self.acks = _queue.Queue()
         self.adapt_base = None
         self.adapt_at = None
+        # router wall-clock minus replica wall-clock, measured at the
+        # HELLO handshake (3-ping NTP fold, min-RTT sample — the
+        # obs/aggregate.py recipe): replica_wall + offset_s lands on
+        # the router's timeline.  The router's trace segments and
+        # tools/obs_stitch.py both key off it.
+        self.offset_s = 0.0
 
 
 class Router:
@@ -259,6 +271,32 @@ class Router:
         try:
             wire.send(rep.sock, wire.HELLO, lock=rep.send_lock)
             cmd, info, _ = wire.recv(rep.sock)
+            # clock offset vs this replica, measured INSIDE the bounded
+            # handshake (frames on the connection are handled in order,
+            # so the pings are synchronous): three NTP folds, keep the
+            # minimum-RTT sample — obs/aggregate.py's recipe, now also
+            # taken at ReplicaAgent HELLO so serving-fleet traces
+            # stitch like SPMD ranks do
+            best = None
+            for _ in range(3):
+                t0 = time.time()
+                wire.send(rep.sock, wire.CLOCK, lock=rep.send_lock, t0=t0)
+                ccmd, cinfo, _arr = wire.recv(rep.sock)
+                t1 = time.time()
+                if ccmd != wire.CLOCK_R:
+                    continue
+                rtt = t1 - t0
+                # sample = replica wall minus router wall
+                sample = float(cinfo["t_server"]) - 0.5 * (t0 + t1)
+                if best is None or rtt < best[0]:
+                    best = (rtt, sample)
+            if best is not None:
+                rep.offset_s = -best[1]  # router minus replica
+                # hand the replica its stitch metadata: its dumped
+                # trace carries clock_offset_us so obs_stitch can
+                # shift it onto the router's timeline
+                wire.send(rep.sock, wire.TRACEMETA, lock=rep.send_lock,
+                          offset_us=rep.offset_s * 1e6)
         except (ConnectionError, OSError):
             if not aborted.is_set():
                 raise
@@ -307,15 +345,27 @@ class Router:
                 names.update(rep.tenants)
         return sorted(names)
 
-    def submit(self, tenant, inputs, timeout_ms=None):
+    def submit(self, tenant, inputs, timeout_ms=None, trace=None):
         """Enqueue one request on the least-loaded healthy replica;
         returns a Future resolving to [one array per model output].
         Raises NoHealthyReplica when the whole fleet is unroutable and
         RouterClosed after close() — per-request failures (timeouts,
-        validation) arrive on the future, exactly like ModelServer."""
+        validation) arrive on the future, exactly like ModelServer.
+
+        `trace` propagates an upstream trace context; when tracing is
+        armed (``MXTPU_TRACE_SAMPLE`` > 0) and none is given, a
+        head-sampled context is minted HERE — Router.submit is the
+        trace root, and the context rides the SUBMIT frame so the
+        replica's segments join the same trace
+        (docs/observability.md "Request tracing & SLOs")."""
+        from ..obs import tracing
+
         flight = _Flight(self._next_req(), tenant, inputs,
                          self._default_timeout_ms if timeout_ms is None
                          else timeout_ms)
+        if trace is None and tracing.enabled():
+            trace = tracing.new_trace()
+        flight.trace = trace
         self._place(flight)
         return flight.future
 
@@ -410,6 +460,8 @@ class Router:
                     flight.replica = name
                     self._flights[flight.req_id] = flight
                     rep.inflight.add(flight.req_id)
+        from ..obs import tracing
+
         if fail_with is not None:
             if book_lost and telemetry.enabled():
                 # a failed DEATH replay is a lost caller future (the
@@ -419,16 +471,33 @@ class Router:
                 # loss, the request got the answer it had coming)
                 telemetry.inc("router.lost")
             flight.fail(fail_with)
+            if tracing.enabled() and flight.trace is not None:
+                # failures are always explained, sampled or not
+                tracing.record_outcome(
+                    flight.trace,
+                    "timeout" if isinstance(fail_with, RequestTimeout)
+                    else "error",
+                    flight.t_submit, time.monotonic(), side="router",
+                    tenant=flight.tenant, error=type(fail_with).__name__)
             return
         if replay and telemetry.enabled():
             telemetry.inc("router.redispatches")
+        trace_meta = None
+        if tracing.enabled() and flight.trace is not None:
+            trace_meta = tracing.to_meta(flight.trace)
+        flight.t_sent = time.monotonic()
         try:
             wire.send(rep.sock, wire.SUBMIT, lock=rep.send_lock,
                       arrays=flight.inputs, req=flight.req_id,
                       tenant=flight.tenant, names=flight.names,
-                      timeout_ms=wire_timeout)
+                      timeout_ms=wire_timeout, trace=trace_meta)
         except (ConnectionError, OSError) as e:
             self._on_death(rep, e)
+            return
+        if tracing.enabled() and flight.trace is not None:
+            # open the router->replica causal flow arrow at the send
+            tracing.flow(flight.trace, "submit", "s",
+                         tracing.wall(flight.t_sent))
 
     def warmup(self, timeout=600.0):
         """Broadcast WARMUP so every replica compiles every (tenant,
@@ -537,10 +606,17 @@ class Router:
             self._flights.clear()
             for rep in self._replicas.values():
                 rep.inflight.clear()
+        from ..obs import tracing
+
         for flight in doomed:
             flight.fail(RouterClosed(
                 "Router.close(drain=False) dropped the in-flight request "
                 "to tenant %r" % flight.tenant))
+            if tracing.enabled() and flight.trace is not None:
+                tracing.record_outcome(
+                    flight.trace, "error", flight.t_submit,
+                    time.monotonic(), side="router",
+                    tenant=flight.tenant, error="RouterClosed")
         self._stop.set()
         self._poller.join(timeout=5.0)
         for rep in list(self._replicas.values()):
@@ -589,7 +665,7 @@ class Router:
                 with self._lock:
                     self._book.beat(rep.name)
                 if cmd == wire.RESULT:
-                    self._resolve(rep, info["req"], arrays)
+                    self._resolve(rep, info, arrays)
                 elif cmd == wire.RERROR:
                     self._resolve_error(rep, info)
                 elif cmd == wire.HEALTH_R:
@@ -608,17 +684,48 @@ class Router:
             self._lock.notify_all()
         return flight
 
-    def _resolve(self, rep, req_id, arrays):
+    def _resolve(self, rep, info, arrays):
         from .. import telemetry
+        from ..obs import tracing
 
-        flight = self._pop_flight(rep, req_id)
+        flight = self._pop_flight(rep, info["req"])
         if flight is None:
             return  # late duplicate of a replayed request: already owned
+        now = time.monotonic()
         flight.fulfil(list(arrays or []))
         if telemetry.enabled():
             telemetry.inc("router.requests")
-            telemetry.observe("router.route_seconds",
-                              time.monotonic() - flight.t_submit)
+            telemetry.observe("router.route_seconds", now - flight.t_submit)
+        if tracing.enabled() and flight.trace is not None:
+            tr = flight.trace
+            if tr.sampled:
+                t_sent = (flight.t_sent if flight.t_sent is not None
+                          else flight.t_submit)
+                # router-side segments: submit -> wire send is
+                # router_queue; the cross-process gaps are named too,
+                # from the replica's boundary stamps mapped onto this
+                # clock with the HELLO offset — so the whole chain
+                # tiles [submit, resolve] with no unattributed gap
+                tracing.record(tr, "router_queue", flight.t_submit,
+                               t_sent, replica=rep.name)
+                reply = info.get("trace_reply") or {}
+                if reply:
+                    t_recv_w = float(reply["t_recv"]) + rep.offset_s
+                    t_done_w = float(reply["t_done"]) + rep.offset_s
+                    tracing.record(tr, "wire", tracing.wall(t_sent),
+                                   t_recv_w, wall_time=True,
+                                   replica=rep.name)
+                    tracing.record(tr, "reply", t_done_w,
+                                   tracing.wall(now), wall_time=True,
+                                   replica=rep.name)
+                tracing.flow(tr, "reply", "f", tracing.wall(now))
+            # a redispatched request that SUCCEEDED still records its
+            # root span (force) — "ended in redispatch" is one of the
+            # always-explained outcomes
+            tracing.record_outcome(tr, "ok", flight.t_submit, now,
+                                   force=flight.redispatches > 0,
+                                   side="router", tenant=flight.tenant,
+                                   redispatches=flight.redispatches)
 
     def _resolve_error(self, rep, info):
         req_id = info.get("req")
@@ -646,12 +753,20 @@ class Router:
             self._lock.notify_all()
         if flight is None:
             return
+        from ..obs import tracing
+
         mapped = _ERROR_KINDS.get(kind, MXNetError)(
             "replica %s: %s" % (rep.name, msg))
         if will_replay:
             # the REPLICA is full/draining, the request is fine: replay
             # to a peer — and if none can take it, surface the ORIGINAL
             # overload error (the ModelServer contract), not a death
+            if tracing.enabled() and flight.trace is not None:
+                # forced marker: a redispatched request is explained
+                # end-to-end even when head-unsampled
+                tracing.record_event(flight.trace, "redispatch",
+                                     force=True, reason=kind,
+                                     replica=rep.name)
             try:
                 self._place(flight, exclude=(rep.name,), replay=True,
                             fallback_exc=mapped)
@@ -661,6 +776,12 @@ class Router:
                     self._lock.notify_all()
             return
         flight.fail(mapped)
+        if tracing.enabled() and flight.trace is not None:
+            tracing.record_outcome(
+                flight.trace,
+                "timeout" if kind == "RequestTimeout" else "error",
+                flight.t_submit, time.monotonic(), side="router",
+                tenant=flight.tenant, error=kind, replica=rep.name)
 
     def _note_health(self, rep, info):
         now = time.monotonic()
@@ -741,6 +862,8 @@ class Router:
         # replica's ack queue — the death is known NOW; without the
         # sentinel they would sit out their full timeout
         rep.acks.put({"error": "replica %s died: %s" % (rep.name, exc)})
+        from ..obs import tracing
+
         for flight in doomed:
             try:
                 if flight.redispatches >= self._redispatch_cap:
@@ -751,8 +874,18 @@ class Router:
                                            self._redispatch_cap)))
                     if telemetry.enabled():
                         telemetry.inc("router.lost")
+                    if tracing.enabled() and flight.trace is not None:
+                        tracing.record_outcome(
+                            flight.trace, "error", flight.t_submit,
+                            time.monotonic(), side="router",
+                            tenant=flight.tenant, error="ReplicaDead",
+                            replica=rep.name)
                     continue
                 flight.redispatches += 1
+                if tracing.enabled() and flight.trace is not None:
+                    tracing.record_event(flight.trace, "redispatch",
+                                         force=True, reason="replica_death",
+                                         replica=rep.name)
                 self._place(flight, exclude=(rep.name,), replay=True)
             finally:
                 with self._lock:

@@ -25,11 +25,13 @@ from ..base import MXNetError
 from ..parallel.dist import _meta, _parse_meta, _recv_frame, _send_frame
 
 __all__ = ["HELLO", "SUBMIT", "RESULT", "RERROR", "HEALTH", "HEALTH_R",
-           "WARMUP", "CLOSE", "ACK", "pack_arrays", "unpack_arrays",
-           "pyify", "send", "recv"]
+           "WARMUP", "CLOSE", "ACK", "CLOCK", "CLOCK_R", "TRACEMETA",
+           "pack_arrays", "unpack_arrays", "pyify", "send", "recv"]
 
 # frame commands — above the dist.py control-plane ids (1..17) so a
 # cross-plane mis-delivery is an unknown command, never a silent alias
+# (the obs aggregation plane uses 41..45 on ITS sockets; the serve
+# plane skips that block so a cross-plane frame still fails loudly)
 HELLO = 32      # router -> agent on connect; agent replies HELLO
 SUBMIT = 33     # router -> agent: one inference request (arrays payload)
 RESULT = 34     # agent -> router: resolved outputs for req id
@@ -39,6 +41,10 @@ HEALTH_R = 37   # agent -> router: health() + serving telemetry extract
 WARMUP = 38     # router -> agent: (re)warm, optional new bucket ladder
 CLOSE = 39      # router -> agent: shut the replica down
 ACK = 40        # agent -> router: control-op acknowledgement
+CLOCK = 48      # router -> agent: NTP-style clock ping (t0)
+CLOCK_R = 49    # agent -> router: clock reply (t0 echoed + t_server)
+TRACEMETA = 50  # router -> agent: measured clock offset for the
+#                 replica's trace stitch metadata (no reply)
 
 
 def pyify(obj):

@@ -52,11 +52,23 @@ class ServerClosed(MXNetError):
 
 
 class Request:
-    """One pending inference request."""
+    """One pending inference request.
 
-    __slots__ = ("tenant", "inputs", "future", "arrival", "deadline")
+    ``taken_at`` (stamped by :meth:`RequestQueue.take`) and
+    ``service_at`` (stamped at the top of the fill that serves it)
+    split the request's life into queue-wait and service; resolution —
+    :meth:`fulfil` OR :meth:`fail` — books BOTH halves plus the
+    combined latency with an outcome label, so the p99 histograms
+    include the worst requests (timeouts, failed fills) instead of
+    silently excluding them.  ``trace`` is the request's
+    :class:`~mxnet_tpu.obs.tracing.TraceContext` (None when tracing is
+    off); ``slo`` an optional ``(budget_s, target)`` pair declared at
+    ``add_tenant`` feeding the ``slo.*`` burn/availability gauges."""
 
-    def __init__(self, tenant, inputs, timeout_s):
+    __slots__ = ("tenant", "inputs", "future", "arrival", "deadline",
+                 "trace", "slo", "taken_at", "service_at", "_booked")
+
+    def __init__(self, tenant, inputs, timeout_s, trace=None, slo=None):
         self.tenant = tenant
         # SNAPSHOT the inputs (the engine-op operand discipline,
         # ndarray._snapshot): the caller may refill its buffer the
@@ -66,15 +78,73 @@ class Request:
         self.future = Future()
         self.arrival = time.monotonic()
         self.deadline = self.arrival + float(timeout_s)
+        self.trace = trace
+        self.slo = slo
+        self.taken_at = None
+        self.service_at = None
+        self._booked = False
+
+    def _book(self, outcome):
+        """Book resolution telemetry ONCE: combined + queue/service
+        split latency histograms (outcome-labeled counters beside
+        them), the per-tenant SLO ledger, and — when tracing is armed —
+        the request's outcome span (forced for failures, so an
+        unsampled timeout is still explained)."""
+        if self._booked:
+            return
+        self._booked = True
+        now = time.monotonic()
+        from .. import telemetry
+
+        tenant = self.tenant
+        total = now - self.arrival
+        q_end = self.taken_at if self.taken_at is not None else now
+        if telemetry.enabled():
+            telemetry.inc("serving.outcomes.%s" % outcome)
+            telemetry.observe("serving.request_seconds", total)
+            telemetry.observe("serving.request_seconds.%s" % tenant, total)
+            telemetry.observe("serving.queue_seconds", q_end - self.arrival)
+            telemetry.observe("serving.queue_seconds.%s" % tenant,
+                              q_end - self.arrival)
+            if self.service_at is not None:
+                telemetry.observe("serving.service_seconds",
+                                  now - self.service_at)
+                telemetry.observe("serving.service_seconds.%s" % tenant,
+                                  now - self.service_at)
+            if outcome == "ok":
+                telemetry.inc("serving.requests")
+                telemetry.inc("serving.requests.%s" % tenant)
+            if self.slo is not None:
+                budget_s, target = self.slo
+                good = outcome == "ok" and total <= budget_s
+                telemetry.inc("slo.good.%s" % tenant if good
+                              else "slo.bad.%s" % tenant)
+                g = telemetry.counter_value("slo.good.%s" % tenant)
+                b = telemetry.counter_value("slo.bad.%s" % tenant)
+                n = g + b
+                telemetry.set_gauge("slo.availability.%s" % tenant, g / n)
+                telemetry.set_gauge(
+                    "slo.burn.%s" % tenant,
+                    (b / n) / max(1e-9, 1.0 - target))
+        from ..obs import tracing
+
+        if tracing.enabled() and self.trace is not None:
+            tracing.record_outcome(self.trace, outcome, self.arrival, now,
+                                   side="server", tenant=tenant)
 
     def fail(self, exc):
         """set_exception that tolerates caller-cancelled futures — a
-        cancelled request must never kill the batcher thread."""
+        cancelled request must never kill the batcher thread.  Books
+        the resolution latency with its outcome label (timeout vs
+        error) — the satellite fix: p99 used to silently exclude
+        exactly the requests that blew it."""
         if not self.future.done():
             try:
                 self.future.set_exception(exc)
             except InvalidStateError:  # cancelled in the check window
-                pass
+                return
+            self._book("timeout" if isinstance(exc, RequestTimeout)
+                       else "error")
 
     def fulfil(self, result):
         """set_result with the same cancellation tolerance."""
@@ -82,7 +152,8 @@ class Request:
             try:
                 self.future.set_result(result)
             except InvalidStateError:
-                pass
+                return
+            self._book("ok")
 
 
 class RequestQueue:
@@ -229,7 +300,15 @@ class RequestQueue:
             while dq and len(out) < limit:
                 req = dq.popleft()
                 self._depth -= 1
-                (expired if now >= req.deadline else out).append(req)
+                if now >= req.deadline:
+                    expired.append(req)
+                else:
+                    # dequeue-side queue-wait stamp: everything before
+                    # this instant books as serving.queue_seconds,
+                    # everything after as service (an expired request
+                    # never dequeued — its whole life was queue)
+                    req.taken_at = now
+                    out.append(req)
             self._note_depth(tenant)
         for req in expired:
             if telemetry.enabled():

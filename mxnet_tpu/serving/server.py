@@ -57,6 +57,7 @@ class ModelServer:
                              else config.get("MXTPU_SERVE_WAIT_MS")) / 1e3
         self._queue = RequestQueue(max_queue if max_queue is not None
                                    else config.get("MXTPU_SERVE_MAX_QUEUE"))
+        self._slo = {}  # tenant -> (budget_s, target) declared at add_tenant
         self._sessions = {}
         self._lock = threading.Lock()
         self._stopping = False
@@ -75,7 +76,8 @@ class ModelServer:
     # ------------------------------------------------------------------
     # client surface
     # ------------------------------------------------------------------
-    def add_tenant(self, name, predictor, dtype_mode=None):
+    def add_tenant(self, name, predictor, dtype_mode=None, slo_ms=None,
+                   slo_target=0.999):
         """Register one model under `name`.  Allowed while serving — a
         new tenant starts empty and simply joins the fairness policy.
 
@@ -85,7 +87,15 @@ class ModelServer:
         signature cache, so mixed bf16/int8 tenants compile one program
         per (tenant, bucket, mode)).  `dtype_mode` here is an assertion
         only: pass it to fail FAST when the wired predictor serves a
-        different mode than the deployment intended."""
+        different mode than the deployment intended.
+
+        ``slo_ms`` declares the tenant's per-request latency budget:
+        every resolution then updates the ``slo.availability.<tenant>``
+        gauge (fraction of requests that resolved OK within the
+        budget) and ``slo.burn.<tenant>`` — the error-budget burn rate
+        ``bad_fraction / (1 - slo_target)``, 1.0 = burning exactly the
+        declared budget.  Shipped to the router in every HEALTH reply
+        (docs/observability.md "Request tracing & SLOs")."""
         mode = getattr(predictor, "dtype_mode", "f32")
         if dtype_mode is not None and dtype_mode != mode:
             raise MXNetError(
@@ -94,6 +104,15 @@ class ModelServer:
                 "construction (build it with dtype_mode=%r and, for "
                 "int8, a calib_table)" % (name, dtype_mode, mode,
                                           dtype_mode))
+        slo = None
+        if slo_ms is not None:
+            target = float(slo_target)
+            if not 0.0 < target < 1.0:
+                raise MXNetError(
+                    "tenant %r: slo_target must be a fraction in (0, 1) "
+                    "(the share of requests that must meet the %s ms "
+                    "budget), got %r" % (name, slo_ms, slo_target))
+            slo = (float(slo_ms) / 1e3, target)
         with self._lock:
             if self._closed:
                 raise ServerClosed("cannot add tenant %r: server is closed"
@@ -101,6 +120,8 @@ class ModelServer:
             if name in self._sessions:
                 raise MXNetError("tenant %r already registered" % name)
             self._sessions[name] = TenantSession(name, predictor, self.ladder)
+            if slo is not None:
+                self._slo[name] = slo
             self._queue.register(name)
         from .. import telemetry
 
@@ -110,12 +131,15 @@ class ModelServer:
             # 32 = f32 (docs/observability.md)
             telemetry.set_gauge("quant.tenant_bits.%s" % name,
                                 {"int8": 8, "bf16": 16}.get(mode, 32))
+            if slo is not None:
+                telemetry.set_gauge("slo.budget_ms.%s" % name, slo[0] * 1e3)
+                telemetry.set_gauge("slo.target.%s" % name, slo[1])
 
     @property
     def tenants(self):
         return sorted(self._sessions)
 
-    def submit(self, tenant, inputs, timeout_ms=None):
+    def submit(self, tenant, inputs, timeout_ms=None, trace=None):
         """Enqueue one request; returns a `concurrent.futures.Future`
         resolving to [one numpy array per model output], each
         sample-shaped (the batcher owns the batch axis end to end).
@@ -123,13 +147,24 @@ class ModelServer:
         after close(), and a clear error for unknown tenants or
         malformed inputs (validated HERE so a bad request fails its own
         caller immediately instead of poisoning the fill it would have
-        been co-batched into)."""
+        been co-batched into).
+
+        `trace` propagates an upstream request trace (the router's
+        agent passes the context that rode the SUBMIT frame); when
+        tracing is armed and none is given, a head-sampled context is
+        minted here — ModelServer.submit is the trace root for direct
+        callers."""
+        from ..obs import tracing
+
+        if trace is None and tracing.enabled():
+            trace = tracing.new_trace()
         timeout_s = (float(timeout_ms) / 1e3 if timeout_ms is not None
                      else self._timeout_s)
         # build (and SNAPSHOT) the request before taking the lock —
         # concurrent submitters must not serialize on each other's
         # input copies
-        req = Request(tenant, inputs, timeout_s)
+        req = Request(tenant, inputs, timeout_s, trace=trace,
+                      slo=self._slo.get(tenant))
         # closed check, tenant lookup + validation, and enqueue share
         # the close()/add_tenant() lock: a request that passes is
         # enqueued before close() can drain/fail the queue (no future

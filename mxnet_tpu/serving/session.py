@@ -141,11 +141,31 @@ class TenantSession:
         """Run one fill: pack `reqs` into the smallest bucket that holds
         them, stage, dispatch, and hand the readback to the engine.
         Returns after the compute is DISPATCHED (not complete); the
-        requests' futures resolve from the readback op."""
+        requests' futures resolve from the readback op.
+
+        Tracing (docs/observability.md "Request tracing & SLOs"): the
+        fill opens ONE `fill` span; every head-sampled request in it
+        records contiguous `replica_queue` / `batch_fill` / `h2d` /
+        `compute` segments here (sharing boundary timestamps, so the
+        segments tile the request's life gap-free) and a `readback`
+        segment from the readback op — each linked to the fill span by
+        its id."""
         import jax
 
         from .. import profiler, telemetry
+        from ..obs import tracing
 
+        t_fill0 = time.monotonic()
+        for r in reqs:
+            # service starts NOW: everything before this fill was
+            # queue-wait (serving.queue_seconds), everything after is
+            # service (serving.service_seconds) — Request._book reads
+            # both stamps at resolution
+            r.service_at = t_fill0
+        traced = ()
+        if tracing.enabled():
+            traced = tuple(r for r in reqs
+                           if r.trace is not None and r.trace.sampled)
         n = len(reqs)
         bucket = choose_bucket(self._ladder, n)
         exe, fn = self._program(bucket)
@@ -172,11 +192,13 @@ class TenantSession:
                 return
             _q.put((placed, None))
 
+        t_stage0 = time.monotonic()
         engine.push(_stage, write_vars=(slot_var,), atomic=False,
                     name="serve_stage")
         staged, err = handoff.get()
         if err is not None:
             raise err
+        t_staged = time.monotonic()
         other_vals, aux_vals = exe.serve_args(self._input_names)
         from ..obs import recorder
 
@@ -205,9 +227,30 @@ class TenantSession:
                 if first_run:
                     recorder.record("compile", "exit", rec_seq)
                 recorder.record("serve", "exit", rec_seq)
+        t_done = time.monotonic()
         tenant = self.name
+        fill_sid = None
+        if tracing.enabled() and traced:
+            # ONE fill span per fill; each sampled request's segments
+            # share the fill's boundary timestamps so the chain tiles
+            # [arrival, resolution] without gaps — the acceptance test
+            # sums exactly these
+            fill_sid = tracing.record(traced[0].trace, "fill", t_fill0,
+                                      t_done, tenant=tenant,
+                                      bucket=bucket, n=n)
+            for r in traced:
+                taken = r.taken_at if r.taken_at is not None else t_fill0
+                tracing.record(r.trace, "replica_queue", r.arrival, taken,
+                               tenant=tenant)
+                tracing.record(r.trace, "batch_fill", taken, t_stage0,
+                               fill=fill_sid)
+                tracing.record(r.trace, "h2d", t_stage0, t_staged,
+                               fill=fill_sid)
+                tracing.record(r.trace, "compute", t_staged, t_done,
+                               fill=fill_sid)
 
-        def _readback(_outs=outs, _reqs=reqs, _bucket=bucket, _tenant=tenant):
+        def _readback(_outs=outs, _reqs=reqs, _bucket=bucket,
+                      _traced=traced, _fill=fill_sid, _t0=t_done):
             try:
                 host_outs = [_np.asarray(o) for o in _outs]
                 for ho in host_outs:
@@ -217,23 +260,23 @@ class TenantSession:
                             "output shape %s from a bucket-%d fill (a "
                             "batch-reducing head cannot be unbatched per "
                             "request)" % (tuple(ho.shape), _bucket))
-                now = time.monotonic()
-                tel = telemetry.enabled()
-                if tel:
+                if telemetry.enabled():
                     telemetry.inc("executor.d2h_bytes",
                                   sum(int(ho.nbytes) for ho in host_outs))
                 for i, r in enumerate(_reqs):
                     if r.future.cancelled():
                         continue
+                    # fulfil books the request/queue/service latency
+                    # histograms + outcome counters (Request._book)
                     r.fulfil([ho[i] for ho in host_outs])
-                    if tel:
-                        telemetry.inc("serving.requests")
-                        telemetry.inc("serving.requests.%s" % _tenant)
-                        telemetry.observe("serving.request_seconds",
-                                          now - r.arrival)
-                        telemetry.observe(
-                            "serving.request_seconds.%s" % _tenant,
-                            now - r.arrival)
+                t_end = time.monotonic()
+                if telemetry.enabled():
+                    telemetry.observe("serving.readback_seconds",
+                                      t_end - _t0)
+                if tracing.enabled():
+                    for r in _traced:
+                        tracing.record(r.trace, "readback", _t0, t_end,
+                                       fill=_fill)
             except BaseException as e:
                 for r in _reqs:
                     r.fail(e)
@@ -246,6 +289,11 @@ class TenantSession:
             telemetry.inc("serving.batch_slots_used", n)
             telemetry.inc("serving.batch_slots_padded", bucket - n)
             telemetry.set_gauge("serving.batch_fill_ratio", n / bucket)
+            # per-segment fill histograms: with the queue/service split
+            # these are what let parse_log/health say WHICH segment
+            # moved when a tenant's p99 burns
+            telemetry.observe("serving.h2d_seconds", t_staged - t_stage0)
+            telemetry.observe("serving.compute_seconds", t_done - t_staged)
         return bucket
 
     def drain(self):

@@ -41,7 +41,8 @@ import time
 __all__ = [
     "enabled", "set_enabled", "inc", "set_gauge", "observe",
     "observe_values", "attach_value_histogram", "ValueHistogram",
-    "counter_value", "gauge_value", "snapshot", "reset", "flush",
+    "counter_value", "gauge_value", "histogram_moments",
+    "histogram_quantile", "snapshot", "reset", "flush",
     "rank_suffixed", "note_retrace", "peak_flops", "flops_of_jaxpr",
     "TIME_BUCKETS", "BYTE_BUCKETS", "COUNT_BUCKETS",
 ]
@@ -481,6 +482,32 @@ def histogram_moments(name):
     with _LOCK:
         h = _HISTOGRAMS.get(name)
         return (0, 0.0) if h is None else (h.count, h.sum)
+
+
+def histogram_quantile(name, q):
+    """Point-read quantile of one histogram without a full snapshot —
+    upper-bucket-boundary convention, the SAME math as
+    ``tools/parse_log.py`` (the probe and the rendered table must
+    never disagree on what p99 means).  None when the histogram does
+    not exist or is empty.  Value-range histograms answer through
+    their own interpolated :meth:`ValueHistogram.quantile`."""
+    with _LOCK:
+        h = _HISTOGRAMS.get(name)
+        if h is None:
+            return None
+        if isinstance(h, ValueHistogram):
+            # per-histogram lock is a leaf under the registry lock (the
+            # observe path takes them in the same order)
+            return h.quantile(q)
+        if not h.count:
+            return None
+        target = q * h.count
+        seen = 0
+        for b, c in zip(h.boundaries, h.bucket_counts):
+            seen += c
+            if seen >= target:
+                return float(b)
+        return h.max
 
 
 def snapshot():

@@ -55,6 +55,16 @@ def main():
     agent.warmup()
     print("AGENT_PORT=%d" % agent.port, flush=True)
     agent.serve_forever()
+    # profiled fleet (the stitched-trace acceptance test exports
+    # MXNET_PROFILER_AUTOSTART=1 + a shared MXNET_PROFILER_FILENAME):
+    # dump this replica's trace once CLOSE drained us — the path
+    # auto-suffixes .r<MXTPU_PROCESS_ID> and carries the clock offset
+    # the router measured at HELLO (tools/obs_stitch.py input)
+    if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") not in ("", "0"):
+        from mxnet_tpu import profiler
+
+        profiler.profiler_set_state("stop")
+        print("AGENT_TRACE=%s" % profiler.dump_profile(), flush=True)
 
 
 if __name__ == "__main__":

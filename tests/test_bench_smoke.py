@@ -131,6 +131,38 @@ def test_bench_serve_smoke_reports_load_row():
 
 
 @pytest.mark.slow
+def test_bench_serve_smoke_trace_overhead_within_noise():
+    """bench.py --serve --smoke --trace-ab: the request-tracing
+    overhead pin (ISSUE 15 acceptance — overhead <=1% at
+    MXTPU_TRACE_SAMPLE=0.01).  The same serving load runs back-to-back
+    with sampling off vs armed, 3 timed chunks per side (the --ab
+    stdev machinery), and the row must report the delta within noise —
+    bench.py asserts it internally under --smoke, this pin keeps the
+    harness from silently rotting."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXTPU_TRACE_SAMPLE", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--smoke", "--trace-ab"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == "trace_overhead" and out["smoke"] is True
+    assert out["a"]["img_s"] > 0 and out["b"]["img_s"] > 0
+    # both sides carry their own stdev and the delta is computed from
+    # the sides it reports (the --ab row contract)
+    expect = round((out["a"]["img_s"] - out["b"]["img_s"])
+                   / out["a"]["img_s"] * 100.0, 3)
+    assert abs(out["overhead_pct"] - expect) < 0.05
+    # the armed side really minted sampling decisions (every B-side
+    # submit draws one — 0 would mean tracing never armed), and the
+    # timed windows were compile-free
+    assert out["sampling_decisions"] > 0
+    assert out["compile_misses_timed"] == 0
+    assert out["overhead_pct"] <= max(1.0, 2.0 * out["noise_pct"])
+
+
+@pytest.mark.slow
 def test_bench_serve_replicas_smoke_scaling_row():
     """bench.py --serve --replicas 1,2 --smoke: the multi-replica tier
     row (docs/serving.md "Multi-replica tier") launches each fleet via

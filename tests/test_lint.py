@@ -922,12 +922,15 @@ def test_e004_bucket_telemetry_clean_when_guarded(tmp_path):
 def test_repo_gate_sweeps_the_obs_package():
     """ISSUE 11 pin: the gate walk covers mxnet_tpu/obs/ — the flight
     recorder's record() sits on the fused-dispatch hot path, so the
-    E004 guard contract applies there exactly as to telemetry."""
+    E004 guard contract applies there exactly as to telemetry.
+    tracing.py (ISSUE 15) joins the list: its record/flow calls sit
+    once per SERVED REQUEST, the serving tier's hottest sites."""
     from tools.analysis.core import iter_py_files
 
     files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
     swept = {os.path.relpath(f, ROOT) for f in files}
-    for mod in ("__init__", "recorder", "watchdog", "aggregate"):
+    for mod in ("__init__", "recorder", "watchdog", "aggregate",
+                "tracing"):
         assert os.path.join("mxnet_tpu", "obs", "%s.py" % mod) in swept
 
 
@@ -972,6 +975,50 @@ def test_e004_fires_on_unguarded_recorder_record(tmp_path):
 
 def test_e004_recorder_record_clean_when_guarded(tmp_path):
     findings, _, _ = _lint_src(tmp_path, E004_RECORDER_HOT_PATH_GUARDED)
+    assert findings == []
+
+
+# the request-tracer hot path (ISSUE 15, serving/session.py dispatch +
+# router/router.py resolve): tracing.record/record_outcome/flow run
+# once per SERVED REQUEST — unguarded, every request pays monotonic
+# stamps, segment dicts, and attr formatting even with tracing off
+# (MXTPU_TRACE_SAMPLE=0), exactly the regression E004 exists for.
+E004_TRACING_HOT_PATH = """
+from mxnet_tpu.obs import tracing
+
+
+def resolve_fill(reqs, t_stage0, t_staged, t_done, fill_sid):
+    for r in reqs:
+        tracing.record(r.trace, "h2d", t_stage0, t_staged, fill=fill_sid)
+        tracing.record(r.trace, "compute", t_staged, t_done, fill=fill_sid)
+        tracing.record_outcome(r.trace, "ok", r.arrival, t_done)
+    tracing.flow(reqs[0].trace, "reply", "s", t_done)
+"""
+
+E004_TRACING_HOT_PATH_GUARDED = """
+from mxnet_tpu.obs import tracing
+
+
+def resolve_fill(reqs, t_stage0, t_staged, t_done, fill_sid):
+    if not tracing.enabled():
+        return
+    for r in reqs:
+        tracing.record(r.trace, "h2d", t_stage0, t_staged, fill=fill_sid)
+        tracing.record(r.trace, "compute", t_staged, t_done, fill=fill_sid)
+        tracing.record_outcome(r.trace, "ok", r.arrival, t_done)
+    tracing.flow(reqs[0].trace, "reply", "s", t_done)
+"""
+
+
+def test_e004_fires_on_unguarded_tracing_record(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_TRACING_HOT_PATH)
+    got = _ids(findings)
+    assert got.count("E004") == 4, findings
+    assert all("tracing.enabled()" in f.message for f in findings)
+
+
+def test_e004_tracing_record_clean_when_guarded(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_TRACING_HOT_PATH_GUARDED)
     assert findings == []
 
 

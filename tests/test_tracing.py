@@ -1,0 +1,505 @@
+"""Request-scoped distributed tracing + the SLO plane (ISSUE 15).
+
+The acceptance pins: a context minted at submit rides the wire and a
+sampled request decomposes into named, contiguous segments whose sum
+matches the measured future-resolution latency (single-process AND
+across a real ``launch.py --serve-replicas`` fleet, stitched onto the
+router's timeline by ``tools/obs_stitch.py`` with HELLO-measured clock
+offsets); a zero-sample run books NOTHING on the tracing fast path;
+failures (queue timeouts) book the split ``serving.queue_seconds`` /
+``service_seconds`` histograms with an outcome label AND are
+trace-recorded even when head-unsampled; per-tenant SLO burn /
+availability gauges move with declared budgets and ship through the
+agent's health extract; and ``parse_log --telemetry`` renders the new
+``trace_sampled`` / ``slo_burn`` / ``queue_p99`` / ``service_p99``
+columns with '-' on pre-trace logs.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.obs import tracing
+from mxnet_tpu.router import Router
+from mxnet_tpu.serving.request import RequestTimeout
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+AGENT = os.path.join(ROOT, "tests", "router_agent_script.py")
+
+# the replica-side segment chain, in causal order; the router side
+# prepends router_queue/wire and appends reply
+REPLICA_CHAIN = ["replica_queue", "batch_fill", "h2d", "compute",
+                 "readback"]
+FULL_CHAIN = (["router_queue", "wire"] + REPLICA_CHAIN + ["reply"])
+
+
+def _mlp(hidden, classes, seed):
+    mx.random.seed(seed)
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
+        act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=classes, name="fc2"),
+        name="softmax")
+
+
+def _predictor(net, sample=(12,)):
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1,) + sample)], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    params = {"arg:%s" % k: v for k, v in arg.items()}
+    params.update({"aux:%s" % k: v for k, v in aux.items()})
+    return mx.Predictor(net, params, {"data": (1,) + sample}, ctx=mx.cpu())
+
+
+@pytest.fixture
+def sampled_tracing():
+    """Tracing armed at fraction 1.0, clean buffer + registry; restored
+    after the test."""
+    prev = tracing.set_sample(1.0)
+    tracing.reset()
+    telemetry.reset()
+    yield
+    tracing.set_sample(prev)
+    tracing.reset()
+
+
+# ----------------------------------------------------------------------
+# the context: minting, sampling, wire meta
+# ----------------------------------------------------------------------
+
+def test_context_mint_and_meta_roundtrip(sampled_tracing):
+    ctx = tracing.new_trace()
+    assert ctx.sampled  # fraction 1.0 -> every head is sampled
+    assert len(ctx.trace_id) == 16
+    meta = tracing.to_meta(ctx)
+    # plain scalars only: the repr/literal_eval wire meta contract
+    assert set(meta) == {"tid", "sid", "sampled"}
+    assert isinstance(meta["tid"], str) and isinstance(meta["sid"], int)
+    back = tracing.from_meta(meta)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    # None-tolerant: a pre-trace router sends no trace key
+    assert tracing.from_meta(None) is None
+    assert tracing.from_meta({}) is None
+    # the sampling decision was counted for the parse_log column
+    assert telemetry.counter_value("trace.requests_sampled") == 1
+
+
+def test_sample_fraction_gates_enabled():
+    prev = tracing.set_sample(0.0)
+    try:
+        assert not tracing.enabled()
+        tracing.set_sample(0.25)
+        assert tracing.enabled() and tracing.sample_fraction() == 0.25
+        # forced verdicts override the coin
+        assert tracing.new_trace(sampled=True).sampled
+        assert not tracing.new_trace(sampled=False).sampled
+    finally:
+        tracing.set_sample(prev)
+
+
+def test_record_skips_unsampled_and_outcome_forces_failures(
+        sampled_tracing):
+    unsampled = tracing.new_trace(sampled=False)
+    assert tracing.record(unsampled, "compute", 0.0, 1.0) is None
+    # an unsampled OK books nothing...
+    assert tracing.record_outcome(unsampled, "ok", 0.0, 1.0) is None
+    assert tracing.spans() == []
+    # ...but an unsampled FAILURE is always explained
+    tracing.record_outcome(unsampled, "timeout", 0.0, 1.0, tenant="m")
+    spans = tracing.spans(unsampled.trace_id)
+    assert len(spans) == 1 and spans[0]["name"] == "request"
+    assert spans[0]["attrs"]["outcome"] == "timeout"
+    assert telemetry.counter_value("trace.forced") == 1
+
+
+# ----------------------------------------------------------------------
+# single-process decomposition (direct ModelServer callers)
+# ----------------------------------------------------------------------
+
+def test_sampled_request_decomposes_gap_free_in_process(sampled_tracing):
+    """One sampled request through a local ModelServer decomposes into
+    the replica segment chain: present, causally ordered, contiguous
+    (shared boundary stamps), and summing to the measured
+    future-resolution latency within 10%."""
+    server = mx.serving.ModelServer({"m": _predictor(_mlp(16, 5, 0))},
+                                    max_batch=8, wait_ms=30,
+                                    timeout_ms=60000)
+    try:
+        server.warmup()  # compile outside the measured request
+        x = np.random.RandomState(0).randn(12).astype("float32")
+        ctx = tracing.new_trace(sampled=True)
+        t0 = time.monotonic()
+        fut = server.submit("m", {"data": x}, trace=ctx)
+        fut.result(timeout=120)
+        measured = time.monotonic() - t0
+    finally:
+        server.close()
+    spans = {s["name"]: s for s in tracing.spans(ctx.trace_id)}
+    # chain present, plus the fill span the segments link into and the
+    # outcome-labeled root
+    for name in REPLICA_CHAIN + ["fill", "request"]:
+        assert name in spans, sorted(spans)
+    assert spans["request"]["attrs"]["outcome"] == "ok"
+    fill_sid = spans["fill"]["span"]
+    for name in ("batch_fill", "h2d", "compute", "readback"):
+        assert spans[name]["attrs"]["fill"] == fill_sid
+    # causally ordered and gap-free: each segment starts where the
+    # previous ended (shared boundary timestamps, zero gap in-process)
+    chain = [spans[n] for n in REPLICA_CHAIN]
+    for prev, nxt in zip(chain, chain[1:]):
+        assert nxt["t0_us"] >= prev["t0_us"]
+        gap_us = nxt["t0_us"] - (prev["t0_us"] + prev["dur_us"])
+        assert abs(gap_us) <= 2000, (prev["name"], nxt["name"], gap_us)
+    total_s = sum(s["dur_us"] for s in chain) / 1e6
+    assert abs(total_s - measured) <= 0.1 * measured + 2e-3, \
+        (total_s, measured)
+
+
+def test_zero_sample_run_books_nothing():
+    """MXTPU_TRACE_SAMPLE=0: the tracing fast path books NOTHING — no
+    contexts, no spans, no trace.* counters — while serving works."""
+    prev = tracing.set_sample(0.0)
+    tracing.reset()
+    telemetry.reset()
+    server = mx.serving.ModelServer({"m": _predictor(_mlp(16, 5, 0))},
+                                    max_batch=8, wait_ms=10,
+                                    timeout_ms=60000)
+    try:
+        futs = [server.submit("m", {"data": x}) for x in
+                np.random.RandomState(1).randn(6, 12).astype("float32")]
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        server.close()
+        tracing.set_sample(prev)
+    assert tracing.spans() == []
+    snap = telemetry.snapshot()
+    assert not any(k.startswith("trace.requests")
+                   or k in ("trace.spans", "trace.forced")
+                   for k in snap["counters"]), snap["counters"]
+    # serving itself was untouched
+    assert snap["counters"]["serving.requests"] == 6
+
+
+def test_trace_spans_mirror_into_profiler_with_flow_links(
+        sampled_tracing, tmp_path):
+    """While profiling runs, every trace span lands in the chrome trace
+    as a cat="trace" event carrying trace/span ids, and the wire
+    handoffs emit flow endpoints — what the stitched fleet view links
+    with."""
+    from mxnet_tpu import profiler
+
+    fname = str(tmp_path / "trace_profile.json")
+    profiler.profiler_set_config(mode="symbolic", filename=fname)
+    profiler.profiler_set_state("run")
+    ctx = tracing.new_trace(sampled=True)
+    try:
+        now = time.monotonic()
+        tracing.record(ctx, "compute", now - 0.01, now, fill=7)
+        tracing.flow(ctx, "submit", "s", tracing.wall(now))
+        tracing.flow(ctx, "submit", "f", tracing.wall(now))
+    finally:
+        profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    spans = [e for e in events if e.get("cat") == "trace"
+             and e.get("ph") == "X"]
+    assert any(e["name"] == "compute"
+               and e["args"]["trace"] == ctx.trace_id for e in spans)
+    flows = [e for e in events if e.get("ph") in ("s", "f")
+             and e.get("id") == tracing.flow_id(ctx, "submit")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    # the request lane is named
+    assert any(e.get("ph") == "M" and e.get("name") == "thread_name"
+               and e["args"]["name"] == "requests (traced)"
+               for e in events)
+
+
+# ----------------------------------------------------------------------
+# queue/service split + outcome booking (the satellite fixes)
+# ----------------------------------------------------------------------
+
+def test_queue_service_split_books_per_tenant(sampled_tracing):
+    server = mx.serving.ModelServer({"m": _predictor(_mlp(16, 5, 0))},
+                                    max_batch=8, wait_ms=10,
+                                    timeout_ms=60000)
+    try:
+        futs = [server.submit("m", {"data": x}) for x in
+                np.random.RandomState(2).randn(5, 12).astype("float32")]
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        server.close()
+    h = telemetry.snapshot()["histograms"]
+    for name in ("serving.request_seconds", "serving.queue_seconds",
+                 "serving.service_seconds"):
+        assert h[name]["count"] == 5, name
+        assert h["%s.m" % name]["count"] == 5, name
+    # the split decomposes the combined latency: queue + service ≈ total
+    total = h["serving.request_seconds"]["sum"]
+    split = (h["serving.queue_seconds"]["sum"]
+             + h["serving.service_seconds"]["sum"])
+    assert abs(split - total) <= 0.1 * total + 5e-3, (split, total)
+
+
+def test_timeout_resolution_books_latency_with_outcome(sampled_tracing):
+    """The satellite fix: a request that DIES in the queue still books
+    serving.request_seconds (and the split) with outcome=timeout — p99
+    no longer silently excludes the worst requests — and, tracing
+    armed, gets a forced outcome span even when head-unsampled."""
+    tracing.set_sample(1e-9)  # armed, but heads land unsampled
+    server = mx.serving.ModelServer({"m": _predictor(_mlp(16, 5, 0))},
+                                    max_batch=8, wait_ms=200)
+    try:
+        x = np.zeros(12, "float32")
+        fut = server.submit("m", {"data": x}, timeout_ms=1)
+        with pytest.raises(RequestTimeout):
+            fut.result(timeout=120)
+        # resolution latency was booked despite the failure
+        deadline = time.time() + 30
+        while (telemetry.counter_value("serving.outcomes.timeout") < 1
+               and time.time() < deadline):
+            time.sleep(0.01)
+    finally:
+        server.close()
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.outcomes.timeout"] == 1
+    h = snap["histograms"]
+    assert h["serving.request_seconds"]["count"] == 1
+    assert h["serving.queue_seconds"]["count"] == 1
+    # its whole life was queue: no service half for a queue death
+    assert "serving.service_seconds" not in h
+    # a successful request is NOT outcome-inflated
+    assert snap["counters"].get("serving.requests", 0) == 0
+    # and the failure was trace-explained despite the unsampled head
+    outcomes = [s for s in tracing.spans() if s["name"] == "request"]
+    assert any(s["attrs"]["outcome"] == "timeout" for s in outcomes)
+
+
+# ----------------------------------------------------------------------
+# the SLO plane
+# ----------------------------------------------------------------------
+
+def test_slo_gauges_burn_and_availability(sampled_tracing):
+    server = mx.serving.ModelServer(max_batch=8, wait_ms=5,
+                                    timeout_ms=60000)
+    # generous budget: everything lands inside it
+    server.add_tenant("easy", _predictor(_mlp(16, 5, 0)), slo_ms=60000,
+                      slo_target=0.99)
+    # impossible budget: everything blows it
+    server.add_tenant("hard", _predictor(_mlp(16, 5, 1)), slo_ms=1e-4,
+                      slo_target=0.99)
+    try:
+        xs = np.random.RandomState(3).randn(4, 12).astype("float32")
+        for tenant in ("easy", "hard"):
+            for f in [server.submit(tenant, {"data": x}) for x in xs]:
+                f.result(timeout=120)
+    finally:
+        server.close()
+    g = telemetry.snapshot()["gauges"]
+    assert g["slo.budget_ms.easy"] == 60000
+    assert g["slo.availability.easy"] == 1.0
+    assert g["slo.burn.easy"] == 0.0
+    assert g["slo.availability.hard"] == 0.0
+    # every request burns budget at 1/(1-0.99) = 100x
+    assert g["slo.burn.hard"] == pytest.approx(100.0)
+
+
+def test_slo_target_must_be_a_fraction():
+    server = mx.serving.ModelServer(max_batch=4, wait_ms=5)
+    try:
+        with pytest.raises(mx.MXNetError, match="slo_target"):
+            server.add_tenant("m", _predictor(_mlp(16, 5, 0)),
+                              slo_ms=100, slo_target=1.0)
+    finally:
+        server.close()
+
+
+def test_agent_health_extract_ships_slo_and_split_p99(sampled_tracing):
+    """The health/aggregator path: the replica's serving extract
+    carries the SLO ledger and the queue/service p99s, so
+    Router.health() can say WHICH segment moved when p99 burns."""
+    from mxnet_tpu.router.agent import _serving_extract
+
+    server = mx.serving.ModelServer(max_batch=8, wait_ms=5,
+                                    timeout_ms=60000)
+    server.add_tenant("m", _predictor(_mlp(16, 5, 0)), slo_ms=60000)
+    try:
+        for f in [server.submit("m", {"data": x}) for x in
+                  np.random.RandomState(4).randn(4, 12).astype("float32")]:
+            f.result(timeout=120)
+    finally:
+        server.close()
+    extract = _serving_extract(("m",))
+    assert extract["queue_p99"] is not None
+    assert extract["service_p99"] is not None
+    assert extract["slo"]["m"]["budget_ms"] == 60000
+    assert extract["slo"]["m"]["availability"] == 1.0
+    assert extract["slo"]["m"]["burn"] == 0.0
+
+
+# ----------------------------------------------------------------------
+# parse_log columns
+# ----------------------------------------------------------------------
+
+def test_parse_log_renders_tracing_and_slo_columns():
+    from tools.parse_log import parse_telemetry
+
+    traced_rec = {
+        "flush_seq": 1, "step": 0,
+        "counters": {"trace.requests_sampled": 7,
+                     "trace.requests_unsampled": 693},
+        "gauges": {"slo.burn.m": 2.5, "slo.burn.k": 0.5},
+        "histograms": {
+            "serving.queue_seconds": {
+                "count": 4, "sum": 0.2, "min": 0.01, "max": 0.09,
+                "buckets": {"le_0.01": 1, "le_0.1": 3, "le_inf": 0}},
+            "serving.service_seconds": {
+                "count": 4, "sum": 0.04, "min": 0.001, "max": 0.009,
+                "buckets": {"le_0.001": 1, "le_0.01": 3, "le_inf": 0}},
+        },
+    }
+    legacy_rec = {"flush_seq": 2, "step": 5, "counters": {},
+                  "gauges": {}, "histograms": {}}
+    # a pre-trace log that DID count retraces must not fake the column
+    retrace_rec = {"flush_seq": 3, "step": 9,
+                   "counters": {"trace.retraces": 3}, "gauges": {},
+                   "histograms": {}}
+    rows = parse_telemetry([json.dumps(traced_rec), json.dumps(legacy_rec),
+                            json.dumps(retrace_rec)])
+    assert rows[0]["trace_sampled"] == 7
+    assert rows[0]["slo_burn"] == 2.5  # the WORST tenant burn
+    assert rows[0]["queue_p99"] == pytest.approx(0.1)
+    assert rows[0]["service_p99"] == pytest.approx(0.01)
+    for col in ("trace_sampled", "slo_burn", "queue_p99", "service_p99"):
+        assert rows[1][col] is None, col
+        assert rows[2][col] is None, col
+
+
+# ----------------------------------------------------------------------
+# ACCEPTANCE: launch.py --serve-replicas fleet, stitched end to end
+# ----------------------------------------------------------------------
+
+def test_fleet_stitched_trace_decomposes_one_request(sampled_tracing,
+                                                     tmp_path):
+    """From a real ``launch.py --serve-replicas 2`` fleet: a sampled
+    request's router-side and replica-side spans share one trace_id,
+    stitch onto one clock-offset-aligned timeline (offsets measured at
+    ReplicaAgent HELLO), are causally ordered with every inter-span gap
+    attributed to a named segment, and their durations sum to the
+    measured future-resolution latency within 10%."""
+    from mxnet_tpu import profiler
+    from tools.obs_stitch import _discover, stitch
+
+    base = str(tmp_path / "serve_trace.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_PROFILER_AUTOSTART="1",
+               MXNET_PROFILER_FILENAME=base,
+               MXTPU_TRACE_SAMPLE="1")
+    launcher = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "--serve-replicas", "2",
+         sys.executable, AGENT, json.dumps({"seed": 0, "max_batch": 8,
+                                            "wait_ms": 40})],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=ROOT)
+    addrs = None
+    for line in launcher.stdout:
+        if line.startswith("MXTPU_ROUTER_REPLICAS="):
+            addrs = line.strip().split("=", 1)[1].split(",")
+            break
+    assert addrs and len(addrs) == 2
+    threading.Thread(target=launcher.stdout.read, daemon=True).start()
+
+    profiler.profiler_set_config(mode="symbolic", filename=base)
+    profiler.set_trace_meta(rank=0, clock_offset_us=0.0)
+    profiler.profiler_set_state("run")
+    router = None
+    ctxs, measured = [], []
+    try:
+        router = Router(addrs, poll_ms=100, adapt_window_s=0)
+        rng = np.random.RandomState(7)
+        # sequential single requests: each rides one fill, waits out
+        # the 40 ms batching window (so replica_queue dominates and the
+        # 10% sum bound is far above the clock-offset error)
+        for _ in range(4):
+            ctx = tracing.new_trace(sampled=True)
+            x = rng.randn(12).astype("float32")
+            t0 = time.monotonic()
+            fut = router.submit("m", {"data": x}, trace=ctx)
+            fut.result(timeout=120)
+            measured.append(time.monotonic() - t0)
+            ctxs.append(ctx)
+        router.close(shutdown_replicas=True)
+        assert launcher.wait(timeout=120) == 0
+    finally:
+        profiler.profiler_set_state("stop")
+        if router is not None:
+            try:
+                router.close(drain=False, shutdown_replicas=True,
+                             timeout=10)
+            except Exception:
+                pass
+        if launcher.poll() is None:
+            launcher.kill()
+            launcher.wait(timeout=30)
+    profiler.dump_profile()
+
+    files = _discover([base])
+    # the router's unsuffixed base trace merges WITH the replicas'
+    # suffixed ones (rank 0 + .r1/.r2 — the obs_stitch satellite)
+    assert base in files and len(files) == 3, files
+    payload = stitch(files)
+    assert payload["otherData"]["stitched_ranks"] == [0, 1, 2]
+
+    # at least one later request (the first may interleave with health
+    # polls) must decompose fully on the aligned timeline
+    checked = 0
+    for ctx, meas in list(zip(ctxs, measured))[1:]:
+        ev = [e for e in payload["traceEvents"]
+              if e.get("ph") == "X" and e.get("cat") == "trace"
+              and (e.get("args") or {}).get("trace") == ctx.trace_id]
+        spans = {e["name"]: e for e in ev}
+        if not all(n in spans for n in FULL_CHAIN):
+            continue
+        checked += 1
+        # router- and replica-side spans really came from different
+        # processes: the stitcher remapped the replica pids into the
+        # rank*100 ranges
+        assert spans["router_queue"]["pid"] < 100
+        assert spans["compute"]["pid"] >= 100
+        chain = [spans[n] for n in FULL_CHAIN]
+        # causally ordered on ONE timeline, every gap attributed: each
+        # segment begins where the previous ended, up to clock-offset
+        # error (the 8 segments ARE the attribution)
+        for prev, nxt in zip(chain, chain[1:]):
+            assert nxt["ts"] >= prev["ts"], (prev["name"], nxt["name"])
+            gap_us = nxt["ts"] - (prev["ts"] + prev["dur"])
+            assert abs(gap_us) <= 50_000, \
+                (prev["name"], nxt["name"], gap_us)
+        total_s = sum(e["dur"] for e in chain) / 1e6
+        assert abs(total_s - meas) <= 0.1 * meas + 5e-3, (total_s, meas)
+        # the causal flow arrows bind the two processes' chains
+        for direction in ("submit", "reply"):
+            fid = tracing.flow_id(ctx, direction)
+            phases = {e["ph"] for e in payload["traceEvents"]
+                      if e.get("id") == fid and e.get("ph") in ("s", "f")}
+            assert phases == {"s", "f"}, (direction, phases)
+    assert checked >= 1, "no request produced a complete stitched chain"

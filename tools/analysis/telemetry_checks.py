@@ -35,12 +35,15 @@ __all__ = ["UnguardedTelemetryCall"]
 
 # module-level handles the framework uses at instrumentation sites
 # (recorder = the obs flight recorder, whose record() sits on the same
-# hot dispatch paths and promises the same ~zero disabled cost)
-_MODULE_NAMES = {"telemetry", "profiler", "recorder"}
+# hot dispatch paths and promises the same ~zero disabled cost;
+# tracing = the request tracer, whose record/record_outcome/flow calls
+# sit once per SERVED REQUEST — the serving tier's hottest sites)
+_MODULE_NAMES = {"telemetry", "profiler", "recorder", "tracing"}
 # the recording entry points whose CALL must be guarded
 _RECORDING_ATTRS = {"inc", "set_gauge", "observe", "observe_values",
                     "attach_value_histogram", "flush", "record_span",
-                    "record_counter", "record"}
+                    "record_counter", "record", "record_outcome",
+                    "record_event", "flow"}
 # the fast-path predicates
 _GUARD_ATTRS = {"enabled", "spans_active"}
 
@@ -148,5 +151,6 @@ class UnguardedTelemetryCall:
                 "one predicted branch"
                 % (call.func.value.id, call.func.attr,
                    {"telemetry": "telemetry.enabled()",
-                    "recorder": "recorder.enabled()"}.get(
+                    "recorder": "recorder.enabled()",
+                    "tracing": "tracing.enabled()"}.get(
                        call.func.value.id, "profiler.spans_active()")))

@@ -139,7 +139,9 @@ def main():
                              "job: N copies of the command, each one "
                              "router.ReplicaAgent process with its own "
                              "exported MXTPU_ROUTER_PORT + "
-                             "MXTPU_REPLICA_ID; the full address list is "
+                             "MXTPU_REPLICA_ID (+ MXTPU_PROCESS_ID=i+1 "
+                             "so file sinks suffix .r<i+1> for trace "
+                             "stitching); the full address list is "
                              "exported to every replica and printed as "
                              "one MXTPU_ROUTER_REPLICAS= line for the "
                              "Router to connect to (docs/serving.md "
@@ -180,6 +182,13 @@ def main():
             env["MXTPU_REPLICA_ID"] = str(i)
             env["MXTPU_ROUTER_PORT"] = str(port)
             env["MXTPU_ROUTER_REPLICAS"] = addrs
+            # per-replica file sinks: rank i+1 suffixes telemetry/
+            # profiler outputs .r<i+1> (telemetry.rank_suffixed) so N
+            # replicas on one host never write over one file, and the
+            # ROUTER side stays the unsuffixed rank-0 base that
+            # tools/obs_stitch.py aligns replica traces onto
+            # (docs/observability.md "Request tracing & SLOs")
+            env["MXTPU_PROCESS_ID"] = str(i + 1)
             env["PYTHONPATH"] = (repo_root + os.pathsep
                                  + os.environ.get("PYTHONPATH", ""))
             procs.append(subprocess.Popen(args.command, env=env))

@@ -19,6 +19,14 @@ mxnet_tpu/obs/aggregate.py) and stamped it into the trace's
     rank;
   * writes one merged chrome-JSON trace.
 
+Serving fleets merge the same way (docs/observability.md "Request
+tracing & SLOs"): the router's trace is the unsuffixed base file
+(rank 0), each replica writes ``<base>.r<i+1>`` with the clock offset
+the router measured at its HELLO handshake, and the stitched timeline
+shows one sampled request's router_queue/wire/replica_queue/batch_fill/
+h2d/compute/readback/reply span chain — one trace id, causally linked
+by chrome flow arrows — across the processes.
+
 Usage::
 
     python tools/obs_stitch.py profile.json -o merged.json
@@ -41,7 +49,15 @@ _PID_STRIDE = 100
 
 
 def _discover(paths):
-    """Resolve the argument list to concrete per-rank trace files."""
+    """Resolve the argument list to concrete per-rank trace files.
+
+    A serving fleet leaves the ROUTER's trace at the bare base path
+    (the router process carries no MXTPU_PROCESS_ID, so its sink is
+    unsuffixed — it IS rank 0 of the stitch) next to the replicas'
+    ``<base>.r1``…``.rN`` (launch.py --serve-replicas exports
+    ``MXTPU_PROCESS_ID=i+1`` per replica), so when both exist the base
+    file joins the merge instead of being shadowed by its suffixed
+    siblings."""
     out = []
     for p in paths:
         if os.path.exists(p) and re.search(r"\.r\d+$", p):
@@ -51,13 +67,18 @@ def _discover(paths):
                       key=lambda s: int(s.rsplit(".r", 1)[1]))
         hits = [h for h in hits if re.search(r"\.r\d+$", h)]
         if hits:
+            if os.path.exists(p):
+                out.append(p)  # the router/rank-0 base trace
             out.extend(hits)
         elif os.path.exists(p):
             out.append(p)  # a single unsuffixed trace still merges
         else:
             raise SystemExit("obs_stitch: no trace at %r (nor %s.r*)"
                              % (p, p))
-    return out
+    # de-dup while preserving order (a base passed twice, or both the
+    # base and an explicit .r file)
+    seen = set()
+    return [f for f in out if not (f in seen or seen.add(f))]
 
 
 def _rank_of(path, payload):

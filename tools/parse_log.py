@@ -33,7 +33,12 @@ quantization columns (``quant_clip_pct`` mean calibration clip rate,
 the multi-replica router columns (``replicas_healthy`` live replica
 count, ``redispatches`` drain-on-death replays, ``route_p99``
 submit-to-result p99 through the tier; docs/serving.md "Multi-replica
-tier").  Older logs render '-' in columns they predate.
+tier"), and the request-tracing + SLO columns (``trace_sampled``
+head-sampled request count, ``slo_burn`` the worst per-tenant
+error-budget burn rate, ``queue_p99``/``service_p99`` the queue-wait
+vs fill-to-resolution latency split that localizes a p99 move;
+docs/observability.md "Request tracing & SLOs").  Older logs render
+'-' in columns they predate.
 
 With ``--cluster`` the input is the rank-0 CLUSTER JSONL
 (``MXTPU_OBS_CLUSTER_FILE``, written by the obs aggregator —
@@ -201,6 +206,25 @@ def parse_telemetry(lines):
                                     + list(gauges)) else None),
             "route_p99": _hist_quantile(
                 hist.get("router.route_seconds", {}), 0.99),
+            # request-tracing + SLO columns (mxnet_tpu/obs/tracing.py,
+            # docs/observability.md "Request tracing & SLOs"):
+            # head-sampled request count, the worst per-tenant SLO
+            # burn rate, and the queue/service latency split that
+            # localizes a p99 move — '-' for logs that predate the
+            # tracing plane
+            "trace_sampled": (counters.get("trace.requests_sampled", 0)
+                              if any(k.startswith("trace.requests_")
+                                     for k in counters) else None),
+            "slo_burn": (max(v for k, v in gauges.items()
+                             if k.startswith("slo.burn."))
+                         if any(k.startswith("slo.burn.")
+                                for k in gauges) else None),
+            "queue_p99": _hist_quantile(
+                hist.get("serving.queue_seconds", {}), 0.99)
+            if "serving.queue_seconds" in hist else None,
+            "service_p99": _hist_quantile(
+                hist.get("serving.service_seconds", {}), 0.99)
+            if "serving.service_seconds" in hist else None,
         })
     return rows
 
@@ -263,7 +287,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "serve_qdepth", "fill_pct", "req_p99", "data_qdepth",
                    "decode_mbps", "comm_gbps", "overlap_pct", "retraces",
                    "sched_div", "quant_clip_pct", "tenant_bits",
-                   "replicas_healthy", "redispatches", "route_p99"]
+                   "replicas_healthy", "redispatches", "route_p99",
+                   "trace_sampled", "slo_burn", "queue_p99", "service_p99"]
 
 
 def _print_rows(rows, cols, fmt):
