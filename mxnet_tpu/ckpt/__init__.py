@@ -1,0 +1,27 @@
+"""mxnet_tpu.ckpt — elastic fault-tolerant training (docs/checkpoint.md).
+
+Four modules:
+
+* :mod:`~mxnet_tpu.ckpt.atomic`   — write-then-rename artifacts + the
+  ``mxtpu-ckpt-v1`` manifest (a checkpoint exists iff its manifest
+  renamed; no torn restores).
+* :mod:`~mxnet_tpu.ckpt.snapshot` — async per-rank shard writes
+  overlapped with the next K-step dispatch (background engine op), with
+  rank-0 deferred manifest commit behind a cluster barrier.
+* :mod:`~mxnet_tpu.ckpt.resume`   — ``Module.fit(resume_from=)``: exact
+  restore of params/optimizer/RNG/lr counters + pure-function data
+  fast-forward; the resumed loss trajectory is bit-identical.
+* :mod:`~mxnet_tpu.ckpt.elastic`  — shrink to N−1 on rank death and
+  regrow at epoch boundaries, driven by the ``tools/launch.py
+  --elastic`` supervisor.
+"""
+from __future__ import annotations
+
+from . import atomic, elastic, resume, snapshot
+from .atomic import latest_manifest, list_manifests, read_manifest
+from .resume import ResumeState, load
+from .snapshot import CheckpointManager, capture_state
+
+__all__ = ["atomic", "snapshot", "resume", "elastic", "CheckpointManager",
+           "capture_state", "ResumeState", "load", "latest_manifest",
+           "list_manifests", "read_manifest"]

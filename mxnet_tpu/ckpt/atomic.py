@@ -1,0 +1,152 @@
+"""Atomic artifact writes + the ``mxtpu-ckpt-v1`` manifest surface.
+
+Every durable artifact this framework emits rides one idiom: write the
+full payload to a sibling ``.tmp`` path, then ``os.replace`` it over the
+final name (the BANDWIDTH.json / watchdog-postmortem pattern —
+obs/watchdog.py, tools/bandwidth/measure.py).  ``os.replace`` is atomic
+on POSIX within a filesystem, so readers observe either the previous
+complete artifact or the new complete artifact, never a torn prefix —
+the property the whole checkpoint design rests on: a checkpoint EXISTS
+iff its manifest renamed, and the manifest renames only after every
+shard it names is durably on disk.
+
+Layout of one checkpoint directory::
+
+    <dir>/shard-r00000-s0000000012.ckpt   per-rank payload (pickle)
+    <dir>/shard-r00001-s0000000012.ckpt
+    <dir>/manifest-s0000000012.json       rank-0 commit record
+
+The manifest is the unit of validity.  Shard files without a manifest
+are garbage from an interrupted snapshot (pruned on the next commit);
+a ``manifest-*.json.tmp`` is a commit that never happened and is
+ignored by :func:`list_manifests`.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+
+from ..base import MXNetError
+
+__all__ = ["MANIFEST_FORMAT", "replace_into", "write_bytes", "write_json",
+           "shard_path", "manifest_path", "list_manifests",
+           "latest_manifest", "read_manifest", "prune"]
+
+MANIFEST_FORMAT = "mxtpu-ckpt-v1"
+
+_MANIFEST_RE = re.compile(r"^manifest-s(\d{10})\.json$")
+_SHARD_RE = re.compile(r"^shard-r(\d{5})-s(\d{10})\.ckpt$")
+
+
+@contextlib.contextmanager
+def replace_into(path):
+    """Yield a temporary sibling path; on clean exit ``os.replace`` it
+    over `path`, on exception unlink it.  The tmp name keeps the final
+    extension as a SUFFIX of the basename prefix (``name.ext.tmp``), so
+    a crashed writer's leftovers are recognizable and never match the
+    artifact globs above."""
+    tmp = path + ".tmp"
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def write_bytes(path, data):
+    """Atomically write `data` to `path` (fsync'd before the rename, so
+    the commit ordering shard-then-manifest survives a host crash, not
+    just a process kill)."""
+    with replace_into(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+    return len(data)
+
+
+def write_json(path, obj):
+    return write_bytes(path, (json.dumps(obj, indent=2, sort_keys=True)
+                              + "\n").encode("utf-8"))
+
+
+def shard_path(directory, rank, step):
+    return os.path.join(directory, "shard-r%05d-s%010d.ckpt"
+                        % (int(rank), int(step)))
+
+
+def manifest_path(directory, step):
+    return os.path.join(directory, "manifest-s%010d.json" % int(step))
+
+
+def list_manifests(directory):
+    """All COMMITTED checkpoints in `directory`, sorted by step:
+    ``[(step, path), ...]``.  ``.tmp`` leftovers (a commit that never
+    renamed) are invisible by construction of the name pattern."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        m = _MANIFEST_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, n)))
+    out.sort()
+    return out
+
+
+def latest_manifest(directory):
+    """Path of the newest committed manifest, or None."""
+    manifests = list_manifests(directory)
+    return manifests[-1][1] if manifests else None
+
+
+def read_manifest(path):
+    """Parse + validate one manifest; raises MXNetError naming the file
+    on a missing/garbled/foreign artifact instead of a raw traceback."""
+    try:
+        with open(path, "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+    except FileNotFoundError:
+        raise MXNetError("checkpoint manifest '%s' does not exist" % path)
+    except (ValueError, OSError) as e:
+        raise MXNetError("checkpoint manifest '%s' is unreadable or "
+                         "corrupt (%s) — it should be impossible for a "
+                         "kill to tear a committed manifest; was the "
+                         "file edited or copied partially?" % (path, e))
+    if manifest.get("format") != MANIFEST_FORMAT:
+        raise MXNetError("'%s' is not a %s manifest (format=%r)"
+                         % (path, MANIFEST_FORMAT, manifest.get("format")))
+    return manifest
+
+
+def prune(directory, keep):
+    """Drop all but the newest `keep` committed checkpoints.  Deletion
+    order is the commit order REVERSED — manifest first, so a kill
+    mid-prune leaves orphan shards (garbage, collected next prune), never
+    a manifest naming missing shards.  Also sweeps shard files whose
+    step has no manifest at all (an interrupted snapshot's leftovers,
+    EXCEPT steps newer than the newest manifest — those may be a commit
+    in flight)."""
+    manifests = list_manifests(directory)
+    keep = max(1, int(keep))
+    dead = manifests[:-keep] if len(manifests) > keep else []
+    live_steps = {s for s, _ in manifests[len(dead):]}
+    newest = manifests[-1][0] if manifests else -1
+    for step, path in dead:
+        with contextlib.suppress(OSError):
+            os.unlink(path)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for n in names:
+        m = _SHARD_RE.match(n)
+        if m and int(m.group(2)) not in live_steps and int(m.group(2)) <= newest:
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(directory, n))

@@ -1,0 +1,83 @@
+"""Elastic occupancy — mesh shrink on rank death, regrow at epoch
+boundaries.
+
+The division of labor (docs/checkpoint.md "Elastic workflow"):
+
+* ``tools/launch.py --elastic`` is the SUPERVISOR: it watches the rank
+  processes it spawned; when one dies mid-run (SIGKILL, OOM) it reaps
+  the survivors (they may be wedged in a collective with the dead peer
+  — the watchdog's ``LivenessBook``/stall postmortem names the culprit,
+  but recovery is membership change, not in-place repair), then
+  relaunches the job at N−1 with a fresh coordinator and
+  ``MXTPU_ELASTIC_GENERATION`` bumped.  Each new generation re-enters
+  ``multihost.initialize`` with the reduced world and resumes from the
+  last committed manifest (``MXTPU_CKPT_RESUME``).
+
+* THIS module is the in-framework half: generation accounting, the
+  regrow request sentinel, and the yield exit code that lets a shrunken
+  generation hand its slots back at an epoch boundary so the supervisor
+  can relaunch at full width.
+
+Why the batch sequence survives the shrink: the data service's epoch
+order is a pure function of ``(seed, epoch)`` and the consumer
+reassembles batches in GLOBAL batch-index order, worker-count invariant
+(data/worker.py epoch_order); params/optimizer state are replicated on
+the data axes, so any survivor subset restores the full state from any
+shard.  N−1 survivors therefore replay the IDENTICAL global batch and
+loss sequence the N-rank run would have produced — the tier-1 elastic
+chaos pin (tests/test_ckpt_elastic.py).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["YIELD_EXIT_CODE", "generation", "request_regrow",
+           "regrow_requested", "clear_regrow", "dead_ranks"]
+
+# a shrunken generation that checkpointed at an epoch boundary and wants
+# the supervisor to relaunch it at full width exits with this code; it
+# must stay in lockstep with _ELASTIC_YIELD_RC in tools/launch.py
+YIELD_EXIT_CODE = 3
+
+_REGROW_SENTINEL = "regrow.request"
+
+
+def generation():
+    """This process's elastic generation (0 = the original launch);
+    bumped by the supervisor on every relaunch."""
+    return int(os.environ.get("MXTPU_ELASTIC_GENERATION", "0"))
+
+
+def _sentinel(directory):
+    return os.path.join(directory, _REGROW_SENTINEL)
+
+
+def request_regrow(directory):
+    """Ask the running (shrunken) job to yield at its next epoch
+    boundary so the supervisor can relaunch at full width.  Written by
+    the supervisor when a replacement slot is available; read by
+    ``CheckpointManager.epoch_end``."""
+    with open(_sentinel(directory), "w") as f:
+        f.write("regrow\n")
+
+
+def regrow_requested(directory):
+    return bool(directory) and os.path.exists(_sentinel(directory))
+
+
+def clear_regrow(directory):
+    try:
+        os.unlink(_sentinel(directory))
+    except OSError:
+        pass
+
+
+def dead_ranks(book):
+    """Ranks a ``parallel.dist.LivenessBook`` currently names dead or
+    unclean — the watchdog/postmortem's answer to "who do we shrink
+    around".  The supervisor ALSO sees deaths directly (it owns the
+    processes); the book is the in-band view for ranks that want to log
+    or gate on membership before the supervisor reaps them."""
+    gone = set(book.dead())
+    gone.update(book.unclean())
+    return sorted(gone)

@@ -1,0 +1,163 @@
+"""Exact resume — the read half of ``mxnet_tpu.ckpt``.
+
+``Module.fit(resume_from=...)`` lands here: :func:`load` picks the
+newest committed manifest (or an explicit one), :func:`apply` puts the
+global arrays back onto the mesh (``Module.set_params`` → the executor
+placement path → ``mesh.global_put`` for sharded params), restores the
+name-keyed optimizer state and the lr-scheduler counters, and replays
+both host RNG streams, and :func:`fast_forward` advances the data
+pipeline to ``batch_index`` — for the sharded data service by the PURE
+epoch function (workers recompute ``epoch_order(seed, epoch)`` and jump,
+zero decode), generically by consuming batches.
+
+The contract is bit-identity, not approximation: after apply +
+fast_forward, every subsequent dispatch sees the identical params,
+optimizer state, lr, dropout seed, and batch bytes the uninterrupted
+run would have seen, so the loss trajectory is equal EXACTLY (the tier-1
+resume-parity pin, tests/test_ckpt.py).  The one sequence that cannot
+be replayed is an epoch-cumulative eval metric across the kill point —
+a mid-epoch resume restarts the metric accumulation at the resume
+batch (docs/checkpoint.md).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from ..base import MXNetError
+from . import atomic
+
+__all__ = ["ResumeState", "load", "apply", "fast_forward"]
+
+
+class ResumeState:
+    """One loaded checkpoint: the commit record + this rank's payload."""
+
+    def __init__(self, manifest, payload, manifest_file):
+        self.manifest = manifest
+        self.payload = payload
+        self.manifest_file = manifest_file
+        self.step = int(manifest["step"])
+        self.epoch = int(manifest["epoch"])
+        self.batch_index = int(manifest["batch_index"])
+
+
+def _pick_shard(directory, manifest, manifest_file):
+    """This rank's shard if the manifest names one, else shard 0: on the
+    data mesh every shard carries the complete replicated state and the
+    identical SPMD RNG stream (ckpt/snapshot.py), so a shrunken or
+    re-ranked survivor set restores from whatever is on disk."""
+    import jax
+
+    shards = manifest.get("shards") or []
+    if not shards:
+        raise MXNetError("manifest '%s' names no shards" % manifest_file)
+    rank = jax.process_index()
+    name = shards[rank] if rank < len(shards) else shards[0]
+    path = os.path.join(directory, name)
+    if not os.path.exists(path):
+        path = os.path.join(directory, shards[0])
+    return path
+
+
+def load(path, required=True):
+    """Resolve `path` (a checkpoint directory or an explicit manifest
+    file) to a :class:`ResumeState`.  ``required=False`` returns None
+    for a directory with no committed checkpoint yet — the elastic
+    supervisor's "resume if there is anything to resume" contract
+    (``MXTPU_CKPT_RESUME``)."""
+    if os.path.isdir(path):
+        directory = path
+        manifest_file = atomic.latest_manifest(directory)
+        if manifest_file is None:
+            if required:
+                raise MXNetError(
+                    "no committed checkpoint in '%s' (a manifest-s*.json "
+                    "is the unit of validity; shard files alone are an "
+                    "interrupted snapshot)" % directory)
+            return None
+    else:
+        manifest_file = path
+        directory = os.path.dirname(os.path.abspath(path))
+    manifest = atomic.read_manifest(manifest_file)
+    shard = _pick_shard(directory, manifest, manifest_file)
+    try:
+        with open(shard, "rb") as f:
+            payload = pickle.load(f)
+    except FileNotFoundError:
+        raise MXNetError("checkpoint shard '%s' named by manifest '%s' is "
+                         "missing" % (shard, manifest_file))
+    except Exception as e:
+        raise MXNetError("checkpoint shard '%s' is truncated or corrupt "
+                         "(%s) — committed shards rename atomically, so "
+                         "this file was damaged after the fact"
+                         % (shard, e))
+    if payload.get("format") != atomic.MANIFEST_FORMAT:
+        raise MXNetError("shard '%s' is not a %s payload"
+                         % (shard, atomic.MANIFEST_FORMAT))
+    if int(payload["step"]) != int(manifest["step"]):
+        raise MXNetError("shard '%s' is step %s but manifest '%s' is step "
+                         "%s — mixed checkpoint directories?"
+                         % (shard, payload["step"], manifest_file,
+                            manifest["step"]))
+    return ResumeState(manifest, payload, manifest_file)
+
+
+def apply(module, state):
+    """Restore `module` (bound, params+optimizer initialized) from
+    `state`; returns ``(epoch, batch_index)`` — the cursor fit resumes
+    at.  Ordering matters: params go to the device first (set_params →
+    global_put placement), then optimizer state and scheduler counters
+    (the fused dispatch re-places its state leaves lazily), then the RNG
+    streams, so the very next ``_next_seed`` draw continues the
+    interrupted run's sequence bit-exactly."""
+    from .. import telemetry
+    from ..ndarray import array
+    from ..ops.random_ops import GLOBAL_RNG, HOST_RNG
+
+    payload = state.payload
+    args = {k: array(v) for k, v in payload["args"].items()}
+    auxs = {k: array(v) for k, v in payload["auxs"].items()}
+    module.set_params(args, auxs, allow_missing=False, force_init=True,
+                      allow_extra=False)
+    updater = getattr(module, "_updater", None)
+    if payload.get("updater") is not None:
+        if updater is None:
+            raise MXNetError(
+                "checkpoint at '%s' carries optimizer state but this "
+                "module has no host-side updater (kvstore update path); "
+                "resume with kvstore=None" % state.manifest_file)
+        updater.set_states(payload["updater"])
+    opt = getattr(module, "_optimizer", None)
+    if opt is not None and payload.get("opt") is not None:
+        rec = payload["opt"]
+        # the lr/wd schedule is a pure function of these counters
+        # (optimizer._get_lr via lr_scheduler(num_update)): restoring
+        # them IS the scheduler replay
+        opt.num_update = int(rec["num_update"])
+        opt.begin_num_update = int(rec["begin_num_update"])
+        opt._index_update_count.clear()
+        opt._index_update_count.update(rec["index_update_count"])
+    HOST_RNG.set_state(payload["host_rng"])
+    GLOBAL_RNG.set_state(payload["global_rng"])
+    if telemetry.enabled():
+        telemetry.inc("ckpt.resumes")
+        telemetry.set_gauge("ckpt.resume_step", state.step)
+    return state.epoch, state.batch_index
+
+
+def fast_forward(data_iter, epoch, nskip):
+    """Advance `data_iter` to batch `nskip` of `epoch`.  Iterators that
+    expose ``seek_epoch(epoch, start_batch)`` (ShardedImageRecordIter —
+    the data service jumps by the pure epoch function, skipping decode
+    entirely) seek directly; anything else consumes ``nskip`` batches,
+    which is equivalent because the epoch sequence is deterministic."""
+    seek = getattr(data_iter, "seek_epoch", None)
+    if callable(seek):
+        seek(epoch, nskip)
+        return
+    for _ in range(int(nskip)):
+        try:
+            data_iter.next()
+        except StopIteration:
+            break

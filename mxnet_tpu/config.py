@@ -354,6 +354,42 @@ REGISTRY = [
            "MXTPU_OBS_STALL_ACTION=abort the rank exits code 18) — "
            "catching the desync BEFORE the stall watchdog's timeout "
            "would fire.  0 (default) = off"),
+    # ---- checkpoint / elastic training (mxnet_tpu/ckpt) ----
+    EnvVar("MXTPU_CKPT_DIR", str, "",
+           "Non-empty arms periodic async distributed checkpoints in "
+           "Module.fit: every rank writes write-then-rename shard "
+           "files here, rank 0 commits the mxtpu-ckpt-v1 manifest "
+           "(docs/checkpoint.md).  Empty = checkpointing off"),
+    EnvVar("MXTPU_CKPT_EVERY_STEPS", int, 0,
+           "Snapshot cadence in TRAINING STEPS (batches); snapshots "
+           "land at the first dispatch boundary on or past the budget, "
+           "so with K-step fused dispatch the effective cadence rounds "
+           "up to a multiple of K.  0 = off even when MXTPU_CKPT_DIR "
+           "is set"),
+    EnvVar("MXTPU_CKPT_KEEP", int, 2,
+           "Committed checkpoints retained; older manifests are pruned "
+           "manifest-first (an interrupted prune leaves orphan shards, "
+           "never a manifest naming missing shards)"),
+    EnvVar("MXTPU_CKPT_ASYNC", int, 1,
+           "1 (default): shard writes ride a background engine op "
+           "overlapped with the next K-step dispatch (the serve_stage "
+           "pattern); the trainer only blocks on the PREVIOUS write at "
+           "the next trigger.  0 = synchronous write+commit, for "
+           "debugging or when the filesystem needs serialized I/O"),
+    EnvVar("MXTPU_CKPT_RESUME", str, "",
+           "Resume source consumed by Module.fit when resume_from is "
+           "not passed explicitly: a checkpoint directory (newest "
+           "committed manifest wins) or one manifest file.  A directory "
+           "with no committed checkpoint starts fresh instead of "
+           "failing — the elastic supervisor (tools/launch.py "
+           "--elastic) sets this unconditionally and generation 0 has "
+           "nothing to resume yet"),
+    EnvVar("MXTPU_ELASTIC_GENERATION", int, 0,
+           "This process's elastic generation, bumped by the "
+           "tools/launch.py --elastic supervisor on every relaunch "
+           "(shrink after a rank death, regrow at an epoch boundary); "
+           "0 = the original launch.  Read via ckpt.elastic.generation "
+           "— set it only if you are standing in for the supervisor"),
     EnvVar("MXTPU_RETRACE_WARN", int, 0,
            "Retrace-storm warning threshold (telemetry.note_retrace, "
            "the runtime half of mxlint W104): every compiled-program "

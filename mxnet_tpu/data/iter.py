@@ -113,6 +113,20 @@ class ShardedImageRecordIter(DataIter):
         self._bg = ThreadedIter(self._fetch, max_prefetch=self._prefetch,
                                 name="data_service")
 
+    def seek_epoch(self, epoch, start_batch=0):
+        """Jump to batch `start_batch` of `epoch` without decoding the
+        skipped prefix — the exact-resume fast-forward hook
+        (ckpt/resume.py): workers recompute the pure ``(seed, epoch)``
+        order and start at their first index >= start_batch."""
+        if self._service is None:
+            raise MXNetError("ShardedImageRecordIter is closed")
+        if self._bg is not None:
+            self._bg.close()
+        self._epoch = int(epoch)
+        self._service.begin_epoch(self._epoch, start_batch=start_batch)
+        self._bg = ThreadedIter(self._fetch, max_prefetch=self._prefetch,
+                                name="data_service")
+
     def next(self):
         if self._bg is None:
             raise MXNetError("ShardedImageRecordIter is closed")

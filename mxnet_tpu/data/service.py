@@ -244,18 +244,26 @@ class DataService:
                 continue
             return msg
 
-    def begin_epoch(self, epoch):
+    def begin_epoch(self, epoch, start_batch=0):
         """Start epoch `epoch`: abort + drain whatever the workers were
         doing, then command every worker into the new epoch.  The batch
-        sequence that follows depends only on ``(seed, epoch)``."""
+        sequence that follows depends only on ``(seed, epoch)``.
+
+        ``start_batch`` > 0 is the exact-resume fast-forward
+        (ckpt/resume.py): workers recompute the pure epoch order and
+        jump straight to their first batch index >= start_batch — no
+        record is read or decoded for the skipped prefix — and the
+        consumer cursor starts there too, so delivery continues in
+        global order exactly where the interrupted run stopped."""
         self._check()
         epoch = int(epoch)
+        start_batch = int(start_batch)
         self._latest.value = epoch  # workers bail out of older epochs
         self._drain()
         for q in self._cmd_qs:
-            q.put(("epoch", epoch))
+            q.put(("epoch", epoch, start_batch))
         self._epoch = epoch
-        self._cursor = 0
+        self._cursor = start_batch
         self._done = [False] * self.num_workers
 
     def _drain(self):

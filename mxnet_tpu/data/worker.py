@@ -77,7 +77,8 @@ def _acquire_slot(free_q, latest_epoch, epoch):
             continue
 
 
-def _run_epoch(spec, wid, epoch, state, free_q, full_q, latest_epoch):
+def _run_epoch(spec, wid, epoch, state, free_q, full_q, latest_epoch,
+               start=0):
     from .shm import batch_views
 
     offsets, reader, decoder, ring = state
@@ -87,6 +88,11 @@ def _run_epoch(spec, wid, epoch, state, free_q, full_q, latest_epoch):
     num_batches = -(-n // batch)
     order = epoch_order(n, spec["seed"], epoch, spec["shuffle"])
     for b in range(wid, num_batches, num_workers):
+        if b < start:
+            # exact-resume fast-forward (ckpt/resume.py): the epoch order
+            # is a pure function of (seed, epoch), so skipping is a pure
+            # index jump — zero records read, zero batches decoded
+            continue
         if latest_epoch.value != epoch:
             break
         slot = _acquire_slot(free_q, latest_epoch, epoch)
@@ -144,7 +150,7 @@ def worker_main(spec, wid, ring_name, free_q, full_q, cmd_q, latest_epoch):
             if cmd[0] == "stop":
                 break
             _run_epoch(spec, wid, cmd[1], state, free_q, full_q,
-                       latest_epoch)
+                       latest_epoch, start=cmd[2] if len(cmd) > 2 else 0)
     except Exception:
         # forward the failure in-band: the consumer re-raises it as a
         # DataWorkerError at next_batch() instead of timing out blind

@@ -221,7 +221,9 @@ class KVStore:
 
     def save_optimizer_states(self, fname):
         self._require_updater("save_optimizer_states")
-        with open(fname, "wb") as fout:
+        from .ckpt.atomic import replace_into
+
+        with replace_into(fname) as tmp, open(tmp, "wb") as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

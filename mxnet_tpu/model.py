@@ -123,20 +123,67 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Write prefix-symbol.json + prefix-%04d.params (parity: model.py:323-352)."""
+    """Write prefix-symbol.json + prefix-%04d.params (parity: model.py:323-352).
+
+    Both artifacts commit by write-then-rename (ckpt/atomic.py), so a
+    kill mid-save leaves the previous epoch's file or the new one,
+    never a truncated .params a later load would choke on."""
+    from .ckpt.atomic import replace_into
+
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        with replace_into("%s-symbol.json" % prefix) as tmp:
+            symbol.save(tmp)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    with replace_into(param_name) as tmp:
+        nd.save(tmp, save_dict)
     logging.info('Saved checkpoint to "%s"', param_name)
 
 
+def _nearest_checkpoint_epochs(prefix):
+    """Epochs for which a `prefix-%04d.params` actually exists (the
+    load_checkpoint error message names them so a typo'd epoch is a
+    one-glance fix)."""
+    import glob
+    import re
+
+    found = []
+    for p in glob.glob("%s-*.params" % prefix):
+        m = re.search(r"-(\d{4})\.params$", p)
+        if m:
+            found.append(int(m.group(1)))
+    return sorted(found)
+
+
 def load_checkpoint(prefix, epoch):
-    """Load (symbol, arg_params, aux_params) (parity: model.py:353+)."""
-    symbol = sym.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    """Load (symbol, arg_params, aux_params) (parity: model.py:353+).
+
+    Raises :class:`MXNetError` naming the missing or damaged file — and
+    the nearest epochs that DO exist under `prefix` — instead of a raw
+    FileNotFoundError/struct.error traceback."""
+    sym_file = "%s-symbol.json" % prefix
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    try:
+        symbol = sym.load(sym_file)
+    except FileNotFoundError:
+        raise MXNetError(
+            "checkpoint symbol file '%s' does not exist — was the "
+            "checkpoint saved with a different prefix?" % sym_file)
+    try:
+        save_dict = nd.load(param_name)
+    except FileNotFoundError:
+        have = _nearest_checkpoint_epochs(prefix)
+        hint = (" (epochs on disk for this prefix: %s)"
+                % ", ".join("%d" % e for e in have) if have
+                else " (no epochs on disk for this prefix at all)")
+        raise MXNetError("checkpoint params file '%s' does not exist%s"
+                         % (param_name, hint))
+    except Exception as e:
+        raise MXNetError(
+            "checkpoint params file '%s' is truncated or corrupt (%s) — "
+            "writers in this framework rename atomically, so this file "
+            "predates them or was copied partially" % (param_name, e))
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():

@@ -101,7 +101,10 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        self._symbol.save("%s-symbol.json" % prefix)
+        from ..ckpt.atomic import replace_into
+
+        with replace_into("%s-symbol.json" % prefix) as tmp:
+            self._symbol.save(tmp)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
         logging.info('Saved checkpoint to "%s"', param_name)
@@ -430,10 +433,14 @@ class Module(BaseModule):
                 and self._exec_group.execs[0]._comm_mode() is not None)
 
     def _run_epoch_block(self, train_data, epoch, eval_metric,
-                         batch_end_callback, k):
+                         batch_end_callback, k, skip=0):
         """Blocked epoch body: K steps per dispatch, inputs double-
         buffered to the device by a background engine op, metrics
-        consumed once per dispatch from the stacked outputs."""
+        consumed once per dispatch from the stacked outputs.  ``skip``
+        continues the batch numbering after an exact resume — the data
+        fast-forward already happened in _run_epoch, and checkpoints
+        only cut at dispatch boundaries, so skip is a multiple of K and
+        the block boundaries line up with the interrupted run's."""
         import time as _time
 
         from .. import telemetry
@@ -443,8 +450,9 @@ class Module(BaseModule):
         exe = self._exec_group.execs[0]
         staged = DeviceStagedIter(train_data, steps_per_dispatch=k,
                                   place_fn=exe.place_block_input)
-        nbatch = 0
+        nbatch = skip
         tel = telemetry.enabled()
+        mgr = getattr(self, "_ckpt_mgr", None)
         try:
             for block in staged:
                 t0 = _time.perf_counter() if tel else 0.0
@@ -459,6 +467,11 @@ class Module(BaseModule):
                     self._observe_steps(_time.perf_counter() - t0,
                                         block.count)
                 nbatch += block.count
+                if mgr is not None:
+                    # dispatch boundary: snapshot D2H sees the post-block
+                    # arrays; the shard write overlaps the next dispatch
+                    mgr.note_dispatch(self, epoch, nbatch,
+                                      steps=block.count)
                 if batch_end_callback is not None:
                     # one callback per dispatch (nbatch = last step index):
                     # per-step callbacks would force per-step host sync,
@@ -543,11 +556,13 @@ class Module(BaseModule):
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
+        from ..ckpt.atomic import replace_into
+
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            with replace_into(fname) as tmp, open(tmp, "wb") as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

@@ -641,12 +641,14 @@ class PipelineModule(BaseModule):
             self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
 
     def save_optimizer_states(self, fname):
+        from ..ckpt.atomic import replace_into
+
         assert self.optimizer_initialized
         arrs = {"state_%d" % i: _np.asarray(jax.device_get(s))
                 for i, s in enumerate(self._opt_state)}
         arrs["num_update"] = _np.asarray(
             self._optimizer._index_update_count.get("__pipeline__", 0))
-        with open(fname, "wb") as f:
+        with replace_into(fname) as tmp, open(tmp, "wb") as f:
             _np.savez(f, **arrs)
 
     def load_optimizer_states(self, fname):

@@ -48,6 +48,29 @@ class _RngState:
             self._key, sub = jax.random.split(self._key)
         return sub
 
+    def get_state(self):
+        """Host-side snapshot of the key chain (ckpt/snapshot.py): the
+        seed plus the current key's raw counter data (None while still
+        lazy — restoring None keeps the lazy contract, so snapshotting
+        never forces backend init on its own)."""
+        import numpy as _host_np
+
+        with self._lock:
+            key = None
+            if self._key is not None:
+                key = _host_np.asarray(
+                    jax.random.key_data(self._key)).copy()
+            return {"seed": self._seed, "key": key}
+
+    def set_state(self, state):
+        """Exact inverse of :meth:`get_state` — after it, `next_key`
+        continues the saved chain bit-identically (ckpt/resume.py)."""
+        with self._lock:
+            self._seed = state["seed"]
+            key = state.get("key")
+            self._key = (None if key is None
+                         else jax.random.wrap_key_data(jnp.asarray(key)))
+
 
 GLOBAL_RNG = _RngState(0)
 

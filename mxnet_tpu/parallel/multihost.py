@@ -180,6 +180,40 @@ def sync_global_devices(tag="barrier"):
             recorder.record("barrier", "exit", seq)
 
 
+def coordination_barrier(tag="barrier", timeout_ms=600000):
+    """Cross-host barrier over the jax.distributed COORDINATION SERVICE
+    (gRPC), not a device collective.  Unlike :func:`sync_global_devices`
+    this is safe to call while device collectives are still in flight:
+    the checkpoint commit (ckpt/snapshot.py) runs on the host thread
+    concurrently with the next dispatch's gradient all-reduce, and a
+    gloo barrier there would interleave with it on the same socket
+    pairs.  Bracketed in the flight recorder like every other barrier
+    so a no-show peer is attributed by tag."""
+    from ..obs import recorder
+
+    try:
+        from jax._src import distributed as _jdist
+
+        client = _jdist.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        client = None
+    if client is None:
+        # single-process (nothing to wait for) or a jax without the
+        # coordination client exposed — the collective barrier is the
+        # only fallback there
+        if jax.process_count() > 1:
+            sync_global_devices(tag)
+        return
+    seq = None
+    if recorder.enabled():
+        seq = recorder.record("barrier", "enter", detail=str(tag))
+    try:
+        client.wait_at_barrier(str(tag), timeout_in_ms=int(timeout_ms))
+    finally:
+        if recorder.enabled() and seq is not None:
+            recorder.record("barrier", "exit", seq)
+
+
 def fetch(x):
     """Global jax.Array -> full host numpy on EVERY process.
 

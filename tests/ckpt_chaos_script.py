@@ -1,0 +1,95 @@
+"""Worker for tests/test_ckpt_elastic.py — the elastic chaos pin
+(ISSUE 16 acceptance): one rank of a ``tools/launch.py --elastic
+--local-spmd`` job that trains with async checkpoints armed and, in
+generation 0, SIGKILLs a chosen rank mid-epoch.
+
+The supervisor then reaps the wedged survivor and relaunches at N-1
+with ``MXTPU_CKPT_RESUME`` pointing at the checkpoint directory; the
+shrunken generation resumes from the last committed manifest and
+replays the identical global batch sequence (data order is a pure
+function of (seed, epoch), state is replicated on the data mesh —
+ckpt/elastic.py).  Every rank prints one ``CKPTSTEP`` line per dispatch
+tagged with its generation; the test asserts each line matches the
+uninterrupted single-process reference byte-for-byte and that the tail
+of the sequence was produced by a LATER generation at reduced width.
+
+A generation whose fit yields for regrow (``Module._ckpt_yielded``)
+exits ``elastic.YIELD_EXIT_CODE`` so the supervisor relaunches it at
+full width without burning a restart.
+"""
+import argparse
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ckpt_resume_script import build_problem  # noqa: E402  (same problem)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--chaos-rank", type=int, default=-1,
+                        help="rank that SIGKILLs itself in generation 0")
+    parser.add_argument("--chaos-after", type=int, default=6,
+                        help="die after this many dispatches")
+    args = parser.parse_args()
+
+    from mxnet_tpu.parallel import multihost
+
+    multihost.initialize()
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.ckpt import elastic
+    from mxnet_tpu.ops.random_ops import HOST_RNG
+
+    rank = jax.process_index()
+    gen = elastic.generation()
+    nranks = jax.process_count()
+    mesh = multihost.global_mesh(hierarchical=True)
+
+    mx.random.seed(0)
+    HOST_RNG.seed(123)
+    it, net = build_problem(mx, np)
+    mod = mx.mod.Module(net, label_names=("lro_label",), context=mx.cpu(),
+                        mesh=mesh)
+    ndisp = [0]
+
+    def on_batch(param):
+        for _, val in param.eval_metric.get_name_value():
+            # one atomic flushed write per dispatch: lines written
+            # before the SIGKILL must survive on the shared pipe
+            sys.stdout.write(
+                "CKPTSTEP gen=%d rank=%d nranks=%d epoch=%d batch=%d "
+                "loss=%.10e\n"
+                % (gen, rank, nranks, param.epoch, param.nbatch, val))
+            sys.stdout.flush()
+        param.eval_metric.reset()
+        ndisp[0] += 1
+        if (gen == 0 and rank == args.chaos_rank
+                and ndisp[0] >= args.chaos_after):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # checkpoint knobs and the resume path come from the supervisor
+    # environment (MXTPU_CKPT_DIR via the test, MXTPU_CKPT_RESUME set by
+    # launch.py --elastic); every dispatch snapshots so the last
+    # committed manifest is at most one dispatch behind the kill
+    mod.fit(it, num_epoch=2, kvstore=None, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric="mse",
+            steps_per_dispatch=1, batch_end_callback=on_batch,
+            checkpoint_every_steps=1)
+    sys.stdout.write("CKPTDONE gen=%d rank=%d nranks=%d\n"
+                     % (gen, rank, nranks))
+    sys.stdout.flush()
+    if getattr(mod, "_ckpt_yielded", False):
+        sys.exit(elastic.YIELD_EXIT_CODE)
+
+
+if __name__ == "__main__":
+    main()

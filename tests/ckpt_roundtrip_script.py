@@ -1,0 +1,69 @@
+"""Saver half of the legacy save/load round-trip pin (tests/test_ckpt.py,
+ISSUE 16 satellite: ``Module.save_checkpoint(save_optimizer_states=True)``
+→ fresh-process load → identical next-step losses).
+
+This process trains epoch 0, saves the legacy-format checkpoint at the
+epoch boundary via ``mx.callback.module_checkpoint`` (the classic
+``epoch_end_callback`` workflow), then keeps training epoch 1 and prints
+one ``ROUNDTRIP`` line per dispatch — the reference continuation.  The
+TEST process (a fresh process relative to this one) then
+``Module.load(prefix, 1, load_optimizer_states=True)``, runs the same
+epoch 1, and must reproduce every line byte-identically: params AND
+momentum state survive the file format, for both the per-step (K=1) and
+fused (K=2) dispatch paths.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ckpt_resume_script import build_problem  # noqa: E402  (same problem)
+
+
+def run(mx, np, k, prefix):
+    from mxnet_tpu.ops.random_ops import HOST_RNG
+
+    mx.random.seed(0)
+    HOST_RNG.seed(123)
+    it, net = build_problem(mx, np)
+    mod = mx.mod.Module(net, label_names=("lro_label",), context=mx.cpu())
+
+    def on_batch(param):
+        if param.epoch >= 1:
+            for _, val in param.eval_metric.get_name_value():
+                sys.stdout.write(
+                    "ROUNDTRIP k=%d epoch=%d batch=%d loss=%.10e\n"
+                    % (k, param.epoch, param.nbatch, val))
+                sys.stdout.flush()
+        param.eval_metric.reset()
+
+    mod.fit(it, num_epoch=2, kvstore=None, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric="mse",
+            steps_per_dispatch=k, batch_end_callback=on_batch,
+            epoch_end_callback=mx.callback.module_checkpoint(
+                mod, prefix, save_optimizer_states=True))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--k", default="1,2")
+    parser.add_argument("--prefix", required=True,
+                        help="checkpoint prefix; the K value is appended")
+    args = parser.parse_args()
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    for k in (int(v) for v in args.k.split(",")):
+        run(mx, np, k, "%s_k%d" % (args.prefix, k))
+    sys.stdout.write("DONE\n")
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1582,3 +1582,94 @@ def test_e004_covers_observe_values(tmp_path):
     assert "telemetry.observe_values" in findings[0].message
     findings, _, _ = _lint_src(tmp_path, E004_OBSERVE_VALUES_GUARDED)
     assert findings == [], findings
+
+
+# ----------------------------------------------------------------------
+# ckpt subsystem surfaces (ISSUE 16)
+# ----------------------------------------------------------------------
+
+def test_repo_gate_sweeps_the_ckpt_package():
+    """Same pin for mxnet_tpu/ckpt/ — the snapshot manager pushes the
+    shard write as an engine callback and books ckpt.* telemetry on the
+    training hot path, exactly the E002/E004 surfaces; pinned so a
+    future repack cannot silently drop the new package from the gate."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    for mod in ("__init__", "atomic", "snapshot", "resume", "elastic"):
+        assert os.path.join("mxnet_tpu", "ckpt", "%s.py" % mod) in swept
+
+
+# a checkpoint-writer-shaped callback that captures D2H INSIDE an atomic
+# engine op: the shard write would sync on device arrays from a worker
+# the scheduler believes is non-blocking — the deadlock shape the real
+# CheckpointManager avoids by capturing before the push (snapshot.py)
+E002_CKPT_WRITE_ATOMIC = """
+def snapshot(eng, params, var, path):
+    def ckpt_write(_params=params, _path=path):
+        blobs = [p.asnumpy() for p in _params]
+        with open(_path, "wb") as f:
+            for b in blobs:
+                f.write(b.tobytes())
+    eng.push(ckpt_write, read_vars=[p._engine_var() for p in params],
+             write_vars=[var])
+"""
+
+E002_CKPT_WRITE_REAL = """
+def snapshot(eng, blob, var, path, handoff):
+    def ckpt_write(_blob=blob, _path=path, _q=handoff):
+        try:
+            with open(_path + ".tmp", "wb") as f:
+                f.write(_blob)
+            _q.put(None)
+        except BaseException as e:
+            _q.put(e)
+    eng.push(ckpt_write, write_vars=[var], atomic=False,
+             name="ckpt_write")
+"""
+
+
+def test_e002_fires_on_atomic_ckpt_write(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_CKPT_WRITE_ATOMIC)
+    assert _ids(findings).count("E002") == 1, findings
+    assert any("asnumpy" in f.message for f in findings)
+
+
+def test_e002_ckpt_write_clean_when_captured_before_push(tmp_path):
+    """The shape snapshot.py actually ships: the D2H capture and pickle
+    happen on the trainer thread, the callback only writes bytes, and
+    atomic=False keeps normal sync semantics with in-band errors."""
+    findings, _, _ = _lint_src(tmp_path, E002_CKPT_WRITE_REAL)
+    assert findings == [], findings
+
+
+E004_CKPT_UNGUARDED = """
+import time
+from . import telemetry
+
+def note_snapshot(step, nbytes, t0):
+    telemetry.inc("ckpt.snapshots")
+    telemetry.observe("ckpt.d2h_seconds", time.time() - t0)
+    telemetry.set_gauge("ckpt.last_step", step)
+"""
+
+E004_CKPT_GUARDED = """
+import time
+from . import telemetry
+
+def note_snapshot(step, nbytes, t0):
+    if telemetry.enabled():
+        telemetry.inc("ckpt.snapshots")
+        telemetry.observe("ckpt.d2h_seconds", time.time() - t0)
+        telemetry.set_gauge("ckpt.last_step", step)
+"""
+
+
+def test_e004_covers_ckpt_telemetry(tmp_path):
+    """ckpt.* bookings ride note_dispatch on the training hot path: the
+    fast-path guard contract applies to them like any other recorder."""
+    findings, _, _ = _lint_src(tmp_path, E004_CKPT_UNGUARDED)
+    assert _ids(findings).count("E004") >= 2, findings
+    findings, _, _ = _lint_src(tmp_path, E004_CKPT_GUARDED)
+    assert findings == [], findings

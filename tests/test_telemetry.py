@@ -620,3 +620,37 @@ def test_attach_value_histogram_shares_one_object():
     with pytest.raises(ValueError, match="fixed ladder"):
         telemetry.attach_value_histogram("test.fixed2",
                                          telemetry.ValueHistogram())
+
+
+def test_parse_log_telemetry_grows_ckpt_columns(tmp_path):
+    """ISSUE 16 satellite: --telemetry renders `ckpt_secs`/`ckpt_bytes`/
+    `resumes` from the ckpt.* namespace; records from runs that predate
+    (or never armed) checkpointing render '-' — the same column-addition
+    contract every prior telemetry growth followed."""
+    from tools.parse_log import _TELEMETRY_COLS, parse_telemetry
+
+    assert _TELEMETRY_COLS[-3:] == ["ckpt_secs", "ckpt_bytes", "resumes"]
+    old = {"flush_seq": 1, "counters": {}, "gauges": {}, "histograms": {}}
+    new = {"flush_seq": 2,
+           "counters": {"ckpt.snapshots": 4, "ckpt.commits": 4,
+                        "ckpt.bytes": 612352, "ckpt.resumes": 1},
+           "gauges": {"ckpt.last_step": 8},
+           "histograms": {"ckpt.write_seconds":
+                          {"count": 4, "sum": 0.125}}}
+    rows = parse_telemetry([json.dumps(old), json.dumps(new)])
+    assert rows[0]["ckpt_secs"] is None and rows[0]["ckpt_bytes"] is None \
+        and rows[0]["resumes"] is None
+    assert rows[1]["ckpt_secs"] == 0.125
+    assert rows[1]["ckpt_bytes"] == 612352
+    assert rows[1]["resumes"] == 1
+    f = tmp_path / "t.jsonl"
+    f.write_text(json.dumps(old) + "\n" + json.dumps(new) + "\n")
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         "--telemetry", str(f)], capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "ckpt_secs" in r.stdout and "ckpt_bytes" in r.stdout
+    assert "resumes" in r.stdout

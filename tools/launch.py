@@ -93,6 +93,138 @@ def _free_port():
     return port
 
 
+# a worker that checkpointed at an epoch boundary and wants its full-width
+# slots back exits with this code (ckpt/elastic.py YIELD_EXIT_CODE — the
+# two constants must stay in lockstep)
+_ELASTIC_YIELD_RC = 3
+
+
+def _elastic_log(msg):
+    print("[elastic] %s" % msg, file=sys.stderr, flush=True)
+
+
+def _watch_generation(workers, poll=0.2):
+    """Block until the generation resolves: every worker exited (returns
+    the list of return codes), or SOME worker died while others still
+    run (reap the survivors — they may be wedged in a collective with
+    the dead peer — and return the codes with survivors marked None →
+    killed)."""
+    import time as _time
+
+    while True:
+        codes = [p.poll() for p in workers]
+        done = [c for c in codes if c is not None]
+        if len(done) == len(workers):
+            return codes
+        if any(c is not None and c not in (0, _ELASTIC_YIELD_RC)
+               for c in codes):
+            # a mid-run death: give the rest a short grace (a clean
+            # near-simultaneous exit wave), then reap
+            deadline = _time.time() + 2.0
+            while _time.time() < deadline:
+                codes = [p.poll() for p in workers]
+                if all(c is not None for c in codes):
+                    return codes
+                _time.sleep(poll)
+            for p in workers:
+                if p.poll() is None:
+                    p.terminate()
+            deadline = _time.time() + 5.0
+            while _time.time() < deadline:
+                if all(p.poll() is not None for p in workers):
+                    break
+                _time.sleep(poll)
+            for p in workers:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            return [p.poll() for p in workers]
+        _time.sleep(poll)
+
+
+def _run_elastic(args, repo_root):
+    """Elastic supervisor (docs/checkpoint.md "Elastic workflow"): run
+    the SPMD job as a sequence of GENERATIONS.  Each generation is a
+    fresh set of worker processes on a fresh coordinator; when a rank
+    dies mid-run the survivors are reaped (membership change, not
+    in-place repair) and the next generation launches at N-1 with
+    ``MXTPU_CKPT_RESUME`` pointing at the checkpoint directory, so it
+    resumes from the last committed manifest and replays the identical
+    global batch sequence.  With --elastic-regrow the shrunken
+    generation is asked (regrow.request sentinel) to yield at its next
+    epoch boundary — exit code _ELASTIC_YIELD_RC — and relaunches at
+    full width without burning a restart."""
+    ckpt_dir = os.environ.get("MXTPU_CKPT_DIR")
+    if not ckpt_dir:
+        _elastic_log("error: --elastic requires MXTPU_CKPT_DIR "
+                     "(the checkpoint directory is the recovery medium)")
+        return 2
+    os.makedirs(ckpt_dir, exist_ok=True)
+    full_n = args.num_workers
+    n = full_n
+    restarts = 0
+    generation = 0
+    while True:
+        coord = "127.0.0.1:%d" % _free_port()
+        _elastic_log("generation %d: %d worker(s), coordinator %s"
+                     % (generation, n, coord))
+        workers = []
+        for i in range(n):
+            env = dict(os.environ)
+            env["MXTPU_COORDINATOR"] = coord
+            env["DMLC_NUM_WORKER"] = str(n)
+            env["MXTPU_PROCESS_ID"] = str(i)
+            env["DMLC_WORKER_ID"] = str(i)
+            env["MXTPU_ELASTIC_GENERATION"] = str(generation)
+            # lenient resume: an empty dir (generation 0) starts fresh
+            env["MXTPU_CKPT_RESUME"] = ckpt_dir
+            if args.local_devices > 0:
+                env["MXTPU_LOCAL_DEVICES"] = str(args.local_devices)
+            env["PYTHONPATH"] = (repo_root + os.pathsep
+                                 + os.environ.get("PYTHONPATH", ""))
+            workers.append(subprocess.Popen(args.command, env=env))
+        codes = _watch_generation(workers)
+        dead = [r for r, c in enumerate(codes)
+                if c not in (0, _ELASTIC_YIELD_RC)]
+        if not dead:
+            if any(c == _ELASTIC_YIELD_RC for c in codes):
+                # the shrunken generation yielded at an epoch boundary:
+                # relaunch at full width (budget-free — nothing failed)
+                _elastic_log("generation %d yielded for regrow; "
+                             "relaunching at %d worker(s)"
+                             % (generation, full_n))
+                # consume the sentinel: the full-width generation must
+                # not see a stale request and yield again immediately
+                try:
+                    os.unlink(os.path.join(ckpt_dir, "regrow.request"))
+                except OSError:
+                    pass
+                n = full_n
+                generation += 1
+                continue
+            _elastic_log("generation %d finished cleanly" % generation)
+            return 0
+        if restarts >= args.elastic_max_restarts:
+            _elastic_log(
+                "generation %d lost rank(s) %s but the restart budget "
+                "(%d) is spent; giving up" % (generation, dead, restarts))
+            return 1
+        restarts += 1
+        n = max(args.elastic_min_workers, n - len(dead))
+        _elastic_log("generation %d lost rank(s) %s (codes %s); "
+                     "shrinking to %d worker(s) and resuming from '%s' "
+                     "(restart %d/%d)"
+                     % (generation, dead, codes, n, ckpt_dir, restarts,
+                        args.elastic_max_restarts))
+        if args.elastic_regrow and n < full_n:
+            # ask the shrunken generation to hand its slots back at the
+            # next epoch boundary (ckpt/elastic.py reads the sentinel)
+            from_path = os.path.join(ckpt_dir, "regrow.request")
+            with open(from_path, "w") as f:
+                f.write("regrow\n")
+        generation += 1
+
+
 def main():
     parser = argparse.ArgumentParser(description="Launch a distributed job")
     parser.add_argument("-n", "--num-workers", type=int, default=None)
@@ -134,6 +266,25 @@ def main():
                              "with MXTPU_OBS_STALL_SECONDS for the "
                              "collective stall watchdog.  See "
                              "docs/observability.md")
+    parser.add_argument("--elastic", action="store_true",
+                        help="(--local-spmd) supervise the SPMD job "
+                             "elastically (docs/checkpoint.md): on a "
+                             "mid-run rank death, reap the survivors and "
+                             "relaunch at N-1 resuming from the last "
+                             "committed checkpoint in MXTPU_CKPT_DIR "
+                             "(exported as MXTPU_CKPT_RESUME); requires "
+                             "MXTPU_CKPT_DIR and -s 0 (pure SPMD, no "
+                             "parameter servers)")
+    parser.add_argument("--elastic-max-restarts", type=int, default=2,
+                        help="(--elastic) how many mid-run rank deaths "
+                             "to survive before giving up")
+    parser.add_argument("--elastic-min-workers", type=int, default=1,
+                        help="(--elastic) never shrink below this many "
+                             "workers")
+    parser.add_argument("--elastic-regrow", action="store_true",
+                        help="(--elastic) after a shrink, ask the "
+                             "running generation to yield at its next "
+                             "epoch boundary and relaunch at full width")
     parser.add_argument("--serve-replicas", type=int, default=0,
                         help="launch a serving fleet instead of a PS/SPMD "
                              "job: N copies of the command, each one "
@@ -205,6 +356,16 @@ def main():
     if args.num_workers is None:
         parser.error("-n/--num-workers is required (except with "
                      "--serve-replicas)")
+    if args.elastic:
+        # elastic supervision is pure-SPMD: the PS control plane has no
+        # membership-change story (server state would be lost with the
+        # generation), so servers are refused rather than half-working
+        if not args.local_spmd:
+            parser.error("--elastic requires --local-spmd")
+        if args.num_servers:
+            parser.error("--elastic requires -s 0 (no parameter servers)")
+        args.num_servers = 0
+        sys.exit(_run_elastic(args, repo_root))
     if args.num_servers is None:
         args.num_servers = args.num_workers
     if args.local_spmd and args.launcher != "local":

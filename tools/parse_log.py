@@ -135,6 +135,8 @@ def parse_telemetry(lines):
         data_bytes = sum(v for k, v in counters.items()
                          if k.startswith("data.worker_bytes."))
         dec_h = hist.get("data.decode_seconds", {})
+        has_ckpt = any(k.startswith("ckpt.")
+                       for k in list(counters) + list(gauges) + list(hist))
         rows.append({
             "flush_seq": rec.get("flush_seq"),
             "step": rec.get("step"),
@@ -225,6 +227,14 @@ def parse_telemetry(lines):
             "service_p99": _hist_quantile(
                 hist.get("serving.service_seconds", {}), 0.99)
             if "serving.service_seconds" in hist else None,
+            # checkpoint columns (mxnet_tpu/ckpt, docs/checkpoint.md):
+            # cumulative background shard-write seconds, bytes written,
+            # and how many times this run resumed from a manifest — '-'
+            # for logs that predate the checkpoint subsystem
+            "ckpt_secs": (hist.get("ckpt.write_seconds", {}).get("sum", 0.0)
+                          if has_ckpt else None),
+            "ckpt_bytes": counters.get("ckpt.bytes", 0) if has_ckpt else None,
+            "resumes": counters.get("ckpt.resumes", 0) if has_ckpt else None,
         })
     return rows
 
@@ -288,7 +298,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "decode_mbps", "comm_gbps", "overlap_pct", "retraces",
                    "sched_div", "quant_clip_pct", "tenant_bits",
                    "replicas_healthy", "redispatches", "route_p99",
-                   "trace_sampled", "slo_burn", "queue_p99", "service_p99"]
+                   "trace_sampled", "slo_burn", "queue_p99", "service_p99",
+                   "ckpt_secs", "ckpt_bytes", "resumes"]
 
 
 def _print_rows(rows, cols, fmt):
