@@ -79,6 +79,16 @@ def parse_args():
                         "one JSON row with both sides + the overhead "
                         "delta.  With --smoke the row asserts the "
                         "delta is within noise and <=1%")
+    p.add_argument("--lock-ab", action="store_true",
+                   help="--serve: measure the MXTPU_LOCK_CHECK=1 "
+                        "RecordingLock sentinel overhead (docs/"
+                        "observability.md 'Observing lock contention') "
+                        "— the SAME load driven against a plain server, "
+                        "then a fresh server built with the sentinel "
+                        "armed, 3 timed chunks per side.  With --smoke "
+                        "the row asserts the armed side saw ZERO "
+                        "order-graph cycles and the overhead is <5% "
+                        "(within noise)")
     p.add_argument("--trace-sample", type=float, default=0.01,
                    help="--trace-ab: the sampled fraction of the ON "
                         "side (default 0.01)")
@@ -1456,6 +1466,9 @@ def serve(args):
     server.warmup()
     if args.trace_ab:
         return _serve_trace_ab(args, server, tenants, xs, total, telemetry)
+    if args.lock_ab:
+        return _serve_lock_ab(args, server, preds, max_batch, wait_ms,
+                              xs, total, telemetry)
     telemetry.reset()
     miss0 = telemetry.counter_value("executor.compile_cache_misses")
 
@@ -1593,6 +1606,107 @@ def _serve_trace_ab(args, server, tenants, xs, total, telemetry):
         assert compile_misses == 0, "trace A/B window recompiled"
         assert row["sampling_decisions"] > 0, row
         assert overhead_pct <= max(1.0, 2.0 * noise_pct), row
+    print(json.dumps(row))
+
+
+def _serve_lock_ab(args, server, preds, max_batch, wait_ms, xs, total,
+                   telemetry):
+    """--serve --lock-ab: the MXTPU_LOCK_CHECK sentinel overhead pin.
+    Side A drives the plain warm server (sentinel off — its locks are
+    raw threading primitives, bound at construction).  Side B sets
+    MXTPU_LOCK_CHECK=1 and builds a FRESH server over the same
+    predictors — the locks.lock/condition factories read the env at
+    construction, so only the new server's locks are RecordingLocks —
+    then drives the identical load.  3 timed chunks per side (the --ab
+    stdev machinery).  Under --smoke the row asserts the armed side's
+    lock-order graph has ZERO cycles and the throughput overhead is
+    under the 5% acceptance bar (within noise)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import locks
+
+    per_chunk = max(24, -(-total // 3))
+    miss0 = telemetry.counter_value("executor.compile_cache_misses")
+
+    def side(srv, chunks=3):
+        rates = []
+        for _ in range(chunks):
+            elapsed, failed, driven = _drive_load(
+                srv.submit, srv.tenants, xs, args, per_chunk)
+            assert failed == 0, "lock A/B dropped requests"
+            rates.append(driven / elapsed)
+        return rates
+
+    side(server, chunks=1)  # settle: one untimed chunk after warmup
+    a_rates = side(server)  # sentinel off
+    server.close()
+
+    prev = os.environ.get("MXTPU_LOCK_CHECK")
+    os.environ["MXTPU_LOCK_CHECK"] = "1"
+    try:
+        locks.reset()
+        armed = mx.serving.ModelServer(preds, max_batch=max_batch,
+                                       wait_ms=wait_ms)
+        armed.warmup()
+        side(armed, chunks=1)  # settle the armed side too
+        b_rates = side(armed)
+        armed.close()
+        cycle_list = locks.cycles()
+        graph_edges = sum(len(v) for v in locks.order_graph().values())
+        snap = telemetry.snapshot()
+        # hold_seconds books on every release; wait_seconds only on
+        # contended acquires (a clean smoke run may legitimately be
+        # contention-free), so the presence pin is on hold hists
+        lock_hists = sorted(k for k in snap["histograms"]
+                            if k.startswith("locks.hold_seconds."))
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_LOCK_CHECK", None)
+        else:
+            os.environ["MXTPU_LOCK_CHECK"] = prev
+
+    compile_misses = (telemetry.counter_value(
+        "executor.compile_cache_misses") - miss0)
+    a, b = float(np.mean(a_rates)), float(np.mean(b_rates))
+    overhead_pct = (a - b) / a * 100.0
+    noise_pct = 100.0 * (float(np.std(a_rates))
+                         + float(np.std(b_rates))) / a
+    row = {
+        "metric": "lock-sentinel overhead, %d-tenant serving load "
+                  "(%s), MXTPU_LOCK_CHECK=0 vs 1"
+                  % (len(preds), "tiny CPU smoke" if args.smoke
+                     else "ResNet-50+152, 1 chip"),
+        "value": round(overhead_pct, 3),
+        "unit": "% img/s overhead",
+        "sink": "lock_overhead",
+        "a": {"label": "MXTPU_LOCK_CHECK=0",
+              "img_s": round(a, 2),
+              "stdev": round(float(np.std(a_rates)), 2)},
+        "b": {"label": "MXTPU_LOCK_CHECK=1",
+              "img_s": round(b, 2),
+              "stdev": round(float(np.std(b_rates)), 2)},
+        "overhead_pct": round(overhead_pct, 3),
+        "noise_pct": round(noise_pct, 3),
+        "requests_per_chunk": per_chunk,
+        "order_cycles": len(cycle_list),
+        "order_edges": graph_edges,
+        "lock_hists": lock_hists,
+        "contended": telemetry.counter_value("locks.contended"),
+        "compile_misses_timed": compile_misses,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        # the CI pin (tests/test_bench_smoke.py): the timed windows
+        # never recompiled, the armed side really recorded (edges +
+        # wait histograms prove RecordingLocks were live), its order
+        # graph is acyclic, and the overhead is within noise of the
+        # <5% acceptance bar
+        assert compile_misses == 0, "lock A/B window recompiled"
+        assert graph_edges > 0, "armed side recorded no lock edges"
+        assert lock_hists, "armed side booked no lock histograms"
+        assert cycle_list == [], cycle_list
+        assert overhead_pct <= max(5.0, 2.0 * noise_pct), row
     print(json.dumps(row))
 
 

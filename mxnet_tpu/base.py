@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import threading
+from . import locks
 
 __all__ = [
     "MXNetError",
@@ -79,7 +80,7 @@ class _NameCounter:
     """Thread-safe per-prefix counter used for auto-naming."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.lock("base.name_counter")
         self._counts = {}
 
     def next(self, prefix):

@@ -354,6 +354,26 @@ REGISTRY = [
            "MXTPU_OBS_STALL_ACTION=abort the rank exits code 18) — "
            "catching the desync BEFORE the stall watchdog's timeout "
            "would fire.  0 (default) = off"),
+    EnvVar("MXTPU_LOCK_CHECK", int, 0,
+           "Runtime lock-contract verifier (mxnet_tpu/locks.py, the "
+           "runtime half of mxlint E008/E009): 1 makes the declared "
+           "lock factories (locks.lock/rlock/condition) hand out "
+           "RecordingLocks that keep per-thread held-sets, fold every "
+           "acquisition into a global lock ORDER graph, raise a "
+           "DeadlockError postmortem naming both conflicting "
+           "acquisition sites when an acquisition would close a cycle "
+           "(BEFORE blocking on the deadlock), and book "
+           "locks.wait_seconds.<name>/locks.hold_seconds.<name> "
+           "histograms + a locks.contended counter into telemetry "
+           "(lock_wait.<name> spans while profiling).  0 (default) = "
+           "plain threading primitives, zero overhead"),
+    EnvVar("MXTPU_LOCK_CHECK_ACTION", str, "raise",
+           "What MXTPU_LOCK_CHECK=1 does on an order-graph cycle: "
+           "'raise' (default) raises the DeadlockError at the "
+           "offending acquisition; 'dump' records it (locks."
+           "violations(), locks.order_violations counter) and prints "
+           "the postmortem to stderr, letting the run continue — the "
+           "soak-test mode"),
     # ---- checkpoint / elastic training (mxnet_tpu/ckpt) ----
     EnvVar("MXTPU_CKPT_DIR", str, "",
            "Non-empty arms periodic async distributed checkpoints in "

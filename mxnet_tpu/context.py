@@ -16,6 +16,7 @@ from __future__ import annotations
 import threading
 
 import jax
+from . import locks
 
 __all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_tpus", "num_gpus"]
 
@@ -35,7 +36,7 @@ class Context:
     devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
     devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
 
-    _default_lock = threading.Lock()
+    _default_lock = locks.lock("context.default")
     _current = threading.local()
 
     def __init__(self, device_type, device_id=0):

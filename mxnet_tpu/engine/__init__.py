@@ -33,6 +33,7 @@ from .threaded import ThreadedEngine
 from .sanitizer import SanitizerEngine
 from .var import Var, in_engine_op, note_access, set_access_hook
 from .threaded_iter import ThreadedIter
+from .. import locks
 
 __all__ = ["get", "set_engine_type", "push", "new_variable", "wait_for_var",
            "wait_for_all", "in_engine_op", "note_access", "set_access_hook",
@@ -40,7 +41,7 @@ __all__ = ["get", "set_engine_type", "push", "new_variable", "wait_for_var",
            "SanitizerEngine"]
 
 _ENGINE = None
-_ENGINE_LOCK = threading.Lock()
+_ENGINE_LOCK = locks.lock("engine.singleton")
 
 _THREADED_NAMES = ("ThreadedEnginePerDevice", "ThreadedEngine")
 
@@ -105,6 +106,9 @@ def set_engine_type(engine_type, num_workers=None):
     global _ENGINE
     with _ENGINE_LOCK:
         if _ENGINE is not None:
+            # the singleton lock must cover the drain: a get() between
+            # drain and swap would push ops onto the dying backend
+            # mxlint: disable=E009 -- intentional: swap serialization must cover the drain
             _ENGINE.wait_for_all()
             _ENGINE.stop()
         _ENGINE = _create(engine_type, num_workers)

@@ -42,6 +42,7 @@ import warnings
 
 from . import var as _varmod
 from .threaded import ThreadedEngine
+from .. import locks
 
 __all__ = ["SanitizerEngine", "RaceWarning", "RaceError", "Violation"]
 
@@ -155,7 +156,7 @@ class SanitizerEngine(ThreadedEngine):
                 strict = False
         self.strict = strict
         self.violations = []
-        self._vio_lock = threading.Lock()
+        self._vio_lock = locks.lock("engine.sanitizer")
         _varmod.set_access_hook(self._on_access)
 
     def stop(self):

@@ -28,6 +28,7 @@ from __future__ import annotations
 import heapq
 import threading
 import time
+from .. import locks
 
 from .var import (OpRecord, Var, attach_tokens, dedupe_vars, grant_ready,
                   release_tokens, enter_op, exit_op, in_engine_op)
@@ -42,9 +43,9 @@ class ThreadedEngine:
 
     def __init__(self, num_workers=2):
         self.num_workers = max(1, int(num_workers))
-        self._lock = threading.Lock()
-        self._work_cv = threading.Condition(self._lock)   # workers idle here
-        self._done_cv = threading.Condition(self._lock)   # sync points wait here
+        self._lock = locks.lock("engine.threaded")
+        self._work_cv = locks.condition("engine.threaded", self._lock)   # workers idle here
+        self._done_cv = locks.condition("engine.threaded", self._lock)   # sync points wait here
         self._ready = []          # heap of runnable OpRecords
         self._inflight = 0        # pushed, not yet completed
         self._waiters = 0         # threads blocked in wait_for_var/all

@@ -73,6 +73,7 @@ import jax.numpy as jnp
 
 from . import engine
 from .ops.registry import get_op
+from . import locks
 
 __all__ = ["enabled", "set_enabled", "max_ops", "set_max_ops", "record",
            "materialize", "flush_for_array", "flush_all", "pending_ops",
@@ -91,11 +92,11 @@ def _env_int(name, fallback):
 _ENABLED = bool(_env_int("MXTPU_LAZY", 1))
 _MAX_OPS = max(1, _env_int("MXTPU_LAZY_MAX_OPS", 64))
 
-_LOCK = threading.RLock()      # guards _GRAPHS + per-graph state
+_LOCK = locks.rlock("lazy.graphs")      # guards _GRAPHS + per-graph state
 _GRAPHS = {}                   # (device_typeid, device_id) -> _Graph
 _PENDING = 0                   # total deferred nodes (lock-free fast check)
 
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = locks.lock("lazy.cache")
 _FUSION_CACHE = {}             # program -> jitted runner
 _SEEN_KEYS = set()             # (program, input sig): telemetry hit/miss
 _SEEN_KEYS_CAP = 65536         # telemetry-only; cleared when full

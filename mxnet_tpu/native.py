@@ -13,10 +13,11 @@ import ctypes
 import os
 import subprocess
 import threading
+from . import locks
 
 __all__ = ["get_recordio_lib", "get_imdecode_lib", "NativeImageDecoder"]
 
-_LOCK = threading.Lock()
+_LOCK = locks.lock("native.build")
 _LIB = {}
 
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src")
@@ -41,6 +42,7 @@ def _load(name, sources, extra=()):
         if name in _LIB:
             return _LIB[name]
         try:
+            # mxlint: disable=E009 -- build-once gate: concurrent first-callers must wait for ONE g++ run
             path = _build(name, sources, extra)
             lib = ctypes.CDLL(path)
         except Exception:
@@ -95,6 +97,7 @@ def _embedded_lib_path(name, sources):
             out = os.path.join(_BUILD_DIR, "lib%s.so" % name)
             if old != flags and os.path.exists(out):
                 os.remove(out)
+            # mxlint: disable=E009 -- same build-once gate as _load: one compile, callers wait for its result
             path = _build(name, sources, extra)
             os.makedirs(_BUILD_DIR, exist_ok=True)
             with open(flags_path, "w") as f:

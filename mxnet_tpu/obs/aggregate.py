@@ -36,6 +36,7 @@ import socket
 import threading
 import time
 
+from .. import locks
 from ..parallel.dist import (_connect_retry, _meta, _parse_meta,
                              _recv_frame, _send_frame)
 
@@ -146,7 +147,7 @@ class Aggregator:
         self.cluster_file = cluster_file
         self.interval_s = float(interval_s)
         self._latest = {}  # rank -> (t_recv_mono, snapshot)
-        self._lock = threading.Lock()
+        self._lock = locks.lock("obs.aggregate")
         self._last_write = 0.0
         self._stopped = False
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -37,6 +37,7 @@ from __future__ import annotations
 import os as _os
 import threading
 import time
+from .. import locks
 
 __all__ = ["enabled", "set_enabled", "record", "events", "open_spans",
            "progress", "compiling", "last_compile_exit", "reset",
@@ -54,7 +55,7 @@ def _env_slots():
     return max(8, n)
 
 
-_LOCK = threading.Lock()
+_LOCK = locks.lock("obs.recorder")
 # collective-schedule hook (parallel/schedule_check.py installs it when
 # MXTPU_COLLECTIVE_CHECK=1): called OUTSIDE _LOCK with every enter
 # event's (kind, seq, nbytes, detail) so the cross-rank schedule

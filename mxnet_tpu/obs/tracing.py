@@ -50,6 +50,7 @@ import os as _os
 import random as _random
 import threading
 import time
+from .. import locks
 
 __all__ = ["TraceContext", "enabled", "sample_fraction", "set_sample",
            "new_trace", "to_meta", "from_meta", "record", "record_event",
@@ -76,7 +77,7 @@ def _env_cap():
 
 _SAMPLE = _env_fraction()
 _CAP = _env_cap()
-_LOCK = threading.Lock()
+_LOCK = locks.lock("obs.tracing")
 _SPANS = []          # bounded: the oldest _CAP spans are kept, then drop
 _DROPPED = 0
 # span ids: a per-process random base keeps ids unique across the

@@ -39,6 +39,7 @@ import time
 import traceback
 
 from . import recorder
+from .. import locks
 
 __all__ = ["StallWatchdog", "start", "stop", "maybe_start_from_env",
            "attribute_stall", "ABORT_EXIT_CODE"]
@@ -48,7 +49,7 @@ __all__ = ["StallWatchdog", "start", "stop", "maybe_start_from_env",
 ABORT_EXIT_CODE = 17
 
 _WD = None
-_WD_LOCK = threading.Lock()
+_WD_LOCK = locks.lock("obs.watchdog")
 
 
 _own_rank = recorder.own_rank

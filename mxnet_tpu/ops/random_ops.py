@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from .registry import register
 from .tensor import _dtype, _lit, _shape
+from .. import locks
 
 
 class _RngState:
@@ -32,7 +33,7 @@ class _RngState:
     (parallel/multihost.py)."""
 
     def __init__(self, seed=0):
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ops.random")
         self._seed = seed
         self._key = None
 

@@ -39,6 +39,7 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from .. import locks
 
 __all__ = ["LivenessBook", "Scheduler", "Server", "DistKVStore",
            "run_scheduler", "run_server"]
@@ -210,7 +211,7 @@ class Scheduler:
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind(("", port))
         self.sock.listen(128)
-        self._lock = threading.Condition()
+        self._lock = locks.condition("dist.scheduler")
         self._server_addrs = {}
         self._ranks = {"worker": 0, "server": 0}
         self._barrier_waiters = []
@@ -223,7 +224,8 @@ class Scheduler:
     def _send(self, conn, cmd, meta=b""):
         """Serialize sends per connection — a dead-node wakeup and a
         barrier reply racing on one socket would interleave mid-frame."""
-        lock = self._send_locks.setdefault(id(conn), threading.Lock())
+        lock = self._send_locks.setdefault(id(conn),
+                                           locks.lock("dist.conn_send"))
         with lock:
             _send_frame(conn, cmd, meta)
 
@@ -445,7 +447,7 @@ class _KeyState:
         self.version = 0
         self.merge = None
         self.count = 0
-        self.cond = threading.Condition()
+        self.cond = locks.condition("dist.entry")
 
 
 class Server:
@@ -457,7 +459,7 @@ class Server:
         self.updater = None  # (key:str, recv np, stored np) -> None
         self.command_hook = None  # (head:int, body:bytes) -> None
         self.store = {}
-        self._store_lock = threading.Lock()
+        self._store_lock = locks.lock("dist.server_store")
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind(("", port))
@@ -621,8 +623,8 @@ class DistKVStore:
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
         self._sched = _connect_retry((root, port))
-        self._sched_send_lock = threading.Lock()
-        self._sched_recv_lock = threading.Lock()
+        self._sched_send_lock = locks.lock("dist.sched_send")
+        self._sched_recv_lock = locks.lock("dist.sched_recv")
         # MXTPU_RECOVER_RANK: rejoin a running job under the old rank after
         # a crash (ps-lite is_recovery; reference kvstore_dist.h:39-44,77-80).
         # Servers retained state, so re-Init is ignored and the worker
@@ -652,7 +654,8 @@ class DistKVStore:
         self._server_addrs = info["servers"]
         _start_heartbeat(self._sched, self._sched_send_lock)
         self._servers = [_connect_retry(tuple(a)) for a in self._server_addrs]
-        self._server_locks = [threading.Lock() for _ in self._servers]
+        self._server_locks = [locks.lock("dist.server_conn")
+                              for _ in self._servers]
         self._push_round = {}
         self._updater = None
         if self.is_recovery:
@@ -707,6 +710,10 @@ class DistKVStore:
             with self._sched_send_lock:
                 _send_frame(self._sched, _DEADNODES)
             while True:
+                # _sched_recv_lock exists to serialize request/reply
+                # turns on the ONE scheduler socket; replies are
+                # immediate and the heartbeat never takes this lock
+                # mxlint: disable=E009 -- intentional: the lock serializes turns on the scheduler socket
                 cmd, meta, _ = _recv_frame(self._sched)
                 if cmd == _DEADNODES_R:
                     return _parse_meta(meta).get("dead", [])
@@ -740,6 +747,7 @@ class DistKVStore:
             try:
                 while True:
                     try:
+                        # mxlint: disable=E009 -- barrier turn on the serialized scheduler socket, bounded by settimeout + deadline
                         cmd, meta, _ = _recv_frame(self._sched)
                     except socket.timeout:
                         if time.monotonic() > deadline:
@@ -858,6 +866,7 @@ class DistKVStore:
                 with self._sched_send_lock:
                     _send_frame(self._sched, _FINALIZE)
                 while True:
+                    # mxlint: disable=E009 -- finalize handshake on the serialized scheduler socket, bounded by the 10 s settimeout
                     cmd, _, _ = _recv_frame(self._sched)
                     if cmd == _ACK:
                         break
@@ -938,5 +947,5 @@ def run_server(command_hook=None):
     _send_frame(sched, _REGISTER, _meta(role="server", host=my_host, port=server.port))
     cmd, meta, _ = _recv_frame(sched)
     assert cmd == _ADDRS
-    _start_heartbeat(sched, threading.Lock(), server._stop)
+    _start_heartbeat(sched, locks.lock("dist.heartbeat_send"), server._stop)
     server.serve_forever()

@@ -42,6 +42,7 @@ import os
 import sys
 import threading
 import time
+from .. import locks
 
 __all__ = ["enabled", "set_enabled", "ScheduleLog", "ScheduleDivergence",
            "ScheduleVerifier", "digest", "note_event", "first_divergence",
@@ -103,7 +104,7 @@ class ScheduleLog:
     instance feeds production, tests build their own."""
 
     def __init__(self, ring_slots=_RING_SLOTS):
-        self._lock = threading.Lock()
+        self._lock = locks.lock("dist.schedule_hash")
         self._ring_slots = int(ring_slots)
         self.reset()
 
@@ -360,7 +361,7 @@ class ScheduleVerifier(threading.Thread):
 
 
 _VERIFIER = None
-_VERIFIER_LOCK = threading.Lock()
+_VERIFIER_LOCK = locks.lock("dist.schedule_verifier")
 
 
 def maybe_start_from_env():

@@ -20,6 +20,7 @@ from . import ndarray as nd
 from . import symbol as sym
 from .base import MXNetError
 from .context import cpu, current_context
+from . import locks
 
 __all__ = ["Predictor"]
 
@@ -114,7 +115,7 @@ class Predictor:
         # sequence must be atomic or the same signature binds twice
         import threading
 
-        self._cache_lock = threading.Lock()
+        self._cache_lock = locks.lock("predict.cache")
         self._type_dict = dict(type_dict) if type_dict else None
         self._bind(dict(input_shapes))
 

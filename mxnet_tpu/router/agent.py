@@ -35,6 +35,7 @@ import time
 from ..base import MXNetError
 from ..serving.server import ModelServer
 from . import wire
+from .. import locks
 
 __all__ = ["ReplicaAgent"]
 
@@ -115,7 +116,7 @@ class ReplicaAgent:
         self.port = self._sock.getsockname()[1]
         # serializes SUBMIT's server grab against WARMUP's server swap
         # (rebucketing) and CLOSE
-        self._server_lock = threading.RLock()
+        self._server_lock = locks.rlock("router.agent_server")
         self._server = ModelServer(self._tenants, buckets=buckets,
                                    **self._server_kw)
         self._stop = threading.Event()
@@ -169,7 +170,7 @@ class ReplicaAgent:
             t.join(timeout=5.0)
 
     def _serve_conn(self, conn):
-        send_lock = threading.Lock()
+        send_lock = locks.lock("router.conn_send")
         try:
             while True:
                 cmd, info, arrays = wire.recv(conn)

@@ -47,6 +47,7 @@ from ..parallel.dist import LivenessBook, _connect_retry
 from ..serving.request import AdmissionError, RequestTimeout, ServerClosed
 from . import wire
 from .policy import NoHealthyReplica, derive_ladder, pick_replica
+from .. import locks
 
 __all__ = ["Router", "ReplicaDead", "RouterClosed", "NoHealthyReplica"]
 
@@ -127,7 +128,7 @@ class _Replica:
         self.addr = addr
         self.name = None
         self.sock = None
-        self.send_lock = threading.Lock()
+        self.send_lock = locks.lock("router.replica_send")
         self.reader = None
         self.alive = True
         self.health = None
@@ -187,7 +188,7 @@ class Router:
         # a replica is stale-dead after 5 silent poll intervals (floored
         # so a very tight test cadence doesn't flap on scheduler jitter)
         self._dead_after = max(5 * self._poll_s, 2.0)
-        self._lock = threading.Condition()
+        self._lock = locks.condition("router.flights")
         self._book = LivenessBook(timeout=self._dead_after)
         self._flights = {}
         self._pending_replays = 0  # flights between pop and re-place

@@ -31,6 +31,7 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as _np
 
 from ..base import MXNetError
+from .. import locks
 
 __all__ = ["Request", "RequestQueue", "RequestTimeout", "AdmissionError",
            "ServerClosed"]
@@ -165,7 +166,7 @@ class RequestQueue:
     chrome counter lane beside the dispatch spans."""
 
     def __init__(self, max_queue):
-        self._cv = threading.Condition()
+        self._cv = locks.condition("serving.queue")
         self._queues = {}
         self._depth = 0
         self._max_queue = int(max_queue)

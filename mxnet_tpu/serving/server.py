@@ -30,6 +30,7 @@ from ..base import MXNetError
 from .bucket import bucket_ladder
 from .request import Request, RequestQueue, ServerClosed
 from .session import TenantSession
+from .. import locks
 
 __all__ = ["ModelServer"]
 
@@ -59,7 +60,7 @@ class ModelServer:
                                    else config.get("MXTPU_SERVE_MAX_QUEUE"))
         self._slo = {}  # tenant -> (budget_s, target) declared at add_tenant
         self._sessions = {}
-        self._lock = threading.Lock()
+        self._lock = locks.lock("serving.server")
         self._stopping = False
         self._closed = False
         # per-server liveness counters for health() — instance-scoped on

@@ -40,6 +40,7 @@ from .. import engine
 from .. import io as _io
 from ..base import MXNetError
 from .bucket import choose_bucket, pad_rows
+from .. import locks
 
 __all__ = ["TenantSession"]
 
@@ -67,7 +68,7 @@ class TenantSession:
         # bucket (add_tenant while serving) — without this, both sides
         # could compile the same program and double-count
         # serving.bucket_programs
-        self._prog_lock = _threading.Lock()
+        self._prog_lock = locks.lock("serving.session_progs")
         self._slot_vars = (engine.new_variable(), engine.new_variable())
         self._fills = 0
         # buckets whose program has RUN at least once (warm() or a

@@ -1,0 +1,63 @@
+"""Scripted AB/BA deadlock for tests/test_locks.py.
+
+Two threads barrier-synchronize so each provably holds its first lock
+before touching its second — a REAL deadlock, not a timing-lucky one.
+With ``MXTPU_LOCK_CHECK=1`` (the test's chaos side) exactly one thread
+gets a DeadlockError at edge-insert time — BEFORE blocking — releases
+its lock on unwind, the other proceeds, and the process exits 0
+printing ``DEADLOCK_CAUGHT`` with both recorded sites.  With the check
+off (the control side) both locks are plain ``threading.Lock`` and the
+process hangs in join() until the test kills it.
+"""
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import locks  # noqa: E402
+
+
+def main():
+    a = locks.lock("chaos.A")
+    b = locks.lock("chaos.B")
+    barrier = threading.Barrier(2)
+    caught = []
+
+    def run_ab():
+        try:
+            with a:
+                barrier.wait(timeout=10)
+                with b:  # site 1: B under A
+                    pass
+        except locks.DeadlockError as e:
+            caught.append(e)
+
+    def run_ba():
+        try:
+            with b:
+                barrier.wait(timeout=10)
+                with a:  # site 2: A under B — the reverse edge
+                    pass
+        except locks.DeadlockError as e:
+            caught.append(e)
+
+    t1 = threading.Thread(target=run_ab, daemon=True)
+    t2 = threading.Thread(target=run_ba, daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(30)
+    t2.join(30)
+    if len(caught) == 1:
+        e = caught[0]
+        print("DEADLOCK_CAUGHT a=%s b=%s sites=%s"
+              % (e.a, e.b, json.dumps(list(e.sites))), flush=True)
+        return 0
+    print("NO_DEADLOCK caught=%d alive=%s"
+          % (len(caught), [t1.is_alive(), t2.is_alive()]), flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

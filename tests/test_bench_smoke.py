@@ -287,3 +287,34 @@ def test_bench_ab_int8_serve_smoke():
     expect = round((out["b"]["value"] - out["a"]["value"])
                    / out["a"]["value"] * 100.0, 2)
     assert abs(out["delta_pct"] - expect) < 0.05
+
+
+@pytest.mark.slow
+def test_bench_serve_smoke_lock_overhead_and_acyclic_graph():
+    """bench.py --serve --smoke --lock-ab: the MXTPU_LOCK_CHECK
+    sentinel pin (ISSUE 17 acceptance — zero order-graph cycles over
+    the serving load and <5% throughput overhead).  Side A drives a
+    plain server, side B a fresh one built with the sentinel armed;
+    bench.py asserts the bars internally under --smoke, this pin keeps
+    the harness from silently rotting."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXTPU_LOCK_CHECK", None)
+    env.pop("MXTPU_LOCK_CHECK_ACTION", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--smoke", "--lock-ab"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == "lock_overhead" and out["smoke"] is True
+    assert out["a"]["img_s"] > 0 and out["b"]["img_s"] > 0
+    expect = round((out["a"]["img_s"] - out["b"]["img_s"])
+                   / out["a"]["img_s"] * 100.0, 3)
+    assert abs(out["overhead_pct"] - expect) < 0.05
+    # the armed side really recorded: the order graph saw edges, the
+    # hold histograms were booked, and no cycle exists over the load
+    assert out["order_edges"] > 0
+    assert out["lock_hists"], out
+    assert out["order_cycles"] == 0
+    assert out["compile_misses_timed"] == 0
+    assert out["overhead_pct"] <= max(5.0, 2.0 * out["noise_pct"])

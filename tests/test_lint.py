@@ -1673,3 +1673,280 @@ def test_e004_covers_ckpt_telemetry(tmp_path):
     assert _ids(findings).count("E004") >= 2, findings
     findings, _, _ = _lint_src(tmp_path, E004_CKPT_GUARDED)
     assert findings == [], findings
+
+
+# ----------------------------------------------------------------------
+# E008/E009 — the lock contracts (ISSUE 17, tools/analysis/lock_checks)
+# ----------------------------------------------------------------------
+
+E008_INCONSISTENT = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+E008_CONSISTENT = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_fwd(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+
+E008_TRANSITIVE = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def _take_b(self):
+        with self._b:
+            pass
+
+    def fwd(self):
+        with self._a:
+            self._take_b()
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+
+def test_e008_flags_inconsistent_lock_order(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E008_INCONSISTENT)
+    assert _ids(findings) == ["E008"], findings
+    assert "order" in findings[0].message
+
+
+def test_e008_consistent_order_is_clean(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E008_CONSISTENT)
+    assert findings == [], findings
+
+
+def test_e008_follows_in_file_helper_calls(tmp_path):
+    """The traced.py resolver: fwd() nests B under A only THROUGH
+    _take_b(), and the pair must still be caught."""
+    findings, _, _ = _lint_src(tmp_path, E008_TRANSITIVE)
+    assert _ids(findings) == ["E008"], findings
+
+
+E009_MIXED = """
+import threading
+
+class Srv:
+    def __init__(self, sock, q):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._q = q
+
+    def bad_recv(self):
+        with self._lock:
+            return self._sock.recv(4)
+
+    def bad_get(self):
+        with self._lock:
+            return self._q.get()
+
+    def bad_sync(self, arr):
+        with self._lock:
+            arr.wait_to_read()
+
+    def ok_get(self):
+        with self._lock:
+            return self._q.get(timeout=1.0)
+
+    def ok_outside(self):
+        data = self._sock.recv(4)
+        with self._lock:
+            return data
+"""
+
+E009_JUSTIFIED = """
+import threading
+
+class Srv:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+
+    def turn(self):
+        with self._lock:
+            # mxlint: disable=E009 -- the lock serializes socket turns
+            return self._sock.recv(4)
+"""
+
+
+def test_e009_flags_blocking_calls_under_lock_only(tmp_path):
+    """socket recv, timeout-less Queue.get and an engine sync under a
+    held lock are each one E009; the timeout'd get and the recv
+    OUTSIDE the lock are clean."""
+    findings, _, _ = _lint_src(tmp_path, E009_MIXED)
+    assert _ids(findings) == ["E009", "E009", "E009"], findings
+    msgs = " ".join(f.message for f in findings)
+    assert "recv" in msgs and "get" in msgs and "wait_to_read" in msgs
+
+
+def test_e009_justified_site_is_suppressed_not_dropped(tmp_path):
+    findings, suppressed, _ = _lint_src(tmp_path, E009_JUSTIFIED)
+    assert findings == [], findings
+    assert _ids(suppressed) == ["E009"]
+    assert "serializes socket turns" in suppressed[0].message
+
+
+W105_UNDISPOSED = """
+import threading
+
+def fire_and_forget(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+"""
+
+W105_DISPOSED = """
+import threading
+
+def joined(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+def daemonized(fn):
+    d = threading.Thread(target=fn, daemon=True)
+    d.start()
+
+def pooled(fns):
+    pool = []
+    for fn in fns:
+        pool.append(threading.Thread(target=fn))
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+"""
+
+
+def test_w105_flags_undisposed_thread(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, W105_UNDISPOSED)
+    assert _ids(findings) == ["W105"], findings
+
+
+def test_w105_join_daemon_and_pool_disposition_are_clean(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, W105_DISPOSED)
+    assert findings == [], findings
+
+
+def test_repo_gate_sweeps_locks_module():
+    """ISSUE 17 pin: the gate walk covers mxnet_tpu/locks.py (the
+    runtime sentinel the lock checks point at) and the check module
+    itself, so a future target-list edit cannot silently drop them."""
+    from tools.analysis.core import iter_py_files
+
+    files = iter_py_files([os.path.join(ROOT, "mxnet_tpu"),
+                           os.path.join(ROOT, "tools")])
+    swept = {os.path.relpath(f, ROOT) for f in files}
+    assert os.path.join("mxnet_tpu", "locks.py") in swept
+    assert os.path.join("tools", "analysis", "lock_checks.py") in swept
+
+
+# ----------------------------------------------------------------------
+# --changed REF — the pre-push restricted run (ISSUE 17)
+# ----------------------------------------------------------------------
+
+
+def test_changed_paths_filters_suffix_scope_and_existence(tmp_path):
+    """Unit pin on the plumbing: only .py names from the diff that
+    still exist on disk AND fall under the requested paths survive;
+    untracked files ride along via ls-files --others."""
+    from tools.analysis.__main__ import changed_paths
+
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "config.py").write_text("REGISTRY = []\n")
+    (pkg / "a.py").write_text("x = 1\n")
+    (pkg / "new.py").write_text("y = 2\n")
+    (tmp_path / "outside.py").write_text("z = 3\n")
+
+    def fake_run(cmd):
+        if cmd[:2] == ["git", "diff"]:
+            return "mxnet_tpu/a.py\nmxnet_tpu/deleted.py\noutside.py\nREADME.md\n"
+        return "mxnet_tpu/new.py\n"
+
+    got = changed_paths("HEAD", [str(pkg)], repo_root=str(tmp_path),
+                        _run=fake_run)
+    assert got == [str(pkg / "a.py"), str(pkg / "new.py")]
+
+
+def _git(tmp_path, *argv):
+    import subprocess
+
+    r = subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+                      + list(argv), cwd=str(tmp_path), capture_output=True,
+                      text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_cli_changed_mode_restricts_to_the_diff(tmp_path):
+    """End-to-end in a hermetic git repo: a committed file carries a
+    REAL finding, a new uncommitted file is clean.  The full run fails
+    on the committed finding; --changed HEAD lints only the new file
+    and exits 0; with a fully-clean tree --changed prints the no-work
+    message and still exits 0.  Both modes pinned."""
+    import subprocess
+
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "config.py").write_text("REGISTRY = []\n")
+    (pkg / "dirty.py").write_text(W105_UNDISPOSED)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (pkg / "fresh.py").write_text("x = 1\n")
+
+    def cli(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.analysis"] + list(argv),
+            cwd=ROOT, capture_output=True, text=True, timeout=120)
+
+    full = cli(str(pkg))
+    assert full.returncode == 1, full.stdout + full.stderr
+    assert "W105" in full.stdout
+
+    changed = cli("--changed", "HEAD", str(pkg))
+    assert changed.returncode == 0, changed.stdout + changed.stderr
+    assert "W105" not in changed.stdout
+
+    (pkg / "fresh.py").unlink()
+    none = cli("--changed", "HEAD", str(pkg))
+    assert none.returncode == 0, none.stdout + none.stderr
+    assert "no changed python files" in none.stdout
+
+    bad = cli("--changed", "no-such-ref", str(pkg))
+    assert bad.returncode == 2, bad.stdout + bad.stderr

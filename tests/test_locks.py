@@ -1,0 +1,363 @@
+"""mxnet_tpu.locks — the MXTPU_LOCK_CHECK runtime lock sentinel
+(ISSUE 17, docs/static_analysis.md + docs/observability.md "Observing
+lock contention").
+
+The acceptance pins: a scripted AB/BA deadlock raises DeadlockError
+naming BOTH conflicting sites in seconds with the check on and
+genuinely hangs with it off (killed by the test); a clean serving fill
+plus a router dispatch burst record ZERO order violations under the
+sentinel; MXTPU_LOCK_CHECK_ACTION=dump records instead of raising;
+hold/wait histograms and the contended counter book into telemetry;
+and with the check off the factories hand back raw threading
+primitives (the zero-overhead contract).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import locks, telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+SCRIPT = os.path.join(ROOT, "tests", "lock_deadlock_script.py")
+
+
+@pytest.fixture
+def sentinel(monkeypatch):
+    """Arm MXTPU_LOCK_CHECK=1 with a clean order graph; disarm and
+    clear on exit so the sentinel cannot leak into other tests."""
+    monkeypatch.setenv("MXTPU_LOCK_CHECK", "1")
+    monkeypatch.delenv("MXTPU_LOCK_CHECK_ACTION", raising=False)
+    locks.reset()
+    yield
+    locks.reset()
+
+
+# ----------------------------------------------------------------------
+# the chaos pin: scripted AB/BA deadlock, check on vs off
+# ----------------------------------------------------------------------
+
+
+def test_scripted_deadlock_raises_naming_both_sites():
+    """Check ON: the barrier-forced AB/BA deadlock must surface as a
+    DeadlockError in seconds — not a hang — and the postmortem must
+    carry BOTH conflicting acquisition sites (this edge and the
+    recorded reverse edge)."""
+    env = dict(os.environ, MXTPU_LOCK_CHECK="1")
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, SCRIPT], capture_output=True,
+                          text=True, timeout=60, env=env, cwd=ROOT)
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DEADLOCK_CAUGHT" in proc.stdout, proc.stdout
+    assert elapsed < 30, "detection took %.1fs — the sentinel blocked" % elapsed
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("DEADLOCK_CAUGHT")][0]
+    assert "a=chaos.A" in line and "b=chaos.B" in line
+    sites = json.loads(line.split("sites=", 1)[1])
+    # both sides of the cycle, two DISTINCT script lines
+    assert len(sites) == 2 and sites[0] != sites[1], sites
+    for s in sites:
+        assert "lock_deadlock_script.py:" in s, sites
+
+
+def test_scripted_deadlock_hangs_with_check_off():
+    """Check OFF: the same script genuinely deadlocks — the control
+    proving the chaos pin exercises a real deadlock, not a scripted
+    exception.  The test asserts the process is STILL STUCK after a
+    grace window, then kills it."""
+    env = dict(os.environ)
+    env.pop("MXTPU_LOCK_CHECK", None)
+    proc = subprocess.Popen([sys.executable, SCRIPT],
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True, env=env, cwd=ROOT)
+    try:
+        try:
+            proc.wait(timeout=8)
+            alive = False
+        except subprocess.TimeoutExpired:
+            alive = True
+        assert alive, ("control side exited — the script no longer "
+                       "deadlocks: %s" % proc.stdout.read())
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+# ----------------------------------------------------------------------
+# dump mode + the in-process detection surface
+# ----------------------------------------------------------------------
+
+
+def test_dump_mode_records_instead_of_raising(sentinel, monkeypatch):
+    monkeypatch.setenv("MXTPU_LOCK_CHECK_ACTION", "dump")
+    a, b = locks.lock("dmp.A"), locks.lock("dmp.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # reverse order: a violation, but dump mode must not raise
+            pass
+    vio = locks.violations()
+    assert len(vio) == 1, vio
+    err = vio[0]
+    assert isinstance(err, locks.DeadlockError)
+    assert {err.a, err.b} == {"dmp.A", "dmp.B"}
+    assert len(err.sites) == 2
+    # the offending edge is REPORTED, never folded in: the order graph
+    # stays acyclic so one bad site cannot poison later detection
+    assert locks.cycles() == [], locks.order_graph()
+    assert "dmp.B" in locks.order_graph().get("dmp.A", {})
+
+
+def test_order_graph_and_reset(sentinel):
+    outer, inner = locks.lock("og.outer"), locks.lock("og.inner")
+    with outer:
+        with inner:
+            assert set(locks.held_names()) == {"og.outer", "og.inner"}
+    assert "og.inner" in locks.order_graph().get("og.outer", {})
+    assert locks.cycles() == [] and locks.violations() == []
+    locks.reset()
+    assert locks.order_graph() == {}
+
+
+def test_factories_return_raw_primitives_when_off(monkeypatch):
+    """The zero-overhead contract: without MXTPU_LOCK_CHECK the
+    factories hand back stock threading objects, not RecordingLocks."""
+    monkeypatch.delenv("MXTPU_LOCK_CHECK", raising=False)
+    assert not locks.enabled()
+    assert isinstance(locks.lock("raw.l"), type(threading.Lock()))
+    assert isinstance(locks.rlock("raw.r"), type(threading.RLock()))
+    cv = locks.condition("raw.c")
+    assert isinstance(cv, threading.Condition)
+    assert not isinstance(cv._lock, locks.RecordingLock)
+
+
+def test_recursive_and_condition_protocol(sentinel):
+    r = locks.rlock("proto.r")
+    with r:
+        with r:  # recursion must not self-deadlock or double-book
+            assert locks.held_names() == ["proto.r"]
+    assert locks.held_names() == []
+    cv = locks.condition("proto.cv")
+    assert isinstance(cv._lock, locks.RecordingLock)
+    hit = []
+
+    def waiter():
+        with cv:
+            while not hit:
+                cv.wait(timeout=5)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    with cv:
+        hit.append(1)
+        cv.notify_all()
+    th.join(10)
+    assert not th.is_alive()
+    assert locks.held_names() == []
+
+
+# ----------------------------------------------------------------------
+# telemetry booking
+# ----------------------------------------------------------------------
+
+
+def test_contention_books_wait_hist_and_counter(sentinel):
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        slow = locks.lock("tm.slow")
+        release = threading.Event()
+
+        def holder():
+            with slow:
+                release.wait(timeout=5)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        time.sleep(0.05)            # holder provably owns the lock
+        release_timer = threading.Timer(0.05, release.set)
+        release_timer.start()
+        with slow:                  # contended acquire
+            pass
+        th.join(10)
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("locks.contended", 0) >= 1
+        wait_h = snap["histograms"].get("locks.wait_seconds.tm.slow")
+        assert wait_h and wait_h["count"] >= 1
+        hold_h = snap["histograms"].get("locks.hold_seconds.tm.slow")
+        assert hold_h and hold_h["count"] >= 2  # holder + contender
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(prev)
+
+
+# ----------------------------------------------------------------------
+# the clean-path pin: serving fill + router dispatch burst, zero
+# violations under the armed sentinel
+# ----------------------------------------------------------------------
+
+
+def _mlp(hidden, classes, seed):
+    mx.random.seed(seed)
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
+        act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=classes, name="fc2"),
+        name="softmax")
+
+
+def _predictor(net, sample=(12,)):
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1,) + sample)], label_shapes=None,
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    params = {"arg:%s" % k: v for k, v in arg.items()}
+    params.update({"aux:%s" % k: v for k, v in aux.items()})
+    return mx.Predictor(net, params, {"data": (1,) + sample}, ctx=mx.cpu())
+
+
+def test_clean_serving_fill_records_zero_violations(sentinel, monkeypatch):
+    """A healthy concurrent serving burst under MXTPU_LOCK_CHECK=1
+    (dump mode so a regression reports every violation rather than
+    dying on the first): the order graph must stay acyclic, zero
+    violations, and the lock histograms must land in the telemetry
+    snapshot (the observability half of the acceptance criterion)."""
+    monkeypatch.setenv("MXTPU_LOCK_CHECK_ACTION", "dump")
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        server = mx.serving.ModelServer(
+            {"t": _predictor(_mlp(16, 5, 0))}, max_batch=8, wait_ms=2)
+        assert isinstance(server._lock, locks.RecordingLock)
+        server.warmup()
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(12).astype("float32") for _ in range(8)]
+        errs = []
+
+        def client(n):
+            try:
+                for i in range(n):
+                    server.submit("t", {"data": xs[i % len(xs)]}).result(
+                        timeout=30)
+            except Exception as e:  # surfaced below — no silent drops
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(12,))
+                   for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(120)
+        server.close()
+        assert not errs, errs
+        assert locks.violations() == []
+        assert locks.cycles() == []
+        snap = telemetry.snapshot()
+        hold = [k for k in snap["histograms"]
+                if k.startswith("locks.hold_seconds.serving.")]
+        assert hold, sorted(snap["histograms"])
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(prev)
+
+
+def test_clean_router_burst_records_zero_violations(sentinel, monkeypatch):
+    """Router dispatch burst under the armed sentinel: one replica
+    agent subprocess (also armed via the inherited env), a burst of
+    submits through the Router, zero violations + acyclic graph on the
+    router side."""
+    monkeypatch.setenv("MXTPU_LOCK_CHECK_ACTION", "dump")
+    from mxnet_tpu.router import Router
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_LOCK_CHECK="1",
+               MXTPU_LOCK_CHECK_ACTION="dump")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tests",
+                                      "router_agent_script.py"),
+         json.dumps({"seed": 0})],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=ROOT)
+    router = None
+    try:
+        port = None
+        deadline = time.time() + 120
+        for line in proc.stdout:
+            if line.startswith("AGENT_PORT="):
+                port = int(line.strip().split("=", 1)[1])
+                break
+            if time.time() > deadline:
+                break
+        assert port is not None, "agent never reported its port"
+        threading.Thread(target=proc.stdout.read, daemon=True).start()
+
+        router = Router(["127.0.0.1:%d" % port], poll_ms=100,
+                        adapt_window_s=0)
+        rng = np.random.RandomState(1)
+        xs = [rng.randn(12).astype("float32") for _ in range(8)]
+        futs = [router.submit("m", {"data": xs[i % len(xs)]})
+                for i in range(24)]
+        for f in futs:
+            f.result(timeout=60)
+        assert locks.violations() == []
+        assert locks.cycles() == []
+    finally:
+        if router is not None:
+            try:
+                router.close(drain=False, shutdown_replicas=True,
+                             timeout=30)
+            except Exception:
+                pass
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+# ----------------------------------------------------------------------
+# parse_log rendering
+# ----------------------------------------------------------------------
+
+
+def test_parse_log_renders_lock_columns():
+    """`parse_log --telemetry` renders the sentinel's contention lane:
+    lock_wait_ms sums every locks.wait_seconds.* histogram, contended
+    is the counter; pre-lock logs (no locks.* namespace) render '-'
+    (None) in both columns."""
+    from tools.parse_log import _TELEMETRY_COLS, parse_telemetry
+
+    lock_rec = {
+        "flush_seq": 1, "step": 0,
+        "counters": {"locks.contended": 5},
+        "gauges": {},
+        "histograms": {
+            "locks.wait_seconds.serving.queue": {
+                "count": 3, "sum": 0.010, "min": 0.001, "max": 0.006,
+                "buckets": {"le_0.01": 3, "le_inf": 0}},
+            "locks.wait_seconds.engine.threaded": {
+                "count": 1, "sum": 0.0025, "min": 0.0025, "max": 0.0025,
+                "buckets": {"le_0.01": 1, "le_inf": 0}}},
+    }
+    legacy_rec = {"flush_seq": 2, "step": 5, "counters": {},
+                  "gauges": {}, "histograms": {}}
+    rows = parse_telemetry([json.dumps(lock_rec), json.dumps(legacy_rec)])
+    assert rows[0]["lock_wait_ms"] == pytest.approx(12.5)
+    assert rows[0]["contended"] == 5
+    assert rows[1]["lock_wait_ms"] is None
+    assert rows[1]["contended"] is None
+    assert "lock_wait_ms" in _TELEMETRY_COLS and "contended" in _TELEMETRY_COLS

@@ -629,7 +629,8 @@ def test_parse_log_telemetry_grows_ckpt_columns(tmp_path):
     contract every prior telemetry growth followed."""
     from tools.parse_log import _TELEMETRY_COLS, parse_telemetry
 
-    assert _TELEMETRY_COLS[-3:] == ["ckpt_secs", "ckpt_bytes", "resumes"]
+    i = _TELEMETRY_COLS.index("ckpt_secs")
+    assert _TELEMETRY_COLS[i:i + 3] == ["ckpt_secs", "ckpt_bytes", "resumes"]
     old = {"flush_seq": 1, "counters": {}, "gauges": {}, "histograms": {}}
     new = {"flush_seq": 2,
            "counters": {"ckpt.snapshots": 4, "ckpt.commits": 4,

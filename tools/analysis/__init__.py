@@ -3,14 +3,14 @@
 Run as ``python -m tools.analysis [paths...]``; see __main__.py for
 the CLI (JSON output, baseline gating, --stats), core.py for the
 one-parse-per-file framework, and docs/static_analysis.md for the
-full check catalog (E001-E007, W101-W104, L001), the justification-
+full check catalog (E001-E009, W101-W105, L001), the justification-
 mandatory allowlist contract, and each check's runtime counterpart
 (SanitizerEngine, the collective-schedule verifier, the retrace
-monitor).
+monitor, the MXTPU_LOCK_CHECK lock sentinel).
 """
 from .core import Finding, all_checks, register, run_paths
 from . import (engine_checks, general_checks, lazy_checks,  # noqa: F401
-               retrace_checks, spmd_checks, telemetry_checks,
-               trace_checks)  # noqa: F401  (register checks)
+               lock_checks, retrace_checks, spmd_checks,
+               telemetry_checks, trace_checks)  # noqa: F401  (register)
 
 __all__ = ["Finding", "all_checks", "register", "run_paths"]

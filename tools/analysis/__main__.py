@@ -12,6 +12,12 @@ Machine-readable mode: ``--format json`` emits one stable object —
 findings additionally carry their allowlist ``justification``
 (tests/test_lint.py pins the schema).
 
+Changed-files mode: ``--changed REF`` restricts the run to ``.py``
+files reported by ``git diff --name-only REF`` (plus untracked files)
+that fall under the given paths — the pre-push recipe: lint only what
+this branch touched.  A run where nothing relevant changed prints
+"no changed python files" and exits 0.
+
 Baseline gating: ``--write-baseline FILE`` snapshots the current
 findings (paths repo-root-relative, matched by (check, path) counts so
 line drift does not churn it); ``--baseline FILE`` then fails only on
@@ -27,6 +33,45 @@ import json
 import sys
 
 from .core import _find_repo_root, all_checks, run_paths
+
+
+def changed_paths(ref, paths, repo_root=None, _run=None):
+    """``.py`` files changed vs ``ref`` (``git diff --name-only`` plus
+    untracked via ``git ls-files --others``) that exist and fall under
+    one of ``paths``.  Returns absolute paths, sorted; raises
+    RuntimeError when git itself fails (unknown ref, not a repo)."""
+    import os
+    import subprocess
+
+    root = repo_root or _find_repo_root(os.path.abspath(paths[0])
+                                        if paths else os.getcwd())
+    if _run is None:
+        def _run(cmd):
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True)
+            if proc.returncode != 0:
+                raise RuntimeError((proc.stderr or proc.stdout).strip()
+                                   or "git failed: %s" % " ".join(cmd))
+            return proc.stdout
+
+    names = _run(["git", "diff", "--name-only", ref]).splitlines()
+    names += _run(["git", "ls-files", "--others",
+                   "--exclude-standard"]).splitlines()
+    scopes = [os.path.abspath(p) for p in paths]
+    out = set()
+    for name in names:
+        name = name.strip()
+        if not name.endswith(".py"):
+            continue
+        full = os.path.join(root, name)
+        if not os.path.isfile(full):
+            continue  # deleted in the diff
+        if scopes and not any(
+                full == s or full.startswith(s.rstrip(os.sep) + os.sep)
+                for s in scopes):
+            continue
+        out.add(full)
+    return sorted(out)
 
 BASELINE_SCHEMA = "mxlint-baseline-v1"
 JSON_SCHEMA = "mxlint-v1"
@@ -111,8 +156,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m tools.analysis",
         description="mxlint: engine dependency-contract (E001-E005), "
-                    "trace/SPMD contract (E006-E007), and hygiene/"
-                    "retrace (W1xx) checks. See docs/static_analysis.md.")
+                    "trace/SPMD contract (E006-E007), lock-contract "
+                    "(E008-E009), and hygiene/retrace/thread (W1xx) "
+                    "checks. See docs/static_analysis.md.")
     ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
                     help="files or directories (default: mxnet_tpu)")
     ap.add_argument("--select", action="append", default=[],
@@ -120,6 +166,10 @@ def main(argv=None):
                     "(repeatable, e.g. --select E)")
     ap.add_argument("--ignore", action="append", default=[],
                     metavar="ID", help="skip checks with this id prefix")
+    ap.add_argument("--changed", metavar="REF",
+                    help="lint only .py files changed vs this git ref "
+                         "(git diff --name-only REF, plus untracked), "
+                         "filtered to the given paths")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print allowlisted findings + justifications")
@@ -142,9 +192,29 @@ def main(argv=None):
                            "`-- justification`"))
         return 0
 
+    lint_paths = args.paths
+    if args.changed:
+        try:
+            lint_paths = changed_paths(args.changed, args.paths)
+        except RuntimeError as e:
+            print("ERROR resolving --changed %s: %s" % (args.changed, e),
+                  file=sys.stderr)
+            return 2
+        if not lint_paths:
+            if args.format == "json":
+                print(json.dumps({
+                    "schema": JSON_SCHEMA, "findings": [], "baselined": [],
+                    "suppressed": [], "errors": [],
+                    "stats": {"files": 0, "findings": 0, "suppressed": 0,
+                              "errors": 0, "seconds": 0.0},
+                }, indent=2))
+            else:
+                print("no changed python files vs %s" % args.changed)
+            return 0
+
     stats = {}
     findings, suppressed, errors = run_paths(
-        args.paths, select=args.select or None, ignore=args.ignore,
+        lint_paths, select=args.select or None, ignore=args.ignore,
         stats=stats)
 
     if args.write_baseline:

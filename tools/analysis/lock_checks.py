@@ -1,0 +1,507 @@
+"""mxlint lock-contract checks — the static half of the concurrency
+audit (the runtime half is ``MXTPU_LOCK_CHECK=1``, mxnet_tpu/locks.py).
+
+The engine/serving/router/obs subsystems are genuinely multithreaded
+and coordinate through declared ``threading.Lock/RLock/Condition``
+(or, equivalently, the ``locks.lock/rlock/condition`` factories).
+These checks build a per-class/per-module lock acquisition graph from
+the ``with self._lock:`` / ``acquire()``/``release()`` sites — chasing
+calls through the same within-one-file resolver the trace checks use
+(traced.py) — and report:
+
+  * **E008** — inconsistent lock ORDER: lock A held while taking B on
+    one path, B held while taking A on another.  Two threads running
+    those paths concurrently deadlock; a consistent global order (or a
+    justified ``# mxlint: disable=E008``) is required.
+  * **E009** — a BLOCKING call under a held lock: socket
+    ``recv``/``accept``, ``Queue.get()``/``Future.result()``/
+    ``.join()``/``.wait()`` without a timeout, engine sync points
+    (``wait_to_read``/``waitall``/``wait_for_all``/``wait_for_var``),
+    ``subprocess`` waits.  Every other thread needing that lock stalls
+    for the full blocking duration — the classic convoy/deadlock-by-
+    starvation shape.  Intentional cases carry a justification
+    (``# mxlint: disable=E009 -- <why the wait is bounded/required>``).
+  * **W105** — a ``threading.Thread`` created with neither
+    ``daemon=True`` nor any ``join()``/``.daemon = True`` disposition
+    in the file: the thread outlives its owner silently and can hang
+    interpreter shutdown.
+
+Like every mxlint check, resolution is names-level and per-file:
+cross-module nesting is the runtime verifier's job (RecordingLock's
+global order graph sees the composed process).  Condition variables
+constructed over a shared lock (``threading.Condition(self._lock)``,
+the engine's one-lock/two-conditions layout) are tracked as ALIASES of
+that lock, so waiting on one condition of a lock never reads as a
+second acquisition.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, register
+from .traced import FN_NODES, _Resolver, own_statements
+
+__all__ = ["LockContracts", "ThreadDisposition"]
+
+_DEF_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SOCKET_BLOCK = ("recv", "recv_into", "recvfrom", "accept")
+_ENGINE_SYNC = ("wait_to_read", "waitall", "wait_for_all", "wait_for_var")
+_SUBPROC = ("run", "call", "check_call", "check_output")
+_MAX_CALL_DEPTH = 6
+
+
+def _kw(call, name):
+    return any(k.arg == name for k in call.keywords)
+
+
+def _is_true(node):
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+class _LockTable:
+    """Declared locks of one file: ``self._x = threading.Lock()`` /
+    ``locks.lock(...)`` sites keyed ``(class_name_or_None, attr)``,
+    with Condition-over-shared-lock aliases resolved to the underlying
+    lock's key."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.decls = {}    # key -> display name
+        self._aliases = {}  # condition key -> underlying lock key
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            key = self._target_key(node.targets[0], node)
+            if key is None:
+                continue
+            kind, under = self._classify(node.value, node)
+            if kind == "lock":
+                self.decls[key] = self._display(key)
+            elif kind == "alias" and under is not None:
+                self._aliases[key] = under
+
+    def _cls_of(self, at):
+        cls = self.ctx.enclosing_class(at)
+        return cls.name if cls is not None else None
+
+    def _target_key(self, tgt, at):
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            return (self._cls_of(at), tgt.attr)
+        if isinstance(tgt, ast.Name) and not self.ctx.enclosing_functions(at):
+            return (self._cls_of(at), tgt.id)
+        return None
+
+    def _classify(self, value, at):
+        """('lock'|'alias'|None, underlying_key) for an assigned value."""
+        if not isinstance(value, ast.Call):
+            return None, None
+        f = value.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            base, name = f.value.id, f.attr
+        elif isinstance(f, ast.Name):
+            base, name = None, f.id
+        else:
+            return None, None
+        if base in (None, "threading") and name in ("Lock", "RLock"):
+            return "lock", None
+        if base == "locks" and name in ("lock", "rlock"):
+            return "lock", None
+        if ((base in (None, "threading") and name == "Condition")
+                or (base == "locks" and name == "condition")):
+            # shared-lock conditions alias their lock; a condition over
+            # its own hidden lock IS a lock for ordering purposes
+            args = value.args if base != "locks" else value.args[1:]
+            if args:
+                under = self._expr_key(args[0], at)
+                return ("alias", under) if under is not None else (None, None)
+            return "lock", None
+        return None, None
+
+    def _expr_key(self, expr, at):
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return (self._cls_of(at), expr.attr)
+        if isinstance(expr, ast.Name):
+            # module-level lock, or a class-body name from inside a method
+            for key in ((None, expr.id), (self._cls_of(at), expr.id)):
+                if key in self.decls or key in self._aliases:
+                    return key
+            return (None, expr.id)
+        return None
+
+    def key_of(self, expr, at):
+        """Canonical declared-lock key for an acquisition expression
+        (aliases chased), or None if it is not a lock this file
+        declared."""
+        key = self._expr_key(expr, at)
+        seen = set()
+        while key in self._aliases and key not in seen:
+            seen.add(key)
+            key = self._aliases[key]
+        return key if key in self.decls else None
+
+    @staticmethod
+    def _display(key):
+        cls, attr = key
+        return "%s.%s" % (cls, attr) if cls else attr
+
+
+def _calls_in(node):
+    """Call nodes in `node`'s expression tree, nested function/lambda
+    bodies excluded (their acquisitions belong to their own scope)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, FN_NODES) and n is not node:
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _blocking_reason(call, held, locks):
+    """Why `call` blocks indefinitely, or None.  `held` is the
+    [(lock_key, line)] list at the call site — condition waits on a
+    HELD lock release it and are fine; everything else is judged on
+    its own unbounded-wait shape."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in ("waitall", "wait_for_all"):
+            return "engine sync %s()" % f.id
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    a = f.attr
+    if a in _SOCKET_BLOCK:
+        return "socket .%s()" % a
+    if a in _ENGINE_SYNC:
+        return "engine sync point .%s()" % a
+    if a == "get" and not call.args and not _kw(call, "timeout") \
+            and not _kw(call, "block"):
+        return ".get() without timeout"
+    if a == "result" and not call.args and not _kw(call, "timeout"):
+        return "Future.result() without timeout"
+    if a == "join" and not call.args and not call.keywords:
+        return ".join() without timeout"
+    if a == "communicate" and not _kw(call, "timeout"):
+        return "subprocess .communicate() without timeout"
+    if a in _SUBPROC and isinstance(f.value, ast.Name) \
+            and f.value.id == "subprocess" and not _kw(call, "timeout"):
+        return "subprocess.%s() without timeout" % a
+    if a == "wait" and not call.args and not _kw(call, "timeout"):
+        key = locks.key_of(f.value, call)
+        if key is not None:
+            # waiting on a condition of a lock we hold releases that
+            # lock; only a wait while holding a DIFFERENT lock convoys
+            if any(h != key for h, _ in held):
+                return ".wait() without timeout while holding another lock"
+            return None
+        return ".wait() without timeout"
+    return None
+
+
+@register
+class LockContracts:
+    id = "E008"  # primary id; E009 findings carry their own id
+    ids = ("E008", "E009")
+    title = "consistent lock order (E008); no blocking calls under a " \
+            "held lock (E009)"
+
+    def run(self, ctx):
+        locks = _LockTable(ctx)
+        if not locks.decls:
+            return
+        self.ctx = ctx
+        self.locks = locks
+        self.res = _Resolver(ctx)
+        self.edges = {}     # (a_key, b_key) -> (outer_line, inner_line)
+        self.findings = []
+        self._acq_memo = {}
+        self._blk_memo = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _DEF_NODES):
+                self._scan(node.body, [])
+        self._scan(ctx.tree.body, [])
+        seen = set()
+        for (a, b), (oln, iln) in sorted(self.edges.items(),
+                                         key=lambda kv: kv[1][1]):
+            rev = self.edges.get((b, a))
+            pair = frozenset((a, b))
+            if rev is None or pair in seen:
+                continue
+            seen.add(pair)
+            line = max(iln, rev[1])
+            self.findings.append(Finding(
+                "E008", ctx.path, line, 0,
+                "inconsistent lock order: %r taken under %r (line %d) "
+                "but %r taken under %r (line %d) — two threads on these "
+                "paths deadlock; pick one order (docs/static_analysis.md "
+                "lock-order contract) or justify with `# mxlint: "
+                "disable=E008 -- why`"
+                % (locks.decls[b], locks.decls[a], iln,
+                   locks.decls[a], locks.decls[b], rev[1])))
+        for f in sorted(self.findings, key=Finding.sort_key):
+            yield f
+
+    # -- statement walk ----------------------------------------------------
+
+    def _scan(self, stmts, held):
+        """Walk a statement list tracking the held-lock stack.  `held`
+        entries are (lock_key, acquire_line); manual acquire()/release()
+        extend it for the remainder of the list."""
+        held = list(held)
+        for st in stmts:
+            if isinstance(st, _DEF_NODES + (ast.ClassDef,)):
+                continue  # separate scope, scanned on its own
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in st.items:
+                    key = self.locks.key_of(item.context_expr, st)
+                    if key is not None:
+                        self._edge(held + acquired, key, st.lineno)
+                        acquired.append((key, st.lineno))
+                    else:
+                        self._exprs(item.context_expr, held)
+                self._scan(st.body, held + acquired)
+            elif isinstance(st, ast.Try):
+                self._scan(st.body, held)
+                for h in st.handlers:
+                    self._scan(h.body, held)
+                self._scan(st.orelse, held)
+                self._scan(st.finalbody, held)
+                self._strip_released(st.finalbody, held)
+            elif isinstance(st, ast.If):
+                self._exprs(st.test, held)
+                self._scan(st.body, held)
+                self._scan(st.orelse, held)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._exprs(st.iter, held)
+                self._scan(st.body, held)
+                self._scan(st.orelse, held)
+            elif isinstance(st, ast.While):
+                self._exprs(st.test, held)
+                self._scan(st.body, held)
+                self._scan(st.orelse, held)
+            else:
+                self._simple(st, held)
+
+    def _lock_method(self, call):
+        """(key, 'acquire'|'release') when `call` is a declared lock's
+        acquire/release, else (None, None)."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+            key = self.locks.key_of(f.value, call)
+            if key is not None:
+                return key, f.attr
+        return None, None
+
+    def _simple(self, st, held):
+        for call in _calls_in(st):
+            key, what = self._lock_method(call)
+            if what == "acquire":
+                self._edge(held, key, call.lineno)
+                held.append((key, call.lineno))
+            elif what == "release":
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i][0] == key:
+                        del held[i]
+                        break
+            else:
+                self._call(call, held)
+
+    def _exprs(self, expr, held):
+        if expr is None:
+            return
+        for call in _calls_in(expr):
+            self._call(call, held)
+
+    def _strip_released(self, finalbody, held):
+        """acquire() in a try-body with release() in finally: the lock
+        is no longer held after the Try statement."""
+        for st in finalbody:
+            for call in _calls_in(st):
+                key, what = self._lock_method(call)
+                if what == "release":
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == key:
+                            del held[i]
+                            break
+
+    # -- edges + blocking --------------------------------------------------
+
+    def _edge(self, held, key, line):
+        for h, hline in held:
+            if h != key and (h, key) not in self.edges:
+                self.edges[(h, key)] = (hline, line)
+
+    def _call(self, call, held):
+        if not held:
+            return
+        reason = _blocking_reason(call, held, self.locks)
+        if reason is not None:
+            h, hline = held[-1]
+            self.findings.append(Finding(
+                "E009", self.ctx.path, call.lineno, call.col_offset,
+                "blocking call (%s) while holding lock %r (acquired line "
+                "%d): every thread needing the lock stalls for the full "
+                "wait — move the call outside the critical section, bound "
+                "it with a timeout, or justify with `# mxlint: "
+                "disable=E009 -- why`"
+                % (reason, self.locks.decls[h], hline)))
+            return
+        # transitive: a same-file callee that acquires or blocks does so
+        # under OUR held lock
+        for fn in self.res.resolve(call.func, call):
+            if not isinstance(fn, _DEF_NODES):
+                continue
+            for key, _ in self._trans_acquires(fn, 0, set()):
+                self._edge(held, key, call.lineno)
+            blocked = self._trans_blocking(fn, 0, set())
+            if blocked:
+                reason, bline = blocked[0]
+                h, hline = held[-1]
+                self.findings.append(Finding(
+                    "E009", self.ctx.path, call.lineno, call.col_offset,
+                    "call to %s() blocks (%s at line %d) while holding "
+                    "lock %r (acquired line %d) — move it outside the "
+                    "critical section, bound it, or justify with "
+                    "`# mxlint: disable=E009 -- why`"
+                    % (fn.name, reason, bline, self.locks.decls[h], hline)))
+
+    def _trans_acquires(self, fn, depth, stack):
+        """Lock keys `fn` may acquire anywhere inside (transitively,
+        within this file), as [(key, line)]."""
+        memo = self._acq_memo.get(fn)
+        if memo is not None:
+            return memo
+        if depth > _MAX_CALL_DEPTH or fn in stack:
+            return []
+        stack = stack | {fn}
+        out = []
+        for n in own_statements(fn):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    key = self.locks.key_of(item.context_expr, n)
+                    if key is not None:
+                        out.append((key, n.lineno))
+            elif isinstance(n, ast.Call):
+                key, what = self._lock_method(n)
+                if what == "acquire":
+                    out.append((key, n.lineno))
+                elif what is None:
+                    for callee in self.res.resolve(n.func, n):
+                        if isinstance(callee, _DEF_NODES):
+                            out.extend(self._trans_acquires(
+                                callee, depth + 1, stack))
+        self._acq_memo[fn] = out
+        return out
+
+    def _trans_blocking(self, fn, depth, stack):
+        """[(reason, line)] blocking calls reachable inside `fn`
+        (transitively, within this file) — they run under whatever lock
+        the CALLER holds."""
+        memo = self._blk_memo.get(fn)
+        if memo is not None:
+            return memo
+        if depth > _MAX_CALL_DEPTH or fn in stack:
+            return []
+        stack = stack | {fn}
+        out = []
+        for n in own_statements(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            key, what = self._lock_method(n)
+            if what is not None:
+                continue
+            reason = _blocking_reason(n, [], self.locks)
+            if reason is not None:
+                out.append((reason, n.lineno))
+            else:
+                for callee in self.res.resolve(n.func, n):
+                    if isinstance(callee, _DEF_NODES):
+                        for reason, line in self._trans_blocking(
+                                callee, depth + 1, stack):
+                            out.append((reason, n.lineno))
+        self._blk_memo[fn] = out
+        return out
+
+
+@register
+class ThreadDisposition:
+    id = "W105"
+    title = "threads need a join() or daemon=True disposition"
+
+    @staticmethod
+    def _base_name(expr):
+        """'x' for ``x`` / ``self.x`` — the loose per-file evidence key."""
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    @staticmethod
+    def _is_thread_ctor(node):
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return (isinstance(f.value, ast.Name)
+                    and f.value.id == "threading" and f.attr == "Thread")
+        return isinstance(f, ast.Name) and f.id == "Thread"
+
+    def run(self, ctx):
+        disposed = set()   # names/attrs with a join()/daemon disposition
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                if n.func.attr == "join" or (
+                        n.func.attr == "setDaemon" and n.args
+                        and _is_true(n.args[0])):
+                    name = self._base_name(n.func.value)
+                    if name:
+                        disposed.add(name)
+            elif isinstance(n, ast.Assign) and len(n.targets) == 1:
+                t = n.targets[0]
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and _is_true(n.value):
+                    name = self._base_name(t.value)
+                    if name:
+                        disposed.add(name)
+        # containers whose ELEMENTS are disposed (`for t in self._threads:
+        # t.join()`) are disposed themselves — loops and comprehensions
+        for n in ast.walk(ctx.tree):
+            target = it = None
+            if isinstance(n, ast.For):
+                target, it = n.target, n.iter
+            elif isinstance(n, ast.comprehension):
+                target, it = n.target, n.iter
+            if isinstance(target, ast.Name) and target.id in disposed:
+                name = self._base_name(it)
+                if name:
+                    disposed.add(name)
+        for n in ast.walk(ctx.tree):
+            if not self._is_thread_ctor(n):
+                continue
+            if any(k.arg == "daemon" and _is_true(k.value)
+                   for k in n.keywords):
+                continue
+            owners = []
+            parent = ctx.parents.get(n)
+            if isinstance(parent, ast.Assign):
+                owners = [self._base_name(t) for t in parent.targets]
+            elif isinstance(parent, ast.Call) \
+                    and isinstance(parent.func, ast.Attribute) \
+                    and parent.func.attr == "append":
+                owners = [self._base_name(parent.func.value)]
+            if any(o in disposed for o in owners if o):
+                continue
+            yield Finding(
+                "W105", ctx.path, n.lineno, n.col_offset,
+                "thread created with neither daemon=True nor any "
+                "join()/.daemon disposition in this file — it outlives "
+                "its owner and can hang interpreter shutdown; join it, "
+                "mark it daemon, or justify with `# mxlint: "
+                "disable=W105 -- why`")

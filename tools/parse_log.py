@@ -137,6 +137,8 @@ def parse_telemetry(lines):
         dec_h = hist.get("data.decode_seconds", {})
         has_ckpt = any(k.startswith("ckpt.")
                        for k in list(counters) + list(gauges) + list(hist))
+        has_locks = any(k.startswith("locks.")
+                        for k in list(counters) + list(hist))
         rows.append({
             "flush_seq": rec.get("flush_seq"),
             "step": rec.get("step"),
@@ -235,6 +237,17 @@ def parse_telemetry(lines):
                           if has_ckpt else None),
             "ckpt_bytes": counters.get("ckpt.bytes", 0) if has_ckpt else None,
             "resumes": counters.get("ckpt.resumes", 0) if has_ckpt else None,
+            # lock-sentinel columns (mxnet_tpu/locks.py, docs/
+            # observability.md "Observing lock contention"): total ms
+            # threads spent blocked on RecordingLocks this flush and the
+            # contended-acquire count — '-' for runs without
+            # MXTPU_LOCK_CHECK=1 (no locks.* namespace at all)
+            "lock_wait_ms": (1e3 * sum(
+                h.get("sum", 0.0) for k, h in hist.items()
+                if k.startswith("locks.wait_seconds."))
+                if has_locks else None),
+            "contended": (counters.get("locks.contended", 0)
+                          if has_locks else None),
         })
     return rows
 
@@ -299,7 +312,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "sched_div", "quant_clip_pct", "tenant_bits",
                    "replicas_healthy", "redispatches", "route_p99",
                    "trace_sampled", "slo_burn", "queue_p99", "service_p99",
-                   "ckpt_secs", "ckpt_bytes", "resumes"]
+                   "ckpt_secs", "ckpt_bytes", "resumes", "lock_wait_ms",
+                   "contended"]
 
 
 def _print_rows(rows, cols, fmt):
