@@ -70,6 +70,17 @@ def parse_args():
                         "single in-process ModelServer path")
     p.add_argument("--serve-agent", action="store_true",
                    help=argparse.SUPPRESS)  # internal: one replica of --replicas
+    p.add_argument("--generate", action="store_true",
+                   help="--serve: generative-serving load driver "
+                        "(docs/serving.md 'Decode sessions & continuous "
+                        "batching') — a transformer-LM tenant behind a "
+                        "ReplicaAgent + Router, closed-loop clients "
+                        "submitting varied-length prompts so prefills "
+                        "and token-level decode steps interleave; one "
+                        "JSON row with decoded tokens/s, request "
+                        "p50/p99, decode batch-fill, and KV-slot "
+                        "occupancy.  With --smoke: tiny CPU LM "
+                        "(tests/test_bench_smoke.py)")
     p.add_argument("--trace-ab", action="store_true",
                    help="--serve: measure request-tracing overhead "
                         "(docs/observability.md 'Request tracing & "
@@ -191,6 +202,8 @@ def main():
     if args.serve_agent:
         return serve_agent(args)
     if args.serve:
+        if args.generate:
+            return serve_generate(args)
         if args.replicas:
             return serve_replicas(args)
         return serve(args)
@@ -659,6 +672,149 @@ def _int8_serve_ab(args):
     print(json.dumps(row))
 
 
+def _lm_spec(args, mx):
+    """(lm, params, decode-length targets, prompt_len, ctx) for the
+    generative benches — a randomly-initialized TransformerLM checkpoint
+    (throughput does not care about the weights; numerics parity vs the
+    trained model is tests/test_transformer_lm.py's job)."""
+    from mxnet_tpu.models import TransformerLM
+
+    if args.smoke:
+        lm = TransformerLM(vocab=32, num_layers=2, num_heads=2,
+                           d_model=32, max_len=48)
+        targets, prompt_len, ctx = [16, 32], 4, mx.cpu()
+    else:
+        lm = TransformerLM(vocab=8192, num_layers=4, num_heads=8,
+                           d_model=512, max_len=320)
+        targets, prompt_len, ctx = [64, 256], 8, mx.tpu()
+    mx.random.seed(0)
+    mod = mx.mod.Module(lm.training_symbol(), data_names=("data",),
+                        label_names=("softmax_label",), context=ctx)
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2, 8))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    arg, aux = mod.get_params()
+    params = dict(arg)
+    params.update(aux)
+    return lm, params, targets, prompt_len, ctx
+
+
+def _kv_decode_ab(args):
+    """--ab kv_decode: KV-cache decode vs full-recompute, matched
+    greedy generation (docs/perf.md "KV-cache decode").
+
+    Side A regenerates every token by re-running the FULL prefix
+    through the score forward (padded to a power-of-two sequence
+    bucket — the honest recompute baseline: it gets the same
+    compile-once bucketing the cache side gets).  Side B prefills once
+    and decodes one token per step through the KV ring
+    (serving/decode.py's engine, driven directly — no server thread in
+    the measurement).  Both sides are warmed first and the timed
+    windows assert compile-free; greedy argmax makes the token
+    sequences bit-comparable, asserted identical under --smoke."""
+    if args.smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.bucket import bucket_ladder, choose_bucket
+    from mxnet_tpu.serving.decode import GenerateRequest, GenerativeSession
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    lm, params, targets, prompt_len, ctx = _lm_spec(args, mx)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, lm.vocab, size=prompt_len).tolist()
+    seq_bucket = 1
+    while seq_bucket < prompt_len:
+        seq_bucket *= 2
+
+    # ---- side A: full recompute through the score forward ----
+    ladder = [b for b in bucket_ladder(lm.max_len, "")
+              if b >= prompt_len] or [lm.max_len]
+    score = mx.Predictor(lm.score_symbol(), dict(params),
+                         {"data": (1, ladder[0])}, ctx=ctx)
+    for b in ladder:  # warm every sequence bucket
+        score.reshape({"data": (1, b)})
+        score.forward(data=np.zeros((1, b), np.float32))
+        score.get_output(0)
+
+    def recompute(max_new):
+        toks = list(prompt)
+        t0 = time.time()
+        for _ in range(max_new):
+            t = len(toks)
+            b = choose_bucket(ladder, t)
+            data = np.zeros((1, b), np.float32)
+            data[0, :t] = toks
+            score.reshape({"data": (1, b)})
+            score.forward(data=data)
+            logits = score.get_output(0).reshape(1, b, lm.vocab)
+            toks.append(int(np.argmax(logits[0, t - 1])))
+        return toks[prompt_len:], time.time() - t0
+
+    # ---- side B: prefill once + token-level KV decode ----
+    def kv_decode(gs, max_new):
+        req = GenerateRequest("kv_bench", prompt, 3600.0, max_new)
+        t0 = time.time()
+        leftovers = gs.admit([req])
+        assert not leftovers, "bench session was not admitted"
+        while gs.active():
+            gs.decode_step()
+        dt = time.time() - t0
+        return list(req.future.result(timeout=0).tokens), dt
+
+    rows = {}
+    for T in targets:
+        max_new = T - prompt_len
+        gs = GenerativeSession("kv_bench", lm, params, ctx=ctx,
+                               max_sessions=1, max_len=lm.max_len,
+                               max_decode_tokens=max_new,
+                               seq_buckets=[seq_bucket])
+        gs.warm()  # compile prefill + decode buckets OUTSIDE the timed window
+        miss0 = telemetry.counter_value("executor.compile_cache_misses")
+        a_toks, a_dt = recompute(max_new)
+        b_toks, b_dt = kv_decode(gs, max_new)
+        misses = (telemetry.counter_value("executor.compile_cache_misses")
+                  - miss0)
+        rows[str(T)] = {
+            "recompute_tok_s": round(max_new / a_dt, 2),
+            "kv_tok_s": round(max_new / b_dt, 2),
+            "delta_pct": round((max_new / b_dt - max_new / a_dt)
+                               / (max_new / a_dt) * 100.0, 2),
+            "tokens": max_new,
+            "match": a_toks == b_toks,
+            "compile_misses_timed": misses,
+        }
+    first, last = rows[str(targets[0])], rows[str(targets[-1])]
+    row = {
+        "metric": "A/B kv_decode: greedy decode to T tokens, full-"
+                  "recompute forward vs KV-cache decode sessions (%s)"
+                  % ("tiny CPU smoke" if args.smoke
+                     else "512d 4-layer LM, 1 chip"),
+        "sink": "kv_decode",
+        "unit": "tokens/s",
+        "a": {"value": last["recompute_tok_s"], "mode": "recompute"},
+        "b": {"value": last["kv_tok_s"], "mode": "kv_cache"},
+        "delta_pct": last["delta_pct"],
+        "targets": rows,
+        "prompt_len": prompt_len,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        # CI pins (tests/test_bench_smoke.py) start here: greedy
+        # sequences must agree token-for-token (the numerics parity the
+        # speedup is not allowed to buy back) and the timed windows
+        # must be compile-free
+        for T, r in rows.items():
+            assert r["match"], "kv decode diverged from recompute at T=%s" % T
+            assert r["compile_misses_timed"] == 0, "timed window recompiled"
+            assert r["kv_tok_s"] > 0 and r["recompute_tok_s"] > 0, rows
+    print(json.dumps(row))
+
+
 AB_SINKS = {
     "s2d_stem": {
         "unit": "img/s",
@@ -687,6 +843,13 @@ AB_SINKS = {
                 "gamma/beta)",
         "side": lambda args, smoke, flag: _conv_ab_side(
             args, smoke, None, flag, frozen=True),
+    },
+    "kv_decode": {
+        "unit": "tokens/s",
+        "desc": "greedy transformer decode, full-recompute forward vs "
+                "KV-cache decode sessions (compile-once bucketed both "
+                "sides)",
+        "run": _kv_decode_ab,
     },
     # inference-side sink: declares a whole-run body ("run") instead of
     # the training-shaped off/on "side" pair — the A/B here is two
@@ -1893,6 +2056,144 @@ def serve_replicas(args):
             # the router genuinely SPREAD traffic: with >1 replica at
             # least two served fills
             assert len(served) >= min(n, 2), per_count
+    print(json.dumps(row))
+
+
+def serve_generate(args):
+    """--serve --generate: mixed prefill/decode generative serving
+    through the Router (docs/serving.md "Decode sessions & continuous
+    batching").
+
+    One in-process ReplicaAgent hosts a generative TransformerLM
+    tenant; closed-loop clients stream generations with VARIED prompt
+    lengths and token budgets through Router.submit_generate, so new
+    prompts prefill while earlier sessions are mid-decode — the
+    token-level continuous-batching path is what gets timed, not a
+    lockstep batch.  The row reports end-to-end generated tokens/s,
+    request latency quantiles from the server's own histogram, and the
+    decode-loop health gauges (batch fill, KV-slot occupancy)."""
+    import threading
+
+    if args.smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.router import ReplicaAgent, Router
+
+    telemetry.set_enabled(True)
+    telemetry.reset()
+
+    lm, params, _targets, _plen, ctx = _lm_spec(args, mx)
+    if args.smoke:
+        max_sessions, max_len, seq_buckets = 4, 48, [8, 16]
+        total = args.requests or 24
+        prompt_lens, budgets = (2, 13), (4, 17)
+    else:
+        max_sessions, max_len, seq_buckets = 16, lm.max_len, None
+        total = args.requests or 256
+        prompt_lens, budgets = (8, 65), (16, 129)
+
+    agent = ReplicaAgent(
+        {}, port=0, replica_id=0, wait_ms=1.0,
+        generative={"lm": dict(model=lm, params=params, ctx=ctx,
+                               max_sessions=max_sessions, max_len=max_len,
+                               max_decode_tokens=budgets[1],
+                               seq_buckets=seq_buckets)})
+    agent_thread = threading.Thread(target=agent.serve_forever, daemon=True)
+    agent_thread.start()
+    router = Router(replicas=["127.0.0.1:%d" % agent.port],
+                    connect_timeout=120.0 if args.smoke else 1800.0)
+    try:
+        router.warmup()  # compiles every prefill/decode bucket program
+        telemetry.reset()
+        miss0 = telemetry.counter_value("executor.compile_cache_misses")
+
+        rng = np.random.RandomState(0)
+        jobs = [(rng.randint(0, lm.vocab,
+                             size=rng.randint(*prompt_lens)).tolist(),
+                 int(rng.randint(*budgets)))
+                for _ in range(total)]
+        tokens_out, failed = [0], [0]
+        lock = threading.Lock()
+        n_clients = max(1, args.clients)
+        shares = [jobs[i::n_clients] for i in range(n_clients)]
+
+        def client(share):
+            for prompt, max_new in share:
+                try:
+                    r = router.submit_generate(
+                        "lm", prompt, max_new_tokens=max_new,
+                        timeout_ms=600000).result(timeout=600)
+                    with lock:
+                        tokens_out[0] += len(r.tokens)
+                except Exception:
+                    with lock:
+                        failed[0] += 1
+
+        t0 = time.time()
+        threads = [threading.Thread(target=client, args=(s,), daemon=True)
+                   for s in shares if s]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        elapsed = time.time() - t0
+        compile_misses = (telemetry.counter_value(
+            "executor.compile_cache_misses") - miss0)
+        snap = telemetry.snapshot()
+        counters, gauges = snap["counters"], snap["gauges"]
+        lat = snap["histograms"].get("serving.request_seconds", {})
+    finally:
+        router.close(shutdown_replicas=True)
+        agent_thread.join(timeout=30)
+
+    retired = counters.get("serving.decode.retired", 0)
+    row = {
+        "metric": "generative serving tokens/s, mixed prefill/decode "
+                  "through the router, %d clients (%s)"
+                  % (n_clients, "tiny CPU smoke" if args.smoke
+                     else "512d 4-layer LM, 1 chip"),
+        "value": round(tokens_out[0] / elapsed, 2),
+        "unit": "tokens/s",
+        "tokens": tokens_out[0],
+        "requests": total,
+        "failed": failed[0],
+        "p50_ms": (round(_hist_q(lat, 0.5) * 1e3, 3)
+                   if lat.get("count") else None),
+        "p99_ms": (round(_hist_q(lat, 0.99) * 1e3, 3)
+                   if lat.get("count") else None),
+        "decode_dispatches": counters.get("serving.decode.dispatches", 0),
+        "decode_tokens": counters.get("serving.decode.tokens", 0),
+        "retired": {
+            "total": retired,
+            "eos": counters.get("serving.decode.retired.eos", 0),
+            "length": counters.get("serving.decode.retired.length", 0),
+        },
+        "batch_fill_ratio": gauges.get("serving.decode.batch_fill_ratio"),
+        "kv_slot_occupancy": gauges.get("kv.slot_occupancy"),
+        "bucket_programs": counters.get("serving.decode.bucket_programs", 0),
+        "compile_misses_timed": compile_misses,
+        "max_sessions": max_sessions,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        # CI pins (tests/test_bench_smoke.py) start here: every
+        # generation completed, the decode loop genuinely ran
+        # token-level batches, and the timed window never compiled
+        assert row["failed"] == 0, "smoke run dropped generations"
+        assert row["requests"] == retired, row["retired"]
+        # each session emits its FIRST token at prefill, the rest
+        # through decode steps — so the end-to-end token count must
+        # reconcile exactly against the decode counter (zero lost or
+        # double-counted tokens across retirement)
+        assert row["tokens"] > 0, row
+        assert row["tokens"] == row["decode_tokens"] + retired, row
+        assert row["decode_dispatches"] > 0, counters
+        assert row["compile_misses_timed"] == 0, "timed window recompiled"
+        assert row["p99_ms"] and row["p99_ms"] >= row["p50_ms"] > 0, lat
+        assert row["kv_slot_occupancy"] is not None, gauges
     print(json.dumps(row))
 
 

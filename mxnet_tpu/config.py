@@ -206,6 +206,32 @@ REGISTRY = [
            "the batcher dispatches a partial fill (a full "
            "MXTPU_SERVE_MAX_BATCH dispatches immediately). Larger = "
            "better fill ratio, worse p99 under light load"),
+    EnvVar("MXTPU_SERVE_MAX_SESSIONS", int, 8,
+           "Generative serving (serving/decode.py): KV-cache slots per "
+           "generative tenant — the hard cap on concurrently decoding "
+           "sessions (admission control: a prompt past the cap waits "
+           "queued until a session retires and frees its slot). The "
+           "device ring is preallocated at (slots+1, heads, "
+           "MXTPU_SERVE_KV_MAX_LEN, d_head) per layer — +1 is the "
+           "scratch slot padded decode rows write into"),
+    EnvVar("MXTPU_SERVE_MAX_DECODE_TOKENS", int, 64,
+           "Default per-session generation budget: a decode session "
+           "retires (future resolves, slot freed) after this many new "
+           "tokens unless EOS lands first "
+           "(submit_generate(max_new_tokens=) overrides per request)"),
+    EnvVar("MXTPU_SERVE_DECODE_WINDOW_MS", float, 2.0,
+           "Token-level continuous-batching window: with decode "
+           "sessions active the batcher runs one packed decode step at "
+           "least this often, admitting newly-arrived prompts (prefill)"
+           " between steps — the Orca iteration-level re-pack cadence. "
+           "Smaller = lower per-token latency, larger = better prefill "
+           "batching under mixed load"),
+    EnvVar("MXTPU_SERVE_KV_MAX_LEN", int, 256,
+           "KV-ring size per slot: max total tokens (prompt + "
+           "generated) a decode session may hold. Bounds the "
+           "preallocated per-layer device ring "
+           "((slots+1) x heads x THIS x d_head floats) and is clamped "
+           "to the model's positional table (TransformerLM.max_len)"),
     # ---- multi-replica serving tier (router/; docs/serving.md
     #      "Multi-replica tier") ----
     EnvVar("MXTPU_ROUTER_PORT", int, 0,

@@ -11,6 +11,7 @@ from . import ssd
 from . import googlenet
 from . import inception_bn
 from . import resnext
+from . import transformer_lm
 from .lenet import get_lenet
 from .mlp import get_mlp
 from .resnet import get_resnet
@@ -21,3 +22,4 @@ from .ssd import get_ssd_vgg16, get_ssd_tiny
 from .googlenet import get_googlenet
 from .inception_bn import get_inception_bn
 from .resnext import get_resnext, resnext
+from .transformer_lm import TransformerLM

@@ -1,0 +1,284 @@
+"""Transformer language model — the first transformer in the zoo
+(ROADMAP item 2: "modern traffic" for the serving tier, and the first
+real SP-runtime consumer outside dryrun).
+
+One :class:`TransformerLM` spec builds FOUR graphs over ONE parameter
+set (shared names, so a training checkpoint serves directly):
+
+* :meth:`sym_gen` — the BucketingModule factory: full-sequence
+  causal-LM training graph (embedding + learned positions, pre-LN
+  blocks with fused ``_sdp_attention``, weight-tied softmax head with
+  the pad label ignored).  Attention is ONE graph node per layer, so
+  every sequence bucket traces the same node count and buckets differ
+  only by shape — exactly what the bucketed compile-once machinery
+  wants.
+* :meth:`score_symbol` — the same forward emitting raw per-position
+  logits ``(N, T, vocab)``: the decode-parity reference and the
+  full-recompute side of ``bench.py --ab kv_decode``.
+* :meth:`prefill_symbol` — serving prefill: run the prompt through a
+  sequence bucket, write each layer's per-head K/V block into the
+  session's KV-ring slot (``_kv_cache_write``), and emit the
+  next-token logits from the prompt's true tail (``_take_step``), all
+  in one dispatch.
+* :meth:`decode_symbol` — one token-level decode step for a PACKED
+  batch of sessions: slot + length ride as traced operands into
+  ``_cached_attention``, so one compiled program per decode bucket
+  serves any join/leave mix (serving/decode.py).
+
+The serving graphs thread the KV rings functionally (caches in ->
+updated caches out); on TPU the serve program's donated-input tuple
+turns that into an in-place update.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+__all__ = ["TransformerLM"]
+
+
+class TransformerLM:
+    """Decoder-only transformer LM spec (GPT-2 shape, pre-LN).
+
+    `vocab`: vocabulary size; `num_layers`/`num_heads`/`d_model`: the
+    usual; `d_ff` defaults to ``4 * d_model``; `max_len` bounds the
+    positional table AND the serving KV ring; `dropout` applies to the
+    residual branches during training only."""
+
+    def __init__(self, vocab, num_layers=2, num_heads=2, d_model=32,
+                 d_ff=None, max_len=64, dropout=0.0):
+        if d_model % num_heads:
+            raise ValueError("d_model=%d not divisible by num_heads=%d"
+                             % (d_model, num_heads))
+        self.vocab = int(vocab)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.d_model = int(d_model)
+        self.d_ff = int(d_ff) if d_ff is not None else 4 * self.d_model
+        self.d_head = self.d_model // self.num_heads
+        self.max_len = int(max_len)
+        self.dropout = float(dropout)
+
+    # ------------------------------------------------------------------
+    # shared pieces
+    # ------------------------------------------------------------------
+    def _embed_weight(self):
+        return sym.Variable("embed_weight",
+                            shape=(self.vocab, self.d_model))
+
+    def _pos_weight(self):
+        return sym.Variable("pos_weight", shape=(self.max_len, self.d_model))
+
+    def _block_params(self, i):
+        d, ff = self.d_model, self.d_ff
+        v = sym.Variable
+        return {
+            "ln1_gamma": v("l%d_ln1_gamma" % i, shape=(d,)),
+            "ln1_beta": v("l%d_ln1_beta" % i, shape=(d,)),
+            "qkv_weight": v("l%d_qkv_weight" % i, shape=(3 * d, d)),
+            "qkv_bias": v("l%d_qkv_bias" % i, shape=(3 * d,)),
+            "out_weight": v("l%d_out_weight" % i, shape=(d, d)),
+            "out_bias": v("l%d_out_bias" % i, shape=(d,)),
+            "ln2_gamma": v("l%d_ln2_gamma" % i, shape=(d,)),
+            "ln2_beta": v("l%d_ln2_beta" % i, shape=(d,)),
+            "ffn1_weight": v("l%d_ffn1_weight" % i, shape=(ff, d)),
+            "ffn1_bias": v("l%d_ffn1_bias" % i, shape=(ff,)),
+            "ffn2_weight": v("l%d_ffn2_weight" % i, shape=(d, ff)),
+            "ffn2_bias": v("l%d_ffn2_bias" % i, shape=(d,)),
+        }
+
+    def _qkv(self, x, p, i):
+        qkv = sym.FullyConnected(x, weight=p["qkv_weight"],
+                                 bias=p["qkv_bias"],
+                                 num_hidden=3 * self.d_model,
+                                 flatten=False, name="l%d_qkv" % i)
+        return sym.SliceChannel(qkv, num_outputs=3, axis=2,
+                                name="l%d_qkv_split" % i)
+
+    def _ffn(self, h, p, i, train):
+        x = sym.LayerNorm(h, gamma=p["ln2_gamma"], beta=p["ln2_beta"],
+                          name="l%d_ln2" % i)
+        f = sym.Activation(
+            sym.FullyConnected(x, weight=p["ffn1_weight"],
+                               bias=p["ffn1_bias"], num_hidden=self.d_ff,
+                               flatten=False, name="l%d_ffn1" % i),
+            act_type="relu", name="l%d_gelu" % i)
+        f = sym.FullyConnected(f, weight=p["ffn2_weight"],
+                               bias=p["ffn2_bias"], num_hidden=self.d_model,
+                               flatten=False, name="l%d_ffn2" % i)
+        if train and self.dropout > 0:
+            f = sym.Dropout(f, p=self.dropout, name="l%d_drop" % i)
+        return h + f
+
+    def _block_train(self, h, i, train):
+        p = self._block_params(i)
+        x = sym.LayerNorm(h, gamma=p["ln1_gamma"], beta=p["ln1_beta"],
+                          name="l%d_ln1" % i)
+        q, k, v = self._qkv(x, p, i)
+        attn = sym._sdp_attention(q, k, v, num_heads=self.num_heads,
+                                  causal=True, name="l%d_attn" % i)
+        a = sym.FullyConnected(attn[0], weight=p["out_weight"],
+                               bias=p["out_bias"], num_hidden=self.d_model,
+                               flatten=False, name="l%d_proj" % i)
+        if train and self.dropout > 0:
+            a = sym.Dropout(a, p=self.dropout, name="l%d_adrop" % i)
+        h = h + a
+        return self._ffn(h, p, i, train)
+
+    def _trunk(self, data, train):
+        """Embedding + positions + the block stack + final LN; returns
+        hidden states ``(N, T, d_model)``."""
+        embed_w = self._embed_weight()
+        h = sym.Embedding(data, weight=embed_w, input_dim=self.vocab,
+                          output_dim=self.d_model, name="embed")
+        h = sym._add_positional(h, self._pos_weight(), name="pos_add")
+        for i in range(self.num_layers):
+            h = self._block_train(h, i, train)
+        h = sym.LayerNorm(h, gamma=sym.Variable("ln_f_gamma",
+                                                shape=(self.d_model,)),
+                          beta=sym.Variable("ln_f_beta",
+                                            shape=(self.d_model,)),
+                          name="ln_f")
+        return h, embed_w
+
+    def _tied_logits(self, h2d, embed_w, name):
+        """Weight-tied LM head: ``h @ embed_weight^T`` over flattened
+        positions (the tie halves head params and is the reference
+        transformer-LM convention)."""
+        return sym.dot(h2d, embed_w, transpose_b=True, name=name)
+
+    # ------------------------------------------------------------------
+    # training
+    # ------------------------------------------------------------------
+    def sym_gen(self, invalid_label=-1):
+        """BucketingModule factory: ``f(seq_len) -> (loss_sym,
+        data_names, label_names)``.  The graph itself is length-
+        independent; seq_len only feeds the iterator's provide_data, so
+        every bucket shares these node names and the arg list (the
+        BucketingModule shared-param contract)."""
+
+        def _gen(seq_len):
+            data = sym.Variable("data")
+            label = sym.Variable("softmax_label")
+            h, embed_w = self._trunk(data, train=True)
+            flat = sym.Reshape(h, shape=(-1, self.d_model), name="flat")
+            logits = self._tied_logits(flat, embed_w, "logits")
+            lab = sym.Reshape(label, shape=(-1,), name="label_flat")
+            out = sym.SoftmaxOutput(logits, lab, use_ignore=True,
+                                    ignore_label=invalid_label,
+                                    normalization="valid", name="softmax")
+            return out, ("data",), ("softmax_label",)
+
+        return _gen
+
+    def training_symbol(self, invalid_label=-1):
+        net, _, _ = self.sym_gen(invalid_label)(None)
+        return net
+
+    # ------------------------------------------------------------------
+    # serving
+    # ------------------------------------------------------------------
+    def score_symbol(self):
+        """Raw per-position logits ``(N*T, vocab)`` (reshape to
+        ``(N, T, V)`` host-side) — the full-recompute decode reference:
+        step t's next-token logits are row ``t`` of this forward run
+        over the first ``t+1`` tokens."""
+        data = sym.Variable("data")
+        h, embed_w = self._trunk(data, train=False)
+        flat = sym.Reshape(h, shape=(-1, self.d_model), name="flat")
+        return self._tied_logits(flat, embed_w, "logits")
+
+    def cache_names(self):
+        """The serving graphs' KV-ring input names, in wire order."""
+        names = []
+        for i in range(self.num_layers):
+            names += ["k_cache_%d" % i, "v_cache_%d" % i]
+        return names
+
+    def cache_shape(self, slots):
+        """Per-layer ring shape for `slots` sessions (callers add the
+        +1 scratch slot themselves — serving/decode.py owns that)."""
+        return (slots, self.num_heads, self.max_len, self.d_head)
+
+    def _cache_vars(self):
+        return {n: sym.Variable(n) for n in self.cache_names()}
+
+    def prefill_symbol(self):
+        """Prefill one prompt (batch 1, padded to a sequence bucket):
+        outputs ``[next_logits (1, vocab), k_cache_0', v_cache_0',
+        ...]``.  Inputs beyond the caches: ``data (1, T)``, ``slot
+        (1,)``, ``length (1,)`` (true prompt length)."""
+        data = sym.Variable("data")
+        slot = sym.Variable("slot")
+        length = sym.Variable("length")
+        caches = self._cache_vars()
+        embed_w = self._embed_weight()
+        h = sym.Embedding(data, weight=embed_w, input_dim=self.vocab,
+                          output_dim=self.d_model, name="embed")
+        h = sym._add_positional(h, self._pos_weight(), name="pos_add")
+        outs = []
+        for i in range(self.num_layers):
+            p = self._block_params(i)
+            x = sym.LayerNorm(h, gamma=p["ln1_gamma"], beta=p["ln1_beta"],
+                              name="l%d_ln1" % i)
+            q, k, v = self._qkv(x, p, i)
+            attn = sym._sdp_attention(q, k, v, num_heads=self.num_heads,
+                                      causal=True, name="l%d_attn" % i)
+            wrote = sym._kv_cache_write(
+                caches["k_cache_%d" % i], caches["v_cache_%d" % i],
+                attn[1], attn[2], slot, name="l%d_kv_write" % i)
+            outs += [wrote[0], wrote[1]]
+            a = sym.FullyConnected(attn[0], weight=p["out_weight"],
+                                   bias=p["out_bias"],
+                                   num_hidden=self.d_model,
+                                   flatten=False, name="l%d_proj" % i)
+            h = h + a
+            h = self._ffn(h, p, i, train=False)
+        h = sym.LayerNorm(h, gamma=sym.Variable("ln_f_gamma",
+                                                shape=(self.d_model,)),
+                          beta=sym.Variable("ln_f_beta",
+                                            shape=(self.d_model,)),
+                          name="ln_f")
+        # logits at the prompt's true tail, not the pad
+        last = sym._take_step(h, length - 1, name="last_h")
+        logits = self._tied_logits(last, embed_w, "next_logits")
+        return sym.Group([logits] + outs)
+
+    def decode_symbol(self):
+        """One decode step for a packed session batch: inputs ``data
+        (B, 1)`` (each session's last token), ``slot (B,)``, ``length
+        (B,)`` (tokens already cached), plus the rings; outputs
+        ``[logits (B, vocab), k_cache_0', v_cache_0', ...]``."""
+        data = sym.Variable("data")
+        slot = sym.Variable("slot")
+        length = sym.Variable("length")
+        caches = self._cache_vars()
+        embed_w = self._embed_weight()
+        h = sym.Embedding(data, weight=embed_w, input_dim=self.vocab,
+                          output_dim=self.d_model, name="embed")
+        h = sym._add_positional_at(h, self._pos_weight(), length,
+                                   name="pos_add")
+        outs = []
+        for i in range(self.num_layers):
+            p = self._block_params(i)
+            x = sym.LayerNorm(h, gamma=p["ln1_gamma"], beta=p["ln1_beta"],
+                              name="l%d_ln1" % i)
+            q, k, v = self._qkv(x, p, i)
+            step = sym._cached_attention(
+                q, k, v, caches["k_cache_%d" % i],
+                caches["v_cache_%d" % i], slot, length,
+                num_heads=self.num_heads, name="l%d_attn" % i)
+            outs += [step[1], step[2]]
+            a = sym.FullyConnected(step[0], weight=p["out_weight"],
+                                   bias=p["out_bias"],
+                                   num_hidden=self.d_model,
+                                   flatten=False, name="l%d_proj" % i)
+            h = h + a
+            h = self._ffn(h, p, i, train=False)
+        h = sym.LayerNorm(h, gamma=sym.Variable("ln_f_gamma",
+                                                shape=(self.d_model,)),
+                          beta=sym.Variable("ln_f_beta",
+                                            shape=(self.d_model,)),
+                          name="ln_f")
+        flat = sym.Reshape(h, shape=(-1, self.d_model), name="flat")
+        logits = self._tied_logits(flat, embed_w, "next_logits")
+        return sym.Group([logits] + outs)

@@ -1,0 +1,246 @@
+"""Transformer attention operators — fused multi-head attention for
+training/prefill and the slot-indexed KV-cache decode step.
+
+The transformer LM workload (models/transformer_lm.py, ROADMAP item 2)
+needs three graph-level primitives beyond the classic registry:
+
+* ``LayerNorm`` — the reference op the zoo lacked (InstanceNorm
+  normalizes spatial dims; a transformer normalizes the channel dim).
+* ``_sdp_attention`` — fused multi-head scaled-dot-product attention
+  over ``(N, T, d_model)`` projected inputs with an optional causal
+  mask.  Keeping QK^T -> mask -> softmax -> V inside ONE op keeps the
+  symbol graph length-independent (one node per layer, not O(T)), so
+  every sequence bucket traces the same graph and only the shapes —
+  and therefore the compiled programs — differ.  It returns the
+  per-head K/V tensors as extra outputs so the serving prefill graph
+  can write them into a KV-cache slot without recomputing the
+  projections.
+* ``_cached_attention`` / ``_kv_cache_write`` — the decode-side pair.
+  The KV ring is a preallocated ``(slots, heads, max_len, d_head)``
+  buffer per layer; the SLOT INDEX and LENGTH ride as traced operands
+  (the vLLM/PagedAttention discipline, see the paged-attention kernel
+  walkthrough: gather pages by index, mask by length), so one compiled
+  decode program serves every session mix — sessions join/leave
+  between steps without recompiling.
+
+Everything is pure jnp/lax: the ops trace into the surrounding XLA
+executable on CPU and TPU alike (the blockwise/ring Pallas kernels in
+parallel/ remain the long-context training path; decode works on
+max_len-bounded buffers where one fused softmax is the right shape).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax, nn as jnn
+
+from .registry import register
+from .tensor import _bool, _lit
+
+# matches contrib_ops._NEG: a finite mask value keeps softmax rows that
+# are ENTIRELY masked (the scratch slot's padded rows) NaN-free
+_NEG = -1e30
+
+
+def _as_index(v):
+    """Slot/length operands ride the serving wire as f32 rows (the
+    Predictor binds every input float32); index math wants i32."""
+    return v.astype(jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# LayerNorm
+# ----------------------------------------------------------------------
+
+
+def _infer_ln(in_shapes, attrs):
+    data = in_shapes[0]
+    c = (data[-1],)
+    return [data, c, c], [data]
+
+
+@register("LayerNorm", inputs=("data", "gamma", "beta"),
+          infer_shape=_infer_ln)
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **kw):
+    """Layer normalization over `axis` (reference src/operator/nn/
+    layer_norm-inl.h): normalize, then scale/shift by gamma/beta."""
+    axis = int(_lit(axis))
+    eps = float(_lit(eps))
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma + beta
+
+
+# ----------------------------------------------------------------------
+# fused multi-head attention (training + prefill)
+# ----------------------------------------------------------------------
+
+
+def _infer_sdp(in_shapes, attrs):
+    q = in_shapes[0]
+    num_heads = int(_lit(attrs.get("num_heads", 1)))
+    n, t, d = q
+    dh = d // num_heads
+    heads = (n, num_heads, t, dh)
+    return [q, q, q], [q, heads, heads]
+
+
+@register("_sdp_attention", inputs=("query", "key", "value"),
+          num_outputs=3, infer_shape=_infer_sdp)
+def sdp_attention(query, key, value, num_heads=1, causal=True, **kw):
+    """Fused multi-head scaled-dot-product attention.
+
+    Inputs are the PROJECTED ``(N, T, d_model)`` tensors (the graph
+    keeps one FullyConnected for the joint QKV projection).  Outputs:
+
+      0. context ``(N, T, d_model)`` — heads re-merged;
+      1. K per head ``(N, H, T, d_head)``;
+      2. V per head ``(N, H, T, d_head)``.
+
+    Outputs 1/2 cost nothing (they are the reshapes the op computes
+    anyway) and exist for the serving prefill graph, which writes them
+    into the session's KV-cache slot (``_kv_cache_write``) so decode
+    steps never re-project the prompt."""
+    h = int(_lit(num_heads))
+    n, t, d = query.shape
+    dh = d // h
+
+    def heads(x):
+        return x.reshape(n, t, h, dh).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = heads(query), heads(key), heads(value)
+    scores = jnp.einsum("nhqd,nhkd->nhqk", qh, kh) / jnp.sqrt(
+        jnp.asarray(dh, qh.dtype))
+    if _bool(causal):
+        keep = jnp.tril(jnp.ones((t, t), dtype=bool))
+        scores = jnp.where(keep[None, None], scores, _NEG)
+    ctx = jnp.einsum("nhqk,nhkd->nhqd", jnn.softmax(scores, axis=-1), vh)
+    return ctx.transpose(0, 2, 1, 3).reshape(n, t, d), kh, vh
+
+
+# ----------------------------------------------------------------------
+# KV-cache decode step
+# ----------------------------------------------------------------------
+
+
+def _infer_cached(in_shapes, attrs):
+    q, k, v, kc, vc, slot, length = in_shapes
+    return [q, q, q, kc, kc, slot, slot], [q, kc, kc]
+
+
+@register("_cached_attention",
+          inputs=("query", "key", "value", "k_cache", "v_cache", "slot",
+                  "length"),
+          num_outputs=3, infer_shape=_infer_cached)
+def cached_attention(query, key, value, k_cache, v_cache, slot, length,
+                     num_heads=1, **kw):
+    """One decode step of multi-head attention against a slot-indexed
+    KV ring (the PagedAttention shape: gather this session's page by
+    slot index, mask by length — both TRACED operands, so one compiled
+    program serves any session mix).
+
+    query/key/value: ``(B, 1, d_model)`` projections of the current
+    token; ``k_cache``/``v_cache``: ``(slots, H, max_len, d_head)``
+    rings; ``slot``/``length``: ``(B,)`` — session slot index and the
+    number of tokens already cached (== the new token's position).
+
+    The step's K/V are scattered into ``cache[slot, :, length]`` FIRST,
+    then attention runs over ``cache[slot, :, :length+1]`` (mask), so
+    the new token attends to itself like the full-sequence forward.
+    Padded rows of a partial decode batch point at the ring's scratch
+    slot; duplicate scatter indices there are harmless garbage.
+
+    Outputs: context ``(B, 1, d_model)``, updated k_cache, updated
+    v_cache (functional update — the serving session threads the rings
+    through every call; on TPU the donated-input path makes the update
+    in place)."""
+    h = int(_lit(num_heads))
+    b, one, d = query.shape
+    dh = d // h
+    slot_i = _as_index(slot)
+    len_i = _as_index(length)
+    kn = key.reshape(b, h, dh)
+    vn = value.reshape(b, h, dh)
+    # scatter this step's K/V at [slot, :, length, :] — advanced indices
+    # (B,) broadcast to the front, so the update block is (B, H, d_head)
+    kc = k_cache.at[slot_i, :, len_i, :].set(kn)
+    vc = v_cache.at[slot_i, :, len_i, :].set(vn)
+    ks = kc[slot_i]  # (B, H, max_len, d_head) — this session's page
+    vs = vc[slot_i]
+    qh = query.reshape(b, h, 1, dh)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, ks) / jnp.sqrt(
+        jnp.asarray(dh, qh.dtype))
+    max_len = k_cache.shape[2]
+    keep = jnp.arange(max_len)[None, None, None, :] <= \
+        len_i[:, None, None, None]
+    scores = jnp.where(keep, scores, _NEG)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", jnn.softmax(scores, axis=-1), vs)
+    return ctx.reshape(b, 1, d), kc, vc
+
+
+def _infer_kv_write(in_shapes, attrs):
+    kc, vc, kb, vb, slot = in_shapes
+    return [kc, kc, kb, kb, slot], [kc, kc]
+
+
+@register("_kv_cache_write",
+          inputs=("k_cache", "v_cache", "k_block", "v_block", "slot"),
+          num_outputs=2, infer_shape=_infer_kv_write)
+def kv_cache_write(k_cache, v_cache, k_block, v_block, slot, **kw):
+    """Prefill-side cache fill: write one request's per-head K/V block
+    ``(1, H, T, d_head)`` into ring slot ``slot`` at positions
+    ``[0, T)``.  Positions beyond the request's true length hold
+    garbage from the padded prefill — safe by construction: decode
+    masks by length and OVERWRITES position `length` before the mask
+    ever exposes it."""
+    slot_i = _as_index(slot).reshape(())
+    start = (slot_i, 0, 0, 0)
+    return (lax.dynamic_update_slice(k_cache, k_block, start),
+            lax.dynamic_update_slice(v_cache, v_block, start))
+
+
+# ----------------------------------------------------------------------
+# positional embedding add
+# ----------------------------------------------------------------------
+
+
+def _infer_pos(in_shapes, attrs):
+    data = in_shapes[0]
+    return list(in_shapes), [data]
+
+
+@register("_add_positional", inputs=("data", "pos_weight"),
+          infer_shape=_infer_pos)
+def add_positional(data, pos_weight, **kw):
+    """``data (N, T, d) + pos_weight[:T]`` — learned positional
+    embedding for the full-sequence (training / prefill) forward.  The
+    slice length is the traced shape, so every sequence bucket shares
+    this one graph node."""
+    t = data.shape[1]
+    return data + pos_weight[None, :t, :]
+
+
+@register("_add_positional_at", inputs=("data", "pos_weight", "index"),
+          infer_shape=_infer_pos)
+def add_positional_at(data, pos_weight, index, **kw):
+    """``data (B, 1, d) + pos_weight[index]`` per row — the decode-step
+    positional add, where each session sits at its OWN position
+    (``index`` == the session length, a traced operand)."""
+    idx = _as_index(index)
+    return data + pos_weight[idx][:, None, :]
+
+
+def _infer_take_step(in_shapes, attrs):
+    data, index = in_shapes
+    n, t, d = data
+    return [data, index], [(n, d)]
+
+
+@register("_take_step", inputs=("data", "index"),
+          infer_shape=_infer_take_step)
+def take_step(data, index, **kw):
+    """``data[i, index[i]]`` for each batch row — prefill uses it to
+    pick the LAST VALID position's hidden state (``index = length-1``)
+    out of the padded sequence bucket, so the next-token logits come
+    from the request's true tail, not the pad."""
+    idx = _as_index(index)
+    return data[jnp.arange(data.shape[0]), idx]

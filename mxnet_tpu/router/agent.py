@@ -98,10 +98,15 @@ class ReplicaAgent:
     ``launch.py --serve-replicas`` exports per replica)."""
 
     def __init__(self, tenants, port=None, replica_id=None, max_batch=None,
-                 buckets=None, timeout_ms=None, max_queue=None, wait_ms=None):
+                 buckets=None, timeout_ms=None, max_queue=None, wait_ms=None,
+                 generative=None):
         from .. import config
 
         self._tenants = dict(tenants)
+        # generative tenants: name -> {"model": lm, "params": {...},
+        # **add_generative_tenant kwargs}; re-registered on every server
+        # (re)construction (the rebucket swap included)
+        self._generative = {k: dict(v) for k, v in (generative or {}).items()}
         self._server_kw = dict(max_batch=max_batch, timeout_ms=timeout_ms,
                                max_queue=max_queue, wait_ms=wait_ms)
         self.replica_id = (int(replica_id) if replica_id is not None
@@ -117,9 +122,17 @@ class ReplicaAgent:
         # serializes SUBMIT's server grab against WARMUP's server swap
         # (rebucketing) and CLOSE
         self._server_lock = locks.rlock("router.agent_server")
-        self._server = ModelServer(self._tenants, buckets=buckets,
-                                   **self._server_kw)
+        self._server = self._make_server(buckets)
         self._stop = threading.Event()
+
+    def _make_server(self, buckets):
+        server = ModelServer(self._tenants, buckets=buckets,
+                             **self._server_kw)
+        for name, spec in self._generative.items():
+            spec = dict(spec)
+            server.add_generative_tenant(name, spec.pop("model"),
+                                         spec.pop("params"), **spec)
+        return server
 
     @property
     def ladder(self):
@@ -177,10 +190,14 @@ class ReplicaAgent:
                 if cmd == wire.HELLO:
                     wire.send(conn, wire.HELLO, lock=send_lock,
                               replica=self.replica_id, name=self.name,
-                              tenants=sorted(self._tenants),
+                              tenants=sorted(set(self._tenants)
+                                             | set(self._generative)),
+                              generative=sorted(self._generative),
                               ladder=self.ladder)
                 elif cmd == wire.SUBMIT:
                     self._handle_submit(conn, send_lock, info, arrays)
+                elif cmd == wire.GENERATE:
+                    self._handle_generate(conn, send_lock, info, arrays)
                 elif cmd == wire.CLOCK:
                     # NTP-style clock leg (the obs/aggregate.py recipe):
                     # echo the router's t0 plus our wall clock; the
@@ -263,6 +280,56 @@ class ReplicaAgent:
 
         fut.add_done_callback(_reply)
 
+    def _handle_generate(self, conn, send_lock, info, arrays):
+        """One GENERATE flight: enqueue into the server's generative
+        tenant, stream a TOKEN frame per sampled token (when the router
+        asked to — ``stream``), close with RESULT carrying the full
+        generated-token array + finish metadata.  TOKEN frames are sent
+        from the batcher thread under the connection's send lock, so
+        they interleave whole-frame with concurrent RESULT callbacks."""
+        req_id = info["req"]
+        prompt = (arrays or [None])[0]
+        on_token = None
+        if info.get("stream"):
+            counter = iter(range(1 << 62))
+
+            def on_token(token, _req=req_id, _conn=conn, _lock=send_lock,
+                         _seq=counter):
+                try:
+                    wire.send(_conn, wire.TOKEN, lock=_lock, req=_req,
+                              token=int(token), seq=next(_seq))
+                except (ConnectionError, OSError):
+                    pass  # router died: generation still resolves locally
+
+        with self._server_lock:
+            server = self._server
+        try:
+            fut = server.submit_generate(
+                info["tenant"], prompt,
+                max_new_tokens=info.get("max_new_tokens"),
+                eos_id=info.get("eos_id"),
+                timeout_ms=info.get("timeout_ms"), on_token=on_token)
+        except BaseException as e:  # noqa: BLE001 — travels the wire
+            self._send_error(conn, send_lock, req_id, e)
+            return
+
+        def _reply(f, _req=req_id, _conn=conn, _lock=send_lock):
+            exc = f.exception()
+            try:
+                if exc is not None:
+                    self._send_error(_conn, _lock, _req, exc)
+                else:
+                    r = f.result()
+                    wire.send(_conn, wire.RESULT, lock=_lock, req=_req,
+                              arrays=[r.tokens], generate=True,
+                              finish_reason=r.finish_reason,
+                              prompt_len=r.prompt_len)
+            except (ConnectionError, OSError):
+                pass  # router died mid-reply; generative flights are
+                #       not replayed (the KV state died with us)
+
+        fut.add_done_callback(_reply)
+
     def _send_error(self, conn, send_lock, req_id, exc):
         try:
             wire.send(conn, wire.RERROR, lock=send_lock, req=req_id,
@@ -287,9 +354,7 @@ class ReplicaAgent:
                     # resolves), stand up the new ladder on the same
                     # predictors, compile it before answering
                     self._server.close(drain=True)
-                    self._server = ModelServer(self._tenants,
-                                               buckets=list(buckets),
-                                               **self._server_kw)
+                    self._server = self._make_server(list(buckets))
                 programs = self._server.warmup()
                 ladder = list(self._server.ladder)
         except BaseException as e:  # noqa: BLE001 — travels the wire

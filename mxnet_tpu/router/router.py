@@ -81,7 +81,7 @@ class _Flight:
 
     __slots__ = ("req_id", "tenant", "inputs", "names", "future",
                  "t_submit", "timeout_ms", "replica", "redispatches",
-                 "trace", "t_sent")
+                 "trace", "t_sent", "generate", "policy", "on_token")
 
     def __init__(self, req_id, tenant, inputs, timeout_ms):
         from concurrent.futures import Future
@@ -100,6 +100,9 @@ class _Flight:
         self.redispatches = 0
         self.trace = None
         self.t_sent = None
+        self.generate = False  # GENERATE flight: never replayed (the
+        self.policy = None     # replica-resident KV state is the request)
+        self.on_token = None
 
     def fulfil(self, result):
         if not self.future.done():
@@ -370,6 +373,31 @@ class Router:
         self._place(flight)
         return flight.future
 
+    def submit_generate(self, tenant, tokens, max_new_tokens=None,
+                        eos_id=None, timeout_ms=None, on_token=None):
+        """Route one generation request to a healthy replica serving
+        the generative tenant; returns a Future resolving to a
+        :class:`~mxnet_tpu.serving.GenerateResult`.  `on_token` streams
+        each sampled token id as it is decoded (called on the reader
+        thread — keep it cheap).
+
+        Unlike classic submissions, generative flights are NOT
+        replayed when their replica dies: the session's KV cache — the
+        request's real state — died with it, and silently re-decoding
+        from the prompt could double-stream tokens the caller already
+        consumed.  The flight fails with :class:`ReplicaDead` and the
+        CALLER owns the resubmit decision (docs/serving.md)."""
+        prompt = _np.asarray(tokens, dtype=_np.int32).reshape(-1)
+        flight = _Flight(self._next_req(), tenant, {"data": prompt},
+                         self._default_timeout_ms if timeout_ms is None
+                         else timeout_ms)
+        flight.generate = True
+        flight.policy = {"max_new_tokens": max_new_tokens,
+                         "eos_id": eos_id}
+        flight.on_token = on_token
+        self._place(flight)
+        return flight.future
+
     def _next_req(self):
         with self._lock:
             self._req_seq += 1
@@ -488,10 +516,18 @@ class Router:
             trace_meta = tracing.to_meta(flight.trace)
         flight.t_sent = time.monotonic()
         try:
-            wire.send(rep.sock, wire.SUBMIT, lock=rep.send_lock,
-                      arrays=flight.inputs, req=flight.req_id,
-                      tenant=flight.tenant, names=flight.names,
-                      timeout_ms=wire_timeout, trace=trace_meta)
+            if flight.generate:
+                wire.send(rep.sock, wire.GENERATE, lock=rep.send_lock,
+                          arrays=flight.inputs, req=flight.req_id,
+                          tenant=flight.tenant,
+                          timeout_ms=wire_timeout,
+                          stream=flight.on_token is not None,
+                          **flight.policy)
+            else:
+                wire.send(rep.sock, wire.SUBMIT, lock=rep.send_lock,
+                          arrays=flight.inputs, req=flight.req_id,
+                          tenant=flight.tenant, names=flight.names,
+                          timeout_ms=wire_timeout, trace=trace_meta)
         except (ConnectionError, OSError) as e:
             self._on_death(rep, e)
             return
@@ -667,6 +703,8 @@ class Router:
                     self._book.beat(rep.name)
                 if cmd == wire.RESULT:
                     self._resolve(rep, info, arrays)
+                elif cmd == wire.TOKEN:
+                    self._note_token(rep, info)
                 elif cmd == wire.RERROR:
                     self._resolve_error(rep, info)
                 elif cmd == wire.HEALTH_R:
@@ -693,7 +731,15 @@ class Router:
         if flight is None:
             return  # late duplicate of a replayed request: already owned
         now = time.monotonic()
-        flight.fulfil(list(arrays or []))
+        if flight.generate:
+            from ..serving.decode import GenerateResult
+
+            toks = (arrays or [_np.zeros((0,), _np.int32)])[0]
+            flight.fulfil(GenerateResult(
+                toks, info.get("finish_reason", "length"),
+                int(info.get("prompt_len", 0))))
+        else:
+            flight.fulfil(list(arrays or []))
         if telemetry.enabled():
             telemetry.inc("router.requests")
             telemetry.observe("router.route_seconds", now - flight.t_submit)
@@ -728,6 +774,22 @@ class Router:
                                    side="router", tenant=flight.tenant,
                                    redispatches=flight.redispatches)
 
+    def _note_token(self, rep, info):
+        """One streamed TOKEN for an in-flight GENERATE: look the
+        flight up WITHOUT popping (the final RESULT closes it) and
+        forward to the caller's on_token.  A token for a finished or
+        unknown flight is silently dropped — frames on the connection
+        are ordered, so this only happens after a local failure
+        already resolved the future."""
+        with self._lock:
+            flight = self._flights.get(info.get("req"))
+        if flight is None or flight.on_token is None:
+            return
+        try:
+            flight.on_token(int(info["token"]))
+        except BaseException:  # noqa: BLE001 — foreign callback
+            pass  # a client callback must never kill the reader
+
     def _resolve_error(self, rep, info):
         req_id = info.get("req")
         if req_id is None:
@@ -745,7 +807,11 @@ class Router:
             flight = self._flights.pop(req_id, None)
             if flight is not None:
                 self._replicas[flight.replica].inflight.discard(req_id)
+                # generative flights never replay (submit_generate
+                # docstring): the error could arrive after tokens
+                # streamed, and a replay would re-decode them
                 will_replay = (kind in _REPLAYABLE_KINDS
+                               and not flight.generate
                                and flight.redispatches
                                < self._redispatch_cap)
                 if will_replay:
@@ -867,6 +933,25 @@ class Router:
 
         for flight in doomed:
             try:
+                if flight.generate:
+                    # the session's KV cache died with the replica; a
+                    # silent replay could double-stream tokens the
+                    # caller already consumed — fail, caller resubmits
+                    flight.fail(ReplicaDead(
+                        "generation on tenant %r: replica %s died (%s) "
+                        "mid-session; generative flights are not "
+                        "replayed (the KV-cache state died with the "
+                        "replica) — resubmit the prompt"
+                        % (flight.tenant, rep.name, exc)))
+                    if telemetry.enabled():
+                        telemetry.inc("router.lost")
+                    if tracing.enabled() and flight.trace is not None:
+                        tracing.record_outcome(
+                            flight.trace, "error", flight.t_submit,
+                            time.monotonic(), side="router",
+                            tenant=flight.tenant, error="ReplicaDead",
+                            replica=rep.name)
+                    continue
                 if flight.redispatches >= self._redispatch_cap:
                     flight.fail(ReplicaDead(
                         "request to tenant %r: replica %s died (%s) and "

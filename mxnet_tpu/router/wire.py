@@ -26,6 +26,7 @@ from ..parallel.dist import _meta, _parse_meta, _recv_frame, _send_frame
 
 __all__ = ["HELLO", "SUBMIT", "RESULT", "RERROR", "HEALTH", "HEALTH_R",
            "WARMUP", "CLOSE", "ACK", "CLOCK", "CLOCK_R", "TRACEMETA",
+           "GENERATE", "TOKEN",
            "pack_arrays", "unpack_arrays", "pyify", "send", "recv"]
 
 # frame commands — above the dist.py control-plane ids (1..17) so a
@@ -45,6 +46,11 @@ CLOCK = 48      # router -> agent: NTP-style clock ping (t0)
 CLOCK_R = 49    # agent -> router: clock reply (t0 echoed + t_server)
 TRACEMETA = 50  # router -> agent: measured clock offset for the
 #                 replica's trace stitch metadata (no reply)
+GENERATE = 52   # router -> agent: one generation request (int prompt
+#                 array payload + decode policy in meta)
+TOKEN = 53      # agent -> router: one streamed token for a GENERATE
+#                 flight (meta only: req id + token id + seq no); the
+#                 final RESULT frame still closes the flight
 
 
 def pyify(obj):

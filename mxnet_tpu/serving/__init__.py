@@ -6,18 +6,22 @@ requests from many callers for many models (tenants), packs them into
 shape-bucketed padded batches, and runs each fill through a compiled
 program that is built once per (tenant, bucket) and reused forever —
 the Orca/vLLM continuous-batching recipe expressed on this framework's
-own engine, executor-cache, staging, and telemetry machinery.  See
+own engine, executor-cache, staging, and telemetry machinery.
+Generative tenants (:mod:`.decode`) extend the same batcher with
+KV-cache decode sessions and token-level continuous batching.  See
 docs/serving.md for the architecture and docs/observability.md for the
 ``serving.*`` metric catalog.
 """
 from __future__ import annotations
 
 from .bucket import bucket_ladder, choose_bucket, pad_rows
+from .decode import GenerateRequest, GenerateResult, GenerativeSession
 from .request import (AdmissionError, Request, RequestQueue, RequestTimeout,
                       ServerClosed)
 from .server import ModelServer
 from .session import TenantSession
 
-__all__ = ["ModelServer", "TenantSession", "Request", "RequestQueue",
+__all__ = ["ModelServer", "TenantSession", "GenerativeSession",
+           "GenerateRequest", "GenerateResult", "Request", "RequestQueue",
            "RequestTimeout", "AdmissionError", "ServerClosed",
            "bucket_ladder", "choose_bucket", "pad_rows"]

@@ -1,0 +1,440 @@
+"""Generative decode sessions — slot-based KV caching + token-level
+continuous batching (ROADMAP item 2; docs/serving.md "Decode sessions
+& continuous batching").
+
+One :class:`GenerativeSession` is the generative analog of
+:class:`~.session.TenantSession`: one autoregressive LM served under
+one tenant name.  Where a TenantSession packs whole requests into one
+forward, a GenerativeSession owns *sessions* — requests that live for
+many decode iterations — and two program families:
+
+* **prefill** — one prompt (batch 1, padded to a sequence-length
+  bucket) runs through the full forward ONCE, writing each layer's
+  per-head K/V block into the session's ring slot and emitting the
+  first next-token logits from the prompt's true tail.  One dispatch,
+  cache write included.
+* **decode** — one token for EVERY active session, packed into a
+  decode-batch bucket.  Slot index and length ride as traced operands
+  (ops/attention.py `_cached_attention`), so each decode bucket
+  compiles exactly ONCE and sessions join/leave between steps without
+  recompiling — the vLLM slot discipline composed with the Orca
+  iteration-level re-pack the batcher already does for classic
+  tenants.
+
+The KV ring is preallocated at ``(max_sessions + 1, heads, max_len,
+d_head)`` per layer; index ``max_sessions`` is the SCRATCH slot padded
+decode rows write into (duplicate scatter indices there are harmless
+garbage).  The rings thread FUNCTIONALLY through every program call —
+caches in, updated caches out — which on TPU rides the serve program's
+donated input tuple (in-place update), and on CPU costs one buffer
+copy per step.
+
+Retirement (EOS, token budget, or ring-full) resolves the request's
+future with a :class:`GenerateResult` and frees the slot under
+admission control: prompts that arrive while all slots are busy wait
+in the tenant queue and are re-offered every decode window.  The
+server's close/drain contract extends to sessions: every future is
+resolved when close() returns, with partial tokens and
+``finish_reason='closed'`` on a no-drain shutdown — never lost.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import locks
+from .bucket import bucket_ladder, choose_bucket
+from .request import Request
+
+__all__ = ["GenerativeSession", "GenerateRequest", "GenerateResult"]
+
+
+class GenerateResult:
+    """What a ``submit_generate`` future resolves to.
+
+    ``tokens``: int32 numpy array of the GENERATED tokens (prompt
+    excluded, EOS included when hit); ``finish_reason``: ``'eos'`` |
+    ``'length'`` (token budget or KV ring exhausted) | ``'closed'``
+    (server shut down no-drain mid-generation — tokens are the partial
+    prefix); ``prompt_len``: tokens consumed by prefill."""
+
+    __slots__ = ("tokens", "finish_reason", "prompt_len")
+
+    def __init__(self, tokens, finish_reason, prompt_len):
+        self.tokens = _np.asarray(tokens, dtype=_np.int32)
+        self.finish_reason = str(finish_reason)
+        self.prompt_len = int(prompt_len)
+
+    def __repr__(self):
+        return ("GenerateResult(tokens=%s, finish_reason=%r, prompt_len=%d)"
+                % (self.tokens.tolist(), self.finish_reason,
+                   self.prompt_len))
+
+
+class GenerateRequest(Request):
+    """One queued generation request: the prompt snapshot plus the
+    per-request decode policy.  Rides the same RequestQueue (deadline
+    at dequeue, admission control, fairness) as classic requests."""
+
+    __slots__ = ("max_new_tokens", "eos_id", "on_token")
+
+    def __init__(self, tenant, tokens, timeout_s, max_new_tokens,
+                 eos_id=None, on_token=None, trace=None, slo=None):
+        tokens = _np.asarray(tokens, dtype=_np.int32).reshape(-1)
+        Request.__init__(self, tenant, {"data": tokens}, timeout_s,
+                         trace=trace, slo=slo)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.on_token = on_token
+
+
+class _Session:
+    """One ACTIVE decode session (post-prefill, slot held)."""
+
+    __slots__ = ("req", "slot", "prompt_len", "generated", "fed")
+
+    def __init__(self, req, slot, prompt_len):
+        self.req = req
+        self.slot = slot
+        self.prompt_len = prompt_len
+        self.generated = []  # sampled tokens; the last one is NOT fed yet
+        # positions cached so far == tokens fed through the model
+        self.fed = prompt_len
+
+
+class GenerativeSession:
+    """One generative LM tenant (module docstring).
+
+    `model` is duck-typed (models/transformer_lm.py TransformerLM is
+    the zoo instance): attributes ``num_layers`` / ``num_heads`` /
+    ``d_head`` / ``vocab`` / ``max_len`` and methods
+    ``prefill_symbol()`` / ``decode_symbol()`` / ``cache_names()``.
+    `params` maps parameter name -> array (a training checkpoint's
+    arg+aux dicts merged).  Knob defaults come from the config
+    registry: ``MXTPU_SERVE_MAX_SESSIONS`` / ``_MAX_DECODE_TOKENS`` /
+    ``_KV_MAX_LEN`` (clamped to the model's positional table)."""
+
+    is_generative = True
+
+    def __init__(self, name, model, params, ctx=None, max_sessions=None,
+                 max_len=None, max_decode_tokens=None, eos_id=None,
+                 seq_buckets=None):
+        from .. import config, telemetry
+        from ..predict import Predictor
+
+        self.name = name
+        self._model = model
+        self._slots = int(max_sessions if max_sessions is not None
+                          else config.get("MXTPU_SERVE_MAX_SESSIONS"))
+        ring_len = int(max_len if max_len is not None
+                       else config.get("MXTPU_SERVE_KV_MAX_LEN"))
+        self._max_len = min(ring_len, int(model.max_len))
+        self._budget_default = int(
+            max_decode_tokens if max_decode_tokens is not None
+            else config.get("MXTPU_SERVE_MAX_DECODE_TOKENS"))
+        self._eos_default = None if eos_id is None else int(eos_id)
+        self._cache_names = list(model.cache_names())
+        self._input_names = ["data", "slot", "length"] + self._cache_names
+        cshape = (self._slots + 1, model.num_heads, self._max_len,
+                  model.d_head)
+        self._cache_shape = cshape
+        # sequence-length ladder for prefill; decode-batch ladder for
+        # the packed step — both compile-once through the predictors'
+        # signature caches
+        self._seq_ladder = (sorted(int(b) for b in seq_buckets)
+                            if seq_buckets else
+                            bucket_ladder(self._max_len, ""))
+        self._decode_ladder = bucket_ladder(self._slots, "")
+        self._prefill_pred = Predictor(
+            model.prefill_symbol(), dict(params),
+            self._shapes(1, self._seq_ladder[0], prefill=True), ctx=ctx)
+        self._decode_pred = Predictor(
+            model.decode_symbol(), dict(params),
+            self._shapes(self._decode_ladder[0], 1, prefill=False),
+            ctx=ctx)
+        # the device-resident KV rings, threaded through every call
+        self._caches = [_np.zeros(cshape, _np.float32)
+                        for _ in self._cache_names]
+        self._free = list(range(self._slots))  # LIFO slot pool
+        self._active = []
+        self._prog_lock = locks.lock("serving.decode_progs")
+        self._programs = {}
+        self._tokens_done = 0
+        self._closed = False
+        if telemetry.enabled():
+            telemetry.set_gauge(
+                "kv.ring_bytes",
+                sum(c.nbytes for c in self._caches))
+            telemetry.set_gauge("kv.slot_occupancy", 0.0)
+            telemetry.set_gauge("serving.decode.active_sessions", 0)
+
+    # ------------------------------------------------------------------
+    # the TenantSession surface the server drives
+    # ------------------------------------------------------------------
+    def _shapes(self, batch, seq, prefill):
+        shp = {"data": (batch, seq), "slot": (batch,),
+               "length": (batch,)}
+        shp.update({n: self._cache_shape for n in self._cache_names})
+        return shp
+
+    def validate(self, inputs):
+        """A classic submit() against a generative tenant is a client
+        bug — fail it at its own caller, like any validation error."""
+        raise MXNetError(
+            "tenant %r is generative: use submit_generate(tenant, "
+            "tokens, ...) — plain submit() has no decode policy to "
+            "ride on" % self.name)
+
+    def validate_generate(self, tokens, max_new_tokens):
+        """Bounds-check one generate request at submit() time."""
+        n = int(_np.asarray(tokens).reshape(-1).shape[0])
+        if n < 1:
+            raise MXNetError("generate request for tenant %r has an "
+                             "empty prompt" % self.name)
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1, got %d"
+                             % max_new_tokens)
+        if n + max_new_tokens > self._max_len:
+            raise MXNetError(
+                "generate request for tenant %r needs %d prompt + %d "
+                "new tokens > the %d-token KV ring "
+                "(MXTPU_SERVE_KV_MAX_LEN, clamped to the model's "
+                "max_len) — shorten the prompt or the budget"
+                % (self.name, n, max_new_tokens, self._max_len))
+
+    def free_slots(self):
+        return len(self._free)
+
+    def active(self):
+        return len(self._active)
+
+    def budget_for(self, max_new_tokens):
+        return (self._budget_default if max_new_tokens is None
+                else int(max_new_tokens))
+
+    def eos_for(self, eos_id):
+        return self._eos_default if eos_id is None else int(eos_id)
+
+    def _program(self, pred, batch, seq, prefill):
+        """(executor, fn) for one (prefill-T | decode-B) bucket; the
+        session pins executors like TenantSession does, so
+        compile-once-per-bucket survives predictor-cache eviction."""
+        from .. import telemetry
+
+        key = ("prefill", seq) if prefill else ("decode", batch)
+        with self._prog_lock:
+            exe = self._programs.get(key)
+            if exe is None:
+                exe = self._programs[key] = pred.executor_for(
+                    self._shapes(batch, seq, prefill))
+                if telemetry.enabled():
+                    telemetry.inc("serving.decode.bucket_programs")
+            fn = exe.serve_program(self._input_names)
+        return exe, fn
+
+    def warm(self, buckets=None):
+        """Compile-and-run every prefill sequence bucket and decode
+        batch bucket with dummy fills (ModelServer.warmup calls this;
+        `buckets` — the server's BATCH ladder — is ignored: generative
+        programs bucket by sequence length and session count)."""
+        n = 0
+        for t in self._seq_ladder:
+            exe, fn = self._program(self._prefill_pred, 1, t, True)
+            self._run(exe, fn, _np.zeros((1, t), _np.float32),
+                      _np.full((1,), self._slots, _np.float32),
+                      _np.ones((1,), _np.float32), commit=False)
+            n += 1
+        for b in self._decode_ladder:
+            exe, fn = self._program(self._decode_pred, b, 1, False)
+            self._run(exe, fn, _np.zeros((b, 1), _np.float32),
+                      _np.full((b,), self._slots, _np.float32),
+                      _np.zeros((b,), _np.float32), commit=False)
+            n += 1
+        return n
+
+    def _run(self, exe, fn, data, slot, length, commit=True):
+        """One program call threading the rings through.  `commit=False`
+        (warmup) runs against the rings but DISCARDS the updated caches
+        — dummy fills target the scratch slot anyway."""
+        other_vals, aux_vals = exe.serve_args(self._input_names)
+        ins = tuple([data, slot, length] + list(self._caches))
+        outs = fn(ins, other_vals, aux_vals, _np.uint32(0))
+        logits = _np.asarray(outs[0])
+        if commit:
+            self._caches = list(outs[1:])
+        return logits
+
+    # ------------------------------------------------------------------
+    # admission: prefill newly-arrived prompts into free slots
+    # ------------------------------------------------------------------
+    def admit(self, reqs):
+        """Prefill each request into a free slot; returns the requests
+        that found NO free slot (the server re-queues them at the
+        front — admission control, not failure).  A prefill error
+        fails ITS request only."""
+        leftovers = []
+        for req in reqs:
+            if self._closed:
+                leftovers.append(req)
+            elif not self._free:
+                leftovers.append(req)
+            else:
+                try:
+                    self._prefill(req)
+                except BaseException as e:  # noqa: BLE001
+                    self._release_maybe(req)
+                    req.fail(e)
+        return leftovers
+
+    def _prefill(self, req):
+        from .. import telemetry
+
+        t0 = time.monotonic()
+        req.service_at = t0
+        tokens = req.inputs["data"].reshape(-1)
+        n = tokens.shape[0]
+        bucket = choose_bucket(self._seq_ladder, n)
+        exe, fn = self._program(self._prefill_pred, 1, bucket, True)
+        slot = self._free.pop()
+        data = _np.zeros((1, bucket), _np.float32)
+        data[0, :n] = tokens
+        logits = self._run(exe, fn, data,
+                           _np.full((1,), slot, _np.float32),
+                           _np.full((1,), n, _np.float32))
+        sess = _Session(req, slot, n)
+        self._active.append(sess)
+        if telemetry.enabled():
+            telemetry.inc("serving.decode.sessions")
+            telemetry.observe("serving.prefill_seconds",
+                              time.monotonic() - t0)
+            self._note_occupancy()
+        self._emit(sess, int(_np.argmax(logits[0])))
+
+    def _release_maybe(self, req):
+        """Roll back a slot a failed prefill may have claimed."""
+        for sess in list(self._active):
+            if sess.req is req:
+                self._active.remove(sess)
+                self._free.append(sess.slot)
+
+    def _note_occupancy(self):
+        from .. import telemetry
+
+        if not telemetry.enabled():
+            return
+        used = self._slots - len(self._free)
+        telemetry.set_gauge("kv.slot_occupancy", used / self._slots)
+        telemetry.set_gauge("serving.decode.active_sessions",
+                            len(self._active))
+
+    # ------------------------------------------------------------------
+    # the decode iteration
+    # ------------------------------------------------------------------
+    def decode_step(self):
+        """One token-level iteration: re-pack ALL active sessions into
+        the smallest decode bucket, run one step, sample, retire.
+        Returns tokens produced (0 when idle)."""
+        from .. import telemetry
+
+        act = self._active
+        if not act:
+            return 0
+        t0 = time.monotonic()
+        n = len(act)
+        bucket = choose_bucket(self._decode_ladder, n)
+        exe, fn = self._program(self._decode_pred, bucket, 1, False)
+        data = _np.zeros((bucket, 1), _np.float32)
+        slot = _np.full((bucket,), self._slots, _np.float32)  # scratch
+        length = _np.zeros((bucket,), _np.float32)
+        for i, sess in enumerate(act):
+            data[i, 0] = sess.generated[-1]
+            slot[i] = sess.slot
+            length[i] = sess.fed
+        logits = self._run(exe, fn, data, slot, length)
+        for i, sess in enumerate(list(act)):
+            sess.fed += 1
+            self._emit(sess, int(_np.argmax(logits[i])))
+        dt = time.monotonic() - t0
+        self._tokens_done += n
+        if telemetry.enabled():
+            telemetry.inc("serving.decode.dispatches")
+            telemetry.inc("serving.decode.tokens", n)
+            telemetry.observe("serving.decode.step_seconds", dt)
+            telemetry.set_gauge("serving.decode.batch_fill_ratio",
+                                n / bucket)
+            telemetry.set_gauge("serving.decode.tokens_per_s",
+                                n / max(dt, 1e-9))
+            self._note_occupancy()
+        return n
+
+    def _emit(self, sess, token):
+        """Book one sampled token; retire on EOS / budget / ring-full."""
+        sess.generated.append(token)
+        req = sess.req
+        if req.on_token is not None:
+            try:
+                req.on_token(token)
+            except BaseException:  # noqa: BLE001 — foreign code
+                pass  # a client callback must never kill the batcher
+        eos = self.eos_for(req.eos_id)
+        if eos is not None and token == eos:
+            self._retire(sess, "eos")
+        elif len(sess.generated) >= req.max_new_tokens:
+            self._retire(sess, "length")
+        elif sess.prompt_len + len(sess.generated) >= self._max_len:
+            self._retire(sess, "length")
+
+    def _retire(self, sess, reason):
+        """Resolve the session's future and free its slot — mid-window
+        retirement is the normal path (sessions leave between decode
+        steps; the next step simply re-packs without them)."""
+        from .. import telemetry
+
+        if sess in self._active:
+            self._active.remove(sess)
+        self._free.append(sess.slot)
+        if telemetry.enabled():
+            telemetry.inc("serving.decode.retired")
+            telemetry.inc("serving.decode.retired.%s" % reason)
+            self._note_occupancy()
+        sess.req.fulfil(GenerateResult(sess.generated, reason,
+                                       sess.prompt_len))
+
+    def finish_all(self, reason="closed"):
+        """Retire every active session NOW with its partial tokens —
+        the close(drain=False) path.  Zero lost futures, by
+        construction."""
+        for sess in list(self._active):
+            self._retire(sess, reason)
+
+    def fail_active(self, exc):
+        """A decode step blew up mid-flight: the packed step serves
+        every active session, so all of them share the failure.  Fail
+        their futures and free the slots — the tenant keeps accepting
+        new prompts (a request-level error, not a server-level one)."""
+        from .. import telemetry
+
+        for sess in list(self._active):
+            self._active.remove(sess)
+            self._free.append(sess.slot)
+            sess.req.fail(exc)
+        if telemetry.enabled():
+            self._note_occupancy()
+
+    def stats(self):
+        return {"active_sessions": len(self._active),
+                "free_slots": len(self._free),
+                "max_sessions": self._slots,
+                "max_len": self._max_len,
+                "tokens_decoded": self._tokens_done}
+
+    def drain(self):
+        """Generative dispatches are synchronous on the batcher thread
+        (the decode loop IS the pipeline) — nothing to fence."""
+
+    def close(self):
+        self._closed = True
+        self.finish_all("closed")
+        self._programs.clear()

@@ -244,12 +244,28 @@ class RequestQueue:
             self._note_depth(req.tenant)
             self._cv.notify_all()
 
+    def put_front(self, req):
+        """Re-queue an ALREADY-ADMITTED request at the head of its
+        tenant queue (no admission check — its depth slot was released
+        by the take() that popped it, and re-counting it here keeps
+        the gauge honest).  The generative batcher uses this for
+        prompts that found no free KV slot: they keep their arrival
+        order and deadline, and are re-offered next decode window."""
+        with self._cv:
+            if req.tenant not in self._queues:
+                raise MXNetError("unknown tenant %r (tenants: %s)"
+                                 % (req.tenant, sorted(self._queues)))
+            self._queues[req.tenant].appendleft(req)
+            self._depth += 1
+            self._note_depth(req.tenant)
+            self._cv.notify_all()
+
     def kick(self):
         """Wake the batcher (close() flips its stop flag, then kicks)."""
         with self._cv:
             self._cv.notify_all()
 
-    def next_work(self, wait_s, max_batch, stopping):
+    def next_work(self, wait_s, max_batch, stopping, until=None):
         """Block until some tenant deserves a dispatch; return its name.
 
         A tenant is *ripe* when its head request has waited out the
@@ -257,10 +273,16 @@ class RequestQueue:
         head's deadline passed (so the timeout fires promptly), or
         `stopping()` is true (drain mode dispatches everything).  Among
         ripe tenants the one with the OLDEST head deadline wins.
-        Returns None only when stopping and fully drained."""
+        Returns None when stopping and fully drained, or — with
+        `until` set (a monotonic instant) — when that instant passes
+        with nothing ripe: the generative batcher's decode-window tick,
+        which must run decode steps on schedule even while the queue
+        is quiet."""
         with self._cv:
             while True:
                 now = time.monotonic()
+                if until is not None and now >= until:
+                    return None
                 best, best_deadline = None, None
                 next_event = None
                 draining = stopping()
@@ -284,7 +306,11 @@ class RequestQueue:
                     return None
                 # fully idle: block until a put()/kick() notifies (close()
                 # always kicks after flipping its stop flag, so an
-                # indefinite wait cannot strand the batcher)
+                # indefinite wait cannot strand the batcher); an `until`
+                # tick bounds the wait either way
+                if until is not None:
+                    next_event = (until if next_event is None
+                                  else min(next_event, until))
                 self._cv.wait(max(1e-4, next_event - now)
                               if next_event is not None else None)
 

@@ -28,6 +28,7 @@ import time
 
 from ..base import MXNetError
 from .bucket import bucket_ladder
+from .decode import GenerateRequest, GenerativeSession
 from .request import Request, RequestQueue, ServerClosed
 from .session import TenantSession
 from .. import locks
@@ -56,6 +57,7 @@ class ModelServer:
                                 else config.get("MXTPU_SERVE_TIMEOUT_MS")) / 1e3
         self._wait_s = float(wait_ms if wait_ms is not None
                              else config.get("MXTPU_SERVE_WAIT_MS")) / 1e3
+        self._window_s = float(config.get("MXTPU_SERVE_DECODE_WINDOW_MS")) / 1e3
         self._queue = RequestQueue(max_queue if max_queue is not None
                                    else config.get("MXTPU_SERVE_MAX_QUEUE"))
         self._slo = {}  # tenant -> (budget_s, target) declared at add_tenant
@@ -63,6 +65,7 @@ class ModelServer:
         self._lock = locks.lock("serving.server")
         self._stopping = False
         self._closed = False
+        self._abandon = False  # close(drain=False): cut sessions short
         # per-server liveness counters for health() — instance-scoped on
         # purpose (the telemetry serving.* counters are process-wide and
         # a host may run several servers)
@@ -136,6 +139,87 @@ class ModelServer:
                 telemetry.set_gauge("slo.budget_ms.%s" % name, slo[0] * 1e3)
                 telemetry.set_gauge("slo.target.%s" % name, slo[1])
 
+    def add_generative_tenant(self, name, model, params, ctx=None,
+                              slo_ms=None, slo_target=0.999,
+                              max_sessions=None, max_len=None,
+                              max_decode_tokens=None, eos_id=None,
+                              seq_buckets=None):
+        """Register one autoregressive LM for token generation
+        (docs/serving.md "Decode sessions & continuous batching").
+
+        `model` is a zoo LM exposing prefill/decode symbols
+        (models/transformer_lm.py TransformerLM); `params` its trained
+        parameters by plain name.  Requests go through
+        :meth:`submit_generate` — plain :meth:`submit` is rejected for
+        generative tenants.  The tenant owns ``max_sessions`` KV-cache
+        slots (``MXTPU_SERVE_MAX_SESSIONS``); classic tenants on the
+        same server interleave with its decode steps under the usual
+        fairness policy."""
+        slo = None
+        if slo_ms is not None:
+            target = float(slo_target)
+            if not 0.0 < target < 1.0:
+                raise MXNetError(
+                    "tenant %r: slo_target must be a fraction in (0, 1), "
+                    "got %r" % (name, slo_target))
+            slo = (float(slo_ms) / 1e3, target)
+        # build outside the lock — Predictor construction compiles the
+        # smallest prefill/decode buckets and must not stall submits
+        session = GenerativeSession(
+            name, model, params, ctx=ctx, max_sessions=max_sessions,
+            max_len=max_len, max_decode_tokens=max_decode_tokens,
+            eos_id=eos_id, seq_buckets=seq_buckets)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("cannot add tenant %r: server is closed"
+                                   % name)
+            if name in self._sessions:
+                raise MXNetError("tenant %r already registered" % name)
+            self._sessions[name] = session
+            if slo is not None:
+                self._slo[name] = slo
+            self._queue.register(name)
+        self._queue.kick()  # the batcher may now have decode work
+        return session
+
+    def submit_generate(self, tenant, tokens, max_new_tokens=None,
+                        eos_id=None, timeout_ms=None, on_token=None,
+                        trace=None):
+        """Enqueue one generation request; returns a Future resolving
+        to a :class:`~.decode.GenerateResult` (generated token ids +
+        finish reason).  `tokens` is the 1-D int prompt;
+        `max_new_tokens` / `eos_id` override the tenant defaults
+        (``MXTPU_SERVE_MAX_DECODE_TOKENS`` / ``add_generative_tenant``).
+        `on_token` — optional callable streamed each sampled token id
+        from the batcher thread (must be cheap and never block; the
+        router agent uses it to push TOKEN frames).  The deadline
+        covers QUEUE TIME only: once a session is admitted to a KV slot
+        it runs to completion."""
+        from ..obs import tracing
+
+        if trace is None and tracing.enabled():
+            trace = tracing.new_trace()
+        timeout_s = (float(timeout_ms) / 1e3 if timeout_ms is not None
+                     else self._timeout_s)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("ModelServer is closed; no new requests")
+            session = self._sessions.get(tenant)
+            if session is None or not getattr(session, "is_generative",
+                                              False):
+                raise MXNetError(
+                    "tenant %r is not generative (tenants: %s) — "
+                    "register the model with add_generative_tenant() "
+                    "or use submit() for classic tenants"
+                    % (tenant, sorted(self._sessions)))
+            budget = session.budget_for(max_new_tokens)
+            session.validate_generate(tokens, budget)
+            req = GenerateRequest(tenant, tokens, timeout_s, budget,
+                                  eos_id=eos_id, on_token=on_token,
+                                  trace=trace, slo=self._slo.get(tenant))
+            self._queue.put(req)
+        return req.future
+
     @property
     def tenants(self):
         return sorted(self._sessions)
@@ -201,7 +285,10 @@ class ModelServer:
             "queue_depth": self._queue.depth(),
             "per_tenant_depth": {t: self._queue.depth(t) for t in sessions},
             "tenant_modes": {t: getattr(s._predictor, "dtype_mode", "f32")
-                             for t, s in sessions.items()},
+                             for t, s in sessions.items()
+                             if not getattr(s, "is_generative", False)},
+            "generative": {t: s.stats() for t, s in sessions.items()
+                           if getattr(s, "is_generative", False)},
             "ladder": list(self.ladder),
             "closed": self._closed,
         }
@@ -252,16 +339,20 @@ class ModelServer:
 
     def close(self, drain=True, timeout=None):
         """Stop the server.  ``drain=True`` (default) serves every
-        already-queued request before returning; ``drain=False`` fails
-        still-queued requests with ServerClosed.  In-flight fills
-        complete either way, so every future this server ever returned
-        is resolved when close() returns.  Idempotent."""
+        already-queued request before returning — generative sessions
+        keep decoding until they retire naturally; ``drain=False``
+        fails still-queued requests with ServerClosed and resolves
+        active decode sessions with their PARTIAL tokens
+        (``finish_reason='closed'``).  In-flight fills complete either
+        way, so every future this server ever returned is resolved when
+        close() returns.  Idempotent."""
         with self._lock:
             already = self._closed
             self._closed = True
         if already and self._thread is None:
             return
         if not drain:
+            self._abandon = True
             self._queue.fail_all(lambda req: ServerClosed(
                 "ModelServer.close(drain=False) dropped the queued "
                 "request to tenant %r" % req.tenant))
@@ -285,25 +376,99 @@ class ModelServer:
     # ------------------------------------------------------------------
     # the batcher thread
     # ------------------------------------------------------------------
+    def _generative(self):
+        with self._lock:
+            return [s for s in self._sessions.values()
+                    if getattr(s, "is_generative", False)]
+
     def _loop(self):
+        """Classic fills and decode steps interleave on this one
+        thread.  Each iteration: (1) wait for ripe queue work, bounded
+        by the decode window whenever sessions are mid-generation;
+        (2) serve the ripe tenant — a classic fill, or prompt
+        admissions into free KV slots; (3) run ONE decode step per
+        generative tenant with active sessions (the Orca iteration:
+        re-packed from whoever is active NOW, so sessions admitted in
+        (2) join and sessions that hit EOS leave, all without
+        recompiling).  Exit only when stopping, the queue is drained,
+        and every decode session has retired — the zero-lost-futures
+        contract."""
         from .. import telemetry
 
         while True:
+            gens = self._generative()
+            ticking = any(s.active() for s in gens)
+            until = (time.monotonic() + self._window_s) if ticking else None
             tenant = self._queue.next_work(self._wait_s, self._max_batch,
-                                           lambda: self._stopping)
-            if tenant is None:
-                return
-            reqs = self._queue.take(tenant, self._max_batch)
-            if not reqs:
-                continue
-            try:
-                self._sessions[tenant].dispatch(reqs)
-                self._dispatches += 1
-            except BaseException as e:
-                # a failed fill fails ITS requests, never the server: the
-                # loop survives to serve the other tenants
-                self._dispatch_errors += 1
-                if telemetry.enabled():
-                    telemetry.inc("serving.dispatch_errors")
-                for r in reqs:
-                    r.fail(e)
+                                           lambda: self._stopping,
+                                           until=until)
+            if tenant is not None:
+                session = self._sessions[tenant]
+                if getattr(session, "is_generative", False):
+                    self._admit(tenant, session)
+                else:
+                    self._fill(tenant, session)
+            for session in gens:
+                if session.active():
+                    try:
+                        if session.decode_step():
+                            self._dispatches += 1
+                    except BaseException as e:
+                        # a failed decode step poisons that tenant's KV
+                        # state: fail ITS active sessions, keep serving
+                        # the others
+                        self._dispatch_errors += 1
+                        if telemetry.enabled():
+                            telemetry.inc("serving.dispatch_errors")
+                        session.fail_active(e)
+            if tenant is None and self._stopping and self._queue.depth() == 0:
+                gens = self._generative()
+                if self._abandon:
+                    for session in gens:
+                        session.finish_all("closed")
+                if not any(s.active() for s in gens):
+                    return
+
+    def _admit(self, tenant, session):
+        """Move queued prompts into free KV slots (prefill).  With no
+        free slot the head requests stay queued — put_front preserves
+        arrival order — and are re-offered after the decode steps
+        below retire sessions."""
+        from .. import telemetry
+
+        limit = min(self._max_batch, session.free_slots())
+        if limit <= 0:
+            return
+        reqs = self._queue.take(tenant, limit)
+        if not reqs:
+            return
+        try:
+            leftovers = session.admit(reqs)
+            self._dispatches += 1
+        except BaseException as e:
+            self._dispatch_errors += 1
+            if telemetry.enabled():
+                telemetry.inc("serving.dispatch_errors")
+            for r in reqs:
+                r.fail(e)
+            return
+        for r in reversed(leftovers):
+            self._queue.put_front(r)
+
+    def _fill(self, tenant, session):
+        from .. import telemetry
+
+        reqs = self._queue.take(tenant, self._max_batch)
+        if not reqs:
+            return
+        try:
+            session.dispatch(reqs)
+            self._dispatches += 1
+        except BaseException as e:
+            # a failed fill fails ITS requests, never the server: the
+            # loop survives to serve the other tenants
+            self._dispatch_errors += 1
+            if telemetry.enabled():
+                telemetry.inc("serving.dispatch_errors")
+            for r in reqs:
+                r.fail(e)

@@ -37,6 +37,55 @@ def build_problem(mx, np):
     return it, net
 
 
+def build_lm_problem(mx, np):
+    """Deterministic next-token LM batches for the transformer parity
+    pin: tokens follow ``next = (prev * 7 + 3) % (V - 2) + 2``, labels
+    are the inputs shifted left (causal LM convention)."""
+    rng = np.random.RandomState(11)
+    V, N, T = 24, 64, 8
+    data = np.empty((N, T + 1), np.float32)
+    data[:, 0] = rng.randint(2, V, size=N)
+    for t in range(T):
+        data[:, t + 1] = (data[:, t] * 7 + 3) % (V - 2) + 2
+    it = mx.io.NDArrayIter(data[:, :T], data[:, 1:],
+                           batch_size=16, label_name="softmax_label")
+    from mxnet_tpu.models import TransformerLM
+
+    lm = TransformerLM(vocab=V, num_layers=2, num_heads=2, d_model=32,
+                       max_len=T)
+    return it, lm.training_symbol()
+
+
+def run_fit_transformer(mx, np, mesh, steps_per_dispatch):
+    """The transformer flavor of run_fit: the SAME fused-dispatch +
+    hierarchical-collective training stack, driven by the attention
+    graph instead of the MLP (the SPMD pin for the transformer rows)."""
+    from mxnet_tpu.ops.random_ops import HOST_RNG
+
+    mx.random.seed(0)
+    HOST_RNG.seed(123)
+    it, net = build_lm_problem(mx, np)
+    mod = mx.mod.Module(net, label_names=("softmax_label",),
+                        context=mx.cpu(), mesh=mesh)
+    losses = []
+
+    def on_batch(param):
+        for name, val in param.eval_metric.get_name_value():
+            losses.append(val)
+
+    mod.fit(it, num_epoch=2, kvstore=None, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2.34),
+            eval_metric=mx.metric.Perplexity(None),
+            steps_per_dispatch=steps_per_dispatch,
+            batch_end_callback=on_batch)
+    args, _ = mod.get_params()
+    digest = np.concatenate([args[n].asnumpy().ravel()
+                             for n in sorted(args)])
+    return losses, digest
+
+
 def run_fit(mx, np, mesh, steps_per_dispatch):
     from mxnet_tpu.ops.random_ops import HOST_RNG
 
@@ -80,6 +129,9 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps-per-dispatch", type=int, default=1)
     parser.add_argument("--kvstore-check", action="store_true")
+    parser.add_argument("--transformer", action="store_true",
+                        help="train the TransformerLM problem instead of "
+                             "the MLP (the transformer SPMD parity pin)")
     parser.add_argument("--no-fit", action="store_true",
                         help="skip the training run (fast control-plane-"
                              "only checks)")
@@ -106,7 +158,8 @@ def main():
         profiler.profiler_set_config(mode="all", filename=args.profile)
         profiler.profiler_set_state("run")
     if not args.no_fit:
-        losses, digest = run_fit(mx, np, mesh, args.steps_per_dispatch)
+        fit = run_fit_transformer if args.transformer else run_fit
+        losses, digest = fit(mx, np, mesh, args.steps_per_dispatch)
         # ONE unbuffered write: both ranks share the launcher's stdout
         # pipe, and separate print() writes from two processes can
         # interleave mid-line (single writes under PIPE_BUF are atomic)

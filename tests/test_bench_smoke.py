@@ -290,6 +290,75 @@ def test_bench_ab_int8_serve_smoke():
 
 
 @pytest.mark.slow
+def test_bench_ab_kv_decode_smoke():
+    """bench.py --ab kv_decode --smoke: the KV-cache decode A/B body
+    (docs/perf.md "KV-cache decode") runs matched greedy generation of
+    a tiny TransformerLM — side A re-running the FULL prefix through
+    the bucketed score forward per token, side B prefill + one
+    KV-decode step per token — and emits one JSON row with both sides'
+    tokens/s per decode target.  The same driver with the 512d 4-layer
+    LM at T in {64, 256} produces the BENCH_TABLE row."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXTPU_SERVE_MAX_SESSIONS", "MXTPU_SERVE_KV_MAX_LEN",
+                 "MXTPU_SERVE_MAX_DECODE_TOKENS", "MXTPU_SERVE_BUCKETS"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ab",
+         "kv_decode", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == "kv_decode" and out["smoke"] is True
+    assert out["unit"] == "tokens/s"
+    assert out["a"]["mode"] == "recompute" and out["b"]["mode"] == "kv_cache"
+    assert out["a"]["value"] > 0 and out["b"]["value"] > 0
+    for T, sub in out["targets"].items():
+        # the numerics pin the speedup may not buy back: greedy token
+        # sequences agree EXACTLY, and the timed windows never compiled
+        assert sub["match"] is True, (T, sub)
+        assert sub["compile_misses_timed"] == 0, (T, sub)
+        assert sub["tokens"] == int(T) - out["prompt_len"]
+        assert sub["kv_tok_s"] > 0 and sub["recompute_tok_s"] > 0
+    expect = round((out["b"]["value"] - out["a"]["value"])
+                   / out["a"]["value"] * 100.0, 2)
+    assert abs(out["delta_pct"] - expect) < 0.05
+
+
+@pytest.mark.slow
+def test_bench_serve_generate_smoke_reports_token_row():
+    """bench.py --serve --generate --smoke: the mixed prefill/decode
+    generative serving driver (docs/serving.md "Decode sessions &
+    continuous batching") streams varied-length generations through a
+    real Router -> ReplicaAgent -> GenerativeSession stack and emits
+    ONE JSON row with tokens/s, request p50/p99, and the decode-loop
+    health gauges.  The same driver with the 512d LM produces the
+    BENCH_TABLE serving row."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXTPU_SERVE_MAX_SESSIONS", "MXTPU_SERVE_KV_MAX_LEN",
+                 "MXTPU_SERVE_MAX_DECODE_TOKENS",
+                 "MXTPU_SERVE_DECODE_WINDOW_MS", "MXTPU_SERVE_BUCKETS"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--generate", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["smoke"] is True and out["unit"] == "tokens/s"
+    assert out["value"] > 0 and out["failed"] == 0
+    # zero lost futures: every submitted generation retired, and the
+    # end-to-end token count reconciles exactly against the decode
+    # counter (+1 prefill-emitted token per session)
+    assert out["retired"]["total"] == out["requests"]
+    assert out["tokens"] == out["decode_tokens"] + out["retired"]["total"]
+    assert out["decode_dispatches"] > 0
+    assert out["p99_ms"] >= out["p50_ms"] > 0
+    assert out["compile_misses_timed"] == 0
+    assert out["batch_fill_ratio"] is not None
+    assert out["kv_slot_occupancy"] is not None
+
+
+@pytest.mark.slow
 def test_bench_serve_smoke_lock_overhead_and_acyclic_graph():
     """bench.py --serve --smoke --lock-ab: the MXTPU_LOCK_CHECK
     sentinel pin (ISSUE 17 acceptance — zero order-graph cycles over

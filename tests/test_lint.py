@@ -376,6 +376,45 @@ def test_e002_fires_on_atomic_router_poll(tmp_path):
     assert findings == []
 
 
+# a decode-loop-shaped callback (serving/decode.py decode_step: sample
+# the packed logits, book tokens, retire sessions): the real loop runs
+# SYNCHRONOUSLY on the batcher thread — reading logits back is its whole
+# job — but routed through an ATOMIC engine push the readback becomes
+# the canonical pool deadlock (the blocked worker starves the pool that
+# must run the very decode program it waits on).  Corpus pins that E002
+# fires the moment someone "pipelines" the decode tick onto the engine,
+# and stays quiet under the atomic=False ThreadedIter convention.
+E002_DECODE_STEP_ATOMIC = """
+def schedule_decode(eng, logits, sessions, ring_var):
+    def step(_logits=logits, _sessions=sessions):
+        _logits.wait_to_read()
+        host = _logits.asnumpy()
+        for i, sess in enumerate(_sessions):
+            sess.emit(int(host[i].argmax()))
+    eng.push(step, read_vars=[logits._engine_var()],
+             write_vars=[ring_var])
+"""
+
+E002_DECODE_STEP_NON_ATOMIC = """
+def schedule_decode(eng, logits, sessions, ring_var):
+    def step(_logits=logits, _sessions=sessions):
+        _logits.wait_to_read()
+        host = _logits.asnumpy()
+        for i, sess in enumerate(_sessions):
+            sess.emit(int(host[i].argmax()))
+    eng.push(step, read_vars=[logits._engine_var()],
+             write_vars=[ring_var], atomic=False)
+"""
+
+
+def test_e002_fires_on_atomic_decode_step(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E002_DECODE_STEP_ATOMIC)
+    got = _ids(findings)
+    assert got.count("E002") == 2, findings  # wait_to_read + asnumpy
+    findings, _, _ = _lint_src(tmp_path, E002_DECODE_STEP_NON_ATOMIC)
+    assert findings == []
+
+
 # ----------------------------------------------------------------------
 # E004 — telemetry/profiler recording must be behind the fast path
 # ----------------------------------------------------------------------
@@ -448,6 +487,53 @@ def test_e004_accepts_the_three_guard_shapes(tmp_path):
     for src in (E004_IF_GUARDED, E004_VAR_GUARDED, E004_EARLY_RETURN):
         findings, _, _ = _lint_src(tmp_path, src)
         assert findings == [], findings
+
+
+# the decode loop's own instrumentation (serving/decode.py decode_step
+# books 2 counters, a histogram, and 3 gauges PER TOKEN-LEVEL STEP —
+# the hottest serving path in the tree): unguarded, that is six
+# registry locks per generated token.  The real loop guards with one
+# `if telemetry.enabled():`; corpus pins both the violation and the
+# shipped shape.
+E004_DECODE_UNGUARDED = """
+import time
+from . import telemetry
+
+def decode_step(active, run, bucket):
+    t0 = time.monotonic()
+    logits = run(active)
+    dt = time.monotonic() - t0
+    telemetry.inc("serving.decode.dispatches")
+    telemetry.inc("serving.decode.tokens", len(active))
+    telemetry.observe("serving.decode.step_seconds", dt)
+    telemetry.set_gauge("serving.decode.batch_fill_ratio",
+                        len(active) / bucket)
+    return logits
+"""
+
+E004_DECODE_GUARDED = """
+import time
+from . import telemetry
+
+def decode_step(active, run, bucket):
+    t0 = time.monotonic()
+    logits = run(active)
+    dt = time.monotonic() - t0
+    if telemetry.enabled():
+        telemetry.inc("serving.decode.dispatches")
+        telemetry.inc("serving.decode.tokens", len(active))
+        telemetry.observe("serving.decode.step_seconds", dt)
+        telemetry.set_gauge("serving.decode.batch_fill_ratio",
+                            len(active) / bucket)
+    return logits
+"""
+
+
+def test_e004_covers_the_decode_loop_shape(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_DECODE_UNGUARDED)
+    assert _ids(findings).count("E004") == 4, findings
+    findings, _, _ = _lint_src(tmp_path, E004_DECODE_GUARDED)
+    assert findings == [], findings
 
 
 E004_WRONG_GUARD = """

@@ -744,6 +744,10 @@ COVERED_ELSEWHERE = {
     "MoE", "RingAttention",
     # test_quant.py (int8 PTQ serving kernels, ops/quant_ops.py)
     "_quantized_conv2d", "_quantized_fully_connected",
+    # test_transformer_lm.py (transformer LM ops, ops/attention.py:
+    # numpy oracles + per-step KV-decode vs full-recompute parity)
+    "LayerNorm", "_sdp_attention", "_cached_attention", "_kv_cache_write",
+    "_add_positional", "_add_positional_at", "_take_step",
     # test_contrib_ops2.py
     "_contrib_fft", "_contrib_ifft", "_contrib_quantize",
     "_contrib_dequantize", "_contrib_count_sketch", "_contrib_Proposal",

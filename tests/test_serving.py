@@ -498,6 +498,37 @@ def test_parse_log_renders_serving_columns():
     assert rows[1]["req_p99"] is None
 
 
+def test_parse_log_renders_decode_columns():
+    """`parse_log --telemetry` renders the generative decode lane:
+    tokens_s is cumulative decode tokens over summed step time,
+    active_sessions / kv_slot_occupancy are the loop gauges — and
+    pre-decode logs (no serving.decode.* namespace) render '-' (None)
+    in all three columns."""
+    from tools.parse_log import _TELEMETRY_COLS, parse_telemetry
+
+    decode_rec = {
+        "flush_seq": 1, "step": 0,
+        "counters": {"serving.decode.tokens": 120,
+                     "serving.decode.dispatches": 40},
+        "gauges": {"serving.decode.active_sessions": 3.0,
+                   "kv.slot_occupancy": 0.75},
+        "histograms": {"serving.decode.step_seconds": {
+            "count": 40, "sum": 0.5, "min": 0.01, "max": 0.02,
+            "buckets": {"le_0.1": 40, "le_inf": 0}}},
+    }
+    legacy_rec = {"flush_seq": 2, "step": 5, "counters": {},
+                  "gauges": {}, "histograms": {}}
+    rows = parse_telemetry([json.dumps(decode_rec), json.dumps(legacy_rec)])
+    assert rows[0]["tokens_s"] == pytest.approx(240.0)
+    assert rows[0]["active_sessions"] == 3.0
+    assert rows[0]["kv_slot_occupancy"] == 0.75
+    assert rows[1]["tokens_s"] is None
+    assert rows[1]["active_sessions"] is None
+    assert rows[1]["kv_slot_occupancy"] is None
+    for col in ("tokens_s", "active_sessions", "kv_slot_occupancy"):
+        assert col in _TELEMETRY_COLS
+
+
 # ----------------------------------------------------------------------
 # Predictor hygiene (the serving sessions depend on both)
 # ----------------------------------------------------------------------

@@ -100,6 +100,32 @@ def test_local_spmd_fit_matches_single_process():
                                rtol=5e-3, atol=5e-5)
 
 
+def test_local_spmd_transformer_fit_matches_single_process():
+    """The transformer SPMD pin (ROADMAP item 2): `launch.py
+    --local-spmd -n 2` trains the TransformerLM causal-LM problem —
+    attention, LayerNorm, weight-tied softmax — through the same fused
+    dispatch + hierarchical gradient collectives, and every rank's
+    per-dispatch perplexity trajectory and final params match the
+    single-process answer."""
+    proc = _launch_spmd(2, 0, ["--transformer"], timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    recs = _parse_fit_lines(proc.stdout)
+    assert sorted(recs) == [0, 1], proc.stdout + proc.stderr
+    assert recs[0]["axes"] == ["data_dcn", "data_ici"], recs[0]["axes"]
+    np.testing.assert_array_equal(recs[0]["losses"], recs[1]["losses"])
+    np.testing.assert_array_equal(recs[0]["digest"], recs[1]["digest"])
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from spmd_fit_script import run_fit_transformer
+
+    ref_losses, ref_digest = run_fit_transformer(mx, np, None, 1)
+    assert len(ref_losses) == len(recs[0]["losses"]) and ref_losses, \
+        (len(ref_losses), len(recs[0]["losses"]))
+    np.testing.assert_allclose(recs[0]["losses"], ref_losses,
+                               rtol=5e-4, atol=1e-5)
+    np.testing.assert_allclose(recs[0]["digest"], ref_digest,
+                               rtol=5e-3, atol=5e-5)
+
+
 def test_local_spmd_dist_kvstore_parity():
     """The dist_sync parameter-server control plane rides the SAME
     --local-spmd launcher invocation: workers that joined the SPMD mesh

@@ -1,0 +1,502 @@
+"""Transformer LM workload: training through BucketingModule and the
+KV-cache decode-session numerics (ROADMAP item 2; docs/serving.md
+"Decode sessions & continuous batching", docs/perf.md "KV-cache
+decode").
+
+The decode pins are the acceptance criteria of the KV-cache PR:
+
+* per-step LOGITS parity — prefill + cached decode must reproduce the
+  full-recompute forward's next-token logits at EVERY step, not just
+  the argmax;
+* join/leave parity — a session decoding in a mixed, continuously
+  re-packed batch must produce EXACTLY the tokens it produces decoding
+  alone (padded rows and slot reuse may not leak across sessions);
+* compile-once-per-bucket — the telemetry program counters stay flat
+  across any admit/retire mix after warmup;
+* zero lost futures — close(drain=False) mid-window resolves every
+  submitted generation, active or queued.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import TransformerLM
+from mxnet_tpu.serving import GenerateRequest, GenerativeSession, ServerClosed
+
+
+def _lm_and_params(vocab=24, num_layers=2, num_heads=2, d_model=16,
+                   max_len=32, seed=0):
+    """A tiny TransformerLM plus a randomly-initialized checkpoint in
+    the plain-name form GenerativeSession consumes (arg+aux merged)."""
+    lm = TransformerLM(vocab=vocab, num_layers=num_layers,
+                       num_heads=num_heads, d_model=d_model,
+                       max_len=max_len)
+    mx.random.seed(seed)
+    mod = mx.mod.Module(lm.training_symbol(), data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2, 8))])
+    mod.init_params(mx.init.Xavier(magnitude=2.0))
+    arg, aux = mod.get_params()
+    params = dict(arg)
+    params.update(aux)
+    return lm, params
+
+
+def _score_logits(lm, params, tokens):
+    """Full-recompute reference: per-position logits ``(T, vocab)`` of
+    one forward over the whole prefix (the honest baseline the cached
+    path must reproduce)."""
+    T = len(tokens)
+    pred = mx.Predictor(lm.score_symbol(), dict(params), {"data": (1, T)})
+    pred.forward(data=np.asarray([tokens], np.float32))
+    return pred.get_output(0).reshape(T, lm.vocab)
+
+
+def _greedy_reference(lm, params, prompt, max_new, eos_id=None):
+    """Greedy generation by full recompute — the token-level oracle."""
+    toks = list(prompt)
+    out = []
+    for _ in range(max_new):
+        nxt = int(np.argmax(_score_logits(lm, params, toks)[-1]))
+        out.append(nxt)
+        toks.append(nxt)
+        if eos_id is not None and nxt == eos_id:
+            break
+        if len(toks) >= lm.max_len:
+            break
+    return out
+
+
+def _drive(gs, reqs):
+    """The server loop in miniature: admit what fits, decode one
+    token-level step, re-offer the leftovers — until every request
+    retires.  Returns results in submission order."""
+    pending = list(reqs)
+    while pending or gs.active():
+        pending = gs.admit(pending)
+        gs.decode_step()
+    return [r.future.result(timeout=0) for r in reqs]
+
+
+# ----------------------------------------------------------------------
+# numerics: the cached path reproduces the full recompute
+# ----------------------------------------------------------------------
+def test_kv_decode_logits_match_full_recompute_every_step():
+    """Prefill writes the prompt's K/V into the ring and emits the
+    tail logits; every decode step then extends the cache by one
+    position.  At EVERY step the logits must be allclose to a full
+    forward over the entire prefix — the invariant that makes the
+    speedup free."""
+    lm, params = _lm_and_params()
+    gs = GenerativeSession("lm", lm, params, max_sessions=1,
+                           max_len=lm.max_len, seq_buckets=[8])
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, lm.vocab, size=5).tolist()
+    n = len(prompt)
+
+    # prefill through the 8-wide bucket (3 pad positions): logits must
+    # come from the TRUE tail, not the pad
+    exe, fn = gs._program(gs._prefill_pred, 1, 8, True)
+    data = np.zeros((1, 8), np.float32)
+    data[0, :n] = prompt
+    logits = gs._run(exe, fn, data, np.zeros((1,), np.float32),
+                     np.full((1,), n, np.float32))
+    ref = _score_logits(lm, params, prompt)
+    np.testing.assert_allclose(logits[0], ref[n - 1], rtol=1e-4, atol=1e-5)
+
+    # decode step-by-step: feed the greedy token, compare against the
+    # full recompute of the grown prefix at every single position
+    toks = list(prompt)
+    exe, fn = gs._program(gs._decode_pred, 1, 1, False)
+    for step in range(8):
+        nxt = int(np.argmax(logits[0]))
+        toks.append(nxt)
+        logits = gs._run(exe, fn, np.asarray([[nxt]], np.float32),
+                         np.zeros((1,), np.float32),
+                         np.full((1,), len(toks) - 1, np.float32))
+        ref = _score_logits(lm, params, toks)
+        np.testing.assert_allclose(
+            logits[0], ref[-1], rtol=1e-4, atol=1e-5,
+            err_msg="decode step %d diverged from full recompute" % step)
+
+
+def test_session_tokens_match_greedy_reference():
+    """End-to-end through admit()/decode_step(): greedy tokens,
+    finish_reason, and prompt_len all match the full-recompute
+    oracle — including EOS cut-off."""
+    lm, params = _lm_and_params(seed=3)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, lm.vocab, size=rng.randint(2, 7)).tolist()
+               for _ in range(5)]
+    eos = 3
+    gs = GenerativeSession("lm", lm, params, max_sessions=4,
+                           max_len=lm.max_len, eos_id=eos,
+                           seq_buckets=[8])
+    reqs = [GenerateRequest("lm", p, 60.0, 6, eos_id=eos)
+            for p in prompts]
+    results = _drive(gs, reqs)
+    for p, r in zip(prompts, results):
+        want = _greedy_reference(lm, params, p, 6, eos_id=eos)
+        assert r.tokens.tolist() == want, (p, r.tokens.tolist(), want)
+        assert r.prompt_len == len(p)
+        assert r.finish_reason == ("eos" if want[-1] == eos else "length")
+
+
+def test_join_leave_mid_batch_matches_solo_decode():
+    """Continuous batching parity: sessions joining (admitted while
+    others are mid-decode) and leaving (retiring mid-window on
+    different budgets) must each produce EXACTLY the token sequence
+    they produce decoding ALONE.  Slot reuse after retirement and the
+    scratch-slot padded rows may not perturb any survivor."""
+    lm, params = _lm_and_params(seed=5)
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, lm.vocab, size=rng.randint(2, 8)).tolist()
+               for _ in range(6)]
+    budgets = [3, 9, 5, 8, 2, 7]  # staggered retirement by design
+
+    solo = []
+    for p, b in zip(prompts, budgets):
+        gs = GenerativeSession("lm", lm, params, max_sessions=1,
+                               max_len=lm.max_len, seq_buckets=[8])
+        (r,) = _drive(gs, [GenerateRequest("lm", p, 60.0, b)])
+        solo.append(r.tokens.tolist())
+
+    # mixed run: 2 KV slots for 6 requests forces queueing — each
+    # retirement frees a slot that the next prompt prefills into while
+    # the survivor keeps decoding (the join/leave path under test)
+    gs = GenerativeSession("lm", lm, params, max_sessions=2,
+                           max_len=lm.max_len, seq_buckets=[8])
+    reqs = [GenerateRequest("lm", p, 60.0, b)
+            for p, b in zip(prompts, budgets)]
+    mixed = _drive(gs, reqs)
+    for i, (r, want) in enumerate(zip(mixed, solo)):
+        assert r.tokens.tolist() == want, (i, r.tokens.tolist(), want)
+
+
+# ----------------------------------------------------------------------
+# compile-once and the telemetry surface
+# ----------------------------------------------------------------------
+def test_decode_compiles_once_per_bucket():
+    """warm() builds one program per prefill sequence bucket plus one
+    per decode batch bucket; any admit/retire mix after that reuses
+    them — zero new programs, zero executor compile misses."""
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    lm, params = _lm_and_params(seed=9)
+    gs = GenerativeSession("lm", lm, params, max_sessions=4,
+                           max_len=lm.max_len, seq_buckets=[4, 8])
+    # decode ladder for 4 slots: [1, 2, 4]
+    assert gs.warm() == 2 + 3
+    progs0 = telemetry.counter_value("serving.decode.bucket_programs")
+    assert progs0 == 5
+    miss0 = telemetry.counter_value("executor.compile_cache_misses")
+
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(0, lm.vocab, size=rng.randint(2, 8)).tolist()
+               for _ in range(7)]
+    reqs = [GenerateRequest("lm", p, 60.0, 2 + (i % 4))
+            for i, p in enumerate(prompts)]
+    _drive(gs, reqs)
+    assert telemetry.counter_value(
+        "serving.decode.bucket_programs") == progs0
+    assert telemetry.counter_value(
+        "executor.compile_cache_misses") == miss0
+    # the loop's own instrumentation saw the run
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.decode.dispatches"] > 0
+    assert snap["counters"]["serving.decode.retired"] == len(reqs)
+    assert snap["counters"]["serving.decode.sessions"] == len(reqs)
+    assert "serving.decode.step_seconds" in snap["histograms"]
+    assert "serving.prefill_seconds" in snap["histograms"]
+    assert snap["gauges"]["kv.ring_bytes"] > 0
+    assert snap["gauges"]["kv.slot_occupancy"] == 0.0  # all retired
+
+
+def test_generate_validation_and_classic_submit_rejected():
+    lm, params = _lm_and_params()
+    server = mx.serving.ModelServer({})
+    try:
+        server.add_generative_tenant("lm", lm, params, max_sessions=2,
+                                     max_len=16, seq_buckets=[8])
+        # a classic submit against a generative tenant is a client bug
+        with pytest.raises(MXNetError, match="generative"):
+            server.submit("lm", {"data": np.zeros(4, np.float32)})
+        with pytest.raises(MXNetError, match="empty prompt"):
+            server.submit_generate("lm", [])
+        with pytest.raises(MXNetError, match="max_new_tokens"):
+            server.submit_generate("lm", [1, 2], max_new_tokens=0)
+        # prompt + budget must fit the KV ring — rejected at submit,
+        # not discovered mid-decode
+        with pytest.raises(MXNetError, match="KV ring"):
+            server.submit_generate("lm", [1] * 10, max_new_tokens=10)
+    finally:
+        server.close()
+
+
+def test_close_no_drain_resolves_every_generation_future():
+    """Zero lost futures on mid-window shutdown: with 2 KV slots and 6
+    outstanding generations (some active mid-decode, some queued),
+    close(drain=False) must resolve EVERY future — partial tokens with
+    finish_reason='closed' for active sessions, ServerClosed for the
+    still-queued ones.  Nothing hangs, nothing leaks."""
+    lm, params = _lm_and_params(seed=4)
+    server = mx.serving.ModelServer({}, wait_ms=1.0)
+    futs = []
+    try:
+        server.add_generative_tenant("lm", lm, params, max_sessions=2,
+                                     max_len=lm.max_len, seq_buckets=[8])
+        rng = np.random.RandomState(2)
+        for _ in range(6):
+            prompt = rng.randint(0, lm.vocab, size=4).tolist()
+            futs.append(server.submit_generate("lm", prompt,
+                                               max_new_tokens=20))
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if server.stats()["generative"]["lm"]["active_sessions"] >= 1:
+                break
+            time.sleep(0.001)
+        else:
+            pytest.fail("no session went active")
+    finally:
+        server.close(drain=False)
+    resolved = 0
+    for f in futs:
+        assert f.done(), "close() returned with an unresolved future"
+        try:
+            r = f.result(timeout=0)
+        except ServerClosed:
+            resolved += 1  # still queued at shutdown — failed, not lost
+        else:
+            resolved += 1
+            assert r.finish_reason in ("closed", "length", "eos")
+            assert len(r.tokens) >= 1  # prefill emitted at least one
+    assert resolved == len(futs)
+
+
+def test_admission_control_requeues_when_slots_full():
+    """More prompts than KV slots: admit() returns the overflow
+    instead of failing it, and the returned requests complete once
+    retirement frees slots (the decode-window re-offer)."""
+    lm, params = _lm_and_params(seed=6)
+    gs = GenerativeSession("lm", lm, params, max_sessions=2,
+                           max_len=lm.max_len, seq_buckets=[8])
+    rng = np.random.RandomState(3)
+    reqs = [GenerateRequest(
+        "lm", rng.randint(0, lm.vocab, size=3).tolist(), 60.0, 4)
+        for _ in range(5)]
+    leftovers = gs.admit(reqs)
+    assert len(leftovers) == 3 and gs.free_slots() == 0
+    results = _drive(gs, leftovers)
+    while gs.active():
+        gs.decode_step()
+    for r in reqs:
+        out = r.future.result(timeout=0)
+        assert len(out.tokens) == 4 and out.finish_reason == "length"
+    assert gs.free_slots() == 2
+    assert len(results) == 3
+
+
+# ----------------------------------------------------------------------
+# training: the first transformer rows
+# ----------------------------------------------------------------------
+def test_transformer_trains_through_bucketing_module():
+    """The tentpole training pin: TransformerLM.sym_gen drives a
+    BucketingModule over variable-length sequences (two buckets, pad
+    label ignored) and the perplexity collapses on a deterministic
+    next-token language — the same recipe that produced the
+    BENCH_TABLE transformer training row."""
+    from mxnet_tpu import rnn
+
+    rng = np.random.RandomState(0)
+    V, B = 30, 16
+    sents = []
+    for _ in range(200):
+        n = rng.randint(4, 12)
+        s = [int(rng.randint(2, V))]
+        for _ in range(n - 1):
+            s.append((s[-1] * 7 + 3) % (V - 2) + 2)
+        sents.append(s)
+    it = rnn.BucketSentenceIter(sents, B, buckets=[8, 12], invalid_label=0)
+    lm = TransformerLM(vocab=V, num_layers=2, num_heads=2, d_model=32,
+                       max_len=16)
+    mod = mx.mod.BucketingModule(
+        sym_gen=lm.sym_gen(invalid_label=0),
+        default_bucket_key=it.default_bucket_key, context=mx.cpu())
+    metric = mx.metric.Perplexity(0)
+
+    def epoch():
+        metric.reset()
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        return metric.get()[1]
+
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2.34))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 3e-3})
+    first = epoch()
+    for _ in range(3):
+        last = epoch()
+    assert np.isfinite(first) and np.isfinite(last)
+    assert last < first * 0.5, (first, last)
+
+
+def test_trained_checkpoint_serves_directly():
+    """The four graphs share one parameter set: a BucketingModule
+    training checkpoint drops straight into a GenerativeSession (no
+    rename, no re-export) and the served generation follows the
+    training-learned structure."""
+    from mxnet_tpu import rnn
+
+    rng = np.random.RandomState(0)
+    V, B = 20, 16
+    sents = []
+    for _ in range(160):
+        n = rng.randint(4, 12)
+        s = [int(rng.randint(2, V))]
+        for _ in range(n - 1):
+            s.append((s[-1] * 3 + 1) % (V - 2) + 2)
+        sents.append(s)
+    it = rnn.BucketSentenceIter(sents, B, buckets=[8, 12], invalid_label=0)
+    lm = TransformerLM(vocab=V, num_layers=1, num_heads=2, d_model=32,
+                       max_len=16)
+    mod = mx.mod.BucketingModule(
+        sym_gen=lm.sym_gen(invalid_label=0),
+        default_bucket_key=it.default_bucket_key, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2.34))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 3e-3})
+    for _ in range(4):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    arg, aux = mod.get_params()
+    params = dict(arg)
+    params.update(aux)
+
+    gs = GenerativeSession("lm", lm, params, max_sessions=1,
+                           max_len=lm.max_len, seq_buckets=[4])
+    start = 5
+    (r,) = _drive(gs, [GenerateRequest("lm", [start], 60.0, 6)])
+    # the trained rule: next = (prev * 3 + 1) % (V - 2) + 2
+    want, prev = [], start
+    for _ in range(6):
+        prev = (prev * 3 + 1) % (V - 2) + 2
+        want.append(prev)
+    assert r.tokens.tolist() == want, (r.tokens.tolist(), want)
+
+
+def test_attention_ops_match_numpy_oracle():
+    """Direct numpy oracles for every op ops/attention.py registers
+    (the test_operator.py registry-coverage contract): LayerNorm,
+    _sdp_attention, _cached_attention, _kv_cache_write,
+    _add_positional, _add_positional_at, _take_step."""
+    rng = np.random.RandomState(7)
+    n, h, t, dh = 2, 2, 5, 4
+    d = h * dh
+
+    # LayerNorm
+    x = rng.randn(n, t, d).astype(np.float32)
+    gamma = rng.randn(d).astype(np.float32)
+    beta = rng.randn(d).astype(np.float32)
+    got = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(gamma),
+                          mx.nd.array(beta), eps=1e-5).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(var + 1e-5) * gamma + beta
+    assert np.allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    # _sdp_attention: causal softmax attention + per-head K/V reshapes
+    def np_softmax(s):
+        m = s.max(-1, keepdims=True)
+        e = np.exp(s - m)
+        return e / e.sum(-1, keepdims=True)
+
+    q = rng.randn(n, t, d).astype(np.float32)
+    k = rng.randn(n, t, d).astype(np.float32)
+    v = rng.randn(n, t, d).astype(np.float32)
+    ctx, kh, vh = mx.nd._sdp_attention(mx.nd.array(q), mx.nd.array(k),
+                                       mx.nd.array(v), num_heads=h,
+                                       causal=True)
+    qh = q.reshape(n, t, h, dh).transpose(0, 2, 1, 3)
+    kh_ref = k.reshape(n, t, h, dh).transpose(0, 2, 1, 3)
+    vh_ref = v.reshape(n, t, h, dh).transpose(0, 2, 1, 3)
+    scores = np.einsum("nhqd,nhkd->nhqk", qh, kh_ref) / np.sqrt(dh)
+    scores = np.where(np.tril(np.ones((t, t), bool))[None, None],
+                      scores, -1e30)
+    ctx_ref = np.einsum("nhqk,nhkd->nhqd", np_softmax(scores), vh_ref)
+    ctx_ref = ctx_ref.transpose(0, 2, 1, 3).reshape(n, t, d)
+    assert np.allclose(ctx.asnumpy(), ctx_ref, rtol=1e-4, atol=1e-5)
+    assert np.allclose(kh.asnumpy(), kh_ref) and np.allclose(vh.asnumpy(),
+                                                             vh_ref)
+
+    # _kv_cache_write: block lands at ring slot [slot, :, :T)
+    slots, max_len = 3, 8
+    kc = rng.randn(slots, h, max_len, dh).astype(np.float32)
+    vc = rng.randn(slots, h, max_len, dh).astype(np.float32)
+    kb = rng.randn(1, h, t, dh).astype(np.float32)
+    vb = rng.randn(1, h, t, dh).astype(np.float32)
+    kc2, vc2 = mx.nd._kv_cache_write(mx.nd.array(kc), mx.nd.array(vc),
+                                     mx.nd.array(kb), mx.nd.array(vb),
+                                     mx.nd.array(np.array([1.0], np.float32)))
+    kc_ref, vc_ref = kc.copy(), vc.copy()
+    kc_ref[1, :, :t] = kb[0]
+    vc_ref[1, :, :t] = vb[0]
+    assert np.allclose(kc2.asnumpy(), kc_ref)
+    assert np.allclose(vc2.asnumpy(), vc_ref)
+
+    # _cached_attention: one decode step == attention over the slot's
+    # cached prefix + the step's own K/V written at position `length`
+    b = 2
+    slot = np.array([1, 2], np.float32)
+    length = np.array([3, 5], np.float32)
+    q1 = rng.randn(b, 1, d).astype(np.float32)
+    k1 = rng.randn(b, 1, d).astype(np.float32)
+    v1 = rng.randn(b, 1, d).astype(np.float32)
+    ctx1, kc3, vc3 = mx.nd._cached_attention(
+        mx.nd.array(q1), mx.nd.array(k1), mx.nd.array(v1),
+        mx.nd.array(kc_ref), mx.nd.array(vc_ref), mx.nd.array(slot),
+        mx.nd.array(length), num_heads=h)
+    kc_up, vc_up = kc_ref.copy(), vc_ref.copy()
+    ctx1_ref = np.zeros((b, 1, d), np.float32)
+    for i in range(b):
+        s, L = int(slot[i]), int(length[i])
+        kc_up[s, :, L] = k1[i].reshape(h, dh)
+        vc_up[s, :, L] = v1[i].reshape(h, dh)
+        qi = q1[i].reshape(h, 1, dh)
+        sc = np.einsum("hqd,hkd->hqk", qi, kc_up[s]) / np.sqrt(dh)
+        sc[:, :, L + 1:] = -1e30
+        ctx1_ref[i, 0] = np.einsum(
+            "hqk,hkd->hqd", np_softmax(sc), vc_up[s]).reshape(d)
+    assert np.allclose(ctx1.asnumpy(), ctx1_ref, rtol=1e-4, atol=1e-5)
+    assert np.allclose(kc3.asnumpy(), kc_up)
+    assert np.allclose(vc3.asnumpy(), vc_up)
+
+    # _add_positional / _add_positional_at
+    pos = rng.randn(max_len, d).astype(np.float32)
+    got = mx.nd._add_positional(mx.nd.array(x), mx.nd.array(pos)).asnumpy()
+    assert np.allclose(got, x + pos[None, :t])
+    idx = np.array([2, 6], np.float32)
+    got = mx.nd._add_positional_at(mx.nd.array(q1), mx.nd.array(pos),
+                                   mx.nd.array(idx)).asnumpy()
+    assert np.allclose(got, q1 + pos[idx.astype(int)][:, None, :])
+
+    # _take_step: per-row gather of one timestep
+    tk = np.array([0, 3], np.float32)
+    got = mx.nd._take_step(mx.nd.array(x), mx.nd.array(tk)).asnumpy()
+    assert np.allclose(got, x[np.arange(n), tk.astype(int)])

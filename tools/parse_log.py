@@ -37,8 +37,12 @@ tier"), and the request-tracing + SLO columns (``trace_sampled``
 head-sampled request count, ``slo_burn`` the worst per-tenant
 error-budget burn rate, ``queue_p99``/``service_p99`` the queue-wait
 vs fill-to-resolution latency split that localizes a p99 move;
-docs/observability.md "Request tracing & SLOs").  Older logs render
-'-' in columns they predate.
+docs/observability.md "Request tracing & SLOs"), and the KV-cache
+decode columns (``tokens_s`` mean decoded tokens/s, ``active_sessions``
+live decode sessions, ``kv_slot_occupancy`` KV-ring slot fill fraction)
+when the run recorded the ``serving.decode`` namespace (docs/serving.md
+"Decode sessions & continuous batching").  Older logs render '-' in
+columns they predate.
 
 With ``--cluster`` the input is the rank-0 CLUSTER JSONL
 (``MXTPU_OBS_CLUSTER_FILE``, written by the obs aggregator —
@@ -139,6 +143,10 @@ def parse_telemetry(lines):
                        for k in list(counters) + list(gauges) + list(hist))
         has_locks = any(k.startswith("locks.")
                         for k in list(counters) + list(hist))
+        has_decode = any(k.startswith("serving.decode.")
+                         for k in list(counters) + list(gauges)
+                         + list(hist))
+        dec_step_h = hist.get("serving.decode.step_seconds", {})
         rows.append({
             "flush_seq": rec.get("flush_seq"),
             "step": rec.get("step"),
@@ -248,6 +256,21 @@ def parse_telemetry(lines):
                 if has_locks else None),
             "contended": (counters.get("locks.contended", 0)
                           if has_locks else None),
+            # KV-cache decode columns (mxnet_tpu/serving/decode.py,
+            # docs/serving.md "Decode sessions & continuous batching"):
+            # mean decoded tokens/s over the flush (cumulative tokens /
+            # cumulative step seconds), live packed-session count, and
+            # KV-ring slot occupancy — '-' for logs that predate the
+            # decode engine (no serving.decode.* namespace)
+            "tokens_s": (counters.get("serving.decode.tokens", 0)
+                         / dec_step_h["sum"]
+                         if has_decode and dec_step_h.get("sum")
+                         else (0.0 if has_decode else None)),
+            "active_sessions": (gauges.get(
+                "serving.decode.active_sessions", 0)
+                if has_decode else None),
+            "kv_slot_occupancy": (gauges.get("kv.slot_occupancy", 0.0)
+                                  if has_decode else None),
         })
     return rows
 
@@ -313,7 +336,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "replicas_healthy", "redispatches", "route_p99",
                    "trace_sampled", "slo_burn", "queue_p99", "service_p99",
                    "ckpt_secs", "ckpt_bytes", "resumes", "lock_wait_ms",
-                   "contended"]
+                   "contended", "tokens_s", "active_sessions",
+                   "kv_slot_occupancy"]
 
 
 def _print_rows(rows, cols, fmt):
