@@ -100,6 +100,16 @@ def parse_args():
                         "the row asserts the armed side saw ZERO "
                         "order-graph cycles and the overhead is <5% "
                         "(within noise)")
+    p.add_argument("--mem-ab", action="store_true",
+                   help="--serve: measure the live-buffer census "
+                        "overhead (docs/observability.md 'Memory "
+                        "observability') — the SAME load driven "
+                        "back-to-back with the census disarmed "
+                        "(MXTPU_MEM_CENSUS=0 equivalent) vs armed, 3 "
+                        "timed chunks per side (the --ab stdev "
+                        "machinery).  With --smoke the row asserts the "
+                        "armed side really booked buffers and the "
+                        "overhead is <=1% (within noise)")
     p.add_argument("--trace-sample", type=float, default=0.01,
                    help="--trace-ab: the sampled fraction of the ON "
                         "side (default 0.01)")
@@ -1629,6 +1639,8 @@ def serve(args):
     server.warmup()
     if args.trace_ab:
         return _serve_trace_ab(args, server, tenants, xs, total, telemetry)
+    if args.mem_ab:
+        return _serve_mem_ab(args, server, tenants, xs, total, telemetry)
     if args.lock_ab:
         return _serve_lock_ab(args, server, preds, max_batch, wait_ms,
                               xs, total, telemetry)
@@ -1768,6 +1780,83 @@ def _serve_trace_ab(args, server, tenants, xs, total, telemetry):
         # noise of the <=1% acceptance bar
         assert compile_misses == 0, "trace A/B window recompiled"
         assert row["sampling_decisions"] > 0, row
+        assert overhead_pct <= max(1.0, 2.0 * noise_pct), row
+    print(json.dumps(row))
+
+
+def _serve_mem_ab(args, server, tenants, xs, total, telemetry):
+    """--serve --mem-ab: the live-buffer census overhead pin.  Both
+    sides run in ONE process against the SAME warm server — side A
+    with the census disarmed (memory.set_census(False), the runtime
+    equivalent of MXTPU_MEM_CENSUS=0: book/unbook return before
+    touching the lock), side B with it armed (the default) — as 3
+    timed chunks each, so the row carries per-side stdev exactly like
+    `--ab`.  The acceptance bar (docs/observability.md "Memory
+    observability"): census cost <=1% of serving throughput, asserted
+    within noise under --smoke."""
+    import numpy as np
+
+    from mxnet_tpu.obs import memory
+
+    per_chunk = max(24, -(-total // 3))
+    miss0 = telemetry.counter_value("executor.compile_cache_misses")
+
+    def side(armed, chunks=3):
+        rates = []
+        prev = memory.set_census(armed)
+        try:
+            for _ in range(chunks):
+                elapsed, failed, driven = _drive_load(
+                    server.submit, tenants, xs, args, per_chunk)
+                assert failed == 0, "mem A/B dropped requests"
+                rates.append(driven / elapsed)
+        finally:
+            memory.set_census(prev)
+        return rates
+
+    side(False, chunks=1)  # settle: one untimed chunk after warmup
+    a_rates = side(False)  # census disarmed
+    books0 = memory.census_stats()["books"]
+    b_rates = side(True)   # census armed (the production default)
+    books = memory.census_stats()["books"] - books0
+    server.close()
+    compile_misses = (telemetry.counter_value(
+        "executor.compile_cache_misses") - miss0)
+    a, b = float(np.mean(a_rates)), float(np.mean(b_rates))
+    overhead_pct = (a - b) / a * 100.0
+    noise_pct = 100.0 * (float(np.std(a_rates))
+                         + float(np.std(b_rates))) / a
+    row = {
+        "metric": "live-buffer census overhead, %d-tenant serving load "
+                  "(%s), MXTPU_MEM_CENSUS=0 vs 1"
+                  % (len(tenants), "tiny CPU smoke" if args.smoke
+                     else "ResNet-50+152, 1 chip"),
+        "value": round(overhead_pct, 3),
+        "unit": "% img/s overhead",
+        "sink": "mem_overhead",
+        "a": {"label": "MXTPU_MEM_CENSUS=0",
+              "img_s": round(a, 2),
+              "stdev": round(float(np.std(a_rates)), 2)},
+        "b": {"label": "MXTPU_MEM_CENSUS=1",
+              "img_s": round(b, 2),
+              "stdev": round(float(np.std(b_rates)), 2)},
+        "overhead_pct": round(overhead_pct, 3),
+        "noise_pct": round(noise_pct, 3),
+        "requests_per_chunk": per_chunk,
+        # census ops during the armed side; 0 means the B side never
+        # actually booked anything (the CI pin's "really armed" check)
+        "census_books": books,
+        "live_bytes": memory.live_bytes(),
+        "peak_bytes": memory.peak()["bytes"],
+        "compile_misses_timed": compile_misses,
+        "smoke": bool(args.smoke),
+    }
+    if args.smoke:
+        # the CI pin (tests/test_bench_smoke.py): the timed windows
+        # never recompiled, the armed side really booked buffers, and
+        # the overhead is within noise of the <=1% acceptance bar
+        assert compile_misses == 0, "mem A/B window recompiled"
+        assert row["census_books"] > 0, row
         assert overhead_pct <= max(1.0, 2.0 * noise_pct), row
     print(json.dumps(row))
 

@@ -196,6 +196,14 @@ class CheckpointManager:
         t0 = time.time()
         payload = capture_state(module, epoch, batch_index, step)
         blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        # census: the D2H blob rides host RAM until the async write
+        # retires it — book here, unbook in _io's finally below
+        blob_booked = 0
+        if telemetry.enabled():
+            from ..obs import memory
+
+            blob_booked = len(blob)
+            memory.book("ckpt_blobs", blob_booked)
         if telemetry.enabled():
             telemetry.inc("ckpt.snapshots")
             telemetry.observe("ckpt.d2h_seconds", time.time() - t0)
@@ -229,7 +237,7 @@ class CheckpointManager:
         mpath = atomic.manifest_path(self.directory, step)
 
         def _io(_blob=blob, _spath=spath, _manifest=manifest, _mpath=mpath,
-                _q=handoff):
+                _q=handoff, _booked=blob_booked):
             # errors travel in-band (serve_stage convention): a deferred
             # engine error would leave the trainer blocked on the
             # handoff at the next drain forever
@@ -253,6 +261,11 @@ class CheckpointManager:
                 _q.put(None)
             except BaseException as e:  # pragma: no cover - error path
                 _q.put(e)
+            finally:
+                if _booked:
+                    from ..obs import memory
+
+                    memory.unbook("ckpt_blobs", _booked)
 
         if self.async_write:
             if self._var is None:

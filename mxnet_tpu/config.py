@@ -350,7 +350,32 @@ REGISTRY = [
            "a failure instead of an indefinite hang"),
     EnvVar("MXTPU_OBS_DIR", str, "",
            "Directory for watchdog post-mortem artifacts (empty = "
-           "current directory)"),
+           "current directory).  The memory plane's OOM artifact "
+           "(obs/memory.py, memory_postmortem.r<rank>.json) lands in "
+           "the same directory"),
+    EnvVar("MXTPU_MEM_BUDGET_MB", int, 0,
+           "Byte-budget for tenant admission (obs/memory.py, docs/"
+           "observability.md 'Memory observability'): add_tenant/"
+           "add_generative_tenant preflight their predicted footprint "
+           "(params + KV ring) against this many MB plus the live "
+           "census and refuse with the numbers instead of OOMing "
+           "mid-traffic.  0 (default) = the platform-queried device "
+           "memory (memory_stats bytes_limit), or unlimited where the "
+           "platform reports none (XLA:CPU)"),
+    EnvVar("MXTPU_MEM_CENSUS", int, 1,
+           "Live-buffer census (obs/memory.py): tag-attributed byte "
+           "accounting at the places device bytes are born and die "
+           "(NDArray payloads, KV rings, serve slots, staged blocks, "
+           "checkpoint blobs), rendered as mem.live_bytes.<tag> "
+           "gauges/counter lanes with a top-K high-watermark tracker. "
+           "0 disarms the bookkeeping (the booking guard itself stays, "
+           "bench.py --serve --mem-ab pins its cost)"),
+    EnvVar("MXTPU_MEM_PROGRAMS", int, 1,
+           "Per-program footprint accounting (obs/memory.py): compile-"
+           "cache sites compile ahead-of-time and harvest XLA's "
+           "compiled memory analysis into the ProgramFootprint table "
+           "and mem.program_bytes.<site> gauges.  0 = plain jax.jit "
+           "dispatch, no footprints (the escape hatch)"),
     EnvVar("MXTPU_OBS_PORT", int, 0,
            "TCP port of the rank-0 observability aggregator "
            "(obs/aggregate.py; host side comes from MXTPU_COORDINATOR). "

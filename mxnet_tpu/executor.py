@@ -302,6 +302,11 @@ class Executor:
         self._aux_applied = False
         self._jit_fwd = {}
         self._jit_bwd = {}
+        # every compile-cache entry this executor built via the memory
+        # plane (obs/memory.py Program) — released on predictor
+        # eviction/close so the ProgramFootprint table cannot drift
+        # upward across a long-lived serving process
+        self._mem_programs = []
         # training-dispatch telemetry: how many device round-trips the
         # training loop has issued (fused single steps, K-step blocks,
         # and materialized fwd+bwd calls each count 1) — bench.py reports
@@ -588,6 +593,34 @@ class Executor:
                 scope = self._retrace_scope = next(_RETRACE_SCOPE_SEQ)
             telemetry.note_retrace(site, signature, scope=scope)
 
+    def _mem_program(self, fn, site, signature, donate_argnums=()):
+        """Build one compile-cache entry through the memory plane
+        (obs/memory.py): an AOT-compiling wrapper that harvests XLA's
+        compiled memory analysis into the ProgramFootprint table and
+        catches RESOURCE_EXHAUSTED for the OOM postmortem.  Drop-in
+        for ``jax.jit(fn, donate_argnums=...)`` — tracked per executor
+        so eviction can release the footprints."""
+        from .obs import memory
+
+        p = memory.program(fn, site=site, key=signature,
+                           donate_argnums=donate_argnums)
+        self._mem_programs.append(p)
+        return p
+
+    def release_footprints(self, evicted=False):
+        """Remove this executor's programs from the ProgramFootprint
+        table (predict.py signature-cache eviction and Predictor.close
+        call this); `evicted=True` additionally ticks the
+        ``mem.programs_evicted`` counter — the census-drift satellite
+        of the memory plane."""
+        from . import telemetry
+
+        programs, self._mem_programs = self._mem_programs, []
+        for p in programs:
+            p.release()
+        if evicted and programs and telemetry.enabled():
+            telemetry.inc("mem.programs_evicted", len(programs))
+
     def _note_dispatch(self, kind, elapsed):
         """One training dispatch: wall latency split by dispatch shape
         (`step` = single fused fwd+bwd(+update), `block` = K-step scan)."""
@@ -650,7 +683,8 @@ class Executor:
 
     def _fwd_fn(self, is_train):
         if is_train not in self._jit_fwd:
-            self._jit_fwd[is_train] = jax.jit(self._build_fwd(is_train))
+            self._jit_fwd[is_train] = self._mem_program(
+                self._build_fwd(is_train), "executor.forward", is_train)
         return self._jit_fwd[is_train]
 
     def _next_seed(self):
@@ -749,7 +783,8 @@ class Executor:
             # (a host-side predictor may serve beside a TPU trainer)
             platform = self._first_ctx.jax_device().platform
             donate = (0,) if platform != "cpu" else ()
-            self._jit_fwd[key] = jax.jit(f, donate_argnums=donate)
+            self._jit_fwd[key] = self._mem_program(
+                f, "executor.serve", names, donate_argnums=donate)
         return self._jit_fwd[key]
 
     def serve_args(self, input_names):
@@ -913,7 +948,8 @@ class Executor:
                     new_states.append(nst)
                 return outs, aux_upd, tuple(new_params), tuple(new_states)
 
-            jitted = jax.jit(step, donate_argnums=(0, 3))
+            jitted = self._mem_program(step, "executor.fused_step", sig,
+                                       donate_argnums=(0, 3))
             self._jit_step = (jitted, sig)
         fn = self._jit_step[0]
         all_vals = self._place(self._gather_args())
@@ -928,9 +964,12 @@ class Executor:
 
         tel = telemetry.enabled()
         if tel:
-            self._note_bytes("executor.donated_bytes",
-                             sum(v.nbytes for v in diff_vals)
-                             + sum(l.nbytes for st in state_tuples for l in st))
+            donated = (sum(v.nbytes for v in diff_vals)
+                       + sum(l.nbytes for st in state_tuples for l in st))
+            self._note_bytes("executor.donated_bytes", donated)
+            # donated-buffer retirement rides the memory plane's books
+            # too: XLA recycles these the moment the step consumes them
+            self._note_bytes("mem.donated_retired_bytes", donated)
         # flight-recorder edge events (obs/recorder.py): the dispatch
         # bracket is what the stall watchdog watches, and the compile
         # bracket suppresses it across a legitimate first XLA compile
@@ -1269,7 +1308,8 @@ class Executor:
                                       out_batch=out_batch)
             if comm is not None:
                 fn = self._wrap_comm_block(fn, out_batch)
-            self._jit_block[key] = jax.jit(fn, donate_argnums=(0, 3))
+            self._jit_block[key] = self._mem_program(
+                fn, "executor.fused_block", key, donate_argnums=(0, 3))
         self._last_block_key = key
         self._last_block_streams = (tuple(stream_idx), tuple(static_idx))
         fn = self._jit_block[key]
@@ -1287,9 +1327,10 @@ class Executor:
 
         tel = telemetry.enabled()
         if tel:
-            self._note_bytes("executor.donated_bytes",
-                             sum(v.nbytes for v in diff_vals)
-                             + sum(l.nbytes for st in state_tuples for l in st))
+            donated = (sum(v.nbytes for v in diff_vals)
+                       + sum(l.nbytes for st in state_tuples for l in st))
+            self._note_bytes("executor.donated_bytes", donated)
+            self._note_bytes("mem.donated_retired_bytes", donated)
             if comm is not None:
                 # bucket accounting is host-static (shapes + the plan
                 # bucketed_psum traces): bytes_reduced counts one full
@@ -1507,7 +1548,9 @@ class Executor:
                 rng = jax.random.key(seed)
                 return core(diff_vals, nondiff_vals, aux_vals, rng, head_grads)
 
-            self._jit_bwd[key] = (jax.jit(f), diff_names, diff_idx, nondiff_idx)
+            self._jit_bwd[key] = (
+                self._mem_program(f, "executor.backward", key),
+                diff_names, diff_idx, nondiff_idx)
         fn, diff_names, diff_idx, nondiff_idx = self._jit_bwd[key]
         all_vals = self._place(self._gather_args())
         diff_vals = tuple(all_vals[i] for i in diff_idx)

@@ -405,7 +405,8 @@ class StagedBlock:
     * ``pad`` — pad rows of the FINAL step (earlier steps are full).
     """
 
-    __slots__ = ("data", "label", "label_host", "count", "pad")
+    __slots__ = ("data", "label", "label_host", "count", "pad",
+                 "_mem_booked")
 
     def __init__(self, data, label, label_host, count, pad=0):
         self.data = data
@@ -413,6 +414,29 @@ class StagedBlock:
         self.label_host = label_host
         self.count = count
         self.pad = pad
+        # live-buffer census: a staged block pins device memory from
+        # H2D until the fused dispatch consumes (donates) it — book it
+        # so "what is holding bytes right now" can name staging depth
+        self._mem_booked = 0
+        from . import telemetry
+
+        if telemetry.enabled():
+            from .obs import memory
+
+            self._mem_booked = sum(
+                int(getattr(a, "nbytes", 0) or 0)
+                for a in list(self.data) + list(self.label))
+            memory.book("staged_blocks", self._mem_booked)
+
+    def __del__(self):
+        try:
+            booked, self._mem_booked = self._mem_booked, 0
+            if booked:
+                from .obs import memory
+
+                memory.unbook("staged_blocks", booked)
+        except Exception:  # pragma: no cover — interpreter teardown
+            pass
 
 
 def stage_put(name, arr, place_fn=None):

@@ -159,9 +159,20 @@ def pending_ops():
 def reset_cache():
     """Drop the fusion cache (tests measuring compile behavior)."""
     with _CACHE_LOCK:
+        _release_footprints()
         _FUSION_CACHE.clear()
         _SEEN_KEYS.clear()
         _EAGER_KEYS.clear()
+
+
+def _release_footprints():
+    """Dropped runners must leave the ProgramFootprint table (the
+    memory plane's census-drift contract) — called under _CACHE_LOCK
+    wherever the fusion cache is cleared."""
+    for runner in _FUSION_CACHE.values():
+        release = getattr(runner, "release", None)
+        if release is not None:
+            release()
 
 
 def cache_stats():
@@ -504,7 +515,15 @@ def _make_runner(program):
     leaves, so jax.jit's own signature cache handles new input shapes
     and every scalar VALUE reuses one executable."""
     ops = [get_op(name) for name, _, _, _ in program]
-    return jax.jit(lambda vals, scalars: _interpret(program, ops, vals, scalars))
+    from .obs import memory
+
+    # through the memory plane (obs/memory.py): the fused program's
+    # compiled footprint joins the ProgramFootprint table like the
+    # executor's executables, and an allocation failure here writes
+    # the OOM postmortem before the eager downgrade replays
+    return memory.program(
+        lambda vals, scalars: _interpret(program, ops, vals, scalars),
+        site="lazy.fusion", key="lazy:%08x" % (hash(program) & 0xffffffff))
 
 
 def _run_eager(program, vals, scalars):
@@ -538,6 +557,7 @@ def _execute(program, vals, scalars):
             runner = _FUSION_CACHE.get(program)
             if runner is None:
                 if len(_FUSION_CACHE) >= _FUSION_CACHE_CAP:
+                    _release_footprints()
                     _FUSION_CACHE.clear()
                     # hit/miss telemetry must track the REAL cache: a
                     # re-trace after this clear is a miss, not a hit

@@ -80,7 +80,7 @@ class NDArray:
     # deferred-op node producing this chunk under lazy imperative
     # evaluation (lazy.py), or None once materialized/flushed.
     __slots__ = ("_data", "_ctx", "_parent", "_index", "writable",
-                 "_fresh_grad", "_var", "_lazy")
+                 "_fresh_grad", "_var", "_lazy", "_mem_booked")
 
     def __init__(self, data, ctx=None, _parent=None, _index=None):
         self._parent = _parent
@@ -89,7 +89,39 @@ class NDArray:
         self._data = data
         self._var = None
         self._lazy = None
+        self._mem_booked = 0
         self.writable = True
+        if data is not None and _parent is None:
+            self._mem_account(data)
+
+    def _mem_account(self, value):
+        """Live-buffer census (obs/memory.py, tag ``ndarray.<device>``):
+        book this chunk's payload bytes at every payload swap.  The
+        booked amount is recorded on the chunk so __del__ releases
+        exactly what was booked — the census stays balanced even when
+        telemetry toggles mid-life.  Views book nothing (the parent
+        owns the payload)."""
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return
+        from .obs import memory
+
+        n = int(getattr(value, "nbytes", 0) or 0)
+        booked = self._mem_booked
+        if n != booked:
+            memory.rebook("ndarray." + self._ctx.device_type, booked, n)
+            self._mem_booked = n
+
+    def __del__(self):
+        booked = getattr(self, "_mem_booked", 0)
+        if booked:
+            try:
+                from .obs import memory
+
+                memory.unbook("ndarray." + self._ctx.device_type, booked)
+            except Exception:
+                pass  # interpreter teardown: books are gone anyway
 
     # ------------------------------------------------------------------
     # payload access
@@ -183,6 +215,7 @@ class NDArray:
                 engine.get().wait_for_var(var, wait_reads=True)
             engine.note_access(var, True)  # SanitizerEngine contract check
             self._data = value
+            self._mem_account(value)
 
     # ------------------------------------------------------------------
     # basic properties
@@ -528,7 +561,9 @@ class NDArray:
         self._lazy = None
         self._ctx = Context(*state["ctx"])
         self._data = jnp.asarray(state["data"])
+        self._mem_booked = 0
         self.writable = True
+        self._mem_account(self._data)
 
     # convenience reductions mirroring generated methods
     def sum(self, axis=None, keepdims=False):

@@ -27,6 +27,13 @@ Three parts (docs/observability.md "Distributed observability"):
     skew and straggler attribution, and the connect handshake measures
     each rank's clock offset for trace stitching
     (``tools/obs_stitch.py``).
+  * :mod:`~mxnet_tpu.obs.memory` — the memory observability plane
+    (docs/observability.md "Memory observability"): per-program
+    footprint accounting harvested from XLA compiled-memory analysis,
+    a tag-attributed live-buffer census (``mem.live_bytes.<tag>``),
+    byte-budget admission for serving tenants
+    (``MXTPU_MEM_BUDGET_MB``), and OOM forensics that dump a
+    schema-versioned ``memory_postmortem.r<rank>.json``.
   * :mod:`~mxnet_tpu.obs.tracing` — request-scoped distributed
     tracing for the serving tier (docs/observability.md "Request
     tracing & SLOs"): head-sampled per-request trace contexts ride the
@@ -42,10 +49,11 @@ without touching user code.
 """
 from __future__ import annotations
 
+from . import memory
 from . import recorder
 from . import tracing
 
-__all__ = ["recorder", "tracing", "bootstrap"]
+__all__ = ["memory", "recorder", "tracing", "bootstrap"]
 
 _BOOTSTRAPPED = False
 

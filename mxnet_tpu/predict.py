@@ -164,7 +164,14 @@ class Predictor:
                               else "predict.bind_cache_misses")
             if exe is None:
                 while len(self._exec_cache) >= _EXEC_CACHE_CAP:
-                    self._exec_cache.pop(next(iter(self._exec_cache)))
+                    old = self._exec_cache.pop(next(iter(self._exec_cache)))
+                    # eviction is a memory event, not just a cache
+                    # event: the evicted executor's compiled programs
+                    # leave the ProgramFootprint table (obs/memory.py)
+                    # and mem.programs_evicted ticks, so the program
+                    # census cannot drift upward across a long-lived
+                    # serving process
+                    old.release_footprints(evicted=True)
                 exe = self._exec_cache[sig] = \
                     self._build_exec(dict(input_shapes))
         return exe
@@ -214,6 +221,20 @@ class Predictor:
         another mode is a NEW Predictor over the same symbol+params."""
         return self._dtype_mode
 
+    def footprint_bytes(self):
+        """Predicted resident bytes of this predictor's parameters
+        (arg + aux) — the byte-budget admission input
+        (obs/memory.py admit; docs/observability.md "Memory
+        observability").  Analytic from shapes/dtypes: callable before
+        any program has compiled."""
+        from .obs import memory
+
+        total = 0
+        for d in (self._arg_params, self._aux_params):
+            for v in (d or {}).values():
+                total += memory.nbytes_of(v)
+        return total
+
     def _check_open(self):
         if self._exec_cache is None:
             raise MXNetError("Predictor is closed (close() released its "
@@ -228,6 +249,9 @@ class Predictor:
         under the cache lock, so a caller racing close() gets the
         closed-error, never a half-torn-down predictor."""
         with self._cache_lock:
+            cached = (self._exec_cache or {}).values()
+            for exe in cached:
+                exe.release_footprints()
             self._exec = None
             self._exec_cache = None
             self._arg_params = {}

@@ -588,7 +588,11 @@ class Router:
     def health(self):
         """The fleet verdict: per-replica liveness + last health
         snapshot age, the dead list (by name — the chaos-test
-        attribution surface), and the router's own flight count."""
+        attribution surface), and the router's own flight count.
+        Each replica row surfaces its ``memory`` headroom section
+        (live/budget/headroom bytes + per-tenant KV rings) lifted out
+        of the HEALTH snapshot so placement logic does not have to dig
+        through the raw health dict."""
         now = time.monotonic()
         with self._lock:
             dead = self._book.dead()
@@ -603,6 +607,7 @@ class Router:
                     "rebucketing": rep.rebucketing,
                     "health_age_s": (None if rep.health_at is None
                                      else now - rep.health_at),
+                    "memory": (rep.health or {}).get("memory"),
                     "health": rep.health,
                 }
             return {

@@ -163,6 +163,15 @@ class GenerativeSession:
         self._programs = {}
         self._tokens_done = 0
         self._closed = False
+        # book the ring in the live-buffer census: nbytes is constant
+        # for the session's lifetime (numpy seeds become device arrays
+        # of the same shape/dtype), so book once and unbook at close()
+        self._mem_booked = 0
+        if telemetry.enabled():
+            from ..obs import memory
+
+            self._mem_booked = sum(c.nbytes for c in self._caches)
+            memory.book("kv_ring.%s" % name, self._mem_booked)
         if telemetry.enabled():
             telemetry.set_gauge(
                 "kv.ring_bytes",
@@ -438,3 +447,8 @@ class GenerativeSession:
         self._closed = True
         self.finish_all("closed")
         self._programs.clear()
+        booked, self._mem_booked = getattr(self, "_mem_booked", 0), 0
+        if booked:
+            from ..obs import memory
+
+            memory.unbook("kv_ring.%s" % self.name, booked)

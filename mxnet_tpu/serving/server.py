@@ -36,6 +36,17 @@ from .. import locks
 __all__ = ["ModelServer"]
 
 
+def _memory_section(tenants):
+    """health()'s ``memory`` key — defensive: a census problem must
+    never fail the health probe a router is steering traffic by."""
+    from ..obs import memory
+
+    try:
+        return memory.health_section(tenants)
+    except Exception:  # pragma: no cover — defensive
+        return None
+
+
 class ModelServer:
     """Continuous-batching server over N Predictor-backed tenants.
 
@@ -117,6 +128,12 @@ class ModelServer:
                     "(the share of requests that must meet the %s ms "
                     "budget), got %r" % (name, slo_ms, slo_target))
             slo = (float(slo_ms) / 1e3, target)
+        # byte-budget admission (docs/observability.md "Memory
+        # observability"): refuse with numbers BEFORE the tenant takes
+        # a queue lane or compiles anything
+        from ..obs import memory
+
+        memory.admit("tenant %r" % name, predictor.footprint_bytes())
         with self._lock:
             if self._closed:
                 raise ServerClosed("cannot add tenant %r: server is closed"
@@ -163,6 +180,23 @@ class ModelServer:
                     "tenant %r: slo_target must be a fraction in (0, 1), "
                     "got %r" % (name, slo_target))
             slo = (float(slo_ms) / 1e3, target)
+        # byte-budget admission: predict the footprint ANALYTICALLY —
+        # two parameter copies (prefill + decode predictors) plus the
+        # KV ring shape GenerativeSession will allocate — so refusal
+        # happens before any compile or ring allocation
+        from .. import config
+        from ..obs import memory
+
+        param_bytes = sum(memory.nbytes_of(v) for v in params.values())
+        slots = int(max_sessions if max_sessions is not None
+                    else config.get("MXTPU_SERVE_MAX_SESSIONS"))
+        ring_len = int(max_len if max_len is not None
+                       else config.get("MXTPU_SERVE_KV_MAX_LEN"))
+        ring_len = min(ring_len, int(model.max_len))
+        ring_bytes = ((slots + 1) * int(model.num_heads) * ring_len
+                      * int(model.d_head) * 4 * len(model.cache_names()))
+        memory.admit("generative tenant %r" % name,
+                     2 * param_bytes + ring_bytes)
         # build outside the lock — Predictor construction compiles the
         # smallest prefill/decode buckets and must not stall submits
         session = GenerativeSession(
@@ -306,7 +340,10 @@ class ModelServer:
         ``oldest_deadline_in_s`` (seconds until the most pressed queued
         request times out; None when idle — negative means requests are
         already expiring), ``dispatches`` / ``dispatch_errors`` (this
-        server's fill counts), ``tenants``, ``ladder``."""
+        server's fill counts), ``tenants``, ``ladder``, and ``memory``
+        — the live-byte census / budget headroom / per-tenant KV-ring
+        bytes section from :func:`mxnet_tpu.obs.memory.health_section`
+        (docs/observability.md "Memory observability")."""
         # the queue probe is taken WHILE holding the server lock (the
         # queue's cv already nests under it on the submit path), so a
         # concurrent add_tenant/close cannot produce a torn probe —
@@ -335,6 +372,7 @@ class ModelServer:
             "dispatch_errors": errors,
             "tenants": sorted(tenants),
             "ladder": list(self.ladder),
+            "memory": _memory_section(tenants),
         }
 
     def close(self, drain=True, timeout=None):

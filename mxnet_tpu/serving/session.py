@@ -154,7 +154,7 @@ class TenantSession:
         import jax
 
         from .. import profiler, telemetry
-        from ..obs import tracing
+        from ..obs import memory, tracing
 
         t_fill0 = time.monotonic()
         for r in reqs:
@@ -201,6 +201,16 @@ class TenantSession:
             raise err
         t_staged = time.monotonic()
         other_vals, aux_vals = exe.serve_args(self._input_names)
+        # live-buffer census (obs/memory.py, tag serve_slots): the
+        # staged request batch is resident from here until the fill's
+        # compute consumes it (donated on device backends) — book the
+        # window so the mem.live_bytes.serve_slots lane pulses with
+        # every fill; the recorded amount keeps the books balanced
+        slot_bytes = 0
+        if telemetry.enabled():
+            slot_bytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                             for a in staged)
+            memory.book("serve_slots", slot_bytes)
         from ..obs import recorder
 
         # flight-recorder bracket: a serving fill wedged in the device
@@ -224,6 +234,8 @@ class TenantSession:
                 outs = tuple(fn(staged, other_vals, aux_vals, _np.uint32(0)))
         finally:
             self._ran_buckets.add(bucket)
+            if slot_bytes:
+                memory.unbook("serve_slots", slot_bytes)
             if recorder.enabled() and rec_seq is not None:
                 if first_run:
                     recorder.record("compile", "exit", rec_seq)

@@ -163,6 +163,34 @@ def test_bench_serve_smoke_trace_overhead_within_noise():
 
 
 @pytest.mark.slow
+def test_bench_serve_smoke_mem_census_overhead_within_noise():
+    """bench.py --serve --smoke --mem-ab: the live-buffer census
+    overhead pin (docs/observability.md "Memory observability" —
+    census cost <=1% of serving throughput).  The same load runs
+    back-to-back with the census disarmed vs armed, 3 timed chunks per
+    side; bench.py asserts the bar internally under --smoke, this pin
+    keeps the harness from silently rotting."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXTPU_MEM_CENSUS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve",
+         "--smoke", "--mem-ab"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == "mem_overhead" and out["smoke"] is True
+    assert out["a"]["img_s"] > 0 and out["b"]["img_s"] > 0
+    expect = round((out["a"]["img_s"] - out["b"]["img_s"])
+                   / out["a"]["img_s"] * 100.0, 3)
+    assert abs(out["overhead_pct"] - expect) < 0.05
+    # the armed side really booked buffers (0 = census never armed),
+    # and the timed windows were compile-free
+    assert out["census_books"] > 0
+    assert out["compile_misses_timed"] == 0
+    assert out["overhead_pct"] <= max(1.0, 2.0 * out["noise_pct"])
+
+
+@pytest.mark.slow
 def test_bench_serve_replicas_smoke_scaling_row():
     """bench.py --serve --replicas 1,2 --smoke: the multi-replica tier
     row (docs/serving.md "Multi-replica tier") launches each fleet via

@@ -536,6 +536,47 @@ def test_e004_covers_the_decode_loop_shape(tmp_path):
     assert findings == [], findings
 
 
+# the live-buffer census (obs/memory.py): book/rebook sit on every
+# NDArray materialization — the same guard contract as telemetry.
+# unbook is deliberately EXEMPT: it must run whenever the matching
+# book ran, whatever the CURRENT telemetry state, or an
+# enabled->disabled flip mid-lifetime leaks census bytes forever.
+E004_MEM_UNGUARDED = """
+from .obs import memory
+
+def materialize(holder, value):
+    holder.payload = value
+    memory.book("ndarray.cpu", value.nbytes)
+    memory.rebook("ndarray.cpu", 0, value.nbytes)
+"""
+
+E004_MEM_GUARDED = """
+from . import telemetry
+from .obs import memory
+
+def materialize(holder, value):
+    holder.payload = value
+    if telemetry.enabled():
+        holder.booked = value.nbytes
+        memory.book("ndarray.cpu", holder.booked)
+
+def release(holder):
+    # the balancing half runs UNGUARDED by design (exempt from E004)
+    memory.unbook("ndarray.cpu", holder.booked)
+    holder.booked = 0
+"""
+
+
+def test_e004_covers_census_booking_but_exempts_unbook(tmp_path):
+    findings, _, _ = _lint_src(tmp_path, E004_MEM_UNGUARDED)
+    assert _ids(findings) == ["E004", "E004"], findings
+    assert "memory.book" in findings[0].message
+    assert "telemetry.enabled()" in findings[0].message
+    assert "memory.rebook" in findings[1].message
+    findings, _, _ = _lint_src(tmp_path, E004_MEM_GUARDED)
+    assert findings == [], findings
+
+
 E004_WRONG_GUARD = """
 from . import telemetry
 
@@ -1016,7 +1057,7 @@ def test_repo_gate_sweeps_the_obs_package():
     files = iter_py_files([os.path.join(ROOT, "mxnet_tpu")])
     swept = {os.path.relpath(f, ROOT) for f in files}
     for mod in ("__init__", "recorder", "watchdog", "aggregate",
-                "tracing"):
+                "tracing", "memory"):
         assert os.path.join("mxnet_tpu", "obs", "%s.py" % mod) in swept
 
 

@@ -9,10 +9,12 @@ formatting, ``time.time()`` pairs, byte-size sums) happens at the call
 site, before the callee can bail.
 
   * **E004** — a recording call (``telemetry.inc/set_gauge/observe/
-    flush``, ``profiler.record_span/record_counter``, and the obs
-    flight recorder's ``recorder.record``) that is not guarded by the
-    fast path.  Two guard shapes are recognized, the ones the codebase
-    actually uses:
+    flush``, ``profiler.record_span/record_counter``, the obs
+    flight recorder's ``recorder.record``, and the memory census's
+    ``memory.book/rebook`` — but NOT ``memory.unbook``, which must run
+    unconditionally to balance a book made while telemetry was on)
+    that is not guarded by the fast path.  Two guard shapes are
+    recognized, the ones the codebase actually uses:
 
       - an enclosing ``if`` whose test reaches ``enabled()`` /
         ``spans_active()`` — directly, or through a local bound from
@@ -37,13 +39,22 @@ __all__ = ["UnguardedTelemetryCall"]
 # (recorder = the obs flight recorder, whose record() sits on the same
 # hot dispatch paths and promises the same ~zero disabled cost;
 # tracing = the request tracer, whose record/record_outcome/flow calls
-# sit once per SERVED REQUEST — the serving tier's hottest sites)
-_MODULE_NAMES = {"telemetry", "profiler", "recorder", "tracing"}
-# the recording entry points whose CALL must be guarded
+# sit once per SERVED REQUEST — the serving tier's hottest sites;
+# memory = the live-buffer census, whose book/rebook sit on every
+# NDArray materialization)
+_MODULE_NAMES = {"telemetry", "profiler", "recorder", "tracing",
+                 "memory"}
+# the recording entry points whose CALL must be guarded.  The census's
+# ``memory.unbook`` is deliberately ABSENT: unbook must run whenever
+# the matching book ran (holders remember the booked amount and
+# release exactly it), and making it conditional on the CURRENT
+# telemetry state would leak census bytes across an enabled->disabled
+# flip mid-lifetime.  Its disabled cost is one dict-miss under a lock,
+# paid only by holders that booked while telemetry was on.
 _RECORDING_ATTRS = {"inc", "set_gauge", "observe", "observe_values",
                     "attach_value_histogram", "flush", "record_span",
                     "record_counter", "record", "record_outcome",
-                    "record_event", "flow"}
+                    "record_event", "flow", "book", "rebook"}
 # the fast-path predicates
 _GUARD_ATTRS = {"enabled", "spans_active"}
 
@@ -152,5 +163,6 @@ class UnguardedTelemetryCall:
                 % (call.func.value.id, call.func.attr,
                    {"telemetry": "telemetry.enabled()",
                     "recorder": "recorder.enabled()",
-                    "tracing": "tracing.enabled()"}.get(
+                    "tracing": "tracing.enabled()",
+                    "memory": "telemetry.enabled()"}.get(
                        call.func.value.id, "profiler.spans_active()")))

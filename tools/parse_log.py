@@ -41,8 +41,11 @@ docs/observability.md "Request tracing & SLOs"), and the KV-cache
 decode columns (``tokens_s`` mean decoded tokens/s, ``active_sessions``
 live decode sessions, ``kv_slot_occupancy`` KV-ring slot fill fraction)
 when the run recorded the ``serving.decode`` namespace (docs/serving.md
-"Decode sessions & continuous batching").  Older logs render '-' in
-columns they predate.
+"Decode sessions & continuous batching"), and the memory-census columns
+(``live_mb`` booked live bytes at flush, ``peak_mb`` the process
+high-watermark, ``mem_headroom_pct`` % headroom under the byte budget)
+when it recorded the ``mem`` namespace (docs/observability.md "Memory
+observability").  Older logs render '-' in columns they predate.
 
 With ``--cluster`` the input is the rank-0 CLUSTER JSONL
 (``MXTPU_OBS_CLUSTER_FILE``, written by the obs aggregator —
@@ -147,6 +150,8 @@ def parse_telemetry(lines):
                          for k in list(counters) + list(gauges)
                          + list(hist))
         dec_step_h = hist.get("serving.decode.step_seconds", {})
+        has_mem = any(k.startswith("mem.")
+                      for k in list(counters) + list(gauges))
         rows.append({
             "flush_seq": rec.get("flush_seq"),
             "step": rec.get("step"),
@@ -271,6 +276,18 @@ def parse_telemetry(lines):
                 if has_decode else None),
             "kv_slot_occupancy": (gauges.get("kv.slot_occupancy", 0.0)
                                   if has_decode else None),
+            # memory-census columns (mxnet_tpu/obs/memory.py,
+            # docs/observability.md "Memory observability"): live booked
+            # MB at flush, the process-lifetime peak, and % headroom
+            # under the byte budget (only present when a budget is
+            # resolvable) — '-' for logs that predate the census (no
+            # mem.* namespace)
+            "live_mb": (gauges.get("mem.live_bytes", 0) / 1e6
+                        if has_mem else None),
+            "peak_mb": (gauges.get("mem.peak_bytes", 0) / 1e6
+                        if has_mem else None),
+            "mem_headroom_pct": (gauges.get("mem.headroom_pct")
+                                 if has_mem else None),
         })
     return rows
 
@@ -337,7 +354,8 @@ _TELEMETRY_COLS = ["flush_seq", "step", "epoch", "step_p50", "step_max",
                    "trace_sampled", "slo_burn", "queue_p99", "service_p99",
                    "ckpt_secs", "ckpt_bytes", "resumes", "lock_wait_ms",
                    "contended", "tokens_s", "active_sessions",
-                   "kv_slot_occupancy"]
+                   "kv_slot_occupancy", "live_mb", "peak_mb",
+                   "mem_headroom_pct"]
 
 
 def _print_rows(rows, cols, fmt):
