@@ -33,6 +33,7 @@ the timed loop runs several steps per fence (amortizing the fixed costs)
 and is fenced by a ONE-element weight transfer.
 """
 import argparse
+import contextlib
 import json
 import os
 import time
@@ -142,6 +143,24 @@ def parse_args():
                         "emits a single JSON row with both sides, "
                         "stdev, and the delta.  With --smoke: tiny "
                         "models on CPU (tests/test_bench_smoke.py)")
+    p.add_argument("--knobs-a", type=str, default="",
+                   help="--ab knobs: side-A knob vector 'K=V,K=V' of "
+                        "registered tunables (empty = registered "
+                        "defaults); each entry is validated against the "
+                        "config tunable annotation")
+    p.add_argument("--knobs-b", type=str, default="",
+                   help="--ab knobs: side-B knob vector (the candidate)")
+    p.add_argument("--workload", choices=("train", "serve"),
+                   default="train",
+                   help="--ab knobs: which workload body the knob "
+                        "vectors drive — the K-step fused training path "
+                        "or the ModelServer closed-loop path")
+    p.add_argument("--comm-ab", action="store_true",
+                   help="--spmd-procs: after the comm probe, run an "
+                        "interleaved matched A/B of the auto-derived "
+                        "comm bucket target vs the registered default "
+                        "(MXTPU_COMM_BUCKET_MB), adding a comm_auto "
+                        "section to the SPMDROW")
     p.add_argument("--spmd-procs", type=int, default=0,
                    help="multi-process SPMD row (docs/distributed.md): "
                         "relaunch this bench as N jax.distributed "
@@ -401,6 +420,32 @@ def _train_rates(mod, batch_obj, batch_size, steps):
     return rates
 
 
+@contextlib.contextmanager
+def _env_overlay(overrides):
+    """Apply one A/B side's env overrides, restore-and-reraise.
+
+    `overrides` maps name -> string value (None = unset for this side).
+    Previous values are captured for EVERY name before anything is
+    applied and restored in a finally — including when application
+    itself raises partway through a multi-knob vector, or when the side
+    body raises — so a failing side can never leak knob state into the
+    other side's measurement (pinned in tests/test_autotune.py)."""
+    prev = {name: os.environ.get(name) for name in overrides}
+    try:
+        for name, val in overrides.items():
+            if val is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = str(val)
+        yield
+    finally:
+        for name, old in prev.items():
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+
+
 def _conv_ab_side(args, smoke, env_name, flag, frozen=False):
     """One side of a conv-model A/B: build a FRESH Module (fresh jit
     caches — config flags are read at trace time) under `env_name`=flag
@@ -409,10 +454,8 @@ def _conv_ab_side(args, smoke, env_name, flag, frozen=False):
 
     import mxnet_tpu as mx
 
-    prev = os.environ.get(env_name) if env_name else None
-    if env_name:
-        os.environ[env_name] = "1" if flag else "0"
-    try:
+    overlay = {} if env_name is None else {env_name: "1" if flag else "0"}
+    with _env_overlay(overlay):
         mx.random.seed(0)
         if smoke:
             net = _tiny_bn_net(mx)
@@ -456,12 +499,6 @@ def _conv_ab_side(args, smoke, env_name, flag, frozen=False):
             label=[mx.nd.array(rng.randint(0, classes, batch)
                                .astype("float32"))])
         return _train_rates(mod, b, batch, steps)
-    finally:
-        if env_name:
-            if prev is None:
-                os.environ.pop(env_name, None)
-            else:
-                os.environ[env_name] = prev
 
 
 def _lstm_ab_side(args, smoke, packed):
@@ -825,6 +862,165 @@ def _kv_decode_ab(args):
     print(json.dumps(row))
 
 
+# ----------------------------------------------------------------------
+# --ab knobs: the GENERIC knob-vector A/B (docs/perf.md "Autotuning").
+# Any combination of registered tunable knobs (config.tunables) can be
+# matched side-A vs side-B in one process: each side applies its vector
+# via _env_overlay, builds a FRESH workload body (fresh jit caches —
+# knobs are read at trace/construction time), and measures warmup + 3
+# fenced chunks.  tools/autotune.py drives exactly this path in-process.
+# ----------------------------------------------------------------------
+
+
+def _parse_knobs(spec):
+    """'K=V,K=V' -> {name: value string}, each entry validated against
+    the registered tunable annotation (unknown names and out-of-range
+    values raise MXNetError naming the offender)."""
+    from mxnet_tpu import config as _config
+
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit("--knobs: '%s' is not K=V" % part)
+        k, v = (s.strip() for s in part.split("=", 1))
+        _config.validate_knob(k, v, where="--knobs")
+        out[k] = v
+    return out
+
+
+def _knobs_train_side(args, smoke, knobs):
+    """One knob-A/B side, train workload: fresh Module through the
+    K-step fused dispatch + staged input path — the consumer of
+    MXTPU_STEPS_PER_DISPATCH / MXTPU_STAGE_BUFFERS / comm knobs — so a
+    knob vector changes the thing actually being timed.  Returns
+    sample/s per fenced chunk (3 chunks)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    with _env_overlay(knobs):
+        from mxnet_tpu import config as _config
+
+        K = max(1, int(_config.get("MXTPU_STEPS_PER_DISPATCH")))
+        mx.random.seed(0)
+        rng = np.random.RandomState(0)
+        if smoke:
+            batch, shape, classes = 32, (64,), 8
+            steps = max(12, args.steps)
+            net = mx.sym.Variable("data")
+            net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+            net = mx.sym.Activation(net, act_type="relu")
+            net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+            net = mx.sym.SoftmaxOutput(net, name="softmax")
+            ctx, dtype = mx.cpu(), None
+        else:
+            from mxnet_tpu.models.resnet import resnet
+
+            net = resnet(50, layout="NHWC")
+            batch, shape, classes = args.batch or 256, (224, 224, 3), 1000
+            steps = args.steps
+            ctx, dtype = mx.tpu(), "bfloat16"
+        it = _endless_iter(mx, rng, batch, shape, classes)
+        mod = mx.mod.Module(net, context=ctx, compute_dtype=dtype)
+        mod.bind(data_shapes=[("data", (batch,) + shape)],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+        exe = mod._exec_group.execs[0]
+        staged = mx.io.DeviceStagedIter(it, steps_per_dispatch=K,
+                                        place_fn=exe.place_block_input)
+        blocks_per_chunk = max(1, -(-steps // K // 3))
+        rates = []
+        try:
+            block = next(staged)  # compile + settle
+            mod.forward_backward(block)
+            mod.update()
+            _fence(mod, "fc1_weight")
+            for _ in range(3):
+                t0 = time.time()
+                n = 0
+                for _ in range(blocks_per_chunk):
+                    block = next(staged)
+                    mod.forward_backward(block)
+                    mod.update()
+                    n += block.count
+                _fence(mod, "fc1_weight")
+                rates.append(batch * n / (time.time() - t0))
+        finally:
+            staged.close()
+        return rates
+
+
+def _knobs_serve_side(args, smoke, knobs):
+    """One knob-A/B side, serve workload: fresh ModelServer built with
+    every ctor default left to the env-backed config reads (so the knob
+    vector governs max_batch/wait_ms/decode window), warmed compile-
+    free, then 3 closed-loop chunks.  Returns req/s per chunk."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    with _env_overlay(knobs):
+        preds, sample, _mb, _wait, total = _serve_models(args, mx)
+        server = mx.serving.ModelServer(preds)
+        tenants = server.tenants
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(*sample).astype("float32") for _ in range(16)]
+        try:
+            server.warmup()
+            rates = []
+            per_chunk = max(len(tenants), total // 3)
+            for _ in range(3):
+                elapsed, failed, driven = _drive_load(
+                    server.submit, tenants, xs, args, per_chunk)
+                if failed:
+                    raise SystemExit(
+                        "--ab knobs serve side dropped %d requests — the "
+                        "row would mislabel an overloaded run" % failed)
+                rates.append(driven / elapsed)
+        finally:
+            server.close()
+        return rates
+
+
+def _knobs_ab(args):
+    """--ab knobs: matched A/B of two validated knob vectors over the
+    selected workload body; one JSON row with both vectors, per-side
+    stdev, and the delta."""
+    import numpy as np
+
+    side = (_knobs_serve_side if args.workload == "serve"
+            else _knobs_train_side)
+    knobs_a = _parse_knobs(args.knobs_a)
+    knobs_b = _parse_knobs(args.knobs_b)
+    a_rates = side(args, args.smoke, knobs_a)
+    b_rates = side(args, args.smoke, knobs_b)
+    a, b = float(np.mean(a_rates)), float(np.mean(b_rates))
+    unit = "req/s" if args.workload == "serve" else "sample/s"
+    print(json.dumps({
+        "metric": "A/B knobs [%s]: %s vs %s"
+                  % (args.workload,
+                     args.knobs_a or "defaults", args.knobs_b or "defaults"),
+        "sink": "knobs",
+        "workload": args.workload,
+        "unit": unit,
+        "knobs_a": knobs_a,
+        "knobs_b": knobs_b,
+        "a": {"value": round(a, 2),
+              "stdev": round(float(np.std(a_rates)), 2)},
+        "b": {"value": round(b, 2),
+              "stdev": round(float(np.std(b_rates)), 2)},
+        "delta_pct": round((b - a) / a * 100.0, 2),
+        "smoke": bool(args.smoke),
+    }))
+
+
 AB_SINKS = {
     "s2d_stem": {
         "unit": "img/s",
@@ -871,6 +1067,16 @@ AB_SINKS = {
         "desc": "bf16 vs int8 post-training-quantized inference through "
                 "the ModelServer fill path (mixed-tenant, one device)",
         "run": _int8_serve_ab,
+    },
+    # the generic knob-vector sink: --knobs-a/--knobs-b pick ANY
+    # registered tunable combination per side, --workload picks the
+    # body (train = K-step fused dispatch, serve = ModelServer closed
+    # loop) — the harness tools/autotune.py searches through
+    "knobs": {
+        "unit": "sample/s",
+        "desc": "generic registered-knob vector A/B "
+                "(--knobs-a vs --knobs-b over --workload)",
+        "run": _knobs_ab,
     },
 }
 
@@ -1215,6 +1421,8 @@ def spmd(args):
            "--steps", str(args.steps), "--ckpt-dir", ckpt_dir]
     if args.smoke:
         cmd.append("--smoke")
+    if args.comm_ab:
+        cmd.append("--comm-ab")
     if args.batch:
         cmd += ["--batch", str(args.batch)]
     if args.steps_per_dispatch:
@@ -1247,6 +1455,10 @@ def spmd_worker(args):
 
     telemetry.set_enabled(True)
     telemetry.reset()
+    if args.comm_ab:
+        # the auto-vs-default bucket A/B: the run itself trains under
+        # the derived target (set BEFORE the module binds)
+        os.environ["MXTPU_COMM_BUCKET_MB"] = "auto"
     rank = jax.process_index()
     mesh = multihost.global_mesh(hierarchical=True)
     n_dev = jax.device_count()
@@ -1256,14 +1468,26 @@ def spmd_worker(args):
     rng = np.random.RandomState(0)
 
     if args.smoke:
-        X = rng.randn(BATCH * 4, 64).astype("float32")
+        # under --comm-ab the smoke net is a chain of MEDIUM ~590KB
+        # params: bucket packing moves whole arrays, so the two probe
+        # bucket sizes only yield DIFFERENT bucket counts (the
+        # two-point model's requirement, tune.fit_comm_model) when the
+        # sweep is many packable arrays — one dominant weight packs
+        # into one bucket at every size and the derivation keeps
+        if args.comm_ab:
+            in_dim, hidden, depth = 384, 384, 6
+        else:
+            in_dim, hidden, depth = 64, 256, 1
+        X = rng.randn(BATCH * 4, in_dim).astype("float32")
         y = rng.randint(0, 8, BATCH * 4).astype("float32")
         it = mx.io.ResizeIter(mx.io.NDArrayIter(X, y, batch_size=BATCH),
                               size=1 << 30)
         net = mx.sym.Variable("data")
-        net = mx.sym.FullyConnected(net, num_hidden=256, name="fc1")
-        net = mx.sym.Activation(net, act_type="relu")
-        net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+        for i in range(depth):
+            net = mx.sym.FullyConnected(net, num_hidden=hidden,
+                                        name="fc%d" % (i + 1))
+            net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=8, name="fc_out")
         net = mx.sym.SoftmaxOutput(net, name="softmax")
         fence_arg = "fc1_weight"
     else:
@@ -1376,6 +1600,55 @@ def spmd_worker(args):
         }
     # the probe is COLLECTIVE: every rank calls it here, in step
     probe = exe.measure_comm(iters=2)
+    # auto-vs-default comm-bucket A/B (docs/perf.md "Autotuning"):
+    # INTERLEAVED chunks over one warm staged iterator — auto (the
+    # derived target), default, auto, ... — flipping only the bucket
+    # env + the comm cache per chunk, so both block variants stay
+    # jit-cached after the discarded first pair and host drift cannot
+    # masquerade as a bucket-size effect.  Every rank flips in step
+    # (same chunk schedule), so bucket plans never diverge across ranks
+    comm_decision = getattr(exe, "_comm_auto_decision", None)
+    comm_ab = None
+    if args.comm_ab:
+        from mxnet_tpu import config as _config
+
+        default_mb = float(_config.spec("MXTPU_COMM_BUCKET_MB").default)
+        auto_rates, dflt_rates = [], []
+        staged = mx.io.DeviceStagedIter(it, steps_per_dispatch=K,
+                                        place_fn=exe.place_block_input)
+        try:
+            for chunk in range(10):
+                auto_side = chunk % 2 == 0
+                os.environ["MXTPU_COMM_BUCKET_MB"] = (
+                    "auto" if auto_side else repr(default_mb))
+                exe._comm_mode_cache = "unset"
+                t0 = time.time()
+                n = 0
+                for _ in range(blocks_per_chunk):
+                    block = next(staged)
+                    mod.forward_backward(block)
+                    mod.update()
+                    n += block.count
+                _fence(mod, fence_arg)
+                if chunk >= 2:  # first pair pays both sides' compiles
+                    (auto_rates if auto_side else dflt_rates).append(
+                        BATCH * n / (time.time() - t0))
+        finally:
+            staged.close()
+            os.environ["MXTPU_COMM_BUCKET_MB"] = "auto"
+            exe._comm_mode_cache = "unset"
+        a = float(np.mean(dflt_rates))
+        b = float(np.mean(auto_rates))
+        comm_ab = {
+            "a_default": {"value": round(a, 2),
+                          "stdev": round(float(np.std(dflt_rates)), 2),
+                          "bucket_mb": default_mb},
+            "b_auto": {"value": round(b, 2),
+                       "stdev": round(float(np.std(auto_rates)), 2),
+                       "bucket_mb": round((comm_decision or {}).get(
+                           "applied_bytes", 0) / 1e6, 3)},
+            "delta_pct": round((b - a) / a * 100.0, 2),
+        }
     snap = telemetry.snapshot()
     # per-rank skew column (docs/observability.md "Distributed
     # observability"): allgather every rank's mean step seconds — a
@@ -1429,7 +1702,12 @@ def spmd_worker(args):
                 "dispatches": comm_counters.get("comm.dispatches"),
                 "gbps": round(probe["comm_gbps"], 4),
                 "overlap_frac": round(probe["overlap_frac"], 4),
+                # the MXTPU_COMM_BUCKET_MB=auto decision record, when
+                # the run derived one (measured basis included)
+                "auto": comm_decision,
             },
+            # matched interleaved auto-vs-default bucket A/B (--comm-ab)
+            "comm_ab": comm_ab,
             # matched interleaved A/B: plain chunks and ckpt-armed chunks
             # alternate over one warm iterator.  overhead_pct is the
             # DIRECTLY measured critical-path cost — host time blocked

@@ -13,6 +13,10 @@ from __future__ import annotations
 
 from . import base
 from .base import MXNetError
+# config imports FIRST among env readers: it materializes a TUNED.json
+# profile (MXTPU_TUNED_FILE) into os.environ, and modules that read env
+# vars at import time (lazy.py, telemetry.py) must see those values.
+from . import config
 from .context import Context, cpu, gpu, tpu, current_context, num_tpus, num_gpus
 from . import ops
 from . import engine
@@ -49,12 +53,12 @@ from . import router
 from . import quant
 from . import image
 from . import rtc
-from . import config
 from . import monitor
 from . import monitor as mon
 from .monitor import Monitor
 from . import profiler
 from . import telemetry
+from . import tune
 from . import module
 from . import module as mod
 from .module import Module

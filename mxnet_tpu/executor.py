@@ -1140,8 +1140,17 @@ class Executor:
             return None
         if mode in ("auto", "") and jax.process_count() <= 1:
             return None
-        bucket_bytes = max(1, int(float(
-            config.get("MXTPU_COMM_BUCKET_MB")) * 1e6))
+        raw = config.get("MXTPU_COMM_BUCKET_MB")
+        self._comm_bucket_auto = (raw == "auto")
+        if self._comm_bucket_auto:
+            # 'auto': arm with the registered default until the first
+            # comm-mode block derives the real target from a measured
+            # probe (autotune_comm_bucket) and re-arms this cache
+            bucket_bytes = getattr(self, "_comm_auto_bytes", None) or max(
+                1, int(float(
+                    config.spec("MXTPU_COMM_BUCKET_MB").default) * 1e6))
+        else:
+            bucket_bytes = max(1, int(float(raw) * 1e6))
         # ICI-first reduction order: the innermost data axis is the LAST
         # in mesh order ('data_dcn' x 'data_ici' -> reduce ici, then dcn)
         return tuple(reversed(axes)), bucket_bytes
@@ -1291,6 +1300,15 @@ class Executor:
         sig = tuple((n, tuple(l.shape for l in leaves_by_name[n]))
                     for n in diff_names)
         comm = self._comm_mode()
+        if comm is not None and getattr(self, "_comm_bucket_auto", False) \
+                and not getattr(self, "_comm_auto_done", False):
+            # MXTPU_COMM_BUCKET_MB=auto: derive the real target from a
+            # measured probe BEFORE the first block compiles, so the
+            # first program already carries the tuned bucket plan (a
+            # COLLECTIVE step — every rank reaches it at its first
+            # comm-mode block)
+            self.autotune_comm_bucket()
+            comm = self._comm_mode()
         out_batch = None
         if comm is not None:
             # resolved ONCE and shared by the body and the shard_map
@@ -1387,6 +1405,141 @@ class Executor:
             for l, v in zip(leaves_by_name[n], nst):
                 l._set_data(v)
 
+    def _time_comm_only(self, axes, bucket_bytes, iters=2):
+        """Compile and time ONE bucketed hierarchical gradient sweep at
+        an arbitrary bucket size — zeros gradients on throwaway
+        buffers, params untouched.  The shared probe under
+        measure_comm's comm-only leg and autotune_comm_bucket's
+        two-point model fit.  Returns mean seconds per sweep."""
+        import time as _time
+
+        import numpy as _np
+
+        from . import profiler
+        from .parallel.collectives import bucketed_psum, shard_map_unchecked
+        from .parallel.mesh import P, global_put
+
+        diff_names, _, _ = self._fused_static
+        n_buckets = len(self._comm_plan_bytes((tuple(axes), bucket_bytes)))
+
+        def comm_only(gs):
+            red, _ = bucketed_psum(gs, axes, bucket_bytes)
+            return red
+
+        comm_fn = jax.jit(shard_map_unchecked(
+            comm_only, mesh=self._mesh, in_specs=(P(),), out_specs=P()))
+        gz = tuple(global_put(
+            _np.zeros(self.arg_dict[nm].shape,
+                      _np.dtype(self.arg_dict[nm].dtype)),
+            self._repl_sharding) for nm in diff_names)
+        jax.block_until_ready(comm_fn(gz))  # compile
+        with profiler.span("comm_allreduce(buckets=%d)" % n_buckets,
+                           cat="comm"):
+            t0 = _time.time()
+            for _ in range(iters):
+                jax.block_until_ready(comm_fn(gz))
+            return (_time.time() - t0) / iters
+
+    def autotune_comm_bucket(self, iters=2):
+        """MXTPU_COMM_BUCKET_MB=auto: derive the bucket target at fit
+        start from a MEASURED probe (docs/perf.md "Autotuning").
+
+        Times one full gradient sweep at the armed bucket size and at a
+        quarter of it, fits the per-collective fixed cost c0 and the
+        wire rate to the two points (tune.fit_comm_model), and adopts
+        the smallest bucket whose per-sweep fixed-cost share stays
+        under 10% of wire time (tune.derive_comm_bucket; clamped
+        [1, 64] MB, no-flapping keep-threshold 25%).  On a
+        multi-process mesh the derived target is allgathered and
+        AVERAGED so every rank arms the IDENTICAL bucket plan —
+        divergent plans would desync the collective schedule — and a
+        rank whose probe did not fit the model vetoes the change
+        everywhere.  The decision and its measured basis are booked as
+        tune.* telemetry and a flight-recorder tune bracket; on a
+        change the comm cache re-arms so the NEXT block program
+        compiles with the target (prior variants stay jit-cached).
+
+        A COLLECTIVE call — fused_update_block runs it at the first
+        comm-mode block when armed, every rank in step.  Returns the
+        decision record (also kept as _comm_auto_decision)."""
+        import numpy as _np
+
+        from . import telemetry, tune
+        from .obs import recorder
+
+        self._comm_auto_done = True
+        comm = self._comm_mode()
+        if comm is None:
+            return None
+        axes, cur_bytes = comm
+        rec = recorder.enabled()
+        if rec:
+            recorder.record("tune", "enter", detail="comm_bucket(auto)")
+        try:
+            plan_cur = self._comm_plan_bytes((axes, cur_bytes))
+            probe_bytes = max(1, cur_bytes // 4)
+            plan_probe = self._comm_plan_bytes((axes, probe_bytes))
+            t_cur = self._time_comm_only(axes, cur_bytes, iters=iters)
+            t_probe = self._time_comm_only(axes, probe_bytes, iters=iters)
+            n_dev = 1
+            for a in axes:
+                n_dev *= self._mesh.shape[a]
+            sweep_bytes = sum(plan_cur)
+            algo_bytes = 2.0 * (n_dev - 1) / n_dev * sweep_bytes
+            proposal = tune.derive_comm_bucket(
+                cur_bytes=cur_bytes, t_cur=t_cur, n_cur=len(plan_cur),
+                t_probe=t_probe, n_probe=len(plan_probe),
+                algo_bytes=algo_bytes, sweep_bytes=sweep_bytes)
+            target = float(proposal["target_bytes"]) if proposal else 0.0
+            if jax.process_count() > 1:
+                # consensus: one rank's no-fit (target 0) vetoes the
+                # change for everyone; otherwise the mean target arms
+                from jax.experimental import multihost_utils
+
+                gathered = _np.asarray(multihost_utils.process_allgather(
+                    _np.float64(target))).reshape(-1)
+                target = (0.0 if (gathered <= 0).any()
+                          else float(gathered.mean()))
+            decision = {
+                "mode": "auto",
+                "prev_bytes": int(cur_bytes),
+                "applied_bytes": (int(target) if target > 0
+                                  else int(cur_bytes)),
+                "changed": bool(target > 0),
+                "probe": {
+                    "t_cur_s": t_cur, "buckets_cur": len(plan_cur),
+                    "t_probe_s": t_probe,
+                    "buckets_probe": len(plan_probe),
+                    "probe_bytes": int(probe_bytes),
+                    "sweep_bytes": int(sweep_bytes),
+                    "algo_bytes": int(algo_bytes),
+                },
+                "model": (None if proposal is None else
+                          {"c0_us": proposal["c0_s"] * 1e6,
+                           "wire_gbps": proposal["wire_bps"] / 1e9}),
+            }
+            if target > 0:
+                self._comm_auto_bytes = int(target)
+                self._comm_mode_cache = "unset"  # re-arm with the target
+            self._comm_auto_decision = decision
+            if telemetry.enabled():
+                telemetry.inc("tune.decisions")
+                telemetry.inc("tune.comm_bucket_changed"
+                              if decision["changed"]
+                              else "tune.comm_bucket_kept")
+                telemetry.set_gauge("tune.comm_bucket_bytes",
+                                    decision["applied_bytes"])
+                if proposal is not None:
+                    telemetry.set_gauge("tune.comm_c0_us",
+                                        proposal["c0_s"] * 1e6)
+                    telemetry.set_gauge("tune.comm_wire_gbps",
+                                        proposal["wire_bps"] / 1e9)
+            return decision
+        finally:
+            if rec:
+                recorder.record("tune", "exit",
+                                detail="comm_bucket(auto)")
+
     def measure_comm(self, iters=3):
         """Measure the armed bucketed collectives against the compute
         they hide under — the three-program probe (docs/distributed.md):
@@ -1414,8 +1567,7 @@ class Executor:
 
         from . import profiler, telemetry
         from .optimizer import schedule_prefix
-        from .parallel.collectives import bucketed_psum, shard_map_unchecked
-        from .parallel.mesh import P, global_put
+        from .parallel.mesh import global_put
 
         comm = self._comm_mode()
         key = getattr(self, "_last_block_key", None)
@@ -1440,23 +1592,7 @@ class Executor:
 
         with profiler.span("comm_overlap_probe", cat="comm"):
             # -- comm-only: one bucketed hierarchical sweep ------------
-            def comm_only(gs):
-                red, _ = bucketed_psum(gs, axes, bucket_bytes)
-                return red
-            comm_fn = jax.jit(shard_map_unchecked(
-                comm_only, mesh=self._mesh, in_specs=(P(),),
-                out_specs=P()))
-            gz = tuple(global_put(
-                _np.zeros(self.arg_dict[nm].shape,
-                          _np.dtype(self.arg_dict[nm].dtype)),
-                self._repl_sharding) for nm in diff_names)
-            _fence(comm_fn(gz))  # compile
-            with profiler.span("comm_allreduce(buckets=%d)" % len(plan),
-                               cat="comm"):
-                t0 = _time.time()
-                for _ in range(iters):
-                    _fence(comm_fn(gz))
-                t_comm = (_time.time() - t0) / iters
+            t_comm = self._time_comm_only(axes, bucket_bytes, iters=iters)
             # -- compute-only vs full block on throwaway inputs --------
             zeros_stream = tuple(global_put(
                 _np.zeros((k,) + tuple(self.arg_dict[an[i]].shape),

@@ -1,0 +1,76 @@
+"""Autotuning decision functions (docs/perf.md "Autotuning").
+
+Pure measured-numbers-in / proposal-out functions, the router/policy.py
+`derive_ladder` idiom generalized to executor knobs: the caller owns the
+probes (Executor._time_comm_only) and the application (cache
+invalidation, recompilation, cross-rank consensus); this module owns
+only the decision, so it unit-tests without a mesh and never flaps —
+a proposal inside the keep-threshold of the current setting is None.
+
+The comm-bucket model: one bucketed gradient all-reduce sweep costs
+
+    time(B) = n_buckets(B) * c0  +  algo_bytes / wire
+
+where c0 is the per-collective fixed cost (dispatch + latency) and
+`wire` the achieved wire rate.  Two measured sweeps at different bucket
+sizes give two equations in (c0, wire); the target bucket is then the
+smallest B whose total fixed cost stays under a declared share of the
+wire time — small enough to overlap early, big enough that fixed costs
+do not dominate.
+"""
+from __future__ import annotations
+
+__all__ = ["fit_comm_model", "derive_comm_bucket"]
+
+
+def fit_comm_model(t_a, n_a, t_b, n_b, algo_bytes):
+    """Fit (c0, wire) to two measured comm-only sweeps.
+
+    `t_a` seconds for a sweep packed into `n_a` buckets, `t_b`/`n_b`
+    the second point, `algo_bytes` the ring-algorithm bytes both moved.
+    Returns (c0_seconds, wire_bytes_per_s), or None when the points do
+    not separate a sane model: equal bucket counts, a non-positive
+    fixed cost, or a non-positive wire time — the noise regimes a CPU
+    mesh probe lands in, where deriving anything would be fiction.
+    """
+    if n_a == n_b or t_a <= 0 or t_b <= 0 or algo_bytes <= 0:
+        return None
+    c0 = (t_a - t_b) / (n_a - n_b)
+    if c0 <= 0:
+        return None
+    wire_t = t_b - n_b * c0
+    if wire_t <= 0:
+        return None
+    return c0, algo_bytes / wire_t
+
+
+def derive_comm_bucket(cur_bytes, t_cur, n_cur, t_probe, n_probe,
+                       algo_bytes, sweep_bytes, fixed_cost_share=0.10,
+                       min_mb=1.0, max_mb=64.0, keep_threshold=0.25):
+    """Propose a comm bucket target from the two-point probe, or None.
+
+    `cur_bytes` is the bucket size in force (its sweep measured as
+    t_cur/n_cur); t_probe/n_probe is the second measured point;
+    `sweep_bytes` the total gradient bytes of one sweep.  The target is
+    the smallest bucket whose total per-sweep fixed cost
+    n(B)*c0 ~ (sweep_bytes/B)*c0 stays within `fixed_cost_share` of the
+    wire time, clamped to [min_mb, max_mb] MB and to one-bucket
+    (sweep_bytes).  None = keep the current setting: the model did not
+    fit, or the proposal is within `keep_threshold` (relative) of
+    cur_bytes — the no-flapping bar derive_ladder set.
+
+    Returns {"target_bytes", "c0_s", "wire_bps"} or None.
+    """
+    model = fit_comm_model(t_cur, n_cur, t_probe, n_probe, algo_bytes)
+    if model is None:
+        return None
+    c0, wire = model
+    wire_t = algo_bytes / wire
+    target = sweep_bytes * c0 / (fixed_cost_share * wire_t)
+    lo = min_mb * 1e6
+    hi = min(max_mb * 1e6, max(float(sweep_bytes), lo))
+    target = min(max(target, lo), hi)
+    if abs(target - cur_bytes) <= keep_threshold * cur_bytes:
+        return None
+    return {"target_bytes": int(round(target)),
+            "c0_s": c0, "wire_bps": wire}

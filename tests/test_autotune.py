@@ -1,0 +1,322 @@
+"""Telemetry-driven autotuning (tools/autotune.py, docs/perf.md
+"Autotuning"): TUNED.json round-trip + schema rejection, the pinned
+env-var > tuned-profile > registered-default precedence (fresh process,
+BOTH orders, on an import-time-read knob), the --ab knob-overlay
+restore-on-failure regression, the tier-1 --smoke end-to-end run, and
+the parse_log tune.* columns."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _tuned_doc(knobs, model="m", fingerprint=None, schema=None):
+    from mxnet_tpu import config
+
+    return {"schema": schema or config.TUNED_SCHEMA,
+            "fingerprint": (fingerprint if fingerprint is not None
+                            else config.host_fingerprint()),
+            "models": {model: {"workload": "train", "knobs": knobs}}}
+
+
+# ----------------------------------------------------------------------
+# TUNED.json round-trip + schema validation (config.load_tuned_profile)
+# ----------------------------------------------------------------------
+
+def test_tuned_round_trip(tmp_path):
+    """A profile written through the tuner's writer loads back with the
+    exact knob vector (and the atomic write leaves no temp litter)."""
+    from mxnet_tpu import config
+    from mxnet_tpu.ckpt import atomic
+
+    path = str(tmp_path / "TUNED.json")
+    atomic.write_json(path, _tuned_doc(
+        {"MXTPU_STEPS_PER_DISPATCH": "4", "MXTPU_STAGE_BUFFERS": "3"}))
+    knobs, reason = config.load_tuned_profile(path, model="m")
+    assert reason is None
+    assert knobs == {"MXTPU_STEPS_PER_DISPATCH": "4",
+                     "MXTPU_STAGE_BUFFERS": "3"}
+    assert os.listdir(str(tmp_path)) == ["TUNED.json"]
+
+
+def test_tuned_rejects_unknown_knob(tmp_path):
+    from mxnet_tpu import config
+    from mxnet_tpu.base import MXNetError
+
+    path = str(tmp_path / "TUNED.json")
+    with open(path, "w") as f:
+        json.dump(_tuned_doc({"MXTPU_NOT_A_KNOB": "4"}), f)
+    with pytest.raises(MXNetError, match="MXTPU_NOT_A_KNOB"):
+        config.load_tuned_profile(path, model="m")
+
+
+def test_tuned_rejects_out_of_range_value(tmp_path):
+    from mxnet_tpu import config
+    from mxnet_tpu.base import MXNetError
+
+    path = str(tmp_path / "TUNED.json")
+    with open(path, "w") as f:
+        json.dump(_tuned_doc({"MXTPU_STEPS_PER_DISPATCH": "5"}), f)
+    with pytest.raises(MXNetError, match="choices"):
+        config.load_tuned_profile(path, model="m")
+
+
+def test_tuned_rejects_schema_version_mismatch(tmp_path):
+    from mxnet_tpu import config
+    from mxnet_tpu.base import MXNetError
+
+    path = str(tmp_path / "TUNED.json")
+    with open(path, "w") as f:
+        json.dump(_tuned_doc({"MXTPU_STAGE_BUFFERS": "3"},
+                             schema="mxtpu-tuned-v999"), f)
+    with pytest.raises(MXNetError, match="mxtpu-tuned-v1"):
+        config.load_tuned_profile(path, model="m")
+
+
+def test_tuned_validates_every_model_before_applying_any(tmp_path):
+    """Atomic adoption: a bad knob in a DIFFERENT model's entry still
+    rejects the whole file — never half-trust a corrupt profile."""
+    from mxnet_tpu import config
+    from mxnet_tpu.base import MXNetError
+
+    doc = _tuned_doc({"MXTPU_STAGE_BUFFERS": "3"}, model="good")
+    doc["models"]["bad"] = {"workload": "train",
+                            "knobs": {"MXTPU_BOGUS": "1"}}
+    path = str(tmp_path / "TUNED.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(MXNetError, match="MXTPU_BOGUS"):
+        config.load_tuned_profile(path, model="good")
+
+
+def test_tuned_fingerprint_mismatch_is_lenient(tmp_path):
+    """A profile from a different box is honest, just inapplicable:
+    ({}, reason) with the mismatched fields named — no exception."""
+    from mxnet_tpu import config
+
+    fp = dict(config.host_fingerprint())
+    fp["cpu_count"] = (fp.get("cpu_count") or 0) + 960
+    path = str(tmp_path / "TUNED.json")
+    with open(path, "w") as f:
+        json.dump(_tuned_doc({"MXTPU_STAGE_BUFFERS": "3"},
+                             fingerprint=fp), f)
+    knobs, reason = config.load_tuned_profile(path, model="m")
+    assert knobs == {}
+    assert reason is not None and "cpu_count" in reason
+
+
+def test_tuned_model_selection_miss_is_lenient(tmp_path):
+    from mxnet_tpu import config
+
+    path = str(tmp_path / "TUNED.json")
+    with open(path, "w") as f:
+        json.dump(_tuned_doc({"MXTPU_STAGE_BUFFERS": "3"}, model="m"), f)
+    knobs, reason = config.load_tuned_profile(path, model="other")
+    assert knobs == {}
+    assert reason is not None and "other" in reason
+
+
+# ----------------------------------------------------------------------
+# precedence: explicit env var > tuned profile > registered default —
+# pinned in a FRESH process on an import-time-read knob (lazy._MAX_OPS)
+# ----------------------------------------------------------------------
+
+_PRECEDENCE_PROBE = textwrap.dedent("""
+    import json
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, lazy
+    print(json.dumps({
+        "lazy_max_ops": lazy._MAX_OPS,
+        "config_get": config.get("MXTPU_LAZY_MAX_OPS"),
+        "tuned_applied": config.tuned_knobs(),
+    }))
+""")
+
+
+def _run_probe(tmp_path, extra_env):
+    tuned = str(tmp_path / "TUNED.json")
+    with open(tuned, "w") as f:
+        json.dump(_tuned_doc({"MXTPU_LAZY_MAX_OPS": "128"},
+                             model="prec"), f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_TUNED_FILE=tuned,
+               MXTPU_TUNED_MODEL="prec", **extra_env)
+    env.pop("MXTPU_LAZY_MAX_OPS", None)
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, "-c", _PRECEDENCE_PROBE], capture_output=True,
+        text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_explicit_env_var_beats_tuned_profile(tmp_path):
+    """Order A: user sets MXTPU_LAZY_MAX_OPS=32 AND points at a profile
+    tuning it to 128 — the env var wins everywhere, including the
+    import-time lazy._MAX_OPS read (config materializes first but never
+    overwrites a name already present in os.environ)."""
+    out = _run_probe(tmp_path, {"MXTPU_LAZY_MAX_OPS": "32"})
+    assert out["lazy_max_ops"] == 32
+    assert out["config_get"] == 32
+    assert "MXTPU_LAZY_MAX_OPS" not in out["tuned_applied"]
+
+
+def test_tuned_profile_beats_registered_default(tmp_path):
+    """Order B: no env var — the tuned 128 beats the registered default
+    (64), and the import-time reader sees it because config loads first
+    in mxnet_tpu/__init__.py."""
+    out = _run_probe(tmp_path, {})
+    assert out["lazy_max_ops"] == 128
+    assert out["config_get"] == 128
+    assert out["tuned_applied"] == {"MXTPU_LAZY_MAX_OPS": "128"}
+
+
+# ----------------------------------------------------------------------
+# bench._env_overlay: a failing side restores the environment (the --ab
+# per-side env leak fix) and re-raises
+# ----------------------------------------------------------------------
+
+def test_env_overlay_restores_on_failure(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("MXTPU_STEPS_PER_DISPATCH", "2")
+    monkeypatch.delenv("MXTPU_STAGE_BUFFERS", raising=False)
+    with pytest.raises(RuntimeError, match="side exploded"):
+        with bench._env_overlay({"MXTPU_STEPS_PER_DISPATCH": "8",
+                                 "MXTPU_STAGE_BUFFERS": "4"}):
+            assert os.environ["MXTPU_STEPS_PER_DISPATCH"] == "8"
+            assert os.environ["MXTPU_STAGE_BUFFERS"] == "4"
+            raise RuntimeError("side exploded")
+    # previously-set name restored, previously-absent name removed
+    assert os.environ["MXTPU_STEPS_PER_DISPATCH"] == "2"
+    assert "MXTPU_STAGE_BUFFERS" not in os.environ
+
+
+def test_knob_ab_failing_side_leaks_nothing(monkeypatch):
+    """The A/B driver level of the same guarantee: side A applies its
+    overlay and dies mid-measurement — the exception propagates and the
+    parent env is byte-identical (no half-applied knob vector for side
+    B or the next trial to inherit)."""
+    import bench
+
+    def exploding_side(args, smoke, knobs):
+        with bench._env_overlay(knobs):
+            raise RuntimeError("injected measurement failure")
+
+    monkeypatch.setattr(bench, "_knobs_train_side", exploding_side)
+    monkeypatch.delenv("MXTPU_STEPS_PER_DISPATCH", raising=False)
+    before = dict(os.environ)
+    import tools.autotune as autotune
+
+    args = autotune.parse_args(
+        ["--model", "x", "--workload", "train", "--smoke"])
+    with pytest.raises(RuntimeError, match="injected"):
+        autotune._ab(bench._knobs_train_side, args, {},
+                     {"MXTPU_STEPS_PER_DISPATCH": "8"})
+    assert dict(os.environ) == before
+
+
+def test_knobs_cli_rejects_unknown_knob():
+    import bench
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="MXTPU_NOT_A_KNOB"):
+        bench._parse_knobs("MXTPU_NOT_A_KNOB=3")
+
+
+# ----------------------------------------------------------------------
+# tools/autotune.py --smoke: the tier-1 end-to-end pin
+# ----------------------------------------------------------------------
+
+def test_autotune_smoke_end_to_end(tmp_path):
+    """One real trial through the bench train side on CPU: exits 0,
+    emits a JSONL trial row, and writes a TUNED.json that validates
+    and loads back through config.load_tuned_profile."""
+    from mxnet_tpu import config
+
+    out = str(tmp_path / "TUNED.json")
+    trial_log = str(tmp_path / "trials.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("MXTPU_TUNED_FILE", "MXTPU_TELEMETRY_FILE",
+              "MXTPU_STEPS_PER_DISPATCH", "MXTPU_STAGE_BUFFERS"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "autotune.py"),
+         "--model", "tier1-smoke", "--workload", "train", "--smoke",
+         "--trials", "1", "--steps", "6", "--out", out,
+         "--trial-log", trial_log],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["model"] == "tier1-smoke"
+    assert summary["n_trials"] == 1
+    rows = [json.loads(l) for l in open(trial_log)]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["knob"] in {s.name for s in config.tunables("train")}
+    assert row["a"]["stdev"] >= 0 and row["b"]["stdev"] >= 0
+    assert isinstance(row["accepted"], bool)
+    # the written profile round-trips through the loader (fingerprints
+    # differ between this process and the child: validate + load with
+    # the child's own recorded fingerprint)
+    doc = json.load(open(out))
+    assert doc["schema"] == config.TUNED_SCHEMA
+    knobs, reason = config.load_tuned_profile(
+        out, model="tier1-smoke", fingerprint=doc["fingerprint"])
+    assert reason is None
+    assert knobs == doc["models"]["tier1-smoke"]["knobs"]
+
+
+def test_autotune_candidate_ladders():
+    """Choice knobs enumerate their declared choices; range knobs get a
+    4-point ladder clamped to [lo, hi]; 'auto' extras are excluded
+    (the online path's value, not a searchable candidate)."""
+    from mxnet_tpu import config
+    import tools.autotune as autotune
+
+    by_name = {s.name: s for s in config.tunables()}
+    assert autotune.candidate_values(
+        by_name["MXTPU_STEPS_PER_DISPATCH"]) == ["1", "2", "4", "8"]
+    bucket = autotune.candidate_values(by_name["MXTPU_COMM_BUCKET_MB"])
+    assert "auto" not in bucket
+    t = by_name["MXTPU_COMM_BUCKET_MB"].tunable
+    assert all(t.lo <= float(v) <= t.hi for v in bucket)
+    wait = autotune.candidate_values(by_name["MXTPU_SERVE_WAIT_MS"])
+    assert len(wait) == 4
+    t = by_name["MXTPU_SERVE_WAIT_MS"].tunable
+    assert all(t.lo <= float(v) <= t.hi for v in wait)
+
+
+# ----------------------------------------------------------------------
+# parse_log --telemetry: tune.* columns
+# ----------------------------------------------------------------------
+
+def test_parse_log_tune_columns():
+    from tools.parse_log import parse_telemetry, _TELEMETRY_COLS
+
+    with_tune = json.dumps({
+        "flush_seq": 1, "step": 0,
+        "counters": {"tune.trials": 7},
+        "gauges": {"tune.tuned_knobs": 2, "tune.trial": 7,
+                   "tune.best_delta_pct": 41.5},
+        "histograms": {}})
+    pre_tune = json.dumps({
+        "flush_seq": 2, "step": 0,
+        "counters": {"executor.train_dispatches": 3},
+        "gauges": {}, "histograms": {}})
+    rows = parse_telemetry([with_tune, pre_tune])
+    assert rows[0]["tuned_knobs"] == 2
+    assert rows[0]["trial"] == 7
+    assert rows[0]["best_delta_pct"] == 41.5
+    # pre-tune logs render '-' (None), not 0
+    assert rows[1]["tuned_knobs"] is None
+    assert rows[1]["trial"] is None
+    assert rows[1]["best_delta_pct"] is None
+    for col in ("tuned_knobs", "trial", "best_delta_pct"):
+        assert col in _TELEMETRY_COLS

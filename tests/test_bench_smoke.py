@@ -415,3 +415,59 @@ def test_bench_serve_smoke_lock_overhead_and_acyclic_graph():
     assert out["order_cycles"] == 0
     assert out["compile_misses_timed"] == 0
     assert out["overhead_pct"] <= max(5.0, 2.0 * out["noise_pct"])
+
+
+@pytest.mark.slow
+def test_bench_ab_knobs_train_smoke():
+    """bench.py --ab knobs --smoke: the generic knob-vector A/B
+    (docs/perf.md "Autotuning") drives the REAL K-step fused dispatch
+    path per side under validated env overlays and emits one JSON row
+    with both vectors, per-side stdev, and the delta.  K=1 vs K=4 on
+    the fused path is the canonical pair: the same driver produces the
+    tuner's trial rows."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXTPU_STEPS_PER_DISPATCH", "MXTPU_STAGE_BUFFERS"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ab", "knobs",
+         "--smoke", "--workload", "train",
+         "--knobs-a", "MXTPU_STEPS_PER_DISPATCH=1",
+         "--knobs-b", "MXTPU_STEPS_PER_DISPATCH=4"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == "knobs" and out["workload"] == "train"
+    assert out["unit"] == "sample/s" and out["smoke"] is True
+    assert out["knobs_a"] == {"MXTPU_STEPS_PER_DISPATCH": "1"}
+    assert out["knobs_b"] == {"MXTPU_STEPS_PER_DISPATCH": "4"}
+    for side in ("a", "b"):
+        assert out[side]["value"] > 0 and out[side]["stdev"] >= 0
+    assert isinstance(out["delta_pct"], float)
+    # the overlays leaked nothing into the parent bench process's row
+    assert "MXTPU_STEPS_PER_DISPATCH" not in env
+
+
+@pytest.mark.slow
+def test_bench_ab_knobs_serve_smoke():
+    """bench.py --ab knobs --workload serve --smoke: the same generic
+    A/B over the ModelServer fill path — the serve-side knob vector
+    (batch ceiling + fill wait) governs the row."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("MXTPU_SERVE_MAX_BATCH", "MXTPU_SERVE_WAIT_MS",
+                 "MXTPU_SERVE_BUCKETS"):
+        env.pop(knob, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ab", "knobs",
+         "--smoke", "--workload", "serve",
+         "--knobs-a", "",
+         "--knobs-b", "MXTPU_SERVE_MAX_BATCH=64,MXTPU_SERVE_WAIT_MS=0.5"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sink"] == "knobs" and out["workload"] == "serve"
+    assert out["unit"] == "req/s" and out["smoke"] is True
+    assert out["knobs_a"] == {}
+    assert out["knobs_b"] == {"MXTPU_SERVE_MAX_BATCH": "64",
+                              "MXTPU_SERVE_WAIT_MS": "0.5"}
+    for side in ("a", "b"):
+        assert out[side]["value"] > 0 and out[side]["stdev"] >= 0

@@ -306,6 +306,49 @@ def test_measure_comm_preserves_optimizer_schedule(monkeypatch):
     assert opt._index_update_count == before[1]
 
 
+def test_comm_bucket_auto_derives_from_measured_probe(monkeypatch):
+    """MXTPU_COMM_BUCKET_MB=auto (docs/perf.md "Autotuning"): the first
+    fused dispatch with a comm plan runs a measured two-point comm-only
+    probe and books the decision — basis (both probe timings + bucket
+    counts), tune.* telemetry, and a comm mode consistent with whatever
+    bucket the derivation settled on.  Whether the bucket CHANGES is
+    host-dependent (a model that does not separate the two probe points
+    honestly keeps the default), so only the decision record and its
+    invariants are pinned."""
+    monkeypatch.setenv("MXTPU_COMM_BUCKETED", "1")
+    monkeypatch.setenv("MXTPU_COMM_BUCKET_MB", "auto")
+    d0 = telemetry.counter_value("tune.decisions")
+    mod, params = _tiny_fit([mx.cpu(i) for i in range(4)], 2)
+    assert all(np.all(np.isfinite(v)) for v in params.values())
+    exe = mod._exec_group.execs[0]
+    dec = getattr(exe, "_comm_auto_decision", None)
+    assert dec is not None and dec["mode"] == "auto"
+    assert isinstance(dec["changed"], bool)
+    probe = dec["probe"]
+    assert probe["t_cur_s"] > 0 and probe["t_probe_s"] > 0
+    assert probe["buckets_cur"] >= 1 and probe["buckets_probe"] >= 1
+    assert probe["sweep_bytes"] > 0 and probe["algo_bytes"] > 0
+    # the derivation ran exactly once and the adopted bucket is live:
+    # the comm plan the executor now compiles with uses applied_bytes
+    assert exe._comm_auto_done is True
+    axes, bucket_bytes = exe._comm_mode()
+    assert bucket_bytes == dec["applied_bytes"]
+    if dec["changed"]:
+        assert dec["applied_bytes"] != dec["prev_bytes"]
+        assert dec["model"] is not None
+    else:
+        assert dec["applied_bytes"] == dec["prev_bytes"]
+    assert telemetry.counter_value("tune.decisions") == d0 + 1
+    assert telemetry.gauge_value("tune.comm_bucket_bytes") == \
+        dec["applied_bytes"]
+    # explicit numeric value must NOT trigger the auto path
+    monkeypatch.setenv("MXTPU_COMM_BUCKET_MB", "0.5")
+    mod2, _ = _tiny_fit([mx.cpu(i) for i in range(4)], 2)
+    exe2 = mod2._exec_group.execs[0]
+    assert getattr(exe2, "_comm_auto_decision", None) is None
+    assert exe2._comm_mode()[1] == int(0.5e6)
+
+
 # ----------------------------------------------------------------------
 # collectives unit surface
 # ----------------------------------------------------------------------

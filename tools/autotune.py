@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Telemetry-driven knob autotuner (docs/perf.md "Autotuning").
+
+Coordinate-descent search over the REGISTERED tunable space
+(mxnet_tpu/config.py Tunable annotations — introspected, never
+hand-listed) for one named model and workload.  Every candidate is a
+matched one-process A/B against the current incumbent through the
+bench.py --ab knobs bodies (warmup + 3 fenced chunks + per-side stdev),
+a move is adopted only when it beats the incumbent by more than
+MXTPU_AUTOTUNE_NOISE_MULT x the combined per-side noise, and the search
+stops early when a full sweep over the space yields no accepted move
+(or the MXTPU_AUTOTUNE_TRIALS budget runs out).
+
+Outputs: one JSON row per trial (stdout; --trial-log appends JSONL), a
+final defaults-vs-best validation A/B, and a schema-checked TUNED.json
+(mxtpu-tuned-v1, keyed by model + host fingerprint) written atomically
+via the ckpt.atomic pattern.  `mxnet_tpu.config` loads it back via
+MXTPU_TUNED_FILE with precedence env var > tuned profile > registered
+default.
+
+    python tools/autotune.py --model smoke-fc --workload train --smoke
+    python tools/autotune.py --model resnet50 --workload serve \
+        --out TUNED.json
+"""
+import argparse
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", required=True,
+                   help="model name keying the TUNED.json entry "
+                        "(MXTPU_TUNED_MODEL selects it at load)")
+    p.add_argument("--workload", choices=("train", "serve"),
+                   default="train",
+                   help="which workload body candidates are measured "
+                        "through (bench.py knob-A/B sides); also filters "
+                        "the searched knobs to those whose Tunable "
+                        "annotation names this workload")
+    p.add_argument("--out", default="TUNED.json",
+                   help="TUNED.json path (written atomically)")
+    p.add_argument("--trial-log", default="",
+                   help="append one JSONL row per trial here")
+    p.add_argument("--trials", type=int, default=None,
+                   help="max A/B trials (default MXTPU_AUTOTUNE_TRIALS)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny CPU model end-to-end (pinned in tier-1, "
+                        "tests/test_autotune.py)")
+    p.add_argument("--steps", type=int, default=30,
+                   help="train-side timed steps per A/B side")
+    p.add_argument("--batch", type=int, default=None,
+                   help="train-side batch size override")
+    p.add_argument("--requests", type=int, default=None,
+                   help="serve-side request floor per A/B side")
+    p.add_argument("--clients", type=int, default=4,
+                   help="serve-side closed-loop clients per tenant")
+    p.add_argument("--offered-load", type=float, default=0.0,
+                   help="serve-side open-loop arrival rate (0 = closed)")
+    return p.parse_args(argv)
+
+
+def candidate_values(spec):
+    """The candidate ladder for one tunable knob: its declared choices,
+    or 4 geometrically spaced points across the declared [lo, hi] range
+    (lo, hi, and two interior points).  Special 'auto' values are the
+    online path's business, not the offline search's."""
+    t = spec.tunable
+    if t.choices is not None:
+        return [str(c) for c in t.choices]
+    lo, hi = float(t.lo), float(t.hi)
+    if lo <= 0:
+        # arithmetic ladder when the range touches zero
+        pts = [lo + (hi - lo) * f for f in (0.0, 1 / 3.0, 2 / 3.0, 1.0)]
+    else:
+        r = hi / lo
+        pts = [lo * r ** f for f in (0.0, 1 / 3.0, 2 / 3.0, 1.0)]
+    if spec.type is int:
+        return [str(int(round(v))) for v in pts]
+    return [str(round(v, 3)) for v in pts]
+
+
+def _measure(side_fn, args, knobs):
+    """One measured side: rates list -> (mean, stdev)."""
+    rates = side_fn(args, args.smoke, dict(knobs))
+    n = len(rates)
+    mean = sum(rates) / n
+    var = sum((r - mean) ** 2 for r in rates) / n
+    return mean, math.sqrt(var)
+
+
+def _ab(side_fn, args, knobs_a, knobs_b):
+    """Matched A/B of two knob vectors; returns the row dict."""
+    a, a_sd = _measure(side_fn, args, knobs_a)
+    b, b_sd = _measure(side_fn, args, knobs_b)
+    return {"a": {"value": round(a, 2), "stdev": round(a_sd, 2)},
+            "b": {"value": round(b, 2), "stdev": round(b_sd, 2)},
+            "delta_pct": round((b - a) / a * 100.0, 2)}
+
+
+def search(args):
+    """Coordinate descent over the tunable space; returns the result
+    document (best knobs + measured basis + trial rows)."""
+    import bench
+    from mxnet_tpu import config, telemetry
+
+    side_fn = (bench._knobs_serve_side if args.workload == "serve"
+               else bench._knobs_train_side)
+    space = config.tunables(args.workload)
+    if not space:
+        raise SystemExit("no registered tunables affect workload '%s'"
+                         % args.workload)
+    max_trials = (args.trials if args.trials is not None
+                  else config.get("MXTPU_AUTOTUNE_TRIALS"))
+    noise_mult = config.get("MXTPU_AUTOTUNE_NOISE_MULT")
+    best = {}
+    trials = []
+    trial_no = 0
+    improved = True
+    log_f = open(args.trial_log, "a") if args.trial_log else None
+    try:
+        while improved and trial_no < max_trials:
+            improved = False
+            for spec in space:
+                current = best.get(spec.name)
+                for cand in candidate_values(spec):
+                    if trial_no >= max_trials:
+                        break
+                    if cand == current or (
+                            current is None
+                            and config.validate_knob(spec.name, cand)
+                            == spec.default):
+                        continue  # the incumbent already IS this value
+                    candidate = dict(best)
+                    candidate[spec.name] = cand
+                    trial_no += 1
+                    row = _ab(side_fn, args, best, candidate)
+                    noise = noise_mult * math.hypot(
+                        row["a"]["stdev"], row["b"]["stdev"])
+                    accepted = (row["b"]["value"] - row["a"]["value"]
+                                > noise)
+                    row.update({"trial": trial_no, "knob": spec.name,
+                                "value": cand,
+                                "noise_floor": round(noise, 2),
+                                "accepted": accepted,
+                                "incumbent": dict(best)})
+                    trials.append(row)
+                    if accepted:
+                        best[spec.name] = cand
+                        improved = True
+                    if telemetry.enabled():
+                        telemetry.inc("tune.trials")
+                        telemetry.set_gauge("tune.trial", trial_no)
+                        telemetry.set_gauge("tune.tuned_knobs", len(best))
+                        telemetry.flush(extra={"tune_trial": row["trial"]})
+                    print(json.dumps(row))
+                    if log_f:
+                        log_f.write(json.dumps(row) + "\n")
+                        log_f.flush()
+    finally:
+        if log_f:
+            log_f.close()
+    # final validation: registered defaults vs the adopted vector, the
+    # matched row the README/BENCH_TABLE artifact quotes (win-or-lose)
+    final = _ab(side_fn, args, {}, best) if best else None
+    if telemetry.enabled():
+        telemetry.set_gauge("tune.best_delta_pct",
+                            final["delta_pct"] if final else 0.0)
+        telemetry.flush()
+    return {"knobs": best, "trials": trials, "final": final,
+            "n_trials": trial_no}
+
+
+def write_tuned(args, result):
+    """Atomically write/merge the TUNED.json profile for --model."""
+    import jax
+
+    from mxnet_tpu import config
+    from mxnet_tpu.ckpt import atomic
+
+    doc = {"schema": config.TUNED_SCHEMA,
+           "fingerprint": config.host_fingerprint(),
+           "host_info": {"device_count": jax.device_count(),
+                         "platform": jax.default_backend()},
+           "models": {}}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+            if (isinstance(prev, dict)
+                    and prev.get("schema") == config.TUNED_SCHEMA
+                    and prev.get("fingerprint")
+                    == doc["fingerprint"]):
+                doc["models"].update(prev.get("models", {}))
+        except ValueError:
+            pass  # unreadable/garbled: the atomic rewrite replaces it
+    doc["models"][args.model] = {
+        "workload": args.workload,
+        "knobs": result["knobs"],
+        "final_ab": result["final"],
+        "n_trials": result["n_trials"],
+    }
+    atomic.write_json(args.out, doc)
+    return doc
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.smoke:
+        # must win over any site TPU default BEFORE jax first imports
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    from mxnet_tpu import telemetry
+
+    telemetry.set_enabled(True)
+    result = search(args)
+    doc = write_tuned(args, result)
+    print(json.dumps({
+        "metric": "autotune %s [%s]" % (args.model, args.workload),
+        "model": args.model,
+        "workload": args.workload,
+        "knobs": result["knobs"],
+        "final_ab": result["final"],
+        "n_trials": result["n_trials"],
+        "out": args.out,
+        "fingerprint": doc["fingerprint"],
+        "smoke": bool(args.smoke),
+    }))
+
+
+if __name__ == "__main__":
+    main()
